@@ -1,0 +1,82 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+A brand-new, TPU-first framework with the capabilities of Ray (reference:
+``python/ray/__init__.py``): tasks, actors, a shared-memory object store with
+reference counting and lineage recovery, placement groups, collectives whose
+accelerator backend is XLA/ICI (not NCCL), and AI libraries on top (train,
+tune, data, serve, rllib).
+
+Design stance (see SURVEY.md §7): the programming model is Ray-shaped; the
+unit of accelerator scheduling is the TPU pod slice and the unit of numerics
+is a jitted GSPMD program.
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle, ActorMethod
+from ray_tpu.api import (
+    init,
+    shutdown,
+    is_initialized,
+    remote,
+    get,
+    put,
+    wait,
+    kill,
+    cancel,
+    get_actor,
+    method,
+    nodes,
+    cluster_resources,
+    available_resources,
+    get_runtime_context,
+    timeline,
+)
+from ray_tpu.exceptions import (
+    RayTpuError,
+    TaskError,
+    ActorError,
+    ActorDiedError,
+    ObjectLostError,
+    TaskCancelledError,
+    OutOfMemoryError,
+    GetTimeoutError,
+)
+from ray_tpu.runtime_context import RuntimeContext
+
+# Subpackages are imported lazily to keep `import ray_tpu` light; heavy
+# libraries (train/tune/data/serve/rllib) pull in jax on import.
+from ray_tpu import util  # noqa: F401
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "method",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "timeline",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "ActorMethod",
+    "RuntimeContext",
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ObjectLostError",
+    "TaskCancelledError",
+    "OutOfMemoryError",
+    "GetTimeoutError",
+]

@@ -1,0 +1,105 @@
+"""TPU accelerator detection and isolation.
+
+Equivalent of the reference's ``python/ray/_private/accelerators/tpu.py``
+(TPUAcceleratorManager :75): detect chips on this host, the pod type of the
+slice this host belongs to, the host's worker index within the slice, and
+per-task chip isolation via ``TPU_VISIBLE_CHIPS`` (:158-192). Detection is
+env-var driven (GCE/GKE metadata endpoints are not reachable in all
+environments; the same env vars the metadata would populate are honored):
+
+- ``TPU_ACCELERATOR_TYPE`` / ``ACCELERATOR_TYPE`` — e.g. ``v5litepod-64``
+- ``TPU_WORKER_ID`` — host index within the slice
+- ``TPU_CHIPS_PER_HOST_BOUNDS`` / ``TPU_CHIPS`` — chips on this host
+- ``TPU_NAME`` — pod/slice name
+
+If jax is already imported (or ``RAY_TPU_DETECT_WITH_JAX=1``), chip count
+falls back to ``jax.local_device_count()``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+NUM_TPUS_PER_HOST_DEFAULT = 4
+
+
+def tpu_chip_count() -> int:
+    raw = os.environ.get("TPU_CHIPS")
+    if raw:
+        return int(raw)
+    bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")  # e.g. "2,2,1"
+    if bounds:
+        n = 1
+        for part in bounds.split(","):
+            n *= int(part)
+        return n
+    if os.environ.get("TPU_ACCELERATOR_TYPE") or os.environ.get("ACCELERATOR_TYPE"):
+        return NUM_TPUS_PER_HOST_DEFAULT
+    jax = sys.modules.get("jax")
+    if jax is not None or os.environ.get("RAY_TPU_DETECT_WITH_JAX") == "1":
+        try:
+            import jax
+            return sum(1 for d in jax.local_devices() if d.platform == "tpu")
+        except Exception:
+            return 0
+    return 0
+
+
+def tpu_accelerator_type() -> Optional[str]:
+    return os.environ.get("TPU_ACCELERATOR_TYPE") or os.environ.get("ACCELERATOR_TYPE")
+
+
+def tpu_pod_type() -> Optional[str]:
+    """Normalized pod type, e.g. ``v5litepod-64`` -> ``v5e-64`` (reference:
+    _get_current_node_tpu_pod_type, tpu.py:199)."""
+    acc = tpu_accelerator_type()
+    if not acc:
+        return None
+    acc = acc.lower()
+    for raw, norm in (("v5litepod", "v5e"), ("v5p", "v5p"), ("v6e", "v6e"),
+                      ("v4", "v4"), ("v3", "v3"), ("v2", "v2")):
+        if acc.startswith(raw):
+            return acc.replace(raw, norm, 1)
+    return acc
+
+
+def tpu_worker_index() -> int:
+    return int(os.environ.get("TPU_WORKER_ID", "0"))
+
+
+def tpu_pod_name() -> Optional[str]:
+    """Reference: ray.util.accelerators.tpu.get_current_pod_name (:7)."""
+    return os.environ.get("TPU_NAME")
+
+
+def tpu_pod_worker_count() -> int:
+    """Total hosts in this slice (reference: get_current_pod_worker_count
+    :19): chips(pod_type) / chips_per_host."""
+    pod = tpu_pod_type()
+    if not pod:
+        return 1
+    try:
+        total_chips = int(pod.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return 1
+    per_host = max(1, tpu_chip_count() or NUM_TPUS_PER_HOST_DEFAULT)
+    return max(1, total_chips // per_host)
+
+
+def set_visible_chips(chip_ids: List[int]) -> None:
+    """Per-worker chip isolation (reference: tpu.py:158-192). Must run
+    before jax initializes in the worker process."""
+    os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in chip_ids)
+    # Bounds for a single-chip or sub-host topology.
+    n = len(chip_ids)
+    if n == 1:
+        os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+        os.environ["TPU_PROCESS_BOUNDS"] = "1,1,1"
+
+
+def gang_resource_name() -> Optional[str]:
+    """`TPU-{pod_type}-head` (reference: tpu.py:379-382)."""
+    pod = tpu_pod_type()
+    return f"TPU-{pod}-head" if pod else None

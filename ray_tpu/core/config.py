@@ -1,0 +1,134 @@
+"""Runtime configuration knobs.
+
+Equivalent of the reference's ``RAY_CONFIG`` table
+(``src/ray/common/ray_config_def.h``, 218 knobs): every knob has a typed
+default and is overridable via an environment variable
+``RAY_TPU_<NAME>`` or via the ``_system_config`` dict passed to
+``ray_tpu.init``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(_ENV_PREFIX + name.upper())
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    # --- object store (reference: plasma defaults, ray_config_def.h) ---
+    #: Objects at or below this size are passed inline in RPCs / stored in
+    #: the in-process memory store (reference: max_direct_call_object_size).
+    max_inline_object_size: int = 100 * 1024
+    #: Fraction of system memory for the per-node shared-memory store.
+    object_store_memory_fraction: float = 0.3
+    #: Absolute cap (bytes) for the object store; 0 = derive from fraction.
+    object_store_memory: int = 0
+    #: Directory for spilled objects (reference: object_spilling_config).
+    spill_dir: str = "/tmp/ray_tpu/spill"
+    #: Start spilling when the store passes this fraction of capacity.
+    object_spilling_threshold: float = 0.8
+
+    # --- scheduler (reference: hybrid_scheduling_policy.h) ---
+    #: Pack onto a node until its critical-resource utilization crosses this
+    #: threshold, then spread (reference: scheduler_spread_threshold = 0.5).
+    scheduler_spread_threshold: float = 0.5
+    #: Top-k fraction of nodes considered for random choice among best
+    #: (reference: scheduler_top_k_fraction).
+    scheduler_top_k_fraction: float = 0.2
+    scheduler_top_k_absolute: int = 1
+
+    # --- health / heartbeats (reference: gcs_health_check_manager.h) ---
+    health_check_period_ms: int = 1000
+    health_check_timeout_ms: int = 10000
+    #: Missed-heartbeat budget before a node is declared dead.
+    health_check_failure_threshold: int = 5
+
+    # --- tasks / retries ---
+    #: Default max retries for normal tasks (reference: task max_retries=3).
+    task_max_retries: int = 3
+    #: Default max restarts for actors (0 = no restart).
+    actor_max_restarts: int = 0
+    #: Lease/worker reuse idle timeout (reference: idle_worker_killing).
+    idle_worker_kill_s: float = 60.0
+    #: Max workers a node will start per CPU if unspecified.
+    workers_per_cpu: int = 1
+
+    # --- transport ---
+    #: Base directory for this session (sockets, logs, spill).
+    session_dir: str = ""
+    #: msgpack/pickle wire chunk size for large transfers.
+    transfer_chunk_bytes: int = 8 * 1024 * 1024
+    #: Timeout for control-plane RPCs (s).
+    rpc_timeout_s: float = 60.0
+
+    # --- task events / observability ---
+    task_events_report_interval_ms: int = 1000
+    task_events_max_buffer: int = 100_000
+    enable_timeline: bool = True
+
+    # --- TPU ---
+    #: Name of the countable chip resource (reference:
+    #: python/ray/_private/accelerators/tpu.py uses "TPU").
+    tpu_resource_name: str = "TPU"
+    #: Auto-create a `TPU-{pod_type}-head` resource on slice hosts
+    #: (reference: tpu.py:379-382).
+    tpu_pod_head_resource: bool = True
+
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for f in fields(self):
+            if f.name == "extra":
+                continue
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    def apply_system_config(self, system_config: Dict[str, Any]) -> None:
+        for key, value in (system_config or {}).items():
+            if hasattr(self, key):
+                setattr(self, key, value)
+            else:
+                self.extra[key] = value
+
+    def to_json(self) -> str:
+        d = {f.name: getattr(self, f.name) for f in fields(self) if f.name != "extra"}
+        d.update(self.extra)
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "Config":
+        data = json.loads(raw)
+        cfg = cls()
+        cfg.apply_system_config(data)
+        return cfg
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,1095 @@
+"""The controller: single control-plane authority for the cluster.
+
+Equivalent of the reference's GCS server (``src/ray/gcs/gcs_server/
+gcs_server.cc:138``) *plus* the scheduling half of the raylet
+(``ClusterTaskManager`` / ``LocalTaskManager``): node membership, actor
+directory, placement groups, KV store, function store, pubsub, health
+checks, task-event sink, object directory, reference-count authority, and
+task scheduling/dispatch. Collapsing GCS + raylet scheduling into one
+authority removes the gossip/spillback machinery (``ray_syncer``,
+``HandleRequestWorkerLease``) — consistent-by-construction scheduling, at
+the cost of a single broker hop per message, which a TPU-pod-scale cluster
+(tens of hosts, not thousands) tolerates.
+
+Threading model: one event-loop thread owns the ROUTER socket (mirroring the
+GCS's single asio io_context); cross-thread sends are marshaled through a
+queue + wakeup. A background thread runs health checks.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+import zmq
+
+from ray_tpu.core import protocol as P
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from ray_tpu.core.reference_counter import GlobalRefTable
+from ray_tpu.core.scheduler import ClusterResourceScheduler, NodeResources
+from ray_tpu.core.task_spec import ActorInfo, PlacementGroupSpec, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ObjectEntry:
+    object_id: ObjectID
+    owner: Optional[bytes] = None          # identity of owning process
+    inline: Optional[bytes] = None         # small-object payload
+    size: int = 0
+    locations: Set[bytes] = field(default_factory=set)   # node_id binaries
+    error: Optional[bytes] = None          # pickled exception
+    lineage_task: Optional[TaskSpec] = None
+    spillable: bool = True
+
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    state: str = "PENDING_DEPS"  # PENDING_DEPS | QUEUED | PENDING_TRANSFER | RUNNING
+    node_id: Optional[NodeID] = None
+    worker: Optional[bytes] = None
+    retries_left: int = 0
+    submitted_at: float = 0.0
+    deps_remaining: Set[bytes] = field(default_factory=set)
+    transfers_remaining: Set[bytes] = field(default_factory=set)
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    identity: bytes
+    resources: NodeResources
+    last_heartbeat: float = 0.0
+    idle_workers: Deque[bytes] = field(default_factory=collections.deque)
+    all_workers: Dict[bytes, dict] = field(default_factory=dict)  # identity -> info
+    starting_workers: int = 0
+    stats: dict = field(default_factory=dict)
+    alive: bool = True
+
+
+class Controller:
+    def __init__(self, session_dir: str, config: Config):
+        self.session_dir = session_dir
+        self.config = config
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.ROUTER)
+        self.sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        self.addr = P.socket_path(session_dir)
+        self.sock.bind(self.addr)
+        # wakeup channel for cross-thread sends
+        self._wake_recv = self.ctx.socket(zmq.PULL)
+        self._wake_recv.bind(f"inproc://ctl-wake-{id(self)}")
+        self._wake_send = self.ctx.socket(zmq.PUSH)
+        self._wake_send.connect(f"inproc://ctl-wake-{id(self)}")
+        self._send_q: Deque[Tuple[bytes, bytes, bytes]] = collections.deque()
+        self._send_lock = threading.Lock()
+
+        self.scheduler = ClusterResourceScheduler()
+        self.refs = GlobalRefTable(self._on_refcount_zero)
+
+        self.peers: Dict[bytes, dict] = {}          # identity -> {kind, node_id}
+        self.nodes: Dict[bytes, NodeInfo] = {}      # node_id binary -> NodeInfo
+        self.objects: Dict[bytes, ObjectEntry] = {}
+        self.actors: Dict[bytes, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}
+        self.actor_queues: Dict[bytes, Deque[Tuple[bytes, TaskSpec]]] = {}
+        self.actor_workers: Dict[bytes, bytes] = {}   # actor_id -> worker identity
+        self.worker_actors: Dict[bytes, bytes] = {}   # worker identity -> actor_id
+        self.kv: Dict[str, Dict[bytes, bytes]] = collections.defaultdict(dict)
+        self.functions: Dict[str, bytes] = {}
+        self.pgs: Dict[bytes, PlacementGroupSpec] = {}
+        self.pg_states: Dict[bytes, str] = {}
+        self.pending_pgs: Deque[Tuple[bytes, PlacementGroupSpec]] = collections.deque()
+        self.subs: Dict[str, Set[bytes]] = collections.defaultdict(set)
+
+        self.tasks: Dict[bytes, PendingTask] = {}    # task_id -> PendingTask
+        self.task_queue: Deque[bytes] = collections.deque()
+        self.dep_waiters: Dict[bytes, Set[bytes]] = collections.defaultdict(set)   # object -> task_ids
+        self.local_waiters: Dict[bytes, List[Tuple[bytes, bytes]]] = collections.defaultdict(list)  # object -> [(identity, rid)]
+        self.worker_running: Dict[bytes, bytes] = {}  # worker identity -> task_id
+        self.task_table: Dict[bytes, dict] = {}       # state-API rows
+        self.task_events: List[dict] = []
+        self.jobs: Dict[bytes, dict] = {}
+        self._job_counter = 0
+
+        self._shutdown = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._health_thread: Optional[threading.Thread] = None
+        self._transfers: Dict[Tuple[bytes, bytes], bool] = {}  # (object, dest_node) -> in-flight
+
+    # ------------------------------------------------------------------ run
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="controller", daemon=True)
+        self._thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="controller-health", daemon=True)
+        self._health_thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        with self._send_lock:
+            pass
+        try:
+            self._wake_send.send(b"")
+        except Exception:
+            pass
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self.sock, zmq.POLLIN)
+        poller.register(self._wake_recv, zmq.POLLIN)
+        while not self._shutdown.is_set():
+            try:
+                events = dict(poller.poll(timeout=100))
+            except zmq.ZMQError:
+                break
+            if self._wake_recv in events:
+                while True:
+                    try:
+                        self._wake_recv.recv(zmq.NOBLOCK)
+                    except zmq.ZMQError:
+                        break
+            self._drain_sends()
+            if self.sock in events:
+                for _ in range(1000):
+                    try:
+                        frames = self.sock.recv_multipart(zmq.NOBLOCK)
+                    except zmq.ZMQError:
+                        break
+                    try:
+                        self._handle(frames)
+                    except Exception:
+                        logger.exception("controller: error handling %s",
+                                         frames[1] if len(frames) > 1 else frames)
+            self._drain_sends()
+        try:
+            self.sock.close(0)
+            self._wake_recv.close(0)
+            self._wake_send.close(0)
+        except Exception:
+            pass
+
+    def _send(self, identity: bytes, mtype: bytes, payload: Any) -> None:
+        """Thread-safe send (queued onto the loop thread)."""
+        blob = P.dumps(payload)
+        if threading.current_thread() is self._thread:
+            try:
+                self.sock.send_multipart([identity, mtype, blob], zmq.NOBLOCK)
+            except zmq.ZMQError:
+                logger.warning("controller: drop %s to %s", mtype, identity.hex()[:8])
+        else:
+            with self._send_lock:
+                self._send_q.append((identity, mtype, blob))
+            try:
+                self._wake_send.send(b"", zmq.NOBLOCK)
+            except zmq.ZMQError:
+                pass
+
+    def _drain_sends(self) -> None:
+        while True:
+            with self._send_lock:
+                if not self._send_q:
+                    return
+                identity, mtype, blob = self._send_q.popleft()
+            try:
+                self.sock.send_multipart([identity, mtype, blob], zmq.NOBLOCK)
+            except zmq.ZMQError:
+                pass
+
+    def _reply(self, identity: bytes, rid: bytes, data: Any, ok: bool = True) -> None:
+        self._send(identity, P.GENERIC_REPLY if ok else P.ERROR_REPLY,
+                   {"rid": rid, "data": data})
+
+    # ------------------------------------------------------------- dispatch
+    def _handle(self, frames: List[bytes]) -> None:
+        identity, mtype, payload = frames[0], frames[1], P.loads(frames[2])
+        handler = self._HANDLERS.get(mtype)
+        if handler is None:
+            logger.warning("controller: unknown message %s", mtype)
+            return
+        handler(self, identity, payload)
+
+    # -------------------------------------------------------- registration
+    def _h_register(self, identity: bytes, m: dict) -> None:
+        kind = m["kind"]
+        self.peers[identity] = {"kind": kind, "node_id": m.get("node_id"),
+                                "pid": m.get("pid")}
+        if kind == "node":
+            node_id = NodeID(m["node_id"])
+            res = NodeResources(node_id, m["resources"], m.get("labels") or {})
+            info = NodeInfo(node_id=node_id, identity=identity, resources=res,
+                            last_heartbeat=time.monotonic())
+            self.nodes[node_id.binary()] = info
+            self.scheduler.add_node(res)
+            self._publish("node", {"event": "added", "node_id": m["node_id"],
+                                   "resources": m["resources"]})
+        elif kind == "worker":
+            nid = m["node_id"]
+            node = self.nodes.get(nid)
+            if node is not None:
+                node.all_workers[identity] = {"pid": m.get("pid"),
+                                              "worker_id": m.get("id")}
+                node.starting_workers = max(0, node.starting_workers - 1)
+                node.idle_workers.append(identity)
+                self._drain_waiting_tasks(node)
+        elif kind == "driver":
+            self._job_counter += 1
+            job_id = JobID.from_int(self._job_counter)
+            self.jobs[job_id.binary()] = {
+                "job_id": job_id.hex(), "pid": m.get("pid"),
+                "start_time": time.time(), "status": "RUNNING"}
+            self.peers[identity]["job_id"] = job_id.binary()
+            self._send(identity, P.REGISTER_REPLY, {
+                "job_id": job_id.binary(),
+                "head_node_id": next(iter(self.nodes), b""),
+                "session_dir": self.session_dir,
+                "config": self.config.to_json(),
+            })
+            self._maybe_schedule()
+            return
+        self._send(identity, P.REGISTER_REPLY, {"ok": True,
+                                                "config": self.config.to_json()})
+        self._maybe_schedule()
+
+    # ------------------------------------------------------------- objects
+    def _entry(self, object_id_b: bytes) -> ObjectEntry:
+        e = self.objects.get(object_id_b)
+        if e is None:
+            e = ObjectEntry(ObjectID(object_id_b))
+            self.objects[object_id_b] = e
+        return e
+
+    def _h_put_object(self, identity: bytes, m: dict) -> None:
+        e = self._entry(m["object_id"])
+        e.owner = e.owner or identity
+        if m.get("inline") is not None:
+            e.inline = m["inline"]
+            e.size = len(e.inline)
+        if m.get("node_id"):
+            e.locations.add(m["node_id"])
+            e.size = m.get("size", e.size)
+        if m.get("error") is not None:
+            e.error = m["error"]
+        self._object_created(m["object_id"])
+        if m.get("rid"):
+            self._reply(identity, m["rid"], {"ok": True})
+
+    def _object_created(self, object_id_b: bytes) -> None:
+        """Wake tasks waiting on this object + local-waiters now satisfiable."""
+        e = self.objects.get(object_id_b)
+        for task_id in list(self.dep_waiters.pop(object_id_b, ())):
+            t = self.tasks.get(task_id)
+            if t is None:
+                continue
+            t.deps_remaining.discard(object_id_b)
+            if t.state == "PENDING_DEPS" and not t.deps_remaining:
+                t.state = "QUEUED"
+                self.task_queue.append(task_id)
+            elif t.state == "PENDING_TRANSFER":
+                t.transfers_remaining.discard(object_id_b)
+                if not t.transfers_remaining:
+                    self._dispatch(task_id)
+        waiters = self.local_waiters.pop(object_id_b, [])
+        for identity, rid in waiters:
+            self._answer_location(identity, rid, object_id_b)
+        self._maybe_schedule()
+
+    def _h_get_location(self, identity: bytes, m: dict) -> None:
+        object_id_b = m["object_id"]
+        e = self.objects.get(object_id_b)
+        if e is not None and (e.inline is not None or e.error is not None or e.locations):
+            self._answer_location(identity, m["rid"], object_id_b,
+                                  want_node=m.get("want_node"))
+        else:
+            # not created yet (or lost) — try lineage reconstruction, else wait
+            if e is not None and e.lineage_task is not None and not e.locations \
+                    and e.inline is None and e.error is None:
+                self._reconstruct(e)
+            self.local_waiters[object_id_b].append((identity, m["rid"]))
+
+    def _answer_location(self, identity: bytes, rid: bytes, object_id_b: bytes,
+                         want_node: Optional[bytes] = None) -> None:
+        e = self.objects[object_id_b]
+        if e.error is not None:
+            self._reply(identity, rid, {"error": e.error})
+            return
+        if e.inline is not None:
+            self._reply(identity, rid, {"inline": e.inline})
+            return
+        peer = self.peers.get(identity, {})
+        want_node = want_node or peer.get("node_id")
+        if want_node and want_node not in e.locations and e.locations:
+            self._start_transfer(object_id_b, want_node)
+            self.local_waiters[object_id_b].append((identity, rid))
+            return
+        if not e.locations:
+            if e.lineage_task is not None:
+                self._reconstruct(e)
+                self.local_waiters[object_id_b].append((identity, rid))
+                return
+            from ray_tpu.exceptions import ObjectLostError
+            self._reply(identity, rid,
+                        {"error": P.dumps(ObjectLostError(e.object_id))})
+            return
+        self._reply(identity, rid, {"node_id": next(iter(e.locations)),
+                                    "size": e.size})
+
+    def _start_transfer(self, object_id_b: bytes, dest_node: bytes) -> None:
+        """Chunked object copy between node stores (equivalent of
+        ObjectManager::Push, object_manager.h:206; routed via the broker)."""
+        key = (object_id_b, dest_node)
+        if self._transfers.get(key):
+            return
+        e = self.objects.get(object_id_b)
+        if e is None or not e.locations:
+            return
+        src = next(iter(e.locations))
+        src_node = self.nodes.get(src)
+        dest = self.nodes.get(dest_node)
+        if src_node is None or dest is None:
+            return
+        self._transfers[key] = True
+        self._send(src_node.identity, P.PULL_OBJECT, {
+            "object_id": object_id_b, "dest_node": dest_node,
+            "dest_identity": dest.identity})
+
+    def _h_push_object(self, identity: bytes, m: dict) -> None:
+        """Relay a push chunk from source node to destination node."""
+        dest = self.nodes.get(m["dest_node"])
+        if dest is not None:
+            self._send(dest.identity, P.PUSH_OBJECT, m)
+
+    def _h_ref_deltas(self, identity: bytes, m: dict) -> None:
+        self.refs.apply_deltas(m["deltas"])
+
+    def _on_refcount_zero(self, object_id: ObjectID) -> None:
+        b = object_id.binary()
+        e = self.objects.pop(b, None)
+        if e is None:
+            return
+        for node_b in e.locations:
+            node = self.nodes.get(node_b)
+            if node is not None:
+                self._send(node.identity, P.FREE_OBJECT, {"object_id": b})
+        self.dep_waiters.pop(b, None)
+
+    # --------------------------------------------------------------- tasks
+    def _h_submit_task(self, identity: bytes, m: dict) -> None:
+        spec: TaskSpec = m["spec"]
+        if spec.is_actor_task:
+            self._submit_actor_task(identity, spec)
+            return
+        t = PendingTask(spec=spec, retries_left=spec.max_retries,
+                        submitted_at=time.monotonic())
+        tid = spec.task_id.binary()
+        self.tasks[tid] = t
+        self.task_table[tid] = {
+            "task_id": spec.task_id.hex(), "name": spec.name or str(spec.function),
+            "state": "PENDING_ARGS_AVAIL", "type": "ACTOR_CREATION_TASK"
+            if spec.is_actor_creation else "NORMAL_TASK",
+            "submitted_at": time.time(),
+        }
+        # phase 1: wait for all arg objects to exist somewhere
+        for _, oid in spec.arg_refs:
+            b = oid.binary()
+            e = self.objects.get(b)
+            if e is None or (e.inline is None and e.error is None and not e.locations):
+                t.deps_remaining.add(b)
+                self.dep_waiters[b].add(tid)
+                if e is not None and e.lineage_task is not None:
+                    self._reconstruct(e)
+        if not t.deps_remaining:
+            t.state = "QUEUED"
+            self.task_queue.append(tid)
+            self._maybe_schedule()
+
+    @staticmethod
+    def _sched_res(spec: TaskSpec) -> Dict[str, float]:
+        """Placement-group tasks consume pre-reserved bundle resources, not
+        fresh node capacity (reference: bundle resources are renamed
+        `CPU_group_<pgid>` instances; here the reservation itself is the
+        accounting)."""
+        if spec.scheduling_strategy.kind == "PLACEMENT_GROUP":
+            return {}
+        return spec.resources
+
+    def _maybe_schedule(self) -> None:
+        """Drain the resource queue (reference:
+        ClusterTaskManager::ScheduleAndDispatchTasks)."""
+        if not self.task_queue:
+            self._maybe_place_pgs()
+            return
+        requeue: List[bytes] = []
+        while self.task_queue:
+            tid = self.task_queue.popleft()
+            t = self.tasks.get(tid)
+            if t is None:
+                continue
+            node_id = self.scheduler.pick_node(
+                self._sched_res(t.spec), t.spec.scheduling_strategy)
+            if node_id is None:
+                requeue.append(tid)
+                continue
+            t.node_id = node_id
+            self.task_table[tid]["state"] = "PENDING_NODE_ASSIGNMENT"
+            # phase 2: ensure deps local to the chosen node
+            node_b = node_id.binary()
+            for _, oid in t.spec.arg_refs:
+                b = oid.binary()
+                e = self.objects.get(b)
+                if e is None or e.inline is not None or e.error is not None:
+                    continue
+                if node_b not in e.locations:
+                    t.transfers_remaining.add(b)
+                    self.dep_waiters[b].add(tid)
+                    self._start_transfer(b, node_b)
+            if t.transfers_remaining:
+                t.state = "PENDING_TRANSFER"
+            else:
+                self._dispatch(tid)
+        self.task_queue.extend(requeue)
+        self._maybe_place_pgs()
+
+    def _dispatch(self, tid: bytes) -> None:
+        t = self.tasks.get(tid)
+        if t is None or t.node_id is None:
+            return
+        node = self.nodes.get(t.node_id.binary())
+        if node is None or not node.alive:
+            self._handle_task_failure(tid, "node died before dispatch")
+            return
+        if not node.idle_workers:
+            # ask the node to start a worker; re-dispatch when it registers
+            if node.starting_workers < 1 + len(node.all_workers):
+                node.starting_workers += 1
+                self._send(node.identity, P.TASK_ASSIGN, {"start_worker": True})
+            t.state = "QUEUED_WORKER"
+            self._waiting_for_worker(node, tid)
+            return
+        worker = node.idle_workers.popleft()
+        self._dispatch_to_worker(tid, node, worker)
+
+    def _waiting_for_worker(self, node: NodeInfo, tid: bytes) -> None:
+        node.stats.setdefault("wait_worker", collections.deque()).append(tid)
+
+    def _drain_waiting_tasks(self, node: NodeInfo) -> None:
+        waiting = node.stats.get("wait_worker")
+        while waiting and node.idle_workers:
+            tid = waiting.popleft()
+            if tid in self.tasks:
+                worker = node.idle_workers.popleft()
+                self._dispatch_to_worker(tid, node, worker)
+
+    def _dispatch_to_worker(self, tid: bytes, node: NodeInfo, worker: bytes) -> None:
+        t = self.tasks[tid]
+        t.worker = worker
+        t.state = "RUNNING"
+        self.worker_running[worker] = tid
+        self.task_table[tid].update(state="RUNNING", node=t.node_id.hex(),
+                                    started_at=time.time())
+        inline_args = {}
+        errors = {}
+        for _, oid in t.spec.arg_refs:
+            e = self.objects.get(oid.binary())
+            if e is None:
+                continue
+            if e.error is not None:
+                errors[oid.binary()] = e.error
+            elif e.inline is not None:
+                inline_args[oid.binary()] = e.inline
+        self._send(worker, P.TASK_DISPATCH, {
+            "spec": t.spec, "inline_args": inline_args, "arg_errors": errors})
+        if t.spec.is_actor_creation:
+            aid = t.spec.actor_id.binary()
+            info = self.actors.get(aid)
+            if info is not None:
+                info.state = "STARTING"
+                info.node_id = t.node_id
+            self.actor_workers[aid] = worker
+            self.worker_actors[worker] = aid
+
+    def _h_task_done(self, identity: bytes, m: dict) -> None:
+        tid = m["task_id"]
+        t = self.tasks.pop(tid, None)
+        self.worker_running.pop(identity, None)
+        row = self.task_table.get(tid)
+        if row is not None:
+            row["state"] = "FAILED" if m.get("error") else "FINISHED"
+            row["finished_at"] = time.time()
+        is_actor_task = False
+        spec = t.spec if t else m.get("spec")
+        if t is not None:
+            is_actor_creation = t.spec.is_actor_creation
+        else:
+            is_actor_creation = False
+        actor_id_b = self.worker_actors.get(identity)
+        if spec is not None and spec.is_actor_task:
+            is_actor_task = True
+
+        # retry path (reference: TaskManager::RetryTaskIfPossible)
+        if m.get("error") is not None and t is not None and t.retries_left > 0 \
+                and m.get("retriable", False):
+            t.retries_left -= 1
+            if t.node_id is not None:
+                self.scheduler.release(t.node_id, self._sched_res(t.spec))
+                t.node_id = None
+            t.state = "QUEUED"
+            t.worker = None
+            t.transfers_remaining.clear()
+            self.tasks[tid] = t
+            if not (is_actor_creation or actor_id_b):
+                self._return_worker(identity)
+            self.task_queue.append(tid)
+            self._maybe_schedule()
+            return
+
+        # record results
+        owner = (t.spec.owner.binary() if t and t.spec.owner else m.get("owner"))
+        results_meta = []
+        for r in m.get("results", []):
+            e = self._entry(r["object_id"])
+            e.owner = m.get("owner_identity", identity)
+            e.size = r.get("size", 0)
+            if r.get("inline") is not None:
+                e.inline = r["inline"]
+            if r.get("node_id"):
+                e.locations.add(r["node_id"])
+            if m.get("error") is not None:
+                e.error = m["error"]
+            if t is not None and not t.spec.is_actor_creation:
+                e.lineage_task = t.spec  # lineage for reconstruction
+            results_meta.append({"object_id": r["object_id"],
+                                 "inline": r.get("inline"),
+                                 "node_id": r.get("node_id"),
+                                 "size": r.get("size", 0),
+                                 "error": m.get("error")})
+        # resource release + worker return (actors hold their resources for
+        # life; failed creations are released in _on_actor_created)
+        if t is not None and t.node_id is not None and not is_actor_task \
+                and not is_actor_creation:
+            self.scheduler.release(t.node_id, self._sched_res(t.spec))
+        if not is_actor_creation and actor_id_b is None:
+            self._return_worker(identity)
+
+        # actor creation completion
+        if is_actor_creation and t is not None:
+            self._on_actor_created(t, identity, error=m.get("error"))
+
+        # notify the owner so its memory store resolves the future
+        owner_identity = self._find_owner_identity(t, m, identity)
+        if owner_identity is not None:
+            self._send(owner_identity, P.TASK_RESULT, {
+                "task_id": tid, "results": results_meta, "error": m.get("error")})
+        for r in m.get("results", []):
+            self._object_created(r["object_id"])
+        self._maybe_schedule()
+
+    def _find_owner_identity(self, t: Optional[PendingTask], m: dict,
+                             default: bytes) -> Optional[bytes]:
+        owner_wid = None
+        if t is not None and t.spec.owner is not None:
+            owner_wid = t.spec.owner.binary()
+        elif m.get("owner"):
+            owner_wid = m["owner"]
+        if owner_wid is None:
+            return None
+        for identity, info in self.peers.items():
+            if info.get("id") == owner_wid or identity == owner_wid:
+                return identity
+        return owner_wid  # identities ARE worker ids in this design
+
+    def _return_worker(self, identity: bytes) -> None:
+        info = self.peers.get(identity)
+        if not info:
+            return
+        node = self.nodes.get(info.get("node_id") or b"")
+        if node is None or identity not in node.all_workers:
+            return
+        waiting = node.stats.get("wait_worker")
+        if waiting:
+            tid = waiting.popleft()
+            if tid in self.tasks:
+                self._dispatch_to_worker(tid, node, identity)
+                return
+        node.idle_workers.append(identity)
+
+    def _handle_task_failure(self, tid: bytes, reason: str,
+                             retriable: bool = True) -> None:
+        t = self.tasks.get(tid)
+        if t is None:
+            return
+        if t.node_id is not None:
+            self.scheduler.release(t.node_id, self._sched_res(t.spec))
+        if retriable and t.retries_left > 0:
+            t.retries_left -= 1
+            t.state = "QUEUED"
+            t.worker = None
+            t.node_id = None
+            t.transfers_remaining.clear()
+            self.task_queue.append(tid)
+            self._maybe_schedule()
+            return
+        self.tasks.pop(tid, None)
+        from ray_tpu.exceptions import TaskError
+        err = P.dumps(TaskError(t.spec.name or str(t.spec.function), reason))
+        results_meta = []
+        for oid in t.spec.return_ids():
+            e = self._entry(oid.binary())
+            e.error = err
+            results_meta.append({"object_id": oid.binary(), "error": err})
+            self._object_created(oid.binary())
+        owner_identity = self._find_owner_identity(t, {}, b"")
+        if owner_identity:
+            self._send(owner_identity, P.TASK_RESULT, {
+                "task_id": tid, "results": results_meta, "error": err})
+        row = self.task_table.get(tid)
+        if row is not None:
+            row["state"] = "FAILED"
+
+    def _reconstruct(self, e: ObjectEntry) -> None:
+        """Lineage reconstruction: resubmit the creating task (reference:
+        ObjectRecoveryManager::RecoverObject + TaskManager::ResubmitTask)."""
+        spec = e.lineage_task
+        if spec is None:
+            return
+        tid = spec.task_id.binary()
+        if tid in self.tasks:
+            return  # already being recomputed
+        logger.info("reconstructing object %s via task %s",
+                    e.object_id.hex()[:12], spec.task_id.hex()[:12])
+        e.lineage_task = None  # avoid infinite loops; re-set on completion
+        self._h_submit_task(e.owner or b"", {"spec": spec})
+
+    def _h_cancel_task(self, identity: bytes, m: dict) -> None:
+        tid = m["task_id"]
+        t = self.tasks.get(tid)
+        if t is None:
+            return
+        from ray_tpu.exceptions import TaskCancelledError
+        if t.state in ("PENDING_DEPS", "QUEUED", "PENDING_TRANSFER", "QUEUED_WORKER"):
+            self.tasks.pop(tid, None)
+            try:
+                self.task_queue.remove(tid)
+            except ValueError:
+                pass
+            if t.node_id is not None:
+                self.scheduler.release(t.node_id, self._sched_res(t.spec))
+            err = P.dumps(TaskCancelledError(t.spec.task_id))
+            results = []
+            for oid in t.spec.return_ids():
+                e = self._entry(oid.binary())
+                e.error = err
+                results.append({"object_id": oid.binary(), "error": err})
+                self._object_created(oid.binary())
+            owner_identity = self._find_owner_identity(t, {}, b"")
+            if owner_identity:
+                self._send(owner_identity, P.TASK_RESULT,
+                           {"task_id": tid, "results": results, "error": err})
+        elif t.worker is not None:
+            # running: interrupt the worker process (SIGINT; SIGKILL if force)
+            info = self.peers.get(t.worker, {})
+            node = self.nodes.get(info.get("node_id") or b"")
+            if node is not None:
+                self._send(node.identity, P.CANCEL_TASK, {
+                    "pid": node.all_workers.get(t.worker, {}).get("pid"),
+                    "force": m.get("force", False)})
+
+    # -------------------------------------------------------------- actors
+    def _h_create_actor(self, identity: bytes, m: dict) -> None:
+        spec: TaskSpec = m["spec"]
+        aid = spec.actor_id.binary()
+        info = ActorInfo(actor_id=spec.actor_id, spec=spec,
+                         name=spec.actor_name, namespace=spec.namespace)
+        if spec.actor_name:
+            key = (spec.namespace, spec.actor_name)
+            if key in self.named_actors:
+                self._reply(identity, m["rid"],
+                            {"error": f"actor name {spec.actor_name!r} taken"},
+                            ok=False)
+                return
+            self.named_actors[key] = aid
+        self.actors[aid] = info
+        self.actor_queues[aid] = collections.deque()
+        self._reply(identity, m["rid"], {"ok": True})
+        self._h_submit_task(identity, {"spec": spec})
+
+    def _on_actor_created(self, t: PendingTask, worker: bytes,
+                          error: Optional[bytes]) -> None:
+        aid = t.spec.actor_id.binary()
+        info = self.actors.get(aid)
+        if info is None:
+            return
+        if error is not None:
+            info.state = "DEAD"
+            info.death_cause = "creation failed"
+            self._fail_actor_queue(aid, error)
+            self.worker_actors.pop(worker, None)
+            self.actor_workers.pop(aid, None)
+            self._return_worker(worker)
+            if t.node_id is not None:
+                self.scheduler.release(t.node_id, self._sched_res(t.spec))
+            return
+        info.state = "ALIVE"
+        info.worker_id = WorkerID(worker) if len(worker) == WorkerID.SIZE else None
+        self._publish(f"actor:{t.spec.actor_id.hex()}",
+                      {"state": "ALIVE", "actor_id": aid})
+        q = self.actor_queues.get(aid)
+        while q:
+            caller, spec = q.popleft()
+            self._route_actor_task(caller, spec, worker)
+
+    def _submit_actor_task(self, identity: bytes, spec: TaskSpec) -> None:
+        aid = spec.actor_id.binary()
+        info = self.actors.get(aid)
+        if info is None or info.state == "DEAD":
+            from ray_tpu.exceptions import ActorDiedError
+            err = P.dumps(ActorDiedError(spec.actor_id,
+                                         info.death_cause if info else "unknown actor"))
+            results = [{"object_id": oid.binary(), "error": err}
+                       for oid in spec.return_ids()]
+            self._send(identity, P.TASK_RESULT, {
+                "task_id": spec.task_id.binary(), "results": results, "error": err})
+            return
+        worker = self.actor_workers.get(aid)
+        if info.state != "ALIVE" or worker is None:
+            self.actor_queues[aid].append((identity, spec))
+            return
+        self._route_actor_task(identity, spec, worker)
+
+    def _route_actor_task(self, caller: bytes, spec: TaskSpec, worker: bytes) -> None:
+        tid = spec.task_id.binary()
+        self.tasks[tid] = PendingTask(spec=spec, state="RUNNING", worker=worker,
+                                      retries_left=spec.max_retries)
+        self.task_table[tid] = {
+            "task_id": spec.task_id.hex(), "name": spec.name,
+            "state": "RUNNING", "type": "ACTOR_TASK",
+            "actor_id": spec.actor_id.hex(), "submitted_at": time.time()}
+        inline_args = {}
+        errors = {}
+        for _, oid in spec.arg_refs:
+            e = self.objects.get(oid.binary())
+            if e is None:
+                continue
+            if e.error is not None:
+                errors[oid.binary()] = e.error
+            elif e.inline is not None:
+                inline_args[oid.binary()] = e.inline
+        self._send(worker, P.TASK_DISPATCH, {
+            "spec": spec, "inline_args": inline_args, "arg_errors": errors})
+
+    def _fail_actor_queue(self, aid: bytes, error: bytes) -> None:
+        q = self.actor_queues.get(aid)
+        while q:
+            caller, spec = q.popleft()
+            results = [{"object_id": oid.binary(), "error": error}
+                       for oid in spec.return_ids()]
+            self._send(caller, P.TASK_RESULT, {
+                "task_id": spec.task_id.binary(), "results": results,
+                "error": error})
+
+    def _h_kill_actor(self, identity: bytes, m: dict) -> None:
+        aid = m["actor_id"]
+        info = self.actors.get(aid)
+        if info is None:
+            return
+        no_restart = m.get("no_restart", True)
+        worker = self.actor_workers.get(aid)
+        if no_restart:
+            info.spec.max_restarts = 0
+        if worker is not None:
+            winfo = self.peers.get(worker, {})
+            node = self.nodes.get(winfo.get("node_id") or b"")
+            if node is not None:
+                self._send(node.identity, P.KILL_ACTOR, {
+                    "pid": node.all_workers.get(worker, {}).get("pid")})
+
+    def _h_get_actor(self, identity: bytes, m: dict) -> None:
+        key = (m.get("namespace", ""), m["name"])
+        aid = self.named_actors.get(key)
+        if aid is None:
+            self._reply(identity, m["rid"], {"error": "not found"}, ok=False)
+        else:
+            info = self.actors[aid]
+            self._reply(identity, m["rid"], {
+                "actor_id": aid, "spec_meta": {
+                    "max_concurrency": info.spec.max_concurrency,
+                    "is_async": info.spec.is_async_actor,
+                    "module": info.spec.function.module,
+                    "qualname": info.spec.function.qualname,
+                }})
+
+    # ------------------------------------------------- kv / functions / pg
+    def _h_kv(self, identity: bytes, m: dict) -> None:
+        ns, op = m.get("ns", ""), m["op"]
+        table = self.kv[ns]
+        if op == "put":
+            overwrite = m.get("overwrite", True)
+            if not overwrite and m["key"] in table:
+                self._reply(identity, m["rid"], {"added": False})
+                return
+            table[m["key"]] = m["value"]
+            self._reply(identity, m["rid"], {"added": True})
+        elif op == "get":
+            self._reply(identity, m["rid"], {"value": table.get(m["key"])})
+        elif op == "del":
+            existed = table.pop(m["key"], None) is not None
+            self._reply(identity, m["rid"], {"deleted": existed})
+        elif op == "exists":
+            self._reply(identity, m["rid"], {"exists": m["key"] in table})
+        elif op == "keys":
+            prefix = m.get("prefix", b"")
+            self._reply(identity, m["rid"],
+                        {"keys": [k for k in table if k.startswith(prefix)]})
+
+    def _h_export_function(self, identity: bytes, m: dict) -> None:
+        self.functions[m["key"]] = m["blob"]
+        if m.get("rid"):
+            self._reply(identity, m["rid"], {"ok": True})
+
+    def _h_fetch_function(self, identity: bytes, m: dict) -> None:
+        self._reply(identity, m["rid"], {"blob": self.functions.get(m["key"])})
+
+    def _h_create_pg(self, identity: bytes, m: dict) -> None:
+        spec: PlacementGroupSpec = m["spec"]
+        b = spec.pg_id.binary()
+        self.pgs[b] = spec
+        if self.scheduler.reserve_placement_group(spec):
+            self.pg_states[b] = "CREATED"
+            self._reply(identity, m["rid"], {"state": "CREATED",
+                                             "bundle_nodes": [bd.node_id.binary() for bd in spec.bundles]})
+        else:
+            self.pg_states[b] = "PENDING"
+            self.pending_pgs.append((identity, spec))
+            self._reply(identity, m["rid"], {"state": "PENDING"})
+
+    def _maybe_place_pgs(self) -> None:
+        if not self.pending_pgs:
+            return
+        still = collections.deque()
+        while self.pending_pgs:
+            identity, spec = self.pending_pgs.popleft()
+            b = spec.pg_id.binary()
+            if b not in self.pgs:
+                continue
+            if self.scheduler.reserve_placement_group(spec):
+                self.pg_states[b] = "CREATED"
+                self._send(identity, P.PG_UPDATE, {
+                    "pg_id": b, "state": "CREATED",
+                    "bundle_nodes": [bd.node_id.binary() for bd in spec.bundles]})
+            else:
+                still.append((identity, spec))
+        self.pending_pgs = still
+
+    def _h_remove_pg(self, identity: bytes, m: dict) -> None:
+        b = m["pg_id"]
+        self.pgs.pop(b, None)
+        self.pg_states[b] = "REMOVED"
+        self.scheduler.release_placement_group(PlacementGroupID(b))
+        self._reply(identity, m["rid"], {"ok": True})
+        self._maybe_schedule()
+
+    # ------------------------------------------------------ cluster health
+    def _h_heartbeat(self, identity: bytes, m: dict) -> None:
+        node = self.nodes.get(m["node_id"])
+        if node is not None:
+            node.last_heartbeat = time.monotonic()
+            node.stats.update(m.get("stats") or {})
+
+    def _h_worker_exit(self, identity: bytes, m: dict) -> None:
+        """Node manager reports a worker process died."""
+        worker_identity = m.get("worker_identity")
+        node = self.nodes.get(m.get("node_id") or b"")
+        if node is not None and worker_identity in node.all_workers:
+            del node.all_workers[worker_identity]
+            try:
+                node.idle_workers.remove(worker_identity)
+            except ValueError:
+                pass
+        self.peers.pop(worker_identity, None)
+        self.worker_running.pop(worker_identity, None)
+        aid = self.worker_actors.pop(worker_identity, None)
+        # fail/retry every in-flight task dispatched to that worker
+        for tid, t in list(self.tasks.items()):
+            if t.worker != worker_identity:
+                continue
+            if t.spec.is_actor_task:
+                self._on_actor_worker_died(worker_identity, tid)
+            elif t.spec.is_actor_creation:
+                # actor restart path owns resubmission (below)
+                self.tasks.pop(tid, None)
+            else:
+                self._handle_task_failure(tid, "worker died during execution")
+        if aid is not None:
+            self._on_actor_died(aid, worker_identity)
+        self._maybe_schedule()
+
+    def _on_actor_worker_died(self, worker_identity: bytes, tid: bytes) -> None:
+        t = self.tasks.pop(tid, None)
+        if t is None:
+            return
+        from ray_tpu.exceptions import ActorDiedError
+        err = P.dumps(ActorDiedError(t.spec.actor_id, "worker died"))
+        results = [{"object_id": oid.binary(), "error": err}
+                   for oid in t.spec.return_ids()]
+        owner_identity = self._find_owner_identity(t, {}, b"")
+        if owner_identity:
+            self._send(owner_identity, P.TASK_RESULT, {
+                "task_id": tid, "results": results, "error": err})
+
+    def _on_actor_died(self, aid: bytes, worker_identity: bytes) -> None:
+        """Actor restart state machine (reference: gcs_actor_manager.h
+        :249-281)."""
+        info = self.actors.get(aid)
+        if info is None:
+            return
+        self.actor_workers.pop(aid, None)
+        if info.node_id is not None:
+            self.scheduler.release(info.node_id, self._sched_res(info.spec))
+        if info.num_restarts < info.spec.max_restarts or info.spec.max_restarts < 0:
+            info.num_restarts += 1
+            info.state = "RESTARTING"
+            self._publish(f"actor:{info.actor_id.hex()}",
+                          {"state": "RESTARTING", "actor_id": aid})
+            self._h_submit_task(b"", {"spec": info.spec})
+        else:
+            info.state = "DEAD"
+            info.death_cause = "worker process died"
+            self._publish(f"actor:{info.actor_id.hex()}",
+                          {"state": "DEAD", "actor_id": aid})
+            from ray_tpu.exceptions import ActorDiedError
+            err = P.dumps(ActorDiedError(info.actor_id, info.death_cause))
+            self._fail_actor_queue(aid, err)
+            if info.name:
+                self.named_actors.pop((info.namespace, info.name), None)
+
+    def _health_loop(self) -> None:
+        cfg = self.config
+        period = cfg.health_check_period_ms / 1000.0
+        threshold = cfg.health_check_failure_threshold * period + \
+            cfg.health_check_timeout_ms / 1000.0
+        while not self._shutdown.wait(period):
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and node.last_heartbeat and \
+                        now - node.last_heartbeat > threshold:
+                    self._on_node_dead(node)
+
+    def _on_node_dead(self, node: NodeInfo) -> None:
+        logger.warning("node %s declared dead", node.node_id.hex()[:12])
+        node.alive = False
+        node.resources.alive = False
+        self.scheduler.remove_node(node.node_id)
+        self._publish("node", {"event": "removed",
+                               "node_id": node.node_id.binary()})
+        node_b = node.node_id.binary()
+        # prune object locations; lost objects get lazily reconstructed
+        for e in self.objects.values():
+            e.locations.discard(node_b)
+        # fail/retry tasks running there
+        for worker_identity in list(node.all_workers):
+            self._h_worker_exit(node.identity, {
+                "worker_identity": worker_identity, "node_id": node_b})
+
+    # -------------------------------------------------------- observability
+    def _h_state_query(self, identity: bytes, m: dict) -> None:
+        what = m["what"]
+        if what == "nodes":
+            rows = [{
+                "node_id": n.node_id.hex(), "alive": n.alive,
+                "resources_total": n.resources.total,
+                "resources_available": n.resources.available,
+                "num_workers": len(n.all_workers), "stats": dict(n.stats, wait_worker=None),
+            } for n in self.nodes.values()]
+        elif what == "tasks":
+            rows = list(self.task_table.values())[-m.get("limit", 1000):]
+        elif what == "actors":
+            rows = [{
+                "actor_id": a.actor_id.hex(), "state": a.state,
+                "name": a.name, "namespace": a.namespace,
+                "num_restarts": a.num_restarts,
+                "node_id": a.node_id.hex() if a.node_id else None,
+            } for a in self.actors.values()]
+        elif what == "objects":
+            rows = [{
+                "object_id": e.object_id.hex(), "size": e.size,
+                "inline": e.inline is not None,
+                "locations": [l.hex()[:12] for l in e.locations],
+                "has_error": e.error is not None,
+            } for e in list(self.objects.values())[:m.get("limit", 1000)]]
+        elif what == "placement_groups":
+            rows = [{
+                "pg_id": PlacementGroupID(b).hex(), "state": self.pg_states.get(b),
+                "strategy": spec.strategy, "name": spec.name,
+                "bundles": [bd.resources for bd in spec.bundles],
+            } for b, spec in self.pgs.items()]
+        elif what == "jobs":
+            rows = list(self.jobs.values())
+        elif what == "cluster_resources":
+            rows = self.scheduler.cluster_resources()
+        elif what == "available_resources":
+            rows = self.scheduler.available_resources()
+        elif what == "timeline":
+            rows = self.task_events[-m.get("limit", 100_000):]
+        else:
+            rows = []
+        self._reply(identity, m["rid"], {"rows": rows})
+
+    def _h_timeline(self, identity: bytes, m: dict) -> None:
+        self.task_events.extend(m["events"])
+        cap = self.config.task_events_max_buffer
+        if len(self.task_events) > cap:
+            self.task_events = self.task_events[-cap:]
+
+    def _h_subscribe(self, identity: bytes, m: dict) -> None:
+        self.subs[m["channel"]].add(identity)
+
+    def _h_pubsub(self, identity: bytes, m: dict) -> None:
+        self._publish(m["channel"], m["data"])
+
+    def _publish(self, channel: str, data: Any) -> None:
+        for identity in self.subs.get(channel, ()):
+            self._send(identity, P.PUBSUB, {"channel": channel, "data": data})
+        for identity in self.subs.get("*", ()):
+            self._send(identity, P.PUBSUB, {"channel": channel, "data": data})
+
+    def _h_shutdown(self, identity: bytes, m: dict) -> None:
+        for node in self.nodes.values():
+            self._send(node.identity, P.SHUTDOWN, {})
+        self._shutdown.set()
+
+    _HANDLERS = {
+        P.REGISTER: _h_register,
+        P.SUBMIT_TASK: _h_submit_task,
+        P.TASK_DONE: _h_task_done,
+        P.CANCEL_TASK: _h_cancel_task,
+        P.CREATE_ACTOR: _h_create_actor,
+        P.KILL_ACTOR: _h_kill_actor,
+        P.GET_ACTOR: _h_get_actor,
+        P.PUT_OBJECT: _h_put_object,
+        P.GET_LOCATION: _h_get_location,
+        P.PUSH_OBJECT: _h_push_object,
+        P.REF_DELTAS: _h_ref_deltas,
+        P.KV_OP: _h_kv,
+        P.EXPORT_FUNCTION: _h_export_function,
+        P.FETCH_FUNCTION: _h_fetch_function,
+        P.CREATE_PG: _h_create_pg,
+        P.REMOVE_PG: _h_remove_pg,
+        P.HEARTBEAT: _h_heartbeat,
+        P.WORKER_EXIT: _h_worker_exit,
+        P.STATE_QUERY: _h_state_query,
+        P.TIMELINE_EVENTS: _h_timeline,
+        P.SUBSCRIBE: _h_subscribe,
+        P.PUBSUB: _h_pubsub,
+        P.SHUTDOWN: _h_shutdown,
+    }

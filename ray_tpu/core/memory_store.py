@@ -1,0 +1,96 @@
+"""In-process memory store for small objects and pending futures.
+
+Equivalent of the reference's ``CoreWorkerMemoryStore``
+(``src/ray/core_worker/store_provider/memory_store/memory_store.h:43``):
+holds small/direct task returns and unresolved futures; ``get`` blocks until
+the object arrives or errors. Objects above the inline threshold live in the
+shared-memory store instead (dual-path ``GetImpl``, memory_store.cc).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.core.ids import ObjectID
+
+
+class _Entry:
+    __slots__ = ("value", "error", "ready")
+
+    def __init__(self):
+        self.value = None
+        self.error: Optional[BaseException] = None
+        self.ready = False
+
+
+class InProcessStore:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._objects: Dict[ObjectID, _Entry] = {}
+        self._callbacks: Dict[ObjectID, List[Callable]] = {}
+
+    def put(self, object_id: ObjectID, value, error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            e = self._objects.setdefault(object_id, _Entry())
+            if e.ready:
+                return  # idempotent (retries may double-complete)
+            e.value = value
+            e.error = error
+            e.ready = True
+            callbacks = self._callbacks.pop(object_id, [])
+            self._lock.notify_all()
+        for cb in callbacks:
+            cb(value, error)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._objects.get(object_id)
+            return e is not None and e.ready
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None):
+        """Blocks; returns value or raises the stored error."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                e = self._objects.get(object_id)
+                if e is not None and e.ready:
+                    if e.error is not None:
+                        raise e.error
+                    return e.value
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    from ray_tpu.exceptions import GetTimeoutError
+                    raise GetTimeoutError(f"timed out waiting for {object_id}")
+                if not self._lock.wait(timeout=remaining if remaining is None or remaining < 0.2 else 0.2):
+                    pass
+
+    def try_get(self, object_id: ObjectID):
+        """Non-blocking; returns (found, value_or_error_raised)."""
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e is None or not e.ready:
+                return False, None
+            if e.error is not None:
+                raise e.error
+            return True, e.value
+
+    def on_ready(self, object_id: ObjectID, callback: Callable) -> None:
+        with self._lock:
+            e = self._objects.get(object_id)
+            if e is not None and e.ready:
+                value, error = e.value, e.error
+            else:
+                self._callbacks.setdefault(object_id, []).append(callback)
+                return
+        callback(value, error)
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._objects.pop(object_id, None)
+            self._callbacks.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)

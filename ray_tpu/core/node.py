@@ -1,0 +1,316 @@
+"""Node manager: per-node daemon for worker lifecycle and the object store.
+
+Equivalent of the reference's raylet (``src/ray/raylet/node_manager.cc``)
+minus scheduling (which lives in the controller here): it spawns/monitors
+worker processes (``worker_pool.h:104``), owns the shared-memory store's
+eviction/spill authority (plasma runs inside the raylet in the reference,
+``object_manager.cc:32``), serves object push/pull transfers
+(``object_manager.h:206``), reports heartbeats, and executes kill/cancel
+signals. Runs as a thread inside the head process for the default
+single-node ``init()``, or as a standalone process (``python -m
+ray_tpu.core.node``) for multi-node clusters and tests (equivalent of
+``ray.cluster_utils.Cluster.add_node``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import zmq
+
+from ray_tpu.core import protocol as P
+from ray_tpu.core.config import Config, get_config
+from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
+from ray_tpu.core.shm_store import ShmClient, ShmObjectStore
+
+logger = logging.getLogger(__name__)
+
+
+class NodeManager:
+    def __init__(self, session_dir: str, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None,
+                 node_id: Optional[NodeID] = None,
+                 num_initial_workers: int = 0,
+                 config: Optional[Config] = None,
+                 env: Optional[Dict[str, str]] = None):
+        self.session_dir = session_dir
+        self.node_id = node_id or NodeID.from_random()
+        self.resources = resources
+        self.labels = labels or {}
+        self.config = config or get_config()
+        self.worker_env = env or {}
+        self.shm_session = f"raytpu-{os.path.basename(session_dir)}-{self.node_id.hex()[:8]}"
+
+        capacity = self.config.object_store_memory
+        if capacity <= 0:
+            try:
+                import psutil
+                capacity = int(psutil.virtual_memory().total
+                               * self.config.object_store_memory_fraction)
+            except Exception:
+                capacity = 2 << 30
+        self.store = ShmObjectStore(
+            self.shm_session, capacity,
+            spill_dir=os.path.join(self.config.spill_dir, self.node_id.hex()[:8]))
+        self.shm = ShmClient(self.shm_session)
+
+        self.workers: Dict[bytes, subprocess.Popen] = {}  # identity -> proc
+        self._workers_lock = threading.Lock()
+        self._stopped = threading.Event()
+
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.DEALER)
+        # node identity: its NodeID binary (distinct size from WorkerID use
+        # is fine — identities are opaque to zmq)
+        self.identity = b"N" + self.node_id.binary()[:27]
+        self.sock.setsockopt(zmq.IDENTITY, self.identity)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        self.sock.connect(P.socket_path(session_dir))
+        self._send_lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self.num_initial_workers = num_initial_workers
+        self._incoming: Dict[bytes, dict] = {}
+
+    # ------------------------------------------------------------------ run
+    def start(self) -> None:
+        self._send(P.REGISTER, {
+            "kind": "node", "id": self.identity,
+            "node_id": self.node_id.binary(), "resources": self.resources,
+            "labels": self.labels, "pid": os.getpid()})
+        for t in (threading.Thread(target=self._loop, name="node-loop", daemon=True),
+                  threading.Thread(target=self._heartbeat_loop, name="node-hb", daemon=True),
+                  threading.Thread(target=self._reaper_loop, name="node-reaper", daemon=True)):
+            t.start()
+            self._threads.append(t)
+        for _ in range(self.num_initial_workers):
+            self._start_worker()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._workers_lock:
+            procs = list(self.workers.values())
+        for p in procs:
+            try:
+                p.terminate()
+            except Exception:
+                pass
+        deadline = time.monotonic() + 3
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+        try:
+            self.sock.close(0)
+        except Exception:
+            pass
+        self.shm.close()
+        self.store.destroy()
+
+    def _send(self, mtype: bytes, payload) -> None:
+        with self._send_lock:
+            self.sock.send_multipart([mtype, P.dumps(payload)])
+
+    # ------------------------------------------------------------ messages
+    def _loop(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self.sock, zmq.POLLIN)
+        while not self._stopped.is_set():
+            try:
+                events = dict(poller.poll(timeout=100))
+            except zmq.ZMQError:
+                break
+            if self.sock not in events:
+                continue
+            while True:
+                try:
+                    frames = self.sock.recv_multipart(zmq.NOBLOCK)
+                except zmq.ZMQError:
+                    break
+                try:
+                    self._handle(frames[0], P.loads(frames[1]))
+                except Exception:
+                    logger.exception("node: error handling %s", frames[0])
+
+    def _handle(self, mtype: bytes, m: dict) -> None:
+        if mtype == P.TASK_ASSIGN:
+            if m.get("start_worker"):
+                self._start_worker()
+        elif mtype == P.FREE_OBJECT:
+            oid = ObjectID(m["object_id"])
+            self.shm.release(oid)
+            self.store.delete(oid)
+        elif mtype == P.PULL_OBJECT:
+            self._push_object(m)
+        elif mtype == P.PUSH_OBJECT:
+            self._receive_push(m)
+        elif mtype == P.CANCEL_TASK:
+            pid = m.get("pid")
+            if pid:
+                try:
+                    os.kill(pid, signal.SIGKILL if m.get("force") else signal.SIGINT)
+                except ProcessLookupError:
+                    pass
+        elif mtype == P.KILL_ACTOR:
+            pid = m.get("pid")
+            if pid:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        elif mtype == P.SHUTDOWN:
+            self._stopped.set()
+
+    # ------------------------------------------------------------- workers
+    def _start_worker(self) -> None:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_SHM_SESSION"] = self.shm_session
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "ray_tpu.core.worker"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        with self._workers_lock:
+            self.workers[worker_id.binary()] = proc
+
+    def _reaper_loop(self) -> None:
+        while not self._stopped.wait(0.2):
+            dead = []
+            with self._workers_lock:
+                for identity, proc in list(self.workers.items()):
+                    if proc.poll() is not None:
+                        dead.append(identity)
+                        del self.workers[identity]
+            for identity in dead:
+                self._send(P.WORKER_EXIT, {
+                    "worker_identity": identity,
+                    "node_id": self.node_id.binary()})
+
+    def _heartbeat_loop(self) -> None:
+        period = self.config.health_check_period_ms / 1000.0
+        while not self._stopped.wait(period):
+            stats = self.store.stats()
+            try:
+                import psutil
+                stats["mem_percent"] = psutil.virtual_memory().percent
+            except Exception:
+                pass
+            self._send(P.HEARTBEAT, {
+                "node_id": self.node_id.binary(), "stats": stats})
+
+    # ----------------------------------------------------------- transfers
+    def _push_object(self, m: dict) -> None:
+        """Source side of a transfer: stream local object to dest node."""
+        oid = ObjectID(m["object_id"])
+        self.store.maybe_restore(oid)
+        view = self.shm.get_view(oid, timeout=2.0)
+        if view is None:
+            logger.warning("pull for missing object %s", oid.hex()[:12])
+            return
+        chunk = self.config.transfer_chunk_bytes
+        total = len(view)
+        nchunks = max(1, (total + chunk - 1) // chunk)
+        for i in range(nchunks):
+            part = bytes(view[i * chunk:(i + 1) * chunk])
+            self._send(P.PUSH_OBJECT, {
+                "object_id": m["object_id"], "dest_node": m["dest_node"],
+                "seq": i, "nchunks": nchunks, "total": total, "data": part})
+        self.shm.release(oid)
+
+    def _receive_push(self, m: dict) -> None:
+        """Destination side: assemble chunks, seal, announce location."""
+        b = m["object_id"]
+        oid = ObjectID(b)
+        if self.store.contains(oid):
+            return
+        st = self._incoming.get(b)
+        if st is None:
+            view = self.shm.create(oid, m["total"])
+            st = {"view": view, "received": 0}
+            self._incoming[b] = st
+        chunk = self.config.transfer_chunk_bytes
+        off = m["seq"] * chunk
+        data = m["data"]
+        st["view"][off:off + len(data)] = data
+        st["received"] += 1
+        if st["received"] >= m["nchunks"]:
+            self.shm.seal(oid)
+            self.store.on_sealed(oid, m["total"])
+            del self._incoming[b]
+            self._send(P.PUT_OBJECT, {
+                "object_id": b, "node_id": self.node_id.binary(),
+                "size": m["total"]})
+
+    def run_forever(self) -> None:
+        while not self._stopped.wait(0.5):
+            pass
+        self.stop()
+
+
+def detect_resources(num_cpus: Optional[float] = None,
+                     num_tpus: Optional[float] = None,
+                     custom: Optional[Dict[str, float]] = None,
+                     memory: Optional[int] = None) -> Dict[str, float]:
+    """Build the node resource map (reference:
+    ``python/ray/_private/resource_spec.py`` + accelerator detection)."""
+    from ray_tpu.core.accelerators import tpu_chip_count, tpu_pod_type
+    res: Dict[str, float] = {}
+    res["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+    if memory is None:
+        try:
+            import psutil
+            memory = int(psutil.virtual_memory().total * 0.7)
+        except Exception:
+            memory = 4 << 30
+    res["memory"] = float(memory)
+    chips = num_tpus if num_tpus is not None else tpu_chip_count()
+    if chips:
+        res["TPU"] = float(chips)
+        pod_type = tpu_pod_type()
+        if pod_type and get_config().tpu_pod_head_resource:
+            # reference: tpu.py:379-382 — one gang resource on slice host 0
+            from ray_tpu.core.accelerators import tpu_worker_index
+            if tpu_worker_index() == 0:
+                res[f"TPU-{pod_type}-head"] = 1.0
+    res.update(custom or {})
+    return res
+
+
+def main() -> None:
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--session-dir", required=True)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--labels", default="{}")
+    p.add_argument("--initial-workers", type=int, default=0)
+    args = p.parse_args()
+    import json
+    res = detect_resources(args.num_cpus, args.num_tpus,
+                           json.loads(args.resources))
+    nm = NodeManager(args.session_dir, res, labels=json.loads(args.labels),
+                     num_initial_workers=args.initial_workers)
+    nm.start()
+    nm.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Control-plane wire protocol over ZeroMQ.
+
+Equivalent role to the reference's gRPC layer (``src/ray/rpc/``) plus the
+protobuf schema (``src/ray/protobuf/``). Transport: one ROUTER socket bound
+by the controller at ``ipc://<session>/controller.sock``; every other
+process (driver, node managers, workers) connects a DEALER whose identity is
+its binary WorkerID/NodeID. Messages are two frames: ``[type][payload]``
+with the payload pickled (protocol 5). Request/response pairs carry a
+correlation id; one-way notifications don't.
+
+ZeroMQ gives the same properties the reference builds on asio+gRPC: ordered
+per-peer delivery, async send queues, and broker routing by identity.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import uuid
+from typing import Any, Dict, Optional
+
+# ---- message types ----
+# registration / lifecycle
+REGISTER = b"REG"            # {kind, id, node_id, pid} -> {ok, session_info}
+REGISTER_REPLY = b"REGR"
+SHUTDOWN = b"BYE"
+# tasks
+SUBMIT_TASK = b"SUB"         # {spec}
+TASK_ASSIGN = b"ASG"         # controller->node {spec}
+TASK_DISPATCH = b"DSP"       # node->worker {spec}
+TASK_DONE = b"DON"           # worker->controller {task_id, results, error}
+TASK_RESULT = b"RES"         # controller->owner {object_id, inline|location|error}
+CANCEL_TASK = b"CAN"
+# actors
+CREATE_ACTOR = b"CAC"
+ACTOR_UPDATE = b"AUP"        # controller->subscribers {actor_id, state, ...}
+SUBMIT_ACTOR_TASK = b"SAT"
+KILL_ACTOR = b"KIL"
+GET_ACTOR = b"GAC"           # lookup by name
+# objects
+PUT_OBJECT = b"PUT"          # seal notification {object_id, node_id, size, owner}
+FREE_OBJECT = b"FRE"         # controller->node {object_id}
+GET_LOCATION = b"LOC"        # {object_id} -> {node_id|None, inline|None}
+PULL_OBJECT = b"PUL"         # node->node via controller: request transfer
+PUSH_OBJECT = b"PSH"         # chunked object payload
+REF_DELTAS = b"RFD"          # {deltas: {bytes: int}}
+# kv / functions
+KV_OP = b"KVO"               # {op: put|get|del|keys|exists, ns, key, value}
+EXPORT_FUNCTION = b"EXF"     # {key, blob}
+FETCH_FUNCTION = b"FEF"      # {key} -> {blob}
+# placement groups
+CREATE_PG = b"CPG"
+REMOVE_PG = b"RPG"
+PG_UPDATE = b"PGU"
+# cluster
+HEARTBEAT = b"HBT"           # node->controller {node_id, available, total, stats}
+NODE_UPDATE = b"NUP"
+WORKER_EXIT = b"WEX"
+STATE_QUERY = b"STQ"         # {what, filters} -> rows
+TIMELINE_EVENTS = b"TLE"     # worker->controller task event batch
+PUBSUB = b"PUB"              # {channel, data} fanout
+SUBSCRIBE = b"SSC"           # {channel}
+GENERIC_REPLY = b"RPL"
+ERROR_REPLY = b"ERR"
+
+_DUMPS_PROTO = 5
+
+
+def dumps(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=_DUMPS_PROTO)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+class ReplyWaiter:
+    """Correlates request/reply over the async socket pump."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: Dict[bytes, threading.Event] = {}
+        self._replies: Dict[bytes, Any] = {}
+
+    def new_request(self) -> bytes:
+        rid = uuid.uuid4().bytes
+        with self._lock:
+            self._events[rid] = threading.Event()
+        return rid
+
+    def fulfill(self, rid: bytes, reply: Any) -> bool:
+        with self._lock:
+            ev = self._events.get(rid)
+            if ev is None:
+                return False
+            self._replies[rid] = reply
+        ev.set()
+        return True
+
+    def wait(self, rid: bytes, timeout: Optional[float]) -> Any:
+        with self._lock:
+            ev = self._events[rid]
+        if not ev.wait(timeout):
+            with self._lock:
+                self._events.pop(rid, None)
+            raise TimeoutError("control-plane RPC timed out")
+        with self._lock:
+            self._events.pop(rid, None)
+            return self._replies.pop(rid)
+
+
+def socket_path(session_dir: str) -> str:
+    return f"ipc://{session_dir}/controller.sock"

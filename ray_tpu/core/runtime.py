@@ -1,0 +1,496 @@
+"""Per-process runtime: the core-worker library.
+
+Equivalent of the reference's ``CoreWorker`` (``src/ray/core_worker/
+core_worker.h``; Cython surface ``python/ray/_raylet.pyx:3177``): lives in
+every driver and worker process; provides submit_task / create_actor /
+submit_actor_task / get / put / wait / cancel, owns the in-process memory
+store, the reference counter, and the serialization context. A background
+pump thread owns the DEALER socket (all control traffic); synchronous RPCs
+are correlated via ReplyWaiter.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import zmq
+
+from ray_tpu.core import protocol as P
+from ray_tpu.core.config import Config, get_config
+from ray_tpu.core.ids import (
+    ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID)
+from ray_tpu.core.memory_store import InProcessStore
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.reference_counter import ReferenceCounter
+from ray_tpu.core.serialization import SerializationContext, SerializedObject
+from ray_tpu.core.shm_store import ShmClient
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.exceptions import GetTimeoutError
+
+logger = logging.getLogger(__name__)
+
+
+class _ArgPlaceholder:
+    """Marks a positional arg that was a top-level ObjectRef."""
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (_ArgPlaceholder, (self.index,))
+
+
+class Runtime:
+    def __init__(self, kind: str, session_dir: str, node_id: NodeID,
+                 worker_id: Optional[WorkerID] = None,
+                 shm_session: Optional[str] = None):
+        self.kind = kind
+        self.session_dir = session_dir
+        self.node_id = node_id
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.job_id = JobID.from_int(0)
+        self.config: Config = get_config()
+
+        self.memory_store = InProcessStore()
+        self.reference_counter = ReferenceCounter(self._flush_ref_deltas)
+        self.serialization = SerializationContext(self)
+        self.shm = ShmClient(shm_session) if shm_session else None
+        self.shm_session = shm_session
+
+        # object_id(bytes) -> result meta {"inline"|"node_id"/"size"|"error"}
+        self._meta: Dict[bytes, dict] = {}
+        self._meta_lock = threading.Lock()
+        self._completion_cbs: Dict[bytes, List[Callable]] = {}
+        self._pending_locations: Dict[bytes, bytes] = {}  # object -> rid
+
+        self.replies = P.ReplyWaiter()
+        self._put_counter = 0
+        self._task_counter = 0
+        self._lock = threading.Lock()
+        self._driver_task_id = TaskID.for_driver(self.job_id)
+        self.current_task_id = self._driver_task_id
+        self._current_actor_id: Optional[ActorID] = None
+
+        self.dispatch_handler: Optional[Callable[[dict], None]] = None
+        self._early_dispatches: List[dict] = []
+        self.pubsub_handlers: Dict[str, List[Callable]] = {}
+        self.pg_events: Dict[bytes, dict] = {}
+        self.pg_cond = threading.Condition()
+        self._register_reply: Optional[dict] = None
+        self._register_ev = threading.Event()
+        self._stopped = threading.Event()
+        self._timeline_buf: List[dict] = []
+
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.DEALER)
+        self.sock.setsockopt(zmq.IDENTITY, self.worker_id.binary())
+        self.sock.setsockopt(zmq.LINGER, 0)
+        self.sock.connect(P.socket_path(session_dir))
+        self._send_lock = threading.Lock()
+        self._pump = threading.Thread(target=self._pump_loop,
+                                      name=f"{kind}-pump", daemon=True)
+        self._pump.start()
+
+    # ------------------------------------------------------------ transport
+    def _send(self, mtype: bytes, payload: Any) -> None:
+        blob = P.dumps(payload)
+        with self._send_lock:
+            self.sock.send_multipart([mtype, blob])
+
+    def request(self, mtype: bytes, payload: dict,
+                timeout: Optional[float] = None) -> dict:
+        rid = self.replies.new_request()
+        payload = dict(payload, rid=rid)
+        self._send(mtype, payload)
+        reply = self.replies.wait(rid, timeout or self.config.rpc_timeout_s)
+        if isinstance(reply, dict) and reply.get("__error__"):
+            raise RuntimeError(reply["data"])
+        return reply
+
+    def _pump_loop(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self.sock, zmq.POLLIN)
+        while not self._stopped.is_set():
+            try:
+                events = dict(poller.poll(timeout=100))
+            except zmq.ZMQError:
+                break
+            if self.sock not in events:
+                continue
+            while True:
+                try:
+                    frames = self.sock.recv_multipart(zmq.NOBLOCK)
+                except zmq.ZMQError:
+                    break
+                try:
+                    self._on_message(frames[0], P.loads(frames[1]))
+                except Exception:
+                    logger.exception("%s: error handling %s", self.kind, frames[0])
+
+    def _on_message(self, mtype: bytes, m: dict) -> None:
+        if mtype == P.GENERIC_REPLY:
+            self.replies.fulfill(m["rid"], m["data"])
+        elif mtype == P.ERROR_REPLY:
+            self.replies.fulfill(m["rid"], {"__error__": True, "data": m["data"]})
+        elif mtype == P.TASK_RESULT:
+            self._on_task_result(m)
+        elif mtype == P.TASK_DISPATCH:
+            if self.dispatch_handler is not None:
+                self.dispatch_handler(m)
+            else:
+                # dispatched before the executor installed its handler
+                # (registration reply races with first dispatch)
+                self._early_dispatches.append(m)
+        elif mtype == P.REGISTER_REPLY:
+            self._register_reply = m
+            self._register_ev.set()
+        elif mtype == P.PUBSUB:
+            for cb in self.pubsub_handlers.get(m["channel"], []) + \
+                    self.pubsub_handlers.get("*", []):
+                cb(m["channel"], m["data"])
+        elif mtype == P.PG_UPDATE:
+            with self.pg_cond:
+                self.pg_events[m["pg_id"]] = m
+                self.pg_cond.notify_all()
+        elif mtype == P.SHUTDOWN:
+            self._stopped.set()
+
+    def set_dispatch_handler(self, handler: Callable[[dict], None]) -> None:
+        self.dispatch_handler = handler
+        while self._early_dispatches:
+            handler(self._early_dispatches.pop(0))
+
+    def register(self, timeout: float = 30.0) -> dict:
+        self._send(P.REGISTER, {
+            "kind": self.kind, "id": self.worker_id.binary(),
+            "node_id": self.node_id.binary(), "pid": os.getpid()})
+        if not self._register_ev.wait(timeout):
+            raise TimeoutError("could not connect to controller")
+        reply = self._register_reply
+        if self.kind == "driver" and reply.get("job_id"):
+            self.job_id = JobID(reply["job_id"])
+            self._driver_task_id = TaskID.for_driver(self.job_id)
+            self.current_task_id = self._driver_task_id
+        return reply
+
+    def shutdown(self) -> None:
+        self.reference_counter.flush()
+        self.flush_timeline()
+        self._stopped.set()
+        try:
+            self.sock.close(0)
+        except Exception:
+            pass
+        if self.shm:
+            self.shm.close()
+
+    # ------------------------------------------------------------- refcount
+    def _flush_ref_deltas(self, deltas: Dict[bytes, int]) -> None:
+        if self._stopped.is_set():
+            return
+        try:
+            self._send(P.REF_DELTAS, {"deltas": deltas})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ put / get
+    def put(self, value: Any, _owner_hint: Optional[bytes] = None) -> ObjectRef:
+        with self._lock:
+            self._put_counter += 1
+            oid = ObjectID.for_put(self.current_task_id, self._put_counter)
+        ref = ObjectRef(oid, self.worker_id)
+        self._store_value(oid, value, notify=True)
+        return ref
+
+    def _store_value(self, oid: ObjectID, value: Any, notify: bool) -> dict:
+        """Serialize and store a value; returns result meta for TASK_DONE."""
+        serialized = self.serialization.serialize(value)
+        size = serialized.total_bytes()
+        b = oid.binary()
+        self.memory_store.put(oid, value)
+        if size <= self.config.max_inline_object_size or self.shm is None:
+            blob = serialized.to_bytes()
+            meta = {"object_id": b, "inline": blob, "size": size}
+            if notify:
+                self._send(P.PUT_OBJECT, {"object_id": b, "inline": blob})
+        else:
+            view = self.shm.create(oid, size)
+            serialized.write_to(view)
+            self.shm.seal(oid)
+            meta = {"object_id": b, "node_id": self.node_id.binary(), "size": size}
+            if notify:
+                self._send(P.PUT_OBJECT, {
+                    "object_id": b, "node_id": self.node_id.binary(), "size": size})
+        return meta
+
+    def seed_meta(self, object_id_b: bytes, meta: dict) -> None:
+        with self._meta_lock:
+            self._meta[object_id_b] = meta
+
+    def _on_task_result(self, m: dict) -> None:
+        for r in m.get("results", []):
+            b = r["object_id"]
+            with self._meta_lock:
+                self._meta[b] = r
+            oid = ObjectID(b)
+            # materialize lazily at get(); but wake any waiter now
+            self.memory_store.put(oid, _MetaReady(r))
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            out.append(self._get_one(ref, remaining))
+        return out[0] if single else out
+
+    def _get_one(self, ref: ObjectRef, timeout: Optional[float]):
+        oid = ref.id()
+        value = self.memory_store.get(oid, timeout)
+        if isinstance(value, _MetaReady):
+            value = self._materialize(oid, value.meta)
+        return value
+
+    def _materialize(self, oid: ObjectID, meta: dict):
+        if meta.get("error") is not None:
+            err = P.loads(meta["error"])
+            self.memory_store.put(oid, None, error=err)
+            raise err
+        if meta.get("inline") is not None:
+            value, _ = self.serialization.deserialize_from_view(
+                memoryview(meta["inline"]))
+            self.memory_store.put(oid, value)
+            return value
+        # shared-memory object
+        node_b = meta.get("node_id")
+        if self.shm is not None and (node_b == self.node_id.binary()
+                                     or self.shm.contains(oid)):
+            view = self.shm.get_view(oid, timeout=5.0)
+            if view is not None:
+                value, _ = self.serialization.deserialize_from_view(view)
+                self.memory_store.put(oid, value)
+                return value
+        # remote: ask controller to make it local (or hand us inline bytes)
+        reply = self.request(P.GET_LOCATION, {
+            "object_id": oid.binary(), "want_node": self.node_id.binary()},
+            timeout=self.config.rpc_timeout_s * 4)
+        if reply.get("error") is not None:
+            err = P.loads(reply["error"])
+            self.memory_store.put(oid, None, error=err)
+            raise err
+        if reply.get("inline") is not None:
+            value, _ = self.serialization.deserialize_from_view(
+                memoryview(reply["inline"]))
+            self.memory_store.put(oid, value)
+            return value
+        if self.shm is None:
+            raise RuntimeError("no shm store attached; cannot fetch object")
+        view = self.shm.get_view(oid, timeout=self.config.rpc_timeout_s)
+        if view is None:
+            from ray_tpu.exceptions import ObjectLostError
+            raise ObjectLostError(oid)
+        value, _ = self.serialization.deserialize_from_view(view)
+        self.memory_store.put(oid, value)
+        return value
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None,
+             fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        asked = set()
+        while len(ready) < num_returns:
+            still = []
+            for ref in pending:
+                if self._is_ready(ref, asked):
+                    ready.append(ref)
+                    if len(ready) >= num_returns:
+                        still.extend(p for p in pending if p is not ref and p not in ready)
+                        break
+                else:
+                    still.append(ref)
+            pending = [r for r in still if r not in ready]
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.002)
+        return ready, pending
+
+    def _is_ready(self, ref: ObjectRef, asked: set) -> bool:
+        oid = ref.id()
+        if self.memory_store.contains(oid):
+            return True
+        with self._meta_lock:
+            if oid.binary() in self._meta:
+                return True
+        b = oid.binary()
+        if b not in asked:
+            asked.add(b)
+            # fire-and-forget location query; reply fulfilled into meta
+            rid = self.replies.new_request()
+            threading.Thread(
+                target=self._bg_location_probe, args=(b, rid), daemon=True).start()
+        return False
+
+    def _bg_location_probe(self, object_id_b: bytes, rid: bytes) -> None:
+        try:
+            payload = {"object_id": object_id_b, "rid": rid,
+                       "want_node": self.node_id.binary()}
+            self._send(P.GET_LOCATION, payload)
+            reply = self.replies.wait(rid, None)
+            with self._meta_lock:
+                self._meta[object_id_b] = reply
+            self.memory_store.put(ObjectID(object_id_b), _MetaReady(reply))
+        except Exception:
+            pass
+
+    def register_completion_callback(self, ref: ObjectRef, cb: Callable) -> None:
+        oid = ref.id()
+
+        def wrapper(value, error):
+            if isinstance(value, _MetaReady):
+                try:
+                    value = self._materialize(oid, value.meta)
+                    error = None
+                except BaseException as e:  # noqa: BLE001
+                    value, error = None, e
+            cb(value, error)
+
+        self.memory_store.on_ready(oid, wrapper)
+
+    # ---------------------------------------------------------- submission
+    def next_task_id(self) -> TaskID:
+        return TaskID.for_normal_task(self.job_id)
+
+    def serialize_args(self, args: tuple, kwargs: dict
+                       ) -> Tuple[bytes, List[Tuple[int, ObjectID]], List[ObjectID]]:
+        """Top-level ObjectRef args become placeholders resolved pre-exec
+        (reference: dependency_resolver.cc); nested refs stay borrowed."""
+        arg_refs: List[Tuple[int, ObjectID]] = []
+        new_args = []
+        for i, a in enumerate(args):
+            if isinstance(a, ObjectRef):
+                arg_refs.append((len(arg_refs), a.id()))
+                new_args.append(_ArgPlaceholder(len(arg_refs) - 1))
+            else:
+                new_args.append(a)
+        new_kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, ObjectRef):
+                arg_refs.append((len(arg_refs), v.id()))
+                new_kwargs[k] = _ArgPlaceholder(len(arg_refs) - 1)
+            else:
+                new_kwargs[k] = v
+        serialized = self.serialization.serialize((tuple(new_args), new_kwargs))
+        contained = [r.id() for r in serialized.contained_refs]
+        return serialized.to_bytes(), arg_refs, contained
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        spec.owner = self.worker_id
+        refs = [ObjectRef(oid, self.worker_id) for oid in spec.return_ids()]
+        for _, oid in spec.arg_refs:
+            self.reference_counter.add_submitted_task_ref(oid)
+        self.reference_counter.flush()
+        if spec.is_actor_task:
+            self._send(P.SUBMIT_TASK, {"spec": spec})
+        else:
+            self._send(P.SUBMIT_TASK, {"spec": spec})
+        self._record_event(spec, "submitted")
+        return refs
+
+    def create_actor(self, spec: TaskSpec) -> None:
+        spec.owner = self.worker_id
+        self.request(P.CREATE_ACTOR, {"spec": spec})
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        self._send(P.CANCEL_TASK, {"task_id": ref.id().task_id().binary(),
+                                   "force": force})
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._send(P.KILL_ACTOR, {"actor_id": actor_id.binary(),
+                                  "no_restart": no_restart})
+
+    # ------------------------------------------------------------ kv / pg
+    def kv_put(self, key: bytes, value: bytes, ns: str = "",
+               overwrite: bool = True) -> bool:
+        return self.request(P.KV_OP, {"op": "put", "ns": ns, "key": key,
+                                      "value": value, "overwrite": overwrite})["added"]
+
+    def kv_get(self, key: bytes, ns: str = "") -> Optional[bytes]:
+        return self.request(P.KV_OP, {"op": "get", "ns": ns, "key": key})["value"]
+
+    def kv_del(self, key: bytes, ns: str = "") -> bool:
+        return self.request(P.KV_OP, {"op": "del", "ns": ns, "key": key})["deleted"]
+
+    def kv_exists(self, key: bytes, ns: str = "") -> bool:
+        return self.request(P.KV_OP, {"op": "exists", "ns": ns, "key": key})["exists"]
+
+    def kv_keys(self, prefix: bytes = b"", ns: str = "") -> List[bytes]:
+        return self.request(P.KV_OP, {"op": "keys", "ns": ns, "prefix": prefix})["keys"]
+
+    def state_query(self, what: str, **kw) -> Any:
+        return self.request(P.STATE_QUERY, {"what": what, **kw})["rows"]
+
+    # ----------------------------------------------------------- functions
+    def export_function(self, key: str, blob: bytes) -> None:
+        self.request(P.EXPORT_FUNCTION, {"key": key, "blob": blob})
+
+    def fetch_function(self, key: str) -> Optional[bytes]:
+        return self.request(P.FETCH_FUNCTION, {"key": key})["blob"]
+
+    # ------------------------------------------------------------ timeline
+    def _record_event(self, spec: TaskSpec, event: str) -> None:
+        if not self.config.enable_timeline:
+            return
+        self._timeline_buf.append({
+            "name": spec.name or spec.function.qualname, "cat": "task",
+            "ph": "i", "ts": time.time() * 1e6, "pid": os.getpid(),
+            "tid": threading.get_ident() % 1_000_000,
+            "args": {"task_id": spec.task_id.hex(), "event": event}})
+        if len(self._timeline_buf) >= 512:
+            self.flush_timeline()
+
+    def record_span(self, name: str, start_s: float, dur_s: float,
+                    **args) -> None:
+        self._timeline_buf.append({
+            "name": name, "cat": "task", "ph": "X", "ts": start_s * 1e6,
+            "dur": dur_s * 1e6, "pid": os.getpid(),
+            "tid": threading.get_ident() % 1_000_000, "args": args})
+        if len(self._timeline_buf) >= 512:
+            self.flush_timeline()
+
+    def flush_timeline(self) -> None:
+        if not self._timeline_buf:
+            return
+        buf, self._timeline_buf = self._timeline_buf, []
+        try:
+            self._send(P.TIMELINE_EVENTS, {"events": buf})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- pubsub
+    def subscribe(self, channel: str, cb: Callable) -> None:
+        self.pubsub_handlers.setdefault(channel, []).append(cb)
+        self._send(P.SUBSCRIBE, {"channel": channel})
+
+    def publish(self, channel: str, data: Any) -> None:
+        self._send(P.PUBSUB, {"channel": channel, "data": data})
+
+
+class _MetaReady:
+    """Marker in the memory store: result meta arrived, value not yet
+    materialized (lazy deserialization at first get)."""
+    __slots__ = ("meta",)
+
+    def __init__(self, meta: dict):
+        self.meta = meta

@@ -1,0 +1,159 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band zero-copy buffers.
+
+Equivalent of the reference's ``python/ray/_private/serialization.py``
+(SerializationContext :110, serialize :482, deserialize_objects :393):
+
+- cloudpickle for arbitrary Python (functions, classes, closures);
+- pickle protocol 5 with out-of-band ``PickleBuffer``s so large numpy /
+  jax-host arrays are written to the shared-memory store without a copy and
+  mapped back as zero-copy views on read;
+- custom reducers for ObjectRef (borrowing) and ActorHandle.
+
+Wire format of a serialized object:
+    [u32 n_buffers][u64 len_meta][meta pickle bytes][buffer 0][buffer 1]...
+buffers 8-byte aligned, each prefixed by u64 length.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+import cloudpickle
+
+_ALIGN = 64  # align buffers for vectorized readers / dlpack import
+
+
+class SerializedObject:
+    """A serialized value: metadata bytes + zero-copy buffer views."""
+
+    __slots__ = ("meta", "buffers", "contained_refs")
+
+    def __init__(self, meta: bytes, buffers: List[memoryview],
+                 contained_refs: list):
+        self.meta = meta
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    def total_bytes(self) -> int:
+        n = 12 + len(self.meta)
+        for b in self.buffers:
+            n = _aligned(n + 8) + b.nbytes
+        return n
+
+    def write_to(self, target: memoryview) -> int:
+        """Write the wire format into ``target``; returns bytes written."""
+        struct.pack_into("<IQ", target, 0, len(self.buffers), len(self.meta))
+        off = 12
+        target[off:off + len(self.meta)] = self.meta
+        off += len(self.meta)
+        for b in self.buffers:
+            off = _aligned(off + 8) - 8
+            struct.pack_into("<Q", target, off, b.nbytes)
+            off += 8
+            flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
+            target[off:off + b.nbytes] = flat
+            off += b.nbytes
+        return off
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_bytes())
+        n = self.write_to(memoryview(out))
+        return bytes(out[:n])
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+_thread_local = threading.local()
+
+
+def get_active_context() -> Optional["SerializationContext"]:
+    return getattr(_thread_local, "active_ctx", None)
+
+
+class SerializationContext:
+    """Per-worker serializer. Tracks refs contained in serialized values
+    (for the borrowing protocol) and refs found while deserializing."""
+
+    def __init__(self, worker=None):
+        self.worker = worker
+        self._contained: list = []
+        self._deserialized: list = []
+        self._custom_serializers = {}
+
+    # -- hooks called from ObjectRef.__reduce__ --
+    def record_contained_ref(self, ref) -> None:
+        self._contained.append(ref)
+
+    def record_deserialized_ref(self, ref) -> None:
+        self._deserialized.append(ref)
+
+    def register_custom_serializer(self, cls, serializer, deserializer):
+        self._custom_serializers[cls] = (serializer, deserializer)
+
+    # -- main entry points --
+    def serialize(self, value) -> SerializedObject:
+        buffers: List[pickle.PickleBuffer] = []
+        self._contained = []
+        _thread_local.active_ctx = self
+        try:
+            value = _pre_serialize(value)
+            meta = cloudpickle.dumps(
+                value, protocol=5, buffer_callback=buffers.append)
+        finally:
+            _thread_local.active_ctx = None
+        views = []
+        for pb in buffers:
+            v = pb.raw()
+            views.append(v)
+        return SerializedObject(meta, views, list(self._contained))
+
+    def deserialize(self, meta: bytes, buffers: List[memoryview]) -> Tuple[object, list]:
+        """Returns (value, deserialized_refs)."""
+        self._deserialized = []
+        _thread_local.active_ctx = self
+        try:
+            value = pickle.loads(meta, buffers=buffers)
+        finally:
+            _thread_local.active_ctx = None
+        return value, list(self._deserialized)
+
+    def deserialize_from_view(self, view: memoryview) -> Tuple[object, list]:
+        n_buffers, len_meta = struct.unpack_from("<IQ", view, 0)
+        off = 12
+        meta = bytes(view[off:off + len_meta])
+        off += len_meta
+        buffers = []
+        for _ in range(n_buffers):
+            off = _aligned(off + 8) - 8
+            (blen,) = struct.unpack_from("<Q", view, off)
+            off += 8
+            buffers.append(view[off:off + blen])
+            off += blen
+        return self.deserialize(meta, buffers)
+
+
+def _pre_serialize(value):
+    """Convert device-resident jax arrays to host numpy so the object store
+    stays host-side (TPU HBM is not host-mappable; SURVEY.md §7 hard part 4).
+    The array round-trips back to device via ``jax.device_put`` on use."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(value, jax.Array):
+        import numpy as np
+        return np.asarray(value)
+    return value
+
+
+_default_ctx: Optional[SerializationContext] = None
+
+
+def default_context() -> SerializationContext:
+    global _default_ctx
+    if _default_ctx is None:
+        _default_ctx = SerializationContext()
+    return _default_ctx

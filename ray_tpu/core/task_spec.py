@@ -1,0 +1,124 @@
+"""Task and actor specifications — the unit of scheduling.
+
+Equivalent of the reference's ``TaskSpecification``
+(``src/ray/common/task/task_spec.h``) minus protobuf: a plain dataclass
+carried over the control plane. Functions/classes are NOT embedded; they are
+exported once to the controller's function store keyed by a
+``FunctionDescriptor`` (reference: ``python/ray/_private/function_manager.py``)
+and loaded lazily (and cached) by workers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+
+
+@dataclass(frozen=True)
+class FunctionDescriptor:
+    """Stable key for a remote function / actor class."""
+    module: str
+    qualname: str
+    function_hash: str  # sha1 of the pickled function
+
+    def key(self) -> str:
+        return f"{self.module}.{self.qualname}:{self.function_hash}"
+
+    def __repr__(self):
+        return f"Fn({self.module}.{self.qualname})"
+
+
+@dataclass
+class SchedulingStrategy:
+    """Union of the reference's scheduling strategies
+    (python/ray/util/scheduling_strategies.py)."""
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP | NODE_LABEL
+    node_id: Optional[NodeID] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+    hard_labels: Dict[str, List[str]] = field(default_factory=dict)
+    soft_labels: Dict[str, List[str]] = field(default_factory=dict)
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    function: FunctionDescriptor
+    # Serialized args blob (SerializedObject wire bytes); refs are passed
+    # positionally via arg_refs and substituted at execution time.
+    args_blob: bytes = b""
+    arg_refs: List[Tuple[int, ObjectID]] = field(default_factory=list)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    owner: Optional[WorkerID] = None
+    name: str = ""
+    runtime_env: Optional[dict] = None
+    # actor task fields
+    actor_id: Optional[ActorID] = None
+    sequence_number: int = -1
+    concurrency_group: str = ""
+    # actor creation fields
+    is_actor_creation: bool = False
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    max_pending_calls: int = -1
+    actor_name: str = ""
+    namespace: str = ""
+    is_async_actor: bool = False
+
+    @property
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None and not self.is_actor_creation
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i + 1)
+                for i in range(self.num_returns)]
+
+
+@dataclass
+class Bundle:
+    """A placement-group bundle: an atomic resource reservation
+    (reference: src/ray/common/bundle_spec.h)."""
+    resources: Dict[str, float]
+    node_id: Optional[NodeID] = None  # filled after placement
+
+
+@dataclass
+class PlacementGroupSpec:
+    pg_id: PlacementGroupID
+    bundles: List[Bundle]
+    strategy: str = "PACK"  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    name: str = ""
+    creator_job: Optional[JobID] = None
+
+
+@dataclass
+class ActorInfo:
+    """Controller-side actor directory entry (reference:
+    gcs_actor_manager.h actor state machine :249-281)."""
+    actor_id: ActorID
+    spec: TaskSpec
+    state: str = "PENDING"  # PENDING|STARTING|ALIVE|RESTARTING|DEAD
+    node_id: Optional[NodeID] = None
+    worker_id: Optional[WorkerID] = None
+    num_restarts: int = 0
+    name: str = ""
+    namespace: str = ""
+    death_cause: str = ""

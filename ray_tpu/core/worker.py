@@ -1,0 +1,271 @@
+"""Worker process: executes tasks and hosts actor instances.
+
+Equivalent of the reference's worker loop (``python/ray/_private/workers/
+default_worker.py`` → ``CCoreWorkerProcess.RunTaskExecutionLoop``
+``_raylet.pyx:3267`` → ``task_execution_handler`` :2177). The main thread
+executes normal tasks and in-order actor tasks (so SIGINT-based
+``ray.cancel`` interrupts user code, like the reference); concurrent actors
+use a thread pool, async actors an asyncio loop (reference:
+``transport/actor_scheduling_queue.h``, ``fiber.h``).
+
+Functions arrive by descriptor key and are fetched once from the
+controller's function store then cached (reference:
+``python/ray/_private/function_manager.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import queue
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu.core import protocol as P
+from ray_tpu.core.global_state import set_global_worker
+from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
+from ray_tpu.core.runtime import Runtime, _ArgPlaceholder
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.exceptions import TaskCancelledError, TaskError
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerExecutor:
+    def __init__(self, runtime: Runtime):
+        self.runtime = runtime
+        self._queue: "queue.Queue[dict]" = queue.Queue()
+        self._functions: Dict[str, Any] = {}
+        self.actor_instance = None
+        self.actor_spec: Optional[TaskSpec] = None
+        self._thread_pool = None
+        self._async_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._async_sema: Optional[asyncio.Semaphore] = None
+        self._stop = False
+        self.runtime.set_dispatch_handler(self._on_dispatch)
+
+    # dispatch arrives on the pump thread; queue for the main thread
+    def _on_dispatch(self, m: dict) -> None:
+        spec: TaskSpec = m["spec"]
+        if self.actor_instance is not None and spec.is_actor_task and (
+                self.actor_spec.max_concurrency > 1 or self.actor_spec.is_async_actor):
+            # concurrent/async actors bypass the serial queue
+            if self.actor_spec.is_async_actor:
+                asyncio.run_coroutine_threadsafe(
+                    self._execute_async(m), self._async_loop)
+            else:
+                self._thread_pool.submit(self._execute, m)
+        else:
+            self._queue.put(m)
+
+    def run_loop(self) -> None:
+        while not self._stop:
+            try:
+                m = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                if self.runtime._stopped.is_set():
+                    break
+                continue
+            self._execute(m)
+
+    # --------------------------------------------------------- execution
+    def _load_function(self, key: str):
+        fn = self._functions.get(key)
+        if fn is None:
+            blob = self.runtime.fetch_function(key)
+            if blob is None:
+                raise RuntimeError(f"function {key} not found in function store")
+            fn = cloudpickle.loads(blob)
+            self._functions[key] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec, inline_args: Dict[bytes, bytes],
+                      arg_errors: Dict[bytes, bytes]):
+        # seed inline metas so get() short-circuits
+        for b, blob in inline_args.items():
+            self.runtime.seed_meta(b, {"object_id": b, "inline": blob})
+        for b, err in arg_errors.items():
+            raise P.loads(err)
+        dep_values = []
+        for _, oid in spec.arg_refs:
+            b = oid.binary()
+            meta = {"object_id": b, "inline": inline_args.get(b)}
+            if inline_args.get(b) is not None:
+                value = self.runtime._materialize(oid, meta)
+            else:
+                from ray_tpu.core.object_ref import ObjectRef
+                value = self.runtime._get_one(
+                    ObjectRef(oid, _register=False),
+                    self.runtime.config.rpc_timeout_s * 4)
+            dep_values.append(value)
+        args, kwargs = (), {}
+        if spec.args_blob:
+            (args, kwargs), _ = self.runtime.serialization.deserialize_from_view(
+                memoryview(spec.args_blob))
+        args = tuple(dep_values[a.index] if isinstance(a, _ArgPlaceholder) else a
+                     for a in args)
+        kwargs = {k: dep_values[v.index] if isinstance(v, _ArgPlaceholder) else v
+                  for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _execute(self, m: dict) -> None:
+        spec: TaskSpec = m["spec"]
+        self.runtime.current_task_id = spec.task_id
+        start = time.time()
+        error_blob = None
+        retriable = True
+        results = []
+        values: Optional[list] = None
+        try:
+            args, kwargs = self._resolve_args(
+                spec, m.get("inline_args") or {}, m.get("arg_errors") or {})
+            if spec.is_actor_creation:
+                values = [self._create_actor_instance(spec, args, kwargs)]
+            elif spec.is_actor_task:
+                values = self._run_actor_method(spec, args, kwargs)
+            else:
+                fn = self._load_function(spec.function.key())
+                out = fn(*args, **kwargs)
+                values = list(out) if spec.num_returns > 1 else [out]
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task returned {len(values)} values, expected "
+                    f"{spec.num_returns}")
+        except KeyboardInterrupt:
+            error_blob = P.dumps(TaskCancelledError(spec.task_id))
+            retriable = False
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, TaskError):
+                err = e
+            else:
+                err = TaskError.from_exception(
+                    spec.name or spec.function.qualname, e)
+            error_blob = P.dumps(err)
+            retriable = bool(spec.retry_exceptions)
+            logger.warning("task %s failed:\n%s", spec.name,
+                           err.traceback_str if hasattr(err, "traceback_str") else err)
+        if error_blob is None:
+            for i, value in enumerate(values):
+                oid = ObjectID.for_task_return(spec.task_id, i + 1)
+                try:
+                    meta = self.runtime._store_value(oid, value, notify=False)
+                except BaseException as e:  # noqa: BLE001
+                    error_blob = P.dumps(TaskError.from_exception(
+                        spec.name or spec.function.qualname, e))
+                    results = []
+                    break
+                results.append(meta)
+        if error_blob is not None:
+            results = [{"object_id": oid.binary()}
+                       for oid in spec.return_ids()]
+        self.runtime._send(P.TASK_DONE, {
+            "task_id": spec.task_id.binary(),
+            "results": results,
+            "error": error_blob,
+            "retriable": retriable,
+            "owner": spec.owner.binary() if spec.owner else None,
+            "spec": spec if spec.is_actor_task else None,
+        })
+        self.runtime.record_span(
+            spec.name or spec.function.qualname, start, time.time() - start,
+            task_id=spec.task_id.hex())
+        self.runtime.current_task_id = self.runtime._driver_task_id
+
+    async def _execute_async(self, m: dict) -> None:
+        async with self._async_sema:
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self._execute_async_inner(m))
+
+    def _execute_async_inner(self, m: dict) -> None:
+        # For async actors, coroutine methods run on the loop; delegate
+        # through _execute with coroutine awaiting inside _run_actor_method.
+        self._execute(m)
+
+    # ------------------------------------------------------------- actors
+    def _create_actor_instance(self, spec: TaskSpec, args, kwargs):
+        cls = self._load_function(spec.function.key())
+        if spec.runtime_env:
+            self._apply_runtime_env(spec.runtime_env)
+        self.actor_instance = cls(*args, **kwargs)
+        self.actor_spec = spec
+        self.runtime._current_actor_id = spec.actor_id
+        if spec.max_concurrency > 1 and not spec.is_async_actor:
+            from concurrent.futures import ThreadPoolExecutor
+            self._thread_pool = ThreadPoolExecutor(spec.max_concurrency)
+        if spec.is_async_actor:
+            self._async_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self._async_loop.run_forever,
+                                 name="actor-asyncio", daemon=True)
+            t.start()
+            fut = asyncio.run_coroutine_threadsafe(
+                self._make_sema(spec.max_concurrency), self._async_loop)
+            fut.result()
+        return None
+
+    async def _make_sema(self, n: int) -> None:
+        self._async_sema = asyncio.Semaphore(max(1, n))
+
+    def _run_actor_method(self, spec: TaskSpec, args, kwargs):
+        if self.actor_instance is None:
+            from ray_tpu.exceptions import ActorDiedError
+            raise ActorDiedError(spec.actor_id, "no instance in this worker")
+        name = spec.function.qualname
+        if name == "__ray_ready__":
+            return [True]
+        if name == "__ray_terminate__":
+            self._stop = True
+            threading.Thread(target=self._delayed_exit, daemon=True).start()
+            return [None]
+        method = getattr(self.actor_instance, name)
+        out = method(*args, **kwargs)
+        if asyncio.iscoroutine(out):
+            if self._async_loop is not None and \
+                    threading.current_thread().name != "actor-asyncio":
+                fut = asyncio.run_coroutine_threadsafe(out, self._async_loop)
+                out = fut.result()
+            else:
+                out = asyncio.new_event_loop().run_until_complete(out)
+        return list(out) if spec.num_returns > 1 else [out]
+
+    def _delayed_exit(self):
+        time.sleep(0.2)
+        os._exit(0)
+
+    @staticmethod
+    def _apply_runtime_env(env: dict) -> None:
+        """Subset of the reference runtime_env (env_vars, working_dir);
+        pip/conda are not applicable in a hermetic TPU image."""
+        for k, v in (env.get("env_vars") or {}).items():
+            os.environ[k] = str(v)
+        wd = env.get("working_dir")
+        if wd and os.path.isdir(wd):
+            os.chdir(wd)
+            if wd not in sys.path:
+                sys.path.insert(0, wd)
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s: %(message)s")
+    session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+    node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    shm_session = os.environ["RAY_TPU_SHM_SESSION"]
+    runtime = Runtime("worker", session_dir, node_id, worker_id, shm_session)
+    set_global_worker(runtime)
+    runtime.register()
+    executor = WorkerExecutor(runtime)
+    try:
+        executor.run_loop()
+    finally:
+        runtime.shutdown()
+
+
+if __name__ == "__main__":
+    main()

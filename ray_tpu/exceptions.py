@@ -1,0 +1,125 @@
+"""Error model: exceptions stored as task results and re-raised at ``get``.
+
+Equivalent of the reference's ``python/ray/exceptions.py`` (RayTaskError
+:46, RayActorError, ObjectLostError, TaskCancelledError, OutOfMemoryError).
+A failed task's result object *is* its exception; ``ray_tpu.get`` re-raises
+it on the caller with the remote traceback attached.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution.
+
+    Stored as the task's return object; re-raised at ``get`` with the remote
+    traceback string (reference: RayTaskError.as_instanceof_cause).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str,
+                 cause: Optional[BaseException] = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(
+            f"Task {function_name} failed.\nRemote traceback:\n{traceback_str}"
+        )
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: BaseException) -> "TaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        return cls(function_name, tb, cause=exc)
+
+    def __reduce__(self):
+        # cause may not be picklable; degrade to its repr
+        cause = self.cause
+        try:
+            import pickle
+            pickle.dumps(cause)
+        except Exception:
+            cause = None
+        return (TaskError, (self.function_name, self.traceback_str, cause))
+
+
+class ActorError(RayTpuError):
+    """Base for actor-related failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead; pending and future calls fail with this.
+
+    Reference: RayActorError / ActorDiedError (python/ray/exceptions.py),
+    produced by GcsActorManager death notifications.
+    """
+
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} died: {reason}")
+
+    def __reduce__(self):
+        return (ActorDiedError, (self.actor_id, self.reason))
+
+
+class ActorUnavailableError(ActorError):
+    """Actor temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object's value was lost and could not be reconstructed via lineage.
+
+    Reference: python/ray/exceptions.py ObjectLostError and the recovery
+    path in src/ray/core_worker/object_recovery_manager.h:90.
+    """
+
+    def __init__(self, object_ref=None, reason: str = "all copies lost"):
+        self.object_ref = object_ref
+        self.reason = reason
+        super().__init__(f"Object {object_ref} lost: {reason}")
+
+    def __reduce__(self):
+        return (ObjectLostError, (self.object_ref, self.reason))
+
+
+class OwnerDiedError(ObjectLostError):
+    def __init__(self, object_ref=None):
+        super(ObjectLostError, self).__init__(
+            f"Object {object_ref} unrecoverable: owner died")
+        self.object_ref = object_ref
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled")
+
+    def __reduce__(self):
+        return (TaskCancelledError, (self.task_id,))
+
+
+class OutOfMemoryError(RayTpuError):
+    """Raised when the node memory monitor kills a task (reference:
+    src/ray/common/memory_monitor.h + worker_killing_policy.h)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get(..., timeout=)`` expired before the object was ready."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """Shared-memory store is full and eviction/spill could not make room."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Failed to set up a task/actor runtime environment."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Too many in-flight calls to an actor (max_pending_calls)."""

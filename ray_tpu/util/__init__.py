@@ -1,0 +1,27 @@
+"""Utility libraries (reference: ``python/ray/util/``)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Queue
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+    placement_group_table,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "ActorPool",
+    "Queue",
+    "PlacementGroup",
+    "placement_group",
+    "remove_placement_group",
+    "placement_group_table",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

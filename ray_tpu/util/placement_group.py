@@ -1,0 +1,76 @@
+"""Placement groups: gang resource reservations.
+
+Equivalent of the reference's ``python/ray/util/placement_group.py`` over
+the GCS placement-group manager (``gcs_placement_group_manager.h:230``,
+2-phase commit scheduler ``gcs_placement_group_scheduler.h:419``). For TPU,
+a STRICT_PACK group over ``{"TPU": chips_per_host}`` bundles is the unit
+that pins a pod slice's hosts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.core import protocol as P
+from ray_tpu.core.global_state import global_worker
+from ray_tpu.core.ids import PlacementGroupID
+from ray_tpu.core.task_spec import Bundle, PlacementGroupSpec
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str, state: str = "PENDING",
+                 bundle_nodes: Optional[List[bytes]] = None):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+        self._state = state
+        self.bundle_nodes = bundle_nodes or []
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the group is placed (reference returns an ObjectRef;
+        a blocking bool keeps the API surface minimal)."""
+        if self._state == "CREATED":
+            return True
+        w = global_worker()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with w.pg_cond:
+            while True:
+                ev = w.pg_events.get(self.id.binary())
+                if ev and ev.get("state") == "CREATED":
+                    self._state = "CREATED"
+                    self.bundle_nodes = ev.get("bundle_nodes", [])
+                    return True
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                w.pg_cond.wait(timeout=min(0.2, remaining) if remaining else 0.2)
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        return self.ready(timeout=timeout_seconds)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs, self.strategy,
+                                 self._state, self.bundle_nodes))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    w = global_worker()
+    spec = PlacementGroupSpec(
+        pg_id=PlacementGroupID.of(w.job_id),
+        bundles=[Bundle(resources=dict(b)) for b in bundles],
+        strategy=strategy, name=name, creator_job=w.job_id)
+    reply = w.request(P.CREATE_PG, {"spec": spec})
+    return PlacementGroup(spec.pg_id, bundles, strategy,
+                          state=reply["state"],
+                          bundle_nodes=reply.get("bundle_nodes"))
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    global_worker().request(P.REMOVE_PG, {"pg_id": pg.id.binary()})
+
+
+def placement_group_table() -> List[dict]:
+    return global_worker().state_query("placement_groups")

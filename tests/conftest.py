@@ -1,0 +1,39 @@
+"""Test fixtures (modeled on the reference's
+``python/ray/tests/conftest.py``: ``ray_start_regular`` /
+``ray_start_regular_shared``).
+
+TPU note: tests run on a virtual 8-device CPU mesh —
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` must be set before
+jax initializes, so it happens here at conftest import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+    info = ray_tpu.init(num_cpus=4, _num_initial_workers=2,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    import ray_tpu
+    info = ray_tpu.init(num_cpus=4, _num_initial_workers=2,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
