@@ -383,6 +383,13 @@ class Controller:
             if node is not None:
                 self._send(node.identity, P.FREE_OBJECT, {"object_id": b})
         self.dep_waiters.pop(b, None)
+        # unblock anyone still waiting on the (now freed) object
+        waiters = self.local_waiters.pop(b, [])
+        if waiters:
+            from ray_tpu.exceptions import ObjectLostError
+            err = P.dumps(ObjectLostError(object_id, "freed: refcount zero"))
+            for identity, rid in waiters:
+                self._reply(identity, rid, {"error": err})
 
     # --------------------------------------------------------------- tasks
     def _h_submit_task(self, identity: bytes, m: dict) -> None:

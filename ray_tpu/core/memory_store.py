@@ -31,10 +31,11 @@ class InProcessStore:
         self._objects: Dict[ObjectID, _Entry] = {}
         self._callbacks: Dict[ObjectID, List[Callable]] = {}
 
-    def put(self, object_id: ObjectID, value, error: Optional[BaseException] = None) -> None:
+    def put(self, object_id: ObjectID, value, error: Optional[BaseException] = None,
+            force: bool = False) -> None:
         with self._lock:
             e = self._objects.setdefault(object_id, _Entry())
-            if e.ready:
+            if e.ready and not force:
                 return  # idempotent (retries may double-complete)
             e.value = value
             e.error = error

@@ -180,6 +180,14 @@ class NodeManager:
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_SHM_SESSION"] = self.shm_session
+        # ensure workers can import ray_tpu (and the driver's cwd modules)
+        import ray_tpu
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        extra_paths = [pkg_parent, os.getcwd()]
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in extra_paths + ([existing] if existing else []) if p)
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out"), "ab")

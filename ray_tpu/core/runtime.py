@@ -17,6 +17,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from queue import SimpleQueue
+
 import zmq
 
 from ray_tpu.core import protocol as P
@@ -73,7 +75,9 @@ class Runtime:
         self._task_counter = 0
         self._lock = threading.Lock()
         self._driver_task_id = TaskID.for_driver(self.job_id)
-        self.current_task_id = self._driver_task_id
+        # task context is thread-local: concurrent actor tasks must not
+        # attribute puts/events to each other's task ids
+        self._task_ctx = threading.local()
         self._current_actor_id: Optional[ActorID] = None
 
         self.dispatch_handler: Optional[Callable[[dict], None]] = None
@@ -86,6 +90,13 @@ class Runtime:
         self._stopped = threading.Event()
         self._timeline_buf: List[dict] = []
 
+        # completion callbacks must not run on the pump thread (they may
+        # materialize via blocking RPCs the pump itself fulfills)
+        self._cb_queue: "SimpleQueue[Optional[Callable]]" = SimpleQueue()
+        self._cb_thread = threading.Thread(
+            target=self._cb_loop, name=f"{kind}-callbacks", daemon=True)
+        self._cb_thread.start()
+
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.DEALER)
         self.sock.setsockopt(zmq.IDENTITY, self.worker_id.binary())
@@ -95,6 +106,24 @@ class Runtime:
         self._pump = threading.Thread(target=self._pump_loop,
                                       name=f"{kind}-pump", daemon=True)
         self._pump.start()
+
+    @property
+    def current_task_id(self) -> TaskID:
+        return getattr(self._task_ctx, "task_id", self._driver_task_id)
+
+    @current_task_id.setter
+    def current_task_id(self, value: TaskID) -> None:
+        self._task_ctx.task_id = value
+
+    def _cb_loop(self) -> None:
+        while True:
+            fn = self._cb_queue.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:
+                logger.exception("completion callback failed")
 
     # ------------------------------------------------------------ transport
     def _send(self, mtype: bytes, payload: Any) -> None:
@@ -182,6 +211,7 @@ class Runtime:
         self.reference_counter.flush()
         self.flush_timeline()
         self._stopped.set()
+        self._cb_queue.put(None)
         try:
             self.sock.close(0)
         except Exception:
@@ -253,7 +283,37 @@ class Runtime:
         return out[0] if single else out
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]):
+        """Dual-path get (reference: CoreWorker::GetObjects dual-path
+        memory-store/plasma resolution, core_worker.cc:1478): try the
+        in-process store, then local shm, then ask the controller for the
+        location (which blocks server-side until the object exists and is
+        local, triggering transfer/reconstruction as needed)."""
         oid = ref.id()
+        b = oid.binary()
+        found, value = self.memory_store.try_get(oid)
+        if found and not isinstance(value, _MetaReady):
+            return value
+        if isinstance(value, _MetaReady):
+            return self._materialize(oid, value.meta)
+        with self._meta_lock:
+            meta = self._meta.get(b)
+        if meta is not None:
+            return self._materialize(oid, meta)
+        if self.shm is not None and self.shm.contains(oid):
+            return self._materialize(
+                oid, {"object_id": b, "node_id": self.node_id.binary()})
+        # Not local: if we own the object its TASK_RESULT will be pushed to
+        # us; otherwise ask the controller (async; reply lands in the memory
+        # store as _MetaReady). Block with the caller's timeout either way.
+        if ref.owner is None or ref.owner != self.worker_id:
+            with self._meta_lock:
+                probing = b in self._pending_locations
+                if not probing:
+                    self._pending_locations[b] = b
+            if not probing:
+                rid = self.replies.new_request()
+                threading.Thread(target=self._bg_location_probe,
+                                 args=(b, rid), daemon=True).start()
         value = self.memory_store.get(oid, timeout)
         if isinstance(value, _MetaReady):
             value = self._materialize(oid, value.meta)
@@ -262,12 +322,12 @@ class Runtime:
     def _materialize(self, oid: ObjectID, meta: dict):
         if meta.get("error") is not None:
             err = P.loads(meta["error"])
-            self.memory_store.put(oid, None, error=err)
+            self.memory_store.put(oid, None, error=err, force=True)
             raise err
         if meta.get("inline") is not None:
             value, _ = self.serialization.deserialize_from_view(
                 memoryview(meta["inline"]))
-            self.memory_store.put(oid, value)
+            self.memory_store.put(oid, value, force=True)
             return value
         # shared-memory object
         node_b = meta.get("node_id")
@@ -276,7 +336,7 @@ class Runtime:
             view = self.shm.get_view(oid, timeout=5.0)
             if view is not None:
                 value, _ = self.serialization.deserialize_from_view(view)
-                self.memory_store.put(oid, value)
+                self.memory_store.put(oid, value, force=True)
                 return value
         # remote: ask controller to make it local (or hand us inline bytes)
         reply = self.request(P.GET_LOCATION, {
@@ -284,12 +344,12 @@ class Runtime:
             timeout=self.config.rpc_timeout_s * 4)
         if reply.get("error") is not None:
             err = P.loads(reply["error"])
-            self.memory_store.put(oid, None, error=err)
+            self.memory_store.put(oid, None, error=err, force=True)
             raise err
         if reply.get("inline") is not None:
             value, _ = self.serialization.deserialize_from_view(
                 memoryview(reply["inline"]))
-            self.memory_store.put(oid, value)
+            self.memory_store.put(oid, value, force=True)
             return value
         if self.shm is None:
             raise RuntimeError("no shm store attached; cannot fetch object")
@@ -298,12 +358,16 @@ class Runtime:
             from ray_tpu.exceptions import ObjectLostError
             raise ObjectLostError(oid)
         value, _ = self.serialization.deserialize_from_view(view)
-        self.memory_store.put(oid, value)
+        self.memory_store.put(oid, value, force=True)
         return value
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None,
              fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError(
+                f"num_returns ({num_returns}) exceeds the number of refs "
+                f"({len(refs)})")
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: List[ObjectRef] = []
@@ -347,17 +411,21 @@ class Runtime:
             payload = {"object_id": object_id_b, "rid": rid,
                        "want_node": self.node_id.binary()}
             self._send(P.GET_LOCATION, payload)
-            reply = self.replies.wait(rid, None)
+            # bounded wait so abandoned probes don't leak threads forever
+            reply = self.replies.wait(rid, 600.0)
             with self._meta_lock:
                 self._meta[object_id_b] = reply
             self.memory_store.put(ObjectID(object_id_b), _MetaReady(reply))
         except Exception:
             pass
+        finally:
+            with self._meta_lock:
+                self._pending_locations.pop(object_id_b, None)
 
     def register_completion_callback(self, ref: ObjectRef, cb: Callable) -> None:
         oid = ref.id()
 
-        def wrapper(value, error):
+        def materialize_and_call(value, error):
             if isinstance(value, _MetaReady):
                 try:
                     value = self._materialize(oid, value.meta)
@@ -365,6 +433,11 @@ class Runtime:
                 except BaseException as e:  # noqa: BLE001
                     value, error = None, e
             cb(value, error)
+
+        def wrapper(value, error):
+            # hop off the pump thread: materialization may issue blocking
+            # RPCs that only the pump can fulfill
+            self._cb_queue.put(lambda: materialize_and_call(value, error))
 
         self.memory_store.on_ready(oid, wrapper)
 

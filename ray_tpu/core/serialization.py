@@ -81,16 +81,16 @@ class SerializationContext:
 
     def __init__(self, worker=None):
         self.worker = worker
-        self._contained: list = []
-        self._deserialized: list = []
         self._custom_serializers = {}
 
     # -- hooks called from ObjectRef.__reduce__ --
+    # ref lists live in thread-local state so concurrent (de)serialize calls
+    # (threaded actors) don't clobber each other's tracking
     def record_contained_ref(self, ref) -> None:
-        self._contained.append(ref)
+        getattr(_thread_local, "contained", []).append(ref)
 
     def record_deserialized_ref(self, ref) -> None:
-        self._deserialized.append(ref)
+        getattr(_thread_local, "deserialized", []).append(ref)
 
     def register_custom_serializer(self, cls, serializer, deserializer):
         self._custom_serializers[cls] = (serializer, deserializer)
@@ -98,29 +98,31 @@ class SerializationContext:
     # -- main entry points --
     def serialize(self, value) -> SerializedObject:
         buffers: List[pickle.PickleBuffer] = []
-        self._contained = []
         _thread_local.active_ctx = self
+        _thread_local.contained = contained = []
         try:
             value = _pre_serialize(value)
             meta = cloudpickle.dumps(
                 value, protocol=5, buffer_callback=buffers.append)
         finally:
             _thread_local.active_ctx = None
+            _thread_local.contained = []
         views = []
         for pb in buffers:
             v = pb.raw()
             views.append(v)
-        return SerializedObject(meta, views, list(self._contained))
+        return SerializedObject(meta, views, contained)
 
     def deserialize(self, meta: bytes, buffers: List[memoryview]) -> Tuple[object, list]:
         """Returns (value, deserialized_refs)."""
-        self._deserialized = []
         _thread_local.active_ctx = self
+        _thread_local.deserialized = deserialized = []
         try:
             value = pickle.loads(meta, buffers=buffers)
         finally:
             _thread_local.active_ctx = None
-        return value, list(self._deserialized)
+            _thread_local.deserialized = []
+        return value, list(deserialized)
 
     def deserialize_from_view(self, view: memoryview) -> Tuple[object, list]:
         n_buffers, len_meta = struct.unpack_from("<IQ", view, 0)
