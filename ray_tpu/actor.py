@@ -78,6 +78,9 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> "ActorHandle":
         opts = self._opts
+        # default-resource actors release their scheduling CPU once alive
+        hold = any(opts.get(k) not in (None, _ACTOR_DEFAULT_OPTS.get(k))
+                   for k in ("num_cpus", "num_tpus", "resources", "memory"))
         w = global_worker()
         descriptor = self._ensure_exported(w)
         actor_id = ActorID.of(w.job_id)
@@ -95,6 +98,7 @@ class ActorClass:
             resources=resources_from_opts(opts),
             scheduling_strategy=make_scheduling_strategy(opts),
             is_actor_creation=True,
+            hold_resources=hold,
             actor_id=actor_id,
             max_restarts=opts["max_restarts"],
             max_task_retries=opts["max_task_retries"],

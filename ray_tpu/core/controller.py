@@ -748,6 +748,9 @@ class Controller:
                 self.scheduler.release(t.node_id, self._sched_res(t.spec))
             return
         info.state = "ALIVE"
+        if not t.spec.hold_resources and t.node_id is not None:
+            # default-resource actor: scheduling CPU released once alive
+            self.scheduler.release(t.node_id, self._sched_res(t.spec))
         info.worker_id = WorkerID(worker) if len(worker) == WorkerID.SIZE else None
         self._publish(f"actor:{t.spec.actor_id.hex()}",
                       {"state": "ALIVE", "actor_id": aid})
@@ -961,7 +964,7 @@ class Controller:
         if info is None:
             return
         self.actor_workers.pop(aid, None)
-        if info.node_id is not None:
+        if info.node_id is not None and info.spec.hold_resources:
             self.scheduler.release(info.node_id, self._sched_res(info.spec))
         if info.num_restarts < info.spec.max_restarts or info.spec.max_restarts < 0:
             info.num_restarts += 1

@@ -75,6 +75,10 @@ class TaskSpec:
     concurrency_group: str = ""
     # actor creation fields
     is_actor_creation: bool = False
+    #: Reference semantics: by default an actor needs 1 CPU to *schedule*
+    #: but holds 0 while alive (python/ray/actor.py default num_cpus); only
+    #: explicitly requested resources (TPU, custom) are held for life.
+    hold_resources: bool = True
     max_restarts: int = 0
     max_task_retries: int = 0
     max_concurrency: int = 1

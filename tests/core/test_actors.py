@@ -1,0 +1,124 @@
+"""Actor semantics tests (modeled on the reference's
+``python/ray/tests/test_actor.py`` / ``test_advanced.py``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_actor_state_and_order(ray_start_shared):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(100)
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs[-1], timeout=60) == 120
+    assert ray_tpu.get(c.value.remote(), timeout=30) == 120
+
+
+def test_actor_exception(ray_start_shared):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise KeyError("nope")
+
+        def fine(self):
+            return "ok"
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(b.boom.remote(), timeout=60)
+    # actor survives method exceptions
+    assert ray_tpu.get(b.fine.remote(), timeout=30) == "ok"
+
+
+def test_named_actor_and_kill(ray_start_shared):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    svc = Svc.options(name="svc1").remote()
+    assert ray_tpu.get(svc.ping.remote(), timeout=60) == "pong"
+    h = ray_tpu.get_actor("svc1")
+    assert ray_tpu.get(h.ping.remote(), timeout=30) == "pong"
+
+    ray_tpu.kill(svc)
+    time.sleep(1.0)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(svc.ping.remote(), timeout=30)
+
+
+def test_actor_handle_in_task(ray_start_shared):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    @ray_tpu.remote
+    def writer(store, k, v):
+        return ray_tpu.get(store.set.remote(k, v))
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, "x", 1), timeout=60)
+    assert ray_tpu.get(s.get.remote("x"), timeout=30) == 1
+
+
+def test_async_actor(ray_start_shared):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, t):
+            import asyncio
+            await asyncio.sleep(t)
+            return t
+
+    a = AsyncWorker.remote()
+    t0 = time.monotonic()
+    # three 1s sleeps overlapping on the actor's event loop
+    refs = [a.work.remote(1.0) for _ in range(3)]
+    assert ray_tpu.get(refs, timeout=60) == [1.0, 1.0, 1.0]
+    assert time.monotonic() - t0 < 20
+
+
+def test_threaded_actor(ray_start_shared):
+    @ray_tpu.remote(max_concurrency=4)
+    class Par:
+        def slow(self):
+            time.sleep(0.8)
+            return 1
+
+    p = Par.remote()
+    ray_tpu.get(p.slow.remote(), timeout=60)  # warm
+    t0 = time.monotonic()
+    assert sum(ray_tpu.get([p.slow.remote() for _ in range(4)], timeout=60)) == 4
+    assert time.monotonic() - t0 < 3.0
+
+
+def test_actor_pool(ray_start_shared):
+    @ray_tpu.remote
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    from ray_tpu.util import ActorPool
+    pool = ActorPool([Sq.remote(), Sq.remote()])
+    out = list(pool.map(lambda a, v: a.sq.remote(v), [1, 2, 3, 4]))
+    assert out == [1, 4, 9, 16]

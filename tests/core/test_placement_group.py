@@ -1,0 +1,45 @@
+"""Placement group tests (modeled on the reference's
+``python/ray/tests/test_placement_group*.py``)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+def test_pg_create_and_use(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0))
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    assert ray_tpu.get(where.remote(), timeout=120)
+    rows = placement_group_table()
+    assert rows and rows[0]["state"] == "CREATED"
+    remove_placement_group(pg)
+
+
+def test_pg_infeasible_pends(ray_start_regular):
+    pg = placement_group([{"CPU": 100}], strategy="STRICT_PACK")
+    assert not pg.ready(timeout=1.0)  # never placeable on 4 CPUs
+    remove_placement_group(pg)
+
+
+def test_pg_strict_pack_atomicity(ray_start_regular):
+    # 2+2 CPUs fits the 4-CPU node; a second identical PG must pend
+    pg1 = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+    assert pg1.ready(timeout=30)
+    pg2 = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+    assert not pg2.ready(timeout=1.0)
+    remove_placement_group(pg1)
+    # freed resources let pg2 place
+    assert pg2.ready(timeout=30)
+    remove_placement_group(pg2)
