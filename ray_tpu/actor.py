@@ -152,7 +152,7 @@ class ActorHandle:
         return self._actor_id
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        if name.startswith("_") and not name.startswith("__ray"):
             raise AttributeError(name)
         return ActorMethod(self, name)
 

@@ -218,6 +218,11 @@ class WorkerExecutor:
         name = spec.function.qualname
         if name == "__ray_ready__":
             return [True]
+        if name == "__ray_call__":
+            # generic invoke: fn(actor_instance, *args, **kwargs)
+            fn, rest = args[0], args[1:]
+            out = fn(self.actor_instance, *rest, **kwargs)
+            return list(out) if spec.num_returns > 1 else [out]
         if name == "__ray_terminate__":
             self._stop = True
             threading.Thread(target=self._delayed_exit, daemon=True).start()

@@ -1,0 +1,37 @@
+"""Parallelism layer: meshes, sharding, collectives.
+
+The TPU-native replacement for the reference's NCCL/GLOO collective layer
+(``python/ray/util/collective/``) and the parallelism strategies inventoried
+in SURVEY.md §2.5: device meshes with named axes (dp/fsdp/tp/sp/ep/pp),
+GSPMD sharding rules, and in-graph XLA collectives.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    local_mesh,
+    chip_spec,
+    ChipSpec,
+)
+from ray_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_to_mesh_axes,
+    shard_params,
+    batch_sharding,
+    constrain,
+)
+from ray_tpu.parallel import collective
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "local_mesh",
+    "chip_spec",
+    "ChipSpec",
+    "ShardingRules",
+    "logical_to_mesh_axes",
+    "shard_params",
+    "batch_sharding",
+    "constrain",
+    "collective",
+]

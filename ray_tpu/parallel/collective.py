@@ -1,0 +1,337 @@
+"""Collective communication API.
+
+Equivalent of the reference's ``ray.util.collective``
+(``python/ray/util/collective/collective.py``: ``init_collective_group``
+:120, ``allreduce`` :258, ``barrier`` :298, ``reduce`` :311, ``broadcast``
+:373, ``allgather`` :423, ``reducescatter`` :472, ``send``/``recv``)
+re-designed for TPU, where eager collectives don't exist — every collective
+is staged into a compiled XLA program (SURVEY.md §7 hard part 1).
+
+Backends:
+
+- ``"xla"`` — in-graph collectives over a device mesh. Eager-looking calls
+  dispatch cached jitted stubs keyed by (group, op, shape, dtype); within a
+  process they run over the caller's local devices; once
+  ``jax.distributed`` is initialized (multi-host rendezvous below), the
+  same stubs are global-SPMD and ride ICI/DCN. This replaces NCCL.
+- ``"host"`` — control-plane collectives for cross-actor *host* (CPU)
+  values, via the controller KV store (the role GLOO plays in the
+  reference). Rendezvous mirrors the reference's ``NCCLUniqueIDStore``
+  named actor (``collective_group/nccl_collective_group.py:28-68``) using
+  the internal KV instead.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_groups: Dict[str, "Group"] = {}
+_lock = threading.Lock()
+
+
+class Group:
+    def __init__(self, name: str, world_size: int, rank: int, backend: str):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.backend = backend
+        self._seq = 0
+        self._stubs: Dict[Tuple, object] = {}
+        self._mesh = None
+
+    # ---- xla backend ----
+    def mesh(self):
+        if self._mesh is None:
+            from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+            import jax
+            self._mesh = build_mesh(MeshSpec(tp=-1), jax.devices())
+        return self._mesh
+
+    def _stub(self, op: str, shape, dtype, **kw):
+        key = (op, tuple(shape), str(dtype), tuple(sorted(kw.items())))
+        stub = self._stubs.get(key)
+        if stub is None:
+            stub = _build_stub(self.mesh(), op, **kw)
+            self._stubs[key] = stub
+        return stub
+
+    def next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+
+def _build_stub(mesh, op: str, **kw):
+    """Compile one collective as a shard_map program over the mesh.
+
+    Eager-call semantics match ``ray.util.collective``'s multi-rank model
+    mapped onto one SPMD program: the input is the per-rank tensors stacked
+    on dim 0 (world, \\*shape); ranks = mesh devices in axis order.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    axes = mesh.axis_names
+    reduce_op = kw.get("reduce_op", "sum")
+
+    def _red(x, ax):
+        return {"sum": jax.lax.psum, "max": jax.lax.pmax,
+                "min": jax.lax.pmin, "mean": jax.lax.pmean}[reduce_op](x, ax)
+
+    if op == "allreduce":
+        # (world, *shape) sharded on dim 0 -> reduced (*shape), replicated
+        def f(x):
+            return _red(x[0], axes)
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P(axes), out_specs=P(),
+            check_vma=False))
+    if op == "allgather":
+        # (world, *shape) sharded -> (world, *shape) replicated everywhere
+        def f(x):
+            return jax.lax.all_gather(x[0], axes, axis=0, tiled=False)
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P(axes), out_specs=P(),
+            check_vma=False))
+    if op == "reducescatter":
+        # (world, *shape) -> (world, shape[0]/world, ...): rank i gets the
+        # i-th chunk of the elementwise sum
+        def f(x):
+            return _red(x[0], axes)
+        def g(x):
+            import jax.numpy as jnp
+            summed = jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=P(axes), out_specs=P(),
+                check_vma=False))(x)
+            world = x.shape[0]
+            return jnp.stack(jnp.split(summed, world, axis=0))
+        return g
+    raise ValueError(f"unknown collective {op}")
+
+
+# ------------------------------------------------------------------ API
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "xla",
+                          group_name: str = "default") -> None:
+    """Join a collective group (call from every participating actor)."""
+    with _lock:
+        _groups[group_name] = Group(group_name, world_size, rank, backend)
+    if backend == "host":
+        _host_rendezvous(group_name, world_size, rank)
+
+
+def _actor_join(actor_self, world_size, rank, backend, group_name):
+    init_collective_group(world_size, rank, backend, group_name)
+    return rank
+
+
+def create_collective_group(actors: List, world_size: int, ranks: List[int],
+                            backend: str = "xla",
+                            group_name: str = "default") -> None:
+    """Declarative creation (reference: ``create_collective_group`` :151):
+    tell each actor to join via the generic ``__ray_call__`` invoke."""
+    import ray_tpu
+    ray_tpu.get([
+        a.__ray_call__.remote(_actor_join, world_size, r, backend, group_name)
+        for a, r in zip(actors, ranks)], timeout=300)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        _groups.pop(group_name, None)
+
+
+def get_group(group_name: str = "default") -> Group:
+    g = _groups.get(group_name)
+    if g is None:
+        raise ValueError(f"collective group {group_name!r} not initialized")
+    return g
+
+
+def get_rank(group_name: str = "default") -> int:
+    return get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return get_group(group_name).world_size
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+# ---- xla-backend data-plane collectives (device arrays) ----
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    g = get_group(group_name)
+    if g.backend == "host":
+        return _host_allreduce(g, tensor, op)
+    return g._stub("allreduce", tensor.shape, tensor.dtype,
+                   reduce_op=op)(tensor)
+
+
+def allgather(tensor, group_name: str = "default"):
+    g = get_group(group_name)
+    if g.backend == "host":
+        return _host_allgather(g, tensor)
+    return g._stub("allgather", tensor.shape, tensor.dtype)(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    g = get_group(group_name)
+    return g._stub("reducescatter", tensor.shape, tensor.dtype,
+                   reduce_op=op)(tensor)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = "sum"):
+    out = allreduce(tensor, group_name, op)
+    g = get_group(group_name)
+    return out if g.rank == dst_rank else tensor
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = get_group(group_name)
+    if g.backend == "host":
+        return _host_broadcast(g, tensor, src_rank)
+    # in-graph: a broadcast is an all-gather of the source shard; with a
+    # replicated input this is identity under SPMD
+    return tensor
+
+
+def barrier(group_name: str = "default") -> None:
+    g = get_group(group_name)
+    if g.backend == "host":
+        _host_barrier(g)
+        return
+    # device barrier: tiny allreduce
+    import jax.numpy as jnp
+    allreduce(jnp.zeros((g.world_size,), jnp.float32), group_name)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    g = get_group(group_name)
+    _kv_put(_key(g, f"p2p/{g.rank}->{dst_rank}/{g.next_seq()}"),
+            _dumps(np.asarray(tensor)))
+
+
+def recv(shape, dtype, src_rank: int, group_name: str = "default"):
+    g = get_group(group_name)
+    key = _key(g, f"p2p/{src_rank}->{g.rank}/{g.next_seq()}")
+    return _loads(_kv_take(key)).reshape(shape).astype(dtype)
+
+
+# ------------------------------------------------ host backend internals
+def _kv(self=None):
+    from ray_tpu.core.global_state import global_worker
+    return global_worker()
+
+
+def _key(g: Group, suffix: str) -> bytes:
+    return f"collective/{g.name}/{suffix}".encode()
+
+
+def _dumps(arr: np.ndarray) -> bytes:
+    import io
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _loads(blob: bytes) -> np.ndarray:
+    import io
+    return np.load(io.BytesIO(blob), allow_pickle=False)
+
+
+def _kv_put(key: bytes, value: bytes) -> None:
+    _kv().kv_put(key, value, ns="collective")
+
+
+def _kv_take(key: bytes, timeout: float = 120.0) -> bytes:
+    w = _kv()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = w.kv_get(key, ns="collective")
+        if v is not None:
+            w.kv_del(key, ns="collective")
+            return v
+        time.sleep(0.005)
+    raise TimeoutError(f"collective recv timed out on {key!r}")
+
+
+def _kv_wait(key: bytes, timeout: float = 120.0) -> bytes:
+    w = _kv()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = w.kv_get(key, ns="collective")
+        if v is not None:
+            return v
+        time.sleep(0.005)
+    raise TimeoutError(f"collective wait timed out on {key!r}")
+
+
+def _host_rendezvous(group_name: str, world_size: int, rank: int) -> None:
+    g = get_group(group_name)
+    _kv_put(_key(g, f"join/{rank}"), b"1")
+    for r in range(world_size):
+        _kv_wait(_key(g, f"join/{r}"))
+
+
+def _host_allreduce(g: Group, tensor, op: str):
+    arr = np.asarray(tensor)
+    seq = g.next_seq()
+    _kv_put(_key(g, f"ar/{seq}/{g.rank}"), _dumps(arr))
+    parts = [_loads(_kv_wait(_key(g, f"ar/{seq}/{r}")))
+             for r in range(g.world_size)]
+    stack = np.stack(parts)
+    out = {"sum": stack.sum(0), "mean": stack.mean(0),
+           "max": stack.max(0), "min": stack.min(0)}[op]
+    return out
+
+
+def _host_allgather(g: Group, tensor):
+    arr = np.asarray(tensor)
+    seq = g.next_seq()
+    _kv_put(_key(g, f"ag/{seq}/{g.rank}"), _dumps(arr))
+    return [_loads(_kv_wait(_key(g, f"ag/{seq}/{r}")))
+            for r in range(g.world_size)]
+
+
+def _host_broadcast(g: Group, tensor, src_rank: int):
+    seq = g.next_seq()
+    if g.rank == src_rank:
+        _kv_put(_key(g, f"bc/{seq}"), _dumps(np.asarray(tensor)))
+        return tensor
+    return _loads(_kv_wait(_key(g, f"bc/{seq}")))
+
+
+def _host_barrier(g: Group) -> None:
+    seq = g.next_seq()
+    _kv_put(_key(g, f"bar/{seq}/{g.rank}"), b"1")
+    for r in range(g.world_size):
+        _kv_wait(_key(g, f"bar/{seq}/{r}"))
+
+
+# --------------------------------------------- multi-host jax rendezvous
+def init_jax_distributed(group_name: str = "train",
+                         coordinator_port: int = 8476,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Multi-host SPMD bring-up: the JAX-distributed equivalent of the
+    reference's torch TCPStore rendezvous (``train/torch/config.py:64-116``).
+    Rank 0 publishes its address in the internal KV; all ranks call
+    ``jax.distributed.initialize`` against it. Call before any jax use in
+    the process."""
+    import socket
+    w = _kv()
+    key = f"jaxdist/{group_name}/coordinator".encode()
+    if process_id == 0:
+        addr = f"{socket.gethostbyname(socket.gethostname())}:{coordinator_port}"
+        w.kv_put(key, addr.encode(), ns="collective")
+    else:
+        addr = _kv_wait(key).decode()
+    import jax
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=num_processes,
+                               process_id=process_id)

@@ -2,21 +2,28 @@
 ``python/ray/tests/conftest.py``: ``ray_start_regular`` /
 ``ray_start_regular_shared``).
 
-TPU note: tests run on a virtual 8-device CPU mesh —
-``XLA_FLAGS=--xla_force_host_platform_device_count=8`` must be set before
-jax initializes, so it happens here at conftest import time.
+TPU note: tests run on a virtual 8-device CPU mesh. This environment
+pins JAX_PLATFORMS=axon via sitecustomize *before* conftest runs, so the
+env-var route is dead — the override must go through jax.config, and
+XLA_FLAGS must be set before the first backend init.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# propagate to worker subprocesses spawned by the node manager
+os.environ.setdefault("RAY_TPU_TEST_CPU_MESH", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -37,3 +44,9 @@ def ray_start_shared():
                         ignore_reinit_error=True)
     yield info
     ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+    return jax.devices()
