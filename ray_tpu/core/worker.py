@@ -258,6 +258,14 @@ class WorkerExecutor:
 def main() -> None:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s: %(message)s")
+    # Honor an explicit platform override before any task imports jax.
+    # (Env-var JAX_PLATFORMS alone is not enough in environments whose
+    # sitecustomize re-pins it at interpreter start — tests set
+    # RAY_TPU_JAX_PLATFORM=cpu to force the virtual CPU mesh in workers.)
+    platform = os.environ.get("RAY_TPU_JAX_PLATFORM")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
     session_dir = os.environ["RAY_TPU_SESSION_DIR"]
     node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])

@@ -1,0 +1,34 @@
+"""TPU-native compute ops: Pallas kernels + XLA-friendly primitives.
+
+This package holds the hot-op layer of the framework. The reference has no
+equivalent (its math lives in torch/CUDA inside user code and integrations);
+here attention, normalization, rotary embeddings and losses are provided as
+first-class jittable ops so the model family and the libraries above share
+one tuned implementation.
+
+- ``flash_attention``: Pallas TPU kernel (VMEM-blocked, MXU matmuls,
+  log-sum-exp streaming softmax), with a pure-XLA fallback for CPU tests.
+- ``ring_attention``: sequence-parallel attention over an ``sp`` mesh axis
+  via ``shard_map`` + ``ppermute`` (the TPU-idiomatic ring attention;
+  SURVEY.md §2.5 — absent in the reference).
+- ``rms_norm`` / ``layer_norm``, ``apply_rotary``, ``cross_entropy_loss``.
+"""
+
+from ray_tpu.ops.norms import rms_norm, layer_norm
+from ray_tpu.ops.rotary import rotary_table, apply_rotary
+from ray_tpu.ops.attention import multihead_attention, attention_reference
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.cross_entropy import cross_entropy_loss
+
+__all__ = [
+    "rms_norm",
+    "layer_norm",
+    "rotary_table",
+    "apply_rotary",
+    "multihead_attention",
+    "attention_reference",
+    "flash_attention",
+    "ring_attention",
+    "cross_entropy_loss",
+]

@@ -1,0 +1,81 @@
+"""Model-facing attention API with automatic kernel dispatch.
+
+Layout here is (batch, seq, num_heads, head_dim) — the layout models carry
+activations in. Dispatch: the Pallas flash kernel on TPU when shapes tile
+cleanly onto the MXU (head_dim % 128 == 0, seq divisible by the block);
+otherwise the pure-XLA reference path (which is what CPU tests exercise).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.flash_attention import flash_attention
+
+_NEG_INF = -1e30
+
+
+def attention_reference(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                        causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Plain masked-softmax attention in f32, layout (B, S, H, D)."""
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        causal_mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        s = jnp.where(causal_mask[None, None], s, _NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+def _can_use_flash(q, k, block: int) -> bool:
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if d % 128 != 0:
+        return False
+    bq, bk = min(block, sq), min(block, sk)
+    return sq % bq == 0 and sk % bk == 0
+
+
+def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                        causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        mask: Optional[jnp.ndarray] = None,
+                        impl: str = "auto",
+                        block_q: int = 512,
+                        block_k: int = 512,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Attention over (batch, seq, heads, head_dim).
+
+    ``impl``: "auto" | "flash" | "reference". Arbitrary ``mask`` forces the
+    reference path (the flash kernel handles only the causal structure).
+    """
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        use_flash = (mask is None and (on_tpu or interpret)
+                     and _can_use_flash(q, k, block_q))
+        impl = "flash" if use_flash else "reference"
+    if impl == "reference" or mask is not None:
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                                   mask=mask)
+    if impl != "flash":
+        raise ValueError(f"unknown attention impl: {impl!r}")
+    qt = jnp.swapaxes(q, 1, 2)    # (B, H, S, D)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = flash_attention(qt, kt, vt, causal=causal, sm_scale=sm_scale,
+                        block_q=block_q, block_k=block_k,
+                        interpret=interpret)
+    return jnp.swapaxes(o, 1, 2)

@@ -1,0 +1,41 @@
+"""Stable softmax cross-entropy for language-model heads.
+
+Computed from logits in float32 with log-sum-exp, optional z-loss
+(stabilizes the softmax normalizer at scale, as in PaLM), and a validity
+mask for padded / shifted-label positions. XLA fuses the reduction with
+the projection that produced the logits, so no Pallas needed here; vocab
+chunking (for very large vocabs) can be layered on later without changing
+the signature.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
+                       mask: Optional[jnp.ndarray] = None,
+                       z_loss_coeff: float = 0.0,
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Mean token cross entropy.
+
+    logits: (..., vocab), labels: (...) int, mask: (...) bool/float of
+    valid positions. Returns (loss, n_valid_tokens) — callers doing
+    data-parallel mean should psum both and divide (exact global mean).
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - label_logit
+    if z_loss_coeff:
+        nll = nll + z_loss_coeff * jnp.square(lse)
+    if mask is None:
+        n = jnp.array(nll.size, jnp.float32)
+        return jnp.sum(nll) / n, n
+    mask = mask.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(nll * mask) / n, n

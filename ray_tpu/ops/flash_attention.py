@@ -1,0 +1,217 @@
+"""Flash attention for TPU: Pallas forward kernel + chunked XLA backward.
+
+Forward: a VMEM-blocked streaming-softmax kernel. Grid is
+(batch, heads, q_blocks, k_blocks) with the k axis innermost so the
+(m, l, acc) scratch accumulators persist across k blocks; matmuls hit the
+MXU in bf16 with float32 accumulation (``preferred_element_type``); the
+log-sum-exp is emitted so the backward pass can recompute P exactly.
+
+Backward: a `lax.scan` over k blocks in float32 — XLA fuses it well and it
+keeps peak memory at O(seq * block) instead of O(seq^2). (A Pallas backward
+kernel is a later optimization; the forward dominates inference and the
+backward is compute-, not launch-, bound.)
+
+Layout convention at this layer: (batch, num_heads, seq, head_dim).
+Use :func:`ray_tpu.ops.attention.multihead_attention` for the (B, S, H, D)
+model-side API with automatic dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is importable on CPU too (for interpret mode)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30  # large-finite instead of -inf: avoids NaN from inf-inf
+
+
+@dataclasses.dataclass(frozen=True)
+class _Cfg:
+    causal: bool
+    sm_scale: float
+    block_q: int
+    block_k: int
+    interpret: bool
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_s, l_s, acc_s, *, cfg: _Cfg, offset: int):
+    """``offset = sk - sq``: causality is end-aligned (query i attends keys
+    0..i+offset), matching ``attention_reference``'s ``tril(k=sk-sq)`` for
+    decode-style sq < sk calls."""
+    ib = pl.program_id(2)          # q block index
+    kb = pl.program_id(3)          # k block index (innermost)
+    nk = pl.num_programs(3)
+    bq, bk = cfg.block_q, cfg.block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    # Under causality, blocks strictly above the diagonal contribute nothing.
+    run = (kb * bk <= ib * bq + (bq - 1) + offset) if cfg.causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]                                  # (bq, d)
+        k = k_ref[0, 0]                                  # (bk, d)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        s = s * cfg.sm_scale
+        if cfg.causal:
+            rows = ib * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            cols = kb * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
+
+        m_prev = m_s[...]                                # (bq, 128) lanes equal
+        l_prev = l_s[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)       # (bq, 1)
+        m_next = jnp.maximum(m_prev, m_cur)              # (bq, 128)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, 0:1])                  # (bq, bk) f32
+        l_s[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        m_s[...] = m_next
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, d)
+        acc_s[...] = acc_s[...] * alpha[:, 0:1] + pv
+
+    @pl.when(kb == nk - 1)
+    def _final():
+        l = l_s[:, 0:1]
+        # Fully-masked rows (can't happen with causal self-attn) guard:
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_s[:, 0] + jnp.log(l[:, 0])).reshape(1, bq)
+
+
+def _fwd_pallas(cfg: _Cfg, q, k, v) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(cfg.block_q, sq)
+    bk = min(cfg.block_k, sk)
+    cfg = dataclasses.replace(cfg, block_q=bq, block_k=bk)
+    nq, nk = sq // bq, sk // bk
+    grid = (b, h, nq, nk)
+
+    kernel = functools.partial(_fwd_kernel, cfg=cfg, offset=sk - sq)
+    compiler_params = None
+    if pltpu is not None and not cfg.interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, i, j: (b_, h_, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max m
+            pltpu.VMEM((bq, 128), jnp.float32),   # running denom l
+            pltpu.VMEM((bq, d), jnp.float32),     # output accumulator
+        ],
+        compiler_params=compiler_params,
+        interpret=cfg.interpret,
+    )(q, k, v)
+    return out, lse[:, :, 0, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _Cfg, q, k, v):
+    o, _ = _fwd_pallas(cfg, q, k, v)
+    return o
+
+
+def _flash_fwd(cfg: _Cfg, q, k, v):
+    o, lse = _fwd_pallas(cfg, q, k, v)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(cfg: _Cfg, res, do):
+    q, k, v, o, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bk = min(cfg.block_k, sk)
+    nk = sk // bk
+    scale = cfg.sm_scale
+
+    q32 = q.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    # D_i = sum_d dO_i * O_i — the softmax-Jacobian diagonal term.
+    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)     # (b,h,sq)
+    rows = jnp.arange(sq)[:, None] + (sk - sq)    # end-aligned causality
+
+    k_blocks = k.astype(jnp.float32).reshape(b, h, nk, bk, d)
+    v_blocks = v.astype(jnp.float32).reshape(b, h, nk, bk, d)
+    k_blocks = jnp.moveaxis(k_blocks, 2, 0)                    # (nk,b,h,bk,d)
+    v_blocks = jnp.moveaxis(v_blocks, 2, 0)
+
+    def step(dq_acc, blk):
+        j, kb_, vb_ = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, kb_) * scale
+        if cfg.causal:
+            cols = j * bk + jnp.arange(bk)[None, :]
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])                        # (b,h,sq,bk)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vb_)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kb_)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        step, dq0, (jnp.arange(nk), k_blocks, v_blocks))
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, sk, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, sk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 512,
+                    block_k: int = 512,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Flash attention over (batch, heads, seq, head_dim) arrays.
+
+    Requires seq divisible by the (clamped) block sizes. ``interpret=True``
+    runs the Pallas kernel in interpreter mode (CPU tests).
+    """
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    cfg = _Cfg(causal=causal, sm_scale=float(sm_scale),
+               block_q=block_q, block_k=block_k, interpret=interpret)
+    return _flash(cfg, q, k, v)

@@ -1,0 +1,36 @@
+"""Normalization ops (f32 accumulation, XLA-fusable).
+
+These are deliberately plain jnp: XLA fuses the reductions into neighboring
+elementwise work on TPU, so a Pallas kernel buys nothing here. The contract
+is numerical: statistics are always computed in float32 regardless of the
+activation dtype (bf16 on TPU), matching standard large-model practice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6,
+             dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+    """RMSNorm over the last axis. ``scale`` broadcast on the last axis."""
+    orig_dtype = dtype or x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * scale.astype(jnp.float32)).astype(orig_dtype)
+
+
+def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+               eps: float = 1e-5,
+               dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+    """LayerNorm over the last axis with learned scale and bias."""
+    orig_dtype = dtype or x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(orig_dtype)

@@ -1,0 +1,75 @@
+"""Rotary position embeddings (RoPE).
+
+Two layouts are supported:
+- ``"neox"`` (rotate-half): the first half of the head dim is paired with
+  the second half. Used by GPT-NeoX/Llama-family models.
+- ``"gptj"`` (rotate-every-two): even/odd interleaved pairs, the original
+  GPT-J layout.
+
+Tables are precomputed once (f32) and gathered per position so the op is a
+pure elementwise fuse target for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rotary_table(max_len: int, rot_dim: int, base: float = 10000.0
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute (sin, cos) tables of shape (max_len, rot_dim // 2)."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, rot_dim, 2,
+                                          dtype=jnp.float32) / rot_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)          # (max_len, rot_dim/2)
+    return jnp.sin(freqs), jnp.cos(freqs)
+
+
+def apply_rotary(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray,
+                 positions: Optional[jnp.ndarray] = None,
+                 layout: str = "gptj") -> jnp.ndarray:
+    """Apply RoPE to ``x`` of shape (..., seq, num_heads, head_dim).
+
+    Only the leading ``2 * sin.shape[-1]`` features of head_dim are rotated
+    (GPT-J rotates ``rotary_dim=64`` of its 256-dim heads); the remainder
+    passes through.
+
+    ``positions``: optional (..., seq) int array of absolute positions
+    (for packed sequences / decode steps); defaults to arange.
+    """
+    rot = 2 * sin.shape[-1]
+    seq = x.shape[-3]
+    if positions is None:
+        sin_p, cos_p = sin[:seq], cos[:seq]            # (seq, rot/2)
+        # broadcast over leading batch dims and the heads axis
+        sin_p = sin_p[:, None, :]
+        cos_p = cos_p[:, None, :]
+    else:
+        sin_p = jnp.take(sin, positions, axis=0)[..., :, None, :]
+        cos_p = jnp.take(cos, positions, axis=0)[..., :, None, :]
+
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    x32 = x_rot.astype(jnp.float32)
+
+    if layout == "gptj":
+        x1 = x32[..., 0::2]
+        x2 = x32[..., 1::2]
+        r1 = x1 * cos_p - x2 * sin_p
+        r2 = x2 * cos_p + x1 * sin_p
+        rotated = jnp.stack([r1, r2], axis=-1).reshape(x32.shape)
+    elif layout == "neox":
+        half = rot // 2
+        x1 = x32[..., :half]
+        x2 = x32[..., half:]
+        r1 = x1 * cos_p - x2 * sin_p
+        r2 = x2 * cos_p + x1 * sin_p
+        rotated = jnp.concatenate([r1, r2], axis=-1)
+    else:
+        raise ValueError(f"unknown rotary layout: {layout!r}")
+
+    rotated = rotated.astype(x.dtype)
+    if x_pass.shape[-1] == 0:
+        return rotated
+    return jnp.concatenate([rotated, x_pass], axis=-1)

@@ -39,16 +39,29 @@ class Group:
         self.world_size = world_size
         self.rank = rank
         self.backend = backend
-        self._seq = 0
+        # Sequence numbers are tracked per op kind (and per peer pair for
+        # p2p) so an asymmetric op — a send between two ranks, say — can't
+        # desynchronize the keys the whole group uses for its next barrier.
+        self._seqs: Dict[str, int] = {}
         self._stubs: Dict[Tuple, object] = {}
         self._mesh = None
+        # Host-backend KV hygiene: keys this rank wrote, per op kind, as
+        # {kind: [(seq, key), ...]}; consumed lazily by _gc (see below).
+        self._written: Dict[str, List[Tuple[int, bytes]]] = {}
+        self._bcast_pending: List[Tuple[bytes, List[bytes]]] = []
 
     # ---- xla backend ----
     def mesh(self):
         if self._mesh is None:
             from ray_tpu.parallel.mesh import MeshSpec, build_mesh
             import jax
-            self._mesh = build_mesh(MeshSpec(tp=-1), jax.devices())
+            devices = jax.devices()
+            if self.world_size > len(devices):
+                raise ValueError(
+                    f"xla collective group {self.name!r}: world_size "
+                    f"{self.world_size} exceeds {len(devices)} devices")
+            self._mesh = build_mesh(MeshSpec(tp=self.world_size),
+                                    devices[:self.world_size])
         return self._mesh
 
     def _stub(self, op: str, shape, dtype, **kw):
@@ -59,9 +72,9 @@ class Group:
             self._stubs[key] = stub
         return stub
 
-    def next_seq(self) -> int:
-        self._seq += 1
-        return self._seq
+    def next_seq(self, kind: str) -> int:
+        self._seqs[kind] = self._seqs.get(kind, 0) + 1
+        return self._seqs[kind]
 
 
 def _build_stub(mesh, op: str, **kw):
@@ -98,16 +111,15 @@ def _build_stub(mesh, op: str, **kw):
     if op == "reducescatter":
         # (world, *shape) -> (world, shape[0]/world, ...): rank i gets the
         # i-th chunk of the elementwise sum
+        import jax.numpy as jnp
+        world = int(mesh.devices.size)
+
         def f(x):
-            return _red(x[0], axes)
-        def g(x):
-            import jax.numpy as jnp
-            summed = jax.jit(jax.shard_map(
-                f, mesh=mesh, in_specs=P(axes), out_specs=P(),
-                check_vma=False))(x)
-            world = x.shape[0]
+            summed = _red(x[0], axes)
             return jnp.stack(jnp.split(summed, world, axis=0))
-        return g
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P(axes), out_specs=P(),
+            check_vma=False))
     raise ValueError(f"unknown collective {op}")
 
 
@@ -212,13 +224,15 @@ def barrier(group_name: str = "default") -> None:
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     g = get_group(group_name)
-    _kv_put(_key(g, f"p2p/{g.rank}->{dst_rank}/{g.next_seq()}"),
+    seq = g.next_seq(f"p2p/{g.rank}->{dst_rank}")
+    _kv_put(_key(g, f"p2p/{g.rank}->{dst_rank}/{seq}"),
             _dumps(np.asarray(tensor)))
 
 
 def recv(shape, dtype, src_rank: int, group_name: str = "default"):
     g = get_group(group_name)
-    key = _key(g, f"p2p/{src_rank}->{g.rank}/{g.next_seq()}")
+    seq = g.next_seq(f"p2p/{src_rank}->{g.rank}")
+    key = _key(g, f"p2p/{src_rank}->{g.rank}/{seq}")
     return _loads(_kv_take(key)).reshape(shape).astype(dtype)
 
 
@@ -272,16 +286,36 @@ def _kv_wait(key: bytes, timeout: float = 120.0) -> bytes:
 
 
 def _host_rendezvous(group_name: str, world_size: int, rank: int) -> None:
+    # Join keys persist for the group's lifetime (one tiny key per rank):
+    # stragglers that rendezvous late must still find every key.
     g = get_group(group_name)
     _kv_put(_key(g, f"join/{rank}"), b"1")
     for r in range(world_size):
         _kv_wait(_key(g, f"join/{r}"))
 
 
+def _gc_symmetric(g: Group, kind: str, seq: int, key: bytes) -> None:
+    """Lag-2 GC for symmetric ops (every rank writes and reads each seq).
+
+    When this rank starts seq s, every rank has started s-1 (this rank
+    finished s-1 only after reading all ranks' s-1 keys, which they write
+    on entry), hence every rank has finished s-2 and read our s-2 key —
+    so our keys with seq <= s-2 are dead and safe to delete.
+    """
+    written = g._written.setdefault(kind, [])
+    w = _kv()
+    while written and written[0][0] <= seq - 2:
+        _, old_key = written.pop(0)
+        w.kv_del(old_key, ns="collective")
+    written.append((seq, key))
+
+
 def _host_allreduce(g: Group, tensor, op: str):
     arr = np.asarray(tensor)
-    seq = g.next_seq()
-    _kv_put(_key(g, f"ar/{seq}/{g.rank}"), _dumps(arr))
+    seq = g.next_seq("ar")
+    key = _key(g, f"ar/{seq}/{g.rank}")
+    _gc_symmetric(g, "ar", seq, key)
+    _kv_put(key, _dumps(arr))
     parts = [_loads(_kv_wait(_key(g, f"ar/{seq}/{r}")))
              for r in range(g.world_size)]
     stack = np.stack(parts)
@@ -292,23 +326,46 @@ def _host_allreduce(g: Group, tensor, op: str):
 
 def _host_allgather(g: Group, tensor):
     arr = np.asarray(tensor)
-    seq = g.next_seq()
-    _kv_put(_key(g, f"ag/{seq}/{g.rank}"), _dumps(arr))
+    seq = g.next_seq("ag")
+    key = _key(g, f"ag/{seq}/{g.rank}")
+    _gc_symmetric(g, "ag", seq, key)
+    _kv_put(key, _dumps(arr))
     return [_loads(_kv_wait(_key(g, f"ag/{seq}/{r}")))
             for r in range(g.world_size)]
 
 
 def _host_broadcast(g: Group, tensor, src_rank: int):
-    seq = g.next_seq()
+    # Broadcast is asymmetric (receivers never write), so lag-GC's
+    # self-synchronization argument doesn't hold; receivers ack instead
+    # and the source reaps fully-acked payloads on its next broadcast.
+    seq = g.next_seq("bc")
+    data_key = _key(g, f"bc/{seq}")
     if g.rank == src_rank:
-        _kv_put(_key(g, f"bc/{seq}"), _dumps(np.asarray(tensor)))
+        w = _kv()
+        still_pending = []
+        for old_data, acks in g._bcast_pending:
+            if all(w.kv_get(a, ns="collective") is not None for a in acks):
+                w.kv_del(old_data, ns="collective")
+                for a in acks:
+                    w.kv_del(a, ns="collective")
+            else:
+                still_pending.append((old_data, acks))
+        g._bcast_pending = still_pending
+        _kv_put(data_key, _dumps(np.asarray(tensor)))
+        g._bcast_pending.append(
+            (data_key, [_key(g, f"bc/{seq}/ack/{r}")
+                        for r in range(g.world_size) if r != src_rank]))
         return tensor
-    return _loads(_kv_wait(_key(g, f"bc/{seq}")))
+    out = _loads(_kv_wait(data_key))
+    _kv_put(_key(g, f"bc/{seq}/ack/{g.rank}"), b"1")
+    return out
 
 
 def _host_barrier(g: Group) -> None:
-    seq = g.next_seq()
-    _kv_put(_key(g, f"bar/{seq}/{g.rank}"), b"1")
+    seq = g.next_seq("bar")
+    key = _key(g, f"bar/{seq}/{g.rank}")
+    _gc_symmetric(g, "bar", seq, key)
+    _kv_put(key, b"1")
     for r in range(g.world_size):
         _kv_wait(_key(g, f"bar/{seq}/{r}"))
 
@@ -324,6 +381,10 @@ def init_jax_distributed(group_name: str = "train",
     ``jax.distributed.initialize`` against it. Call before any jax use in
     the process."""
     import socket
+    if process_id is None or num_processes is None:
+        raise ValueError(
+            "init_jax_distributed requires explicit num_processes and "
+            "process_id (rank 0 hosts the coordinator)")
     w = _kv()
     key = f"jaxdist/{group_name}/coordinator".encode()
     if process_id == 0:

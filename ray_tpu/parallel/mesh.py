@@ -59,8 +59,6 @@ def chip_spec(kind: Optional[str] = None) -> ChipSpec:
         for name in ("v6e", "v5p", "v5e", "v4"):
             if name in k.replace(" ", "").replace("lite", "e"):
                 return CHIP_SPECS[name]
-        if "v5" in k and "lite" in k:
-            return CHIP_SPECS["v5e"]
         return CHIP_SPECS["v5e"]
     return CHIP_SPECS[kind]
 

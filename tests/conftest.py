@@ -16,8 +16,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
-# propagate to worker subprocesses spawned by the node manager
-os.environ.setdefault("RAY_TPU_TEST_CPU_MESH", "1")
+# propagate to worker subprocesses spawned by the node manager: the worker
+# entrypoint (ray_tpu.core.worker.main) applies this via jax.config before
+# any task code imports jax.
+os.environ.setdefault("RAY_TPU_JAX_PLATFORM", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
