@@ -1,0 +1,329 @@
+"""Decoder-only transformer LM, TPU-first.
+
+Supports two block styles behind one config:
+
+- ``"gptj"`` — parallel attention+MLP residual off a single LayerNorm
+  (GPT-J 6B: rotary over the first 64 of 256 head dims, untied lm_head
+  with bias). The flagship matches the reference's GPT-J fine-tune recipe
+  (``release/air_examples/gptj_deepspeed_finetuning/``) architecturally.
+- ``"llama"`` — sequential pre-RMSNorm blocks, SwiGLU MLP, full-dim neox
+  rotary, optional GQA (num_kv_heads < num_heads).
+
+Design (TPU-first, not a port):
+- params are a plain dict pytree; per-layer weights are STACKED on a
+  leading ``layers`` axis and the forward pass is one ``lax.scan`` over
+  layers (+ ``jax.checkpoint`` per block) — constant compile time in
+  depth, XLA-friendly.
+- every weight has an entry in :func:`logical_axes` — the same treedef
+  with tuples of logical names ("embed", "mlp", "heads", "vocab", …);
+  ``parallel.sharding.ShardingRules`` maps those to mesh axes, so DP /
+  FSDP / TP / SP are rule-table changes, not model changes.
+- master params live in f32; ``config.dtype`` (bf16 on TPU) is the
+  compute dtype, cast at use sites so the MXU sees bf16 while layernorm
+  statistics and the softmax stay f32 (ops layer contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops import (
+    apply_rotary,
+    layer_norm,
+    multihead_attention,
+    ring_attention,
+    rms_norm,
+    rotary_table,
+    cross_entropy_loss,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50400
+    d_model: int = 4096
+    n_layers: int = 28
+    n_heads: int = 16
+    head_dim: int = 256
+    n_kv_heads: Optional[int] = None        # GQA; None = n_heads
+    d_ff: int = 16384
+    max_seq_len: int = 2048
+    rotary_dim: int = 64                     # gptj rotates a prefix
+    rope_base: float = 10000.0
+    block_style: str = "gptj"               # "gptj" | "llama"
+    dtype: Any = jnp.bfloat16                # compute dtype
+    remat: bool = True
+    attn_impl: str = "auto"                  # ops.multihead_attention impl
+    attn_block_q: int = 512
+    attn_block_k: int = 512
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def num_params(self) -> int:
+        """Parameter count (for MFU accounting)."""
+        e, v, h = self.d_model, self.vocab_size, self.n_heads * self.head_dim
+        kvh = self.kv_heads * self.head_dim
+        per_layer = e * h + 2 * e * kvh + h * e          # q, k, v, o
+        if self.block_style == "llama":
+            per_layer += 3 * e * self.d_ff + 2 * e       # swiglu + 2 rmsnorm
+        else:
+            per_layer += 2 * e * self.d_ff + self.d_ff + e  # fc biases
+            per_layer += 2 * e                           # ln scale+bias
+        total = v * e + self.n_layers * per_layer
+        total += e if self.block_style == "llama" else 2 * e  # final norm
+        total += e * v + (v if self.block_style == "gptj" else 0)  # lm head
+        return total
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Approximate train FLOPs/token (6·N params + attention term)."""
+        s = seq_len or self.max_seq_len
+        attn = 12 * self.n_layers * self.n_heads * self.head_dim * s
+        return 6.0 * self.num_params + attn
+
+
+# ------------------------------------------------------------------ init
+def _dense_init(key, shape, scale=0.02):
+    return scale * jax.random.normal(key, shape, jnp.float32)
+
+
+def init_params(config: TransformerConfig, key) -> Dict:
+    c = config
+    keys = jax.random.split(key, 9)
+    h = c.n_heads * c.head_dim
+    kvh = c.kv_heads * c.head_dim
+    L = c.n_layers
+
+    def stack(k, shape, scale=0.02):
+        return _dense_init(k, (L,) + shape, scale)
+
+    out_scale = 0.02 / (2 * L) ** 0.5    # scaled residual-out init
+    layers: Dict[str, jnp.ndarray] = {
+        "wq": stack(keys[0], (c.d_model, h)),
+        "wk": stack(keys[1], (c.d_model, kvh)),
+        "wv": stack(keys[2], (c.d_model, kvh)),
+        "wo": stack(keys[3], (h, c.d_model), out_scale),
+    }
+    if c.block_style == "llama":
+        layers.update({
+            "w_gate": stack(keys[4], (c.d_model, c.d_ff)),
+            "w_up": stack(keys[5], (c.d_model, c.d_ff)),
+            "w_down": stack(keys[6], (c.d_ff, c.d_model), out_scale),
+            "attn_norm": jnp.ones((L, c.d_model), jnp.float32),
+            "mlp_norm": jnp.ones((L, c.d_model), jnp.float32),
+        })
+        final = {"scale": jnp.ones((c.d_model,), jnp.float32)}
+        head = {"w": _dense_init(keys[8], (c.d_model, c.vocab_size))}
+    else:
+        layers.update({
+            "fc_in": stack(keys[4], (c.d_model, c.d_ff)),
+            "fc_in_b": jnp.zeros((L, c.d_ff), jnp.float32),
+            "fc_out": stack(keys[5], (c.d_ff, c.d_model), out_scale),
+            "fc_out_b": jnp.zeros((L, c.d_model), jnp.float32),
+            "ln_scale": jnp.ones((L, c.d_model), jnp.float32),
+            "ln_bias": jnp.zeros((L, c.d_model), jnp.float32),
+        })
+        final = {"scale": jnp.ones((c.d_model,), jnp.float32),
+                 "bias": jnp.zeros((c.d_model,), jnp.float32)}
+        head = {"w": _dense_init(keys[8], (c.d_model, c.vocab_size)),
+                "b": jnp.zeros((c.vocab_size,), jnp.float32)}
+
+    return {
+        "embed": _dense_init(keys[7], (c.vocab_size, c.d_model)),
+        "layers": layers,
+        "final_norm": final,
+        "lm_head": head,
+    }
+
+
+def logical_axes(config: TransformerConfig) -> Dict:
+    """Pytree (same treedef as params) of logical-axis tuples."""
+    c = config
+    common = {
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv"),
+        "wv": ("layers", "embed", "kv"),
+        "wo": ("layers", "heads", "embed"),
+    }
+    if c.block_style == "llama":
+        layers = {**common,
+                  "w_gate": ("layers", "embed", "mlp"),
+                  "w_up": ("layers", "embed", "mlp"),
+                  "w_down": ("layers", "mlp", "embed"),
+                  "attn_norm": ("layers", "embed"),
+                  "mlp_norm": ("layers", "embed")}
+        final = {"scale": ("embed",)}
+        head = {"w": ("embed", "vocab")}
+    else:
+        layers = {**common,
+                  "fc_in": ("layers", "embed", "mlp"),
+                  "fc_in_b": ("layers", "mlp"),
+                  "fc_out": ("layers", "mlp", "embed"),
+                  "fc_out_b": ("layers", "embed"),
+                  "ln_scale": ("layers", "embed"),
+                  "ln_bias": ("layers", "embed")}
+        final = {"scale": ("embed",), "bias": ("embed",)}
+        head = {"w": ("embed", "vocab"), "b": ("vocab",)}
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": final,
+        "lm_head": head,
+    }
+
+
+# --------------------------------------------------------------- forward
+def _attention(c: TransformerConfig, q, k, v, mesh, rules):
+    """Dispatch attention: ring over the sp axis when it's nontrivial,
+    otherwise the flash/reference dispatcher (ops layer)."""
+    sp_axis = rules.get("sequence") if rules else None
+    if mesh is not None and sp_axis is not None and sp_axis in mesh.shape \
+            and mesh.shape[sp_axis] > 1:
+        from jax.sharding import PartitionSpec as P
+        batch_axes = rules.get("batch")
+        spec = P(batch_axes, sp_axis, None, None)
+        fn = jax.shard_map(
+            functools.partial(ring_attention, axis_name=sp_axis,
+                              causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(q, k, v)
+    return multihead_attention(
+        q, k, v, causal=True, impl=c.attn_impl,
+        block_q=c.attn_block_q, block_k=c.attn_block_k)
+
+
+def _gptj_block(c, x, lp, sin, cos, mesh, rules):
+    b, s, e = x.shape
+    h = layer_norm(x, lp["ln_scale"], lp["ln_bias"])
+    dt = c.dtype
+
+    def proj(w, n):
+        return jnp.einsum("bse,ehd->bshd", h.astype(dt),
+                          w.reshape(e, n, -1).astype(dt))
+    q = proj(lp["wq"], c.n_heads)
+    k = proj(lp["wk"], c.kv_heads)
+    v = proj(lp["wv"], c.kv_heads)
+    q = apply_rotary(q, sin, cos, layout="gptj")
+    k = apply_rotary(k, sin, cos, layout="gptj")
+    if c.kv_heads != c.n_heads:
+        rep = c.n_heads // c.kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    att = _attention(c, q, k, v, mesh, rules)
+    att = jnp.einsum("bshd,hde->bse", att,
+                     lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
+    mlp = jnp.dot(h.astype(dt), lp["fc_in"].astype(dt)) \
+        + lp["fc_in_b"].astype(dt)
+    mlp = jax.nn.gelu(mlp)
+    mlp = jnp.dot(mlp, lp["fc_out"].astype(dt)) + lp["fc_out_b"].astype(dt)
+    return x + (att + mlp).astype(x.dtype)
+
+
+def _llama_block(c, x, lp, sin, cos, mesh, rules):
+    b, s, e = x.shape
+    dt = c.dtype
+    h = rms_norm(x, lp["attn_norm"])
+
+    def proj(w, n):
+        return jnp.einsum("bse,ehd->bshd", h.astype(dt),
+                          w.reshape(e, n, -1).astype(dt))
+    q = proj(lp["wq"], c.n_heads)
+    k = proj(lp["wk"], c.kv_heads)
+    v = proj(lp["wv"], c.kv_heads)
+    q = apply_rotary(q, sin, cos, layout="neox")
+    k = apply_rotary(k, sin, cos, layout="neox")
+    if c.kv_heads != c.n_heads:
+        rep = c.n_heads // c.kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    att = _attention(c, q, k, v, mesh, rules)
+    att = jnp.einsum("bshd,hde->bse", att,
+                     lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
+    x = x + att.astype(x.dtype)
+    h2 = rms_norm(x, lp["mlp_norm"]).astype(dt)
+    gate = jax.nn.silu(jnp.dot(h2, lp["w_gate"].astype(dt)))
+    up = jnp.dot(h2, lp["w_up"].astype(dt))
+    mlp = jnp.dot(gate * up, lp["w_down"].astype(dt))
+    return x + mlp.astype(x.dtype)
+
+
+def apply(config: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
+          mesh=None, rules=None) -> jnp.ndarray:
+    """Forward pass: (batch, seq) int32 -> (batch, seq, vocab) logits.
+
+    ``mesh``/``rules`` enable in-graph sharding constraints and ring
+    attention; both optional (single-device path needs neither).
+    """
+    c = config
+    x = jnp.take(params["embed"], input_ids, axis=0).astype(c.dtype)
+    seq = input_ids.shape[1]
+    sin, cos = rotary_table(
+        seq, c.rotary_dim if c.block_style == "gptj" else c.head_dim,
+        c.rope_base)
+
+    block = _gptj_block if c.block_style == "gptj" else _llama_block
+    body = functools.partial(block, c, sin=sin, cos=cos,
+                             mesh=mesh, rules=rules)
+    if c.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(carry, lp):
+        out = body(carry, lp)
+        if mesh is not None and rules is not None:
+            from ray_tpu.parallel.sharding import constrain
+            out = constrain(out, mesh, rules, ("batch", "sequence", None))
+        return out, None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+
+    fn = params["final_norm"]
+    if c.block_style == "llama":
+        x = rms_norm(x, fn["scale"])
+        logits = jnp.dot(x.astype(c.dtype),
+                         params["lm_head"]["w"].astype(c.dtype))
+    else:
+        x = layer_norm(x, fn["scale"], fn["bias"])
+        logits = jnp.dot(x.astype(c.dtype),
+                         params["lm_head"]["w"].astype(c.dtype))
+        logits = logits + params["lm_head"]["b"].astype(c.dtype)
+    return logits
+
+
+def lm_loss(config: TransformerConfig, params: Dict, batch: Dict,
+            mesh=None, rules=None) -> Tuple[jnp.ndarray, Dict]:
+    """Next-token LM loss. batch: {"input_ids": (b,s) int32,
+    "loss_mask": optional (b,s)}. Returns (loss, aux)."""
+    ids = batch["input_ids"]
+    logits = apply(config, params, ids, mesh=mesh, rules=rules)
+    labels = ids[:, 1:]
+    mask = batch.get("loss_mask")
+    mask = mask[:, 1:] if mask is not None else None
+    loss, n = cross_entropy_loss(logits[:, :-1], labels, mask=mask)
+    return loss, {"n_tokens": n}
+
+
+class Transformer:
+    """Convenience OO wrapper binding a config: ``init``/``apply``/``loss``
+    plus the sharding-annotation tree."""
+
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+
+    def init(self, key) -> Dict:
+        return init_params(self.config, key)
+
+    def logical_axes(self) -> Dict:
+        return logical_axes(self.config)
+
+    def apply(self, params, input_ids, mesh=None, rules=None):
+        return apply(self.config, params, input_ids, mesh=mesh, rules=rules)
+
+    def loss(self, params, batch, mesh=None, rules=None):
+        return lm_loss(self.config, params, batch, mesh=mesh, rules=rules)

@@ -72,16 +72,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     kv = (k.astype(jnp.float32), v.astype(jnp.float32))
     q_off = my_idx * sl
 
-    m0 = jnp.full((b, h, sl), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sl), jnp.float32)
-    acc0 = jnp.zeros((b, h, sl, d), jnp.float32)
-    # Mark the carry as device-varying over the ring axis so the scan's
-    # carry type matches after the first ppermute (shard_map vma typing).
-    if hasattr(jax.lax, "pcast"):
-        m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), (axis_name,),
-                                     to="varying")
-    else:  # jax < 0.9
-        m0, l0, acc0 = jax.lax.pvary((m0, l0, acc0), (axis_name,))
+    # Build the initial carry FROM q so it inherits q's varying-axes type
+    # (this op may be nested under an outer shard_map that is manual over
+    # dp/fsdp/etc. in addition to the ring axis — the scan carry must be
+    # device-varying over every axis the per-step results vary over).
+    qt = jnp.swapaxes(q32, 1, 2)                     # (b,h,sl,d)
+    acc0 = qt * 0.0
+    m0 = qt[..., 0] * 0.0 + _NEG_INF                 # (b,h,sl)
+    l0 = qt[..., 0] * 0.0
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
