@@ -51,16 +51,24 @@ def main() -> None:
     batch_d = {"input_ids": ids,
                "loss_mask": jnp.ones((batch, seq), jnp.float32)}
 
+    def sync(state, metrics):
+        # Host-side scalar fetches of values that depend on the FULL step
+        # (optimizer update included): the state's step counter is only
+        # ready once donation/apply finished, and grad_norm depends on the
+        # backward pass. (block_until_ready has proven unreliable on
+        # experimental tunnel platforms.)
+        int(state["step"])
+        float(metrics["grad_norm"])
+        return float(metrics["loss"])
+
     for _ in range(warmup):
         state, metrics = bundle.step(state, batch_d)
-    # Force a true sync with a host-side scalar fetch (block_until_ready
-    # has proven unreliable on experimental tunnel platforms).
-    float(metrics["loss"])
+    sync(state, metrics)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = bundle.step(state, batch_d)
-    final_loss = float(metrics["loss"])
+    final_loss = sync(state, metrics)
     dt = time.perf_counter() - t0
 
     tokens_per_s = batch * seq * steps / dt

@@ -1,0 +1,15 @@
+"""ray_tpu.air: shared ML plumbing (reference: ``python/ray/air/``)."""
+
+from ray_tpu.air.config import (
+    ScalingConfig,
+    RunConfig,
+    FailureConfig,
+    CheckpointConfig,
+)
+
+__all__ = [
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+]

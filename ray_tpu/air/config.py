@@ -1,0 +1,120 @@
+"""Shared ML-plumbing configs (reference: ``python/ray/air/config.py``:
+``ScalingConfig`` :101, ``FailureConfig`` :375, ``CheckpointConfig`` :425,
+``RunConfig`` :574).
+
+TPU-first deltas: ``ScalingConfig`` speaks TPU chips (``use_tpu``/
+``tpus_per_worker``) and a ``topology`` string (e.g. ``"v5e-64"``) whose
+gang resource (``TPU-{topology}-head``) pins one trainer actor per host of
+a pod slice, mirroring the reference accelerator hook
+(``python/ray/_private/accelerators/tpu.py:379``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How much compute a trainer gets (reference ``air/config.py:101``)."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    use_gpu: bool = False  # accepted for API parity; maps onto chips
+    trainer_resources: Optional[Dict[str, float]] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None  # e.g. "v5e-64": gang-schedule a slice
+
+    def __post_init__(self):
+        if self.use_gpu and not self.use_tpu:
+            # This framework is TPU-native; treat GPU requests as chips.
+            self.use_tpu = True
+
+    @property
+    def _chips_per_worker(self) -> float:
+        rpw = self.resources_per_worker or {}
+        if "TPU" in rpw:
+            return float(rpw["TPU"])
+        return 1.0 if self.use_tpu else 0.0
+
+    def worker_bundle(self) -> Dict[str, float]:
+        rpw = dict(self.resources_per_worker or {})
+        bundle: Dict[str, float] = {}
+        bundle["CPU"] = float(rpw.pop("CPU", 0.0 if self.use_tpu else 1.0))
+        chips = rpw.pop("TPU", self._chips_per_worker)
+        if chips:
+            bundle["TPU"] = float(chips)
+        bundle.update({k: float(v) for k, v in rpw.items()})
+        return bundle
+
+    def trainer_bundle(self) -> Dict[str, float]:
+        tr = dict(self.trainer_resources or {"CPU": 1.0})
+        return {k: float(v) for k, v in tr.items()}
+
+    def as_placement_group_factory(self):
+        from ray_tpu.tune.placement_groups import PlacementGroupFactory
+        bundles = [self.trainer_bundle()] + [
+            self.worker_bundle() for _ in range(self.num_workers)]
+        if self.topology:
+            # Reserve the slice's gang resource on the first worker bundle,
+            # like the reference's TPU-{pod_type}-head custom resource.
+            bundles[1] = dict(bundles[1])
+            bundles[1][f"TPU-{self.topology}-head"] = 1.0
+        return PlacementGroupFactory(
+            bundles, strategy=self.placement_strategy)
+
+    @property
+    def total_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = dict(self.trainer_bundle())
+        wb = self.worker_bundle()
+        for k, v in wb.items():
+            total[k] = total.get(k, 0.0) + v * self.num_workers
+        return total
+
+
+@dataclass
+class FailureConfig:
+    """Restart-from-checkpoint policy (reference ``air/config.py:375``)."""
+
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclass
+class CheckpointConfig:
+    """Top-K checkpoint retention (reference ``air/config.py:425``)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be None or > 0")
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """Run-level config (reference ``air/config.py:574``)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1
+    log_to_file: bool = False
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.path.expanduser(
+                os.environ.get("RAY_TPU_STORAGE_PATH", "~/ray_tpu_results"))

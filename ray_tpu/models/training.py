@@ -90,16 +90,26 @@ def make_train_step(config: TransformerConfig, mesh,
     flat_params, params_treedef = jax.tree.flatten(
         state_shapes["params"])
     flat_param_sh = jax.tree.flatten(param_sh)[0]
-    shape_to_sh = {}
-    for leaf, sh in zip(flat_params, flat_param_sh):
-        shape_to_sh.setdefault((leaf.shape, leaf.dtype), sh)
+    param_sh_tree = jax.tree.unflatten(params_treedef, flat_param_sh)
 
-    def sh_for(leaf):
-        return shape_to_sh.get((leaf.shape, leaf.dtype), rep)
+    # Optax state (adam mu/nu, etc.) nests whole param-shaped subtrees;
+    # substitute each such subtree with the params' sharding tree and
+    # replicate everything else (counters). Matching by treedef — not by
+    # leaf shape — keeps same-shaped params with different shardings
+    # (e.g. wq/wk/wv/wo when n_heads*head_dim == d_model) distinct.
+    def is_param_tree(x):
+        try:
+            return jax.tree.structure(x) == params_treedef
+        except Exception:
+            return False
+
+    opt_sh = jax.tree.map(
+        lambda sub: param_sh_tree if is_param_tree(sub) else rep,
+        state_shapes["opt_state"], is_leaf=is_param_tree)
 
     state_sh = {
-        "params": jax.tree.unflatten(params_treedef, flat_param_sh),
-        "opt_state": jax.tree.map(sh_for, state_shapes["opt_state"]),
+        "params": param_sh_tree,
+        "opt_state": opt_sh,
         "step": rep,
     }
 
@@ -136,11 +146,19 @@ def make_train_step(config: TransformerConfig, mesh,
 def make_eval_step(config: TransformerConfig, mesh,
                    rules: Optional[ShardingRules] = None,
                    state_shardings=None):
-    """Jitted forward-only loss."""
+    """Jitted forward-only loss, honoring the train step's layouts."""
     rules = rules if rules is not None else FSDP_RULES
-    batch_sh = batch_sharding(mesh, rules)
+    batch_sh = batch_sharding(mesh, rules, ("batch", "sequence"))
+    if state_shardings is not None:
+        param_sh = state_shardings["params"]
+    else:
+        param_sh = shard_params({}, logical_axes(config), rules, mesh)
 
-    @functools.partial(jax.jit, out_shardings=replicated(mesh))
+    @functools.partial(
+        jax.jit,
+        in_shardings=(param_sh, {"input_ids": batch_sh,
+                                 "loss_mask": batch_sh}),
+        out_shardings=replicated(mesh))
     def eval_step(params, batch):
         loss, aux = lm_loss(config, params, batch, mesh=mesh, rules=rules)
         return {"loss": loss, "n_tokens": aux["n_tokens"]}

@@ -199,9 +199,10 @@ def _attention(c: TransformerConfig, q, k, v, mesh, rules):
         block_q=c.attn_block_q, block_k=c.attn_block_k)
 
 
-def _gptj_block(c, x, lp, sin, cos, mesh, rules):
-    b, s, e = x.shape
-    h = layer_norm(x, lp["ln_scale"], lp["ln_bias"])
+def _attn_sublayer(c, h, lp, sin, cos, layout, mesh, rules):
+    """qkv projection → rotary → GQA repeat → attention → output proj.
+    Shared by both block styles (only the rotary layout differs)."""
+    e = h.shape[-1]
     dt = c.dtype
 
     def proj(w, n):
@@ -210,15 +211,21 @@ def _gptj_block(c, x, lp, sin, cos, mesh, rules):
     q = proj(lp["wq"], c.n_heads)
     k = proj(lp["wk"], c.kv_heads)
     v = proj(lp["wv"], c.kv_heads)
-    q = apply_rotary(q, sin, cos, layout="gptj")
-    k = apply_rotary(k, sin, cos, layout="gptj")
+    q = apply_rotary(q, sin, cos, layout=layout)
+    k = apply_rotary(k, sin, cos, layout=layout)
     if c.kv_heads != c.n_heads:
         rep = c.n_heads // c.kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     att = _attention(c, q, k, v, mesh, rules)
-    att = jnp.einsum("bshd,hde->bse", att,
-                     lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
+    return jnp.einsum("bshd,hde->bse", att,
+                      lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
+
+
+def _gptj_block(c, x, lp, sin, cos, mesh, rules):
+    h = layer_norm(x, lp["ln_scale"], lp["ln_bias"])
+    dt = c.dtype
+    att = _attn_sublayer(c, h, lp, sin, cos, "gptj", mesh, rules)
     mlp = jnp.dot(h.astype(dt), lp["fc_in"].astype(dt)) \
         + lp["fc_in_b"].astype(dt)
     mlp = jax.nn.gelu(mlp)
@@ -227,25 +234,9 @@ def _gptj_block(c, x, lp, sin, cos, mesh, rules):
 
 
 def _llama_block(c, x, lp, sin, cos, mesh, rules):
-    b, s, e = x.shape
     dt = c.dtype
     h = rms_norm(x, lp["attn_norm"])
-
-    def proj(w, n):
-        return jnp.einsum("bse,ehd->bshd", h.astype(dt),
-                          w.reshape(e, n, -1).astype(dt))
-    q = proj(lp["wq"], c.n_heads)
-    k = proj(lp["wk"], c.kv_heads)
-    v = proj(lp["wv"], c.kv_heads)
-    q = apply_rotary(q, sin, cos, layout="neox")
-    k = apply_rotary(k, sin, cos, layout="neox")
-    if c.kv_heads != c.n_heads:
-        rep = c.n_heads // c.kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    att = _attention(c, q, k, v, mesh, rules)
-    att = jnp.einsum("bshd,hde->bse", att,
-                     lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
+    att = _attn_sublayer(c, h, lp, sin, cos, "neox", mesh, rules)
     x = x + att.astype(x.dtype)
     h2 = rms_norm(x, lp["mlp_norm"]).astype(dt)
     gate = jax.nn.silu(jnp.dot(h2, lp["w_gate"].astype(dt)))

@@ -1,0 +1,46 @@
+"""ray_tpu.train: distributed training (reference: ``python/ray/train/``).
+
+Public surface mirrors ``ray.train``: configs, Checkpoint, Result,
+``report``/``get_checkpoint``/``get_context``/``get_dataset_shard``, the
+generic DataParallelTrainer, and the flagship JaxTrainer (TPU-native
+replacement for the reference's TorchTrainer)."""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.result import Result
+from ray_tpu.train._internal.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.base_trainer import BaseTrainer
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax import JaxConfig, JaxTrainer
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+]

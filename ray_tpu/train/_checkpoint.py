@@ -1,0 +1,110 @@
+"""Checkpoint: a directory snapshot addressed by URI.
+
+Reference: ``python/ray/train/_checkpoint.py:56`` — a Checkpoint is a
+directory of files at a (possibly remote) filesystem path, created from /
+materialized to local directories. TPU-first delta: ``from_jax`` /
+``to_jax`` store pytrees via numpy ``.npz`` flattening so a checkpoint
+written under ``jit`` donation survives process death without orbax being
+required (orbax can still be layered on by the user).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+_METADATA_FILE = ".metadata.json"
+_JAX_PYTREE_FILE = "_pytree.npz"
+_JAX_TREEDEF_FILE = "_treedef.pkl"
+
+
+class Checkpoint:
+    """A directory snapshot. ``path`` is the canonical location."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(os.path.expanduser(path))
+
+    # -- construction -------------------------------------------------
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        with open(os.path.join(d, "dict_checkpoint.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    @classmethod
+    def from_jax(cls, pytree: Any, **extra: Any) -> "Checkpoint":
+        """Save a JAX pytree (params/opt state) as npz + treedef."""
+        import jax
+        import numpy as np
+        d = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        leaves, treedef = jax.tree_util.tree_flatten(pytree)
+        arrays = {f"leaf_{i}": np.asarray(leaf)
+                  for i, leaf in enumerate(leaves)}
+        np.savez(os.path.join(d, _JAX_PYTREE_FILE), **arrays)
+        with open(os.path.join(d, _JAX_TREEDEF_FILE), "wb") as f:
+            pickle.dump(treedef, f)
+        if extra:
+            with open(os.path.join(d, "dict_checkpoint.pkl"), "wb") as f:
+                pickle.dump(extra, f)
+        return cls(d)
+
+    # -- materialization ----------------------------------------------
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        if os.path.abspath(path) != self.path:
+            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        # Local checkpoints are served in place, zero-copy.
+        yield self.path
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "dict_checkpoint.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_jax(self) -> Any:
+        import jax
+        import numpy as np
+        data = np.load(os.path.join(self.path, _JAX_PYTREE_FILE))
+        leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+        with open(os.path.join(self.path, _JAX_TREEDEF_FILE), "rb") as f:
+            treedef = pickle.load(f)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # -- metadata -----------------------------------------------------
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, _METADATA_FILE)
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def set_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+            json.dump(metadata, f)
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        m = self.get_metadata()
+        m.update(metadata)
+        self.set_metadata(m)
+
+    def __repr__(self) -> str:
+        return f"Checkpoint(path={self.path!r})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))

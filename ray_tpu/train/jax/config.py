@@ -1,0 +1,92 @@
+"""JAX backend: multi-host SPMD rendezvous for the worker group.
+
+Reference shape: ``python/ray/train/torch/config.py:146`` —
+``_TorchBackend.on_start`` picks a rendezvous address on rank 0 and runs
+``dist.init_process_group`` on every worker. TPU-native equivalent: rank
+0 publishes a coordinator address; every worker calls
+``jax.distributed.initialize(coordinator, num_processes, process_id)``,
+which is the JAX runtime's coordination service (barrier + device mesh
+discovery over DCN). Inside a host, no process group exists at all —
+collectives are XLA ICI ops compiled into the jitted program.
+
+On a single host (tests, one-chip dev) distributed init is skipped:
+``jax.devices()`` already sees every local chip and GSPMD handles the
+rest, so ``train_func`` code is identical either way.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Type
+
+from ray_tpu.train.backend import Backend, BackendConfig
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    # Force-enable/disable jax.distributed.initialize; None = auto
+    # (enabled iff the group spans >1 node).
+    distributed: Optional[bool] = None
+    coordinator_port: int = 8476
+
+    @property
+    def backend_cls(self) -> Type["_JaxBackend"]:
+        return _JaxBackend
+
+
+def _get_coordinator_ip() -> str:
+    import socket
+    return socket.gethostbyname(socket.gethostname())
+
+
+def _setup_jax_distributed(coordinator_address: str, num_processes: int,
+                           process_id: int) -> None:
+    """Runs on each worker before train_func (reference analog:
+    ``_setup_torch_process_group`` torch/config.py:64)."""
+    os.environ["RAY_TPU_JAX_COORDINATOR"] = coordinator_address
+    os.environ["RAY_TPU_JAX_NUM_PROCESSES"] = str(num_processes)
+    os.environ["RAY_TPU_JAX_PROCESS_ID"] = str(process_id)
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def _shutdown_jax_distributed() -> None:
+    import jax
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig) -> None:
+        metas = worker_group.fetch_metadata()
+        worker_group.sort_workers_by_node()
+        metas = worker_group.metadata
+        n_nodes = len({m.node_ip for m in metas})
+        use_distributed = backend_config.distributed
+        if use_distributed is None:
+            use_distributed = n_nodes > 1
+        if not use_distributed:
+            return
+        coordinator = worker_group.execute_single(
+            0, _get_coordinator_ip)
+        address = f"{coordinator}:{backend_config.coordinator_port}"
+        futures = []
+        for rank, worker in enumerate(worker_group.workers):
+            futures.append(worker.execute.remote(
+                _setup_jax_distributed, address,
+                len(worker_group), rank))
+        import ray_tpu
+        ray_tpu.get(futures)
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig) -> None:
+        if worker_group.workers:
+            try:
+                worker_group.execute(_shutdown_jax_distributed)
+            except Exception:
+                pass

@@ -1,0 +1,43 @@
+"""JaxTrainer: the flagship trainer — GSPMD training over TPU slices.
+
+Reference shape: ``python/ray/train/torch/torch_trainer.py`` (a
+DataParallelTrainer bound to the framework backend). The BASELINE.json
+north star (GPT-J fine-tune ≥35% MFU on v5e-64) runs through this class:
+one worker actor per TPU host of a slice, ``jax.distributed`` rendezvous
+via ``JaxConfig``, and the user's train_func building a
+``jax.sharding.Mesh`` over the global device set (dp/fsdp/tp/sp axes via
+``ray_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax.config import JaxConfig
+
+
+class JaxTrainer(DataParallelTrainer):
+    _backend_config_cls = JaxConfig
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 jax_config: Optional[JaxConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 dataset_config: Optional[Any] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 metadata: Optional[Dict[str, Any]] = None):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=jax_config or JaxConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            dataset_config=dataset_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+            metadata=metadata)

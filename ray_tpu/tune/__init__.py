@@ -1,0 +1,5 @@
+"""ray_tpu.tune: hyperparameter tuning (reference: ``python/ray/tune/``)."""
+
+from ray_tpu.tune.placement_groups import PlacementGroupFactory
+
+__all__ = ["PlacementGroupFactory"]

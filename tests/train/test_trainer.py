@@ -1,0 +1,195 @@
+"""Train-library tests, modeled on the reference's
+``python/ray/train/tests`` patterns: small local worker groups, dummy
+backends, checkpoint round-trips, and failure/restart semantics."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint, CheckpointConfig, DataParallelTrainer, FailureConfig,
+    JaxTrainer, RunConfig, ScalingConfig)
+
+
+@pytest.fixture
+def storage_path(tmp_path):
+    return str(tmp_path / "results")
+
+
+def test_checkpoint_dict_roundtrip(tmp_path):
+    ckpt = Checkpoint.from_dict({"step": 3, "w": [1, 2]})
+    assert ckpt.to_dict() == {"step": 3, "w": [1, 2]}
+    ckpt.set_metadata({"kind": "test"})
+    assert ckpt.get_metadata() == {"kind": "test"}
+    dest = ckpt.to_directory(str(tmp_path / "ck"))
+    assert Checkpoint.from_directory(dest).to_dict()["step"] == 3
+
+
+def test_checkpoint_jax_roundtrip():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    pytree = {"w": jnp.arange(4.0), "b": {"x": jnp.ones((2, 2))}}
+    ckpt = Checkpoint.from_jax(pytree, step=7)
+    restored = ckpt.to_jax()
+    assert restored["b"]["x"].shape == (2, 2)
+    assert float(restored["w"][3]) == 3.0
+    assert ckpt.to_dict()["step"] == 7
+
+
+def test_data_parallel_trainer_basic(ray_session, storage_path):
+    def train_func(config):
+        import ray_tpu.train as train
+        ctx = train.get_context()
+        for step in range(3):
+            train.report({"step": step,
+                          "rank": ctx.get_world_rank(),
+                          "world_size": ctx.get_world_size(),
+                          "lr": config["lr"]})
+
+    trainer = DataParallelTrainer(
+        train_func,
+        train_loop_config={"lr": 0.1},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="basic", storage_path=storage_path))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["rank"] == 0
+    assert result.metrics["world_size"] == 2
+    assert result.metrics["lr"] == 0.1
+
+
+def test_trainer_checkpointing_and_retention(ray_session, storage_path):
+    def train_func():
+        import ray_tpu.train as train
+        rank = train.get_context().get_world_rank()
+        for step in range(5):
+            ckpt = None
+            if rank == 0:
+                ckpt = Checkpoint.from_dict({"step": step})
+            train.report({"score": float(step)}, checkpoint=ckpt)
+
+    trainer = DataParallelTrainer(
+        train_func,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="ckpt", storage_path=storage_path,
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score")))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    assert result.checkpoint.to_dict()["step"] == 4
+    # top-2 retention by score
+    assert len(result.best_checkpoints) == 2
+    kept = sorted(c.to_dict()["step"] for c, _ in result.best_checkpoints)
+    assert kept == [3, 4]
+    # evicted dirs are gone from storage
+    run_dir = result.path
+    dirs = [d for d in os.listdir(run_dir) if d.startswith("checkpoint_")]
+    assert len(dirs) == 2
+
+
+def test_trainer_failure_restart_from_checkpoint(ray_session, storage_path):
+    marker = os.path.join(storage_path, "fail_once_marker")
+
+    def train_func(config):
+        import os
+        import ray_tpu.train as train
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        for step in range(start, 4):
+            c = (Checkpoint.from_dict({"step": step})
+                 if ctx.get_world_rank() == 0 else None)
+            train.report({"step": step}, checkpoint=c)
+            if step == 1 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                os._exit(1)  # simulate host death → gang restart
+
+    os.makedirs(storage_path, exist_ok=True)
+    trainer = DataParallelTrainer(
+        train_func,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="restart", storage_path=storage_path,
+            failure_config=FailureConfig(max_failures=2)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    assert result.checkpoint.to_dict()["step"] == 3
+
+
+def test_trainer_user_error_surfaces(ray_session, storage_path):
+    def train_func():
+        raise ValueError("boom in train_func")
+
+    trainer = DataParallelTrainer(
+        train_func,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="err", storage_path=storage_path))
+    result = trainer.fit()
+    assert result.error is not None
+    assert "boom in train_func" in str(result.error)
+
+
+def test_jax_trainer_single_host(ray_session, storage_path):
+    pytest.importorskip("jax")
+
+    def train_func():
+        import jax
+        import jax.numpy as jnp
+        import ray_tpu.train as train
+
+        @jax.jit
+        def step(w, x):
+            return w + x.sum()
+
+        w = jnp.zeros(())
+        for i in range(2):
+            w = step(w, jnp.ones(4))
+            train.report({"w": float(w)},
+                         checkpoint=(Checkpoint.from_jax({"w": w})
+                                     if train.get_context().get_world_rank()
+                                     == 0 else None))
+
+    trainer = JaxTrainer(
+        train_func,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="jax", storage_path=storage_path))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["w"] == 8.0
+    assert float(result.checkpoint.to_jax()["w"]) == 8.0
+
+
+def test_trainer_restore(ray_session, storage_path):
+    def train_func():
+        import ray_tpu.train as train
+        ckpt = train.get_checkpoint()
+        start = ckpt.to_dict()["step"] + 1 if ckpt else 0
+        for step in range(start, start + 2):
+            train.report(
+                {"step": step},
+                checkpoint=(Checkpoint.from_dict({"step": step})
+                            if train.get_context().get_world_rank() == 0
+                            else None))
+
+    trainer = DataParallelTrainer(
+        train_func,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="resume", storage_path=storage_path))
+    r1 = trainer.fit()
+    assert r1.metrics["step"] == 1
+
+    assert DataParallelTrainer.can_restore(r1.path)
+    trainer2 = DataParallelTrainer.restore(
+        r1.path, train_loop_per_worker=train_func,
+        scaling_config=ScalingConfig(num_workers=1))
+    r2 = trainer2.fit()
+    assert r2.error is None
+    assert r2.metrics["step"] == 3
