@@ -44,23 +44,33 @@ class BackendExecutor:
         self._trial_name = trial_name
         self._trial_id = trial_id
         self.worker_group: Optional[WorkerGroup] = None
-        self._pg = None
+        self._pg = None          # owned placement group (we remove it)
+        self._used_pg = None     # group used for (re)starts, owned or not
+        self._bundle_offset = 1
+        self._finished_ranks: set = set()
 
     # -- lifecycle ----------------------------------------------------
     def start(self, placement_group=None) -> None:
         sc = self._scaling_config
+        factory = sc.as_placement_group_factory()
+        # Worker bundles follow the trainer's head bundle unless the head
+        # is empty and thus absent from the created group.
+        self._bundle_offset = 0 if factory.head_bundle_is_empty else 1
         if placement_group is None:
-            factory = sc.as_placement_group_factory()
             self._pg = factory()
             if not self._pg.wait(timeout_seconds=60):
                 raise TrainBackendError(
                     f"Timed out reserving resources for {sc.num_workers} "
                     f"workers: {factory.required_resources()}")
             placement_group = self._pg
+        # The group used for (re)starts — owned or externally supplied
+        # (e.g. the enclosing Tune trial's reservation).
+        self._used_pg = placement_group
         self.worker_group = WorkerGroup(
             num_workers=sc.num_workers,
             resources_per_worker=sc.worker_bundle(),
-            placement_group=placement_group)
+            placement_group=placement_group,
+            bundle_offset=self._bundle_offset)
         self._backend.on_start(self.worker_group, self._backend_config)
 
     def start_training(self, train_func: Callable[[], Any],
@@ -68,6 +78,7 @@ class BackendExecutor:
                        dataset_shards: Optional[List[dict]] = None) -> None:
         wg = self.worker_group
         assert wg is not None, "call start() first"
+        self._finished_ranks = set()
         if not wg.metadata:
             wg.fetch_metadata()
         metas = wg.metadata
@@ -89,13 +100,20 @@ class BackendExecutor:
         ray_tpu.get([w.start_training.remote() for w in wg.workers])
 
     def get_next_results(self) -> Optional[List[_TrainingResult]]:
-        """Fetch one result from every worker (lockstep). Returns None
-        when all workers finished cleanly; raises the user error if any
-        worker's train_func raised; raises TrainingWorkerError if a
-        worker process died."""
+        """Fetch one result from every still-running worker (lockstep).
+        Returns the results ordered by world rank (lowest live rank
+        first), None when all workers finished cleanly; raises the user
+        error if any worker's train_func raised; raises
+        TrainingWorkerError if a worker process died. Finished workers
+        are never polled again (their queue is empty — a second
+        get_next would block forever)."""
         wg = self.worker_group
         assert wg is not None
-        futs = [w.get_next.remote() for w in wg.workers]
+        live = [rank for rank in range(len(wg.workers))
+                if rank not in self._finished_ranks]
+        if not live:
+            return None
+        futs = [wg.workers[rank].get_next.remote() for rank in live]
         try:
             results: List[_TrainingResult] = ray_tpu.get(futs)
         except (ActorError, ActorDiedError) as e:
@@ -103,13 +121,16 @@ class BackendExecutor:
         for r in results:
             if r.error is not None:
                 raise r.error
-        if all(r.done for r in results):
+        out = []
+        for rank, r in zip(live, results):
+            if r.done:
+                self._finished_ranks.add(rank)
+            else:
+                out.append(r)
+        if len(self._finished_ranks) == len(wg.workers):
             return None
-        if any(r.done for r in results):
-            # Ragged finish: some workers returned while others report.
-            # Treat as finished once every live result is drained.
-            return [r for r in results if not r.done] or None
-        return results
+        # Ragged finish round: drop the done markers, keep live results.
+        return out if out else self.get_next_results()
 
     def shutdown(self) -> None:
         if self.worker_group is not None:
@@ -129,14 +150,15 @@ class BackendExecutor:
             self._pg = None
 
     def restart(self) -> None:
-        """Slice-granular restart (reference ``_restart`` :690)."""
+        """Slice-granular restart (reference ``_restart`` :690). Reuses
+        the original reservation, whether owned or externally supplied."""
         wg = self.worker_group
         if wg is not None:
             wg.shutdown()
-        pg = self._pg
         sc = self._scaling_config
         self.worker_group = WorkerGroup(
             num_workers=sc.num_workers,
             resources_per_worker=sc.worker_bundle(),
-            placement_group=pg)
+            placement_group=self._used_pg,
+            bundle_offset=self._bundle_offset)
         self._backend.on_start(self.worker_group, self._backend_config)

@@ -25,8 +25,13 @@ class StorageContext:
         self.storage_path = os.path.abspath(os.path.expanduser(storage_path))
         self.experiment_name = experiment_name
         self.trial_dir_name = trial_dir_name
-        self.current_checkpoint_index = 0
         os.makedirs(self.experiment_dir, exist_ok=True)
+        # Resume numbering past any checkpoints already on disk so a
+        # restored/restarted run never overwrites earlier directories.
+        existing = self.list_checkpoints()
+        self.current_checkpoint_index = (
+            int(os.path.basename(existing[-1]).split("_")[-1]) + 1
+            if existing else 0)
 
     @property
     def experiment_dir(self) -> str:

@@ -85,7 +85,8 @@ class WorkerGroup:
 
     def __init__(self, num_workers: int,
                  resources_per_worker: Optional[Dict[str, float]] = None,
-                 placement_group=None, actor_cls_env: Optional[dict] = None):
+                 placement_group=None, bundle_offset: int = 1,
+                 actor_cls_env: Optional[dict] = None):
         self.num_workers = num_workers
         self._pg = placement_group
         opts: Dict[str, Any] = {}
@@ -103,11 +104,12 @@ class WorkerGroup:
             if placement_group is not None:
                 from ray_tpu.util.scheduling_strategies import (
                     PlacementGroupSchedulingStrategy)
-                # Bundle 0 is the trainer's; workers take bundles 1..N.
+                # Worker bundles start after the trainer's head bundle
+                # (offset 0 when the head bundle was empty/absent).
                 w_opts["scheduling_strategy"] = (
                     PlacementGroupSchedulingStrategy(
                         placement_group,
-                        placement_group_bundle_index=i + 1))
+                        placement_group_bundle_index=i + bundle_offset))
             self.workers.append(remote_cls.options(**w_opts).remote())
 
     def fetch_metadata(self) -> List[WorkerMetadata]:

@@ -45,10 +45,21 @@ class BaseTrainer:
 
         def _train_fn(config: Dict[str, Any]):
             from ray_tpu.tune import trainable as _t
+            from ray_tpu.tune._trial_context import get_trial_dir
             import copy
+            import os
             t = copy.copy(trainer)
             if config:
                 t = t._with_parameters(config)
+            # Under Tune each trial gets its own directory; point the
+            # inner run's storage there so concurrent trials never share
+            # checkpoint paths.
+            trial_dir = get_trial_dir()
+            if trial_dir:
+                t.run_config = copy.copy(t.run_config)
+                t.run_config.name = os.path.basename(trial_dir.rstrip("/"))
+                t.run_config.storage_path = os.path.dirname(
+                    trial_dir.rstrip("/"))
             result = t.fit()
             if result.error:
                 raise result.error
@@ -64,8 +75,11 @@ class BaseTrainer:
     def _with_parameters(self, config: Dict[str, Any]) -> "BaseTrainer":
         import copy
         t = copy.copy(self)
+        # Reference convention: a trainer's param_space nests the loop
+        # config under "train_loop_config"; flat dicts merge directly.
+        overrides = config.get("train_loop_config", config)
         loop_cfg = dict(getattr(t, "train_loop_config", None) or {})
-        loop_cfg.update(config)
+        loop_cfg.update(overrides)
         t.train_loop_config = loop_cfg
         return t
 

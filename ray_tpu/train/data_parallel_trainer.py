@@ -87,8 +87,12 @@ class DataParallelTrainer(BaseTrainer):
         checkpoint = self.resume_from_checkpoint
         failures = 0
         error: Optional[BaseException] = None
+        # Inside a Tune trial the trial's placement group already reserves
+        # the worker bundles — reuse it instead of reserving twice.
+        from ray_tpu.tune._trial_context import get_trial_placement_group
+        trial_pg = get_trial_placement_group()
         try:
-            executor.start()
+            executor.start(placement_group=trial_pg)
             executor.start_training(
                 train_func, checkpoint=checkpoint,
                 dataset_shards=self._dataset_shards(
@@ -122,6 +126,15 @@ class DataParallelTrainer(BaseTrainer):
                 ckpt = results[0].checkpoint
                 if ckpt is not None:
                     manager.register_checkpoint(ckpt, latest_metrics)
+                    # Advance the driver-side index so a gang restart
+                    # hands workers a StorageContext that numbers past
+                    # already-persisted checkpoints.
+                    import os as _os
+                    base = _os.path.basename(ckpt.path.rstrip("/"))
+                    if base.startswith("checkpoint_"):
+                        storage.current_checkpoint_index = max(
+                            storage.current_checkpoint_index,
+                            int(base.split("_")[-1]) + 1)
         finally:
             executor.shutdown()
 

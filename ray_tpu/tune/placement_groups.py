@@ -33,9 +33,16 @@ class PlacementGroupFactory:
         return total
 
     def __call__(self):
-        """Create the placement group (non-empty bundles only)."""
+        """Create the placement group. An empty HEAD bundle is omitted
+        (consumers must then use bundle offset 0 for workers); empty
+        non-head bundles are invalid — dropping them would silently
+        shift every later bundle index."""
         from ray_tpu.util.placement_group import placement_group
-        bundles = [b for b in self.bundles if b]
+        bundles = self.bundles[1:] if self.head_bundle_is_empty \
+            else self.bundles
+        if any(not b for b in bundles):
+            raise ValueError(
+                f"Empty non-head bundle in {self.bundles!r}")
         return placement_group(bundles, strategy=self.strategy)
 
     def __eq__(self, other):

@@ -1,0 +1,99 @@
+"""Population Based Training.
+
+Reference: ``python/ray/tune/schedulers/pbt.py`` — every
+``perturbation_interval``, bottom-quantile trials EXPLOIT a top-quantile
+trial (clone weights via checkpoint + copy config) and EXPLORE (mutate
+hyperparams: resample with prob ``resample_probability``, else
+perturb ×1.2/×0.8). The controller performs the actual clone via
+save/restore on the trial actors.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, List, Optional, Union
+
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+from ray_tpu.tune.search.sample import Domain
+from ray_tpu.tune.trainable import TRAINING_ITERATION
+
+
+def _explore(config: Dict, mutations: Dict, resample_prob: float,
+             rng: random.Random) -> Dict:
+    new = dict(config)
+    for key, spec in mutations.items():
+        old = config.get(key)
+        if rng.random() < resample_prob or old is None:
+            if isinstance(spec, Domain):
+                new[key] = spec.sample(rng)
+            elif isinstance(spec, list):
+                new[key] = rng.choice(spec)
+            elif callable(spec):
+                new[key] = spec()
+        else:
+            if isinstance(spec, list):
+                # move to a neighboring listed value
+                try:
+                    i = spec.index(old)
+                    j = max(0, min(len(spec) - 1,
+                                   i + rng.choice([-1, 1])))
+                    new[key] = spec[j]
+                except ValueError:
+                    new[key] = rng.choice(spec)
+            elif isinstance(old, (int, float)):
+                factor = rng.choice([0.8, 1.2])
+                new[key] = type(old)(old * factor)
+    return new
+
+
+class PopulationBasedTraining(TrialScheduler):
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 time_attr: str = TRAINING_ITERATION,
+                 perturbation_interval: float = 10,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, float] = {}
+        self._latest: Dict[str, float] = {}  # trial_id -> score
+        self.perturbation_count = 0
+
+    def on_trial_result(self, controller, trial, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        score = self._score(result)
+        if t is None or score is None:
+            return self.CONTINUE
+        self._latest[trial.trial_id] = score
+        last = self._last_perturb.get(trial.trial_id, 0.0)
+        if t - last < self.interval:
+            return self.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+
+        live = {tid: s for tid, s in self._latest.items()
+                if controller.is_live(tid)}
+        if len(live) < 2:
+            return self.CONTINUE
+        ordered = sorted(live, key=live.get)
+        n_q = max(1, int(len(ordered) * self.quantile))
+        bottom = set(ordered[:n_q])
+        top = ordered[-n_q:]
+        if trial.trial_id not in bottom:
+            return self.CONTINUE
+        source_id = self._rng.choice(
+            [tid for tid in top if tid != trial.trial_id] or top)
+        source = controller.get_trial(source_id)
+        if source is None or source is trial:
+            return self.CONTINUE
+        new_config = _explore(source.config, self.mutations,
+                              self.resample_prob, self._rng)
+        controller.exploit_trial(trial, source, new_config)
+        self.perturbation_count += 1
+        return self.CONTINUE

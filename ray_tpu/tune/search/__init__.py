@@ -1,0 +1,12 @@
+from ray_tpu.tune.search.sample import (
+    choice, grid_search, lograndint, loguniform, qloguniform, qrandint,
+    quniform, randint, randn, sample_from, uniform)
+from ray_tpu.tune.search.searcher import (
+    BasicVariantGenerator, ConcurrencyLimiter, OptunaSearch, Searcher)
+
+__all__ = [
+    "BasicVariantGenerator", "ConcurrencyLimiter", "OptunaSearch",
+    "Searcher", "choice", "grid_search", "lograndint", "loguniform",
+    "qloguniform", "qrandint", "quniform", "randint", "randn",
+    "sample_from", "uniform",
+]

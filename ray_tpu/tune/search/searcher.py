@@ -1,0 +1,174 @@
+"""Searcher interface + built-in search algorithms.
+
+Reference: ``python/ray/tune/search/searcher.py`` (``Searcher`` ABC with
+``suggest``/``on_trial_complete``), ``basic_variant.py``
+(``BasicVariantGenerator``: grid + random, the default), and the wrapper
+pattern of ``concurrency_limiter.py``. Third-party searchers (hyperopt,
+optuna, …) follow the same interface; OptunaSearch is provided gated on
+the optional dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.search.variant_generator import generate_variants
+
+
+class Searcher:
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str],
+                              config: Dict) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        """Next config, or None when exhausted."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid + random sampling (reference default searcher)."""
+
+    def __init__(self, points_to_evaluate: Optional[List[Dict]] = None,
+                 max_concurrent: int = 0,
+                 random_state: Optional[int] = None):
+        super().__init__()
+        self._points = list(points_to_evaluate or [])
+        self._space: Optional[Dict] = None
+        self._num_samples = 1
+        self._variants = None
+        self._seed = random_state
+        self.max_concurrent = max_concurrent
+
+    def set_search_properties(self, metric, mode, config,
+                              num_samples: int = 1) -> bool:
+        super().set_search_properties(metric, mode, config)
+        self._space = config
+        self._num_samples = num_samples
+        self._variants = iter(self._make())
+        return True
+
+    def _make(self):
+        for p in self._points:
+            yield dict(p)
+        if self._space is not None:
+            remaining = self._num_samples
+            yield from generate_variants(
+                self._space, num_samples=remaining, seed=self._seed)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._variants is None:
+            self._variants = iter(self._make())
+        try:
+            return next(self._variants)
+        except StopIteration:
+            return None
+
+    @property
+    def total_samples(self) -> int:
+        from ray_tpu.tune.search.variant_generator import _find_grids
+        n_grid = 1
+        for _, vals in _find_grids(self._space or {}):
+            n_grid *= max(1, len(vals))
+        return len(self._points) + n_grid * self._num_samples
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (reference concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, config, **kw) -> bool:
+        return self.searcher.set_search_properties(metric, mode, config, **kw)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+
+class OptunaSearch(Searcher):
+    """TPE via optuna, if installed (reference ``search/optuna/``)."""
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        try:
+            import optuna  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires `optuna`, which is not installed."
+            ) from e
+        self._seed = seed
+        self._study = None
+        self._space = None
+        self._live: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, config, **kw) -> bool:
+        super().set_search_properties(metric, mode, config)
+        import optuna
+        self._space = config
+        direction = "maximize" if self.mode == "max" else "minimize"
+        sampler = optuna.samplers.TPESampler(seed=self._seed)
+        self._study = optuna.create_study(
+            direction=direction, sampler=sampler)
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        from ray_tpu.tune.search import sample as s
+        ot = self._study.ask()
+        cfg = {}
+        for k, v in (self._space or {}).items():
+            if isinstance(v, s.Float):
+                cfg[k] = ot.suggest_float(k, v.lower, v.upper, log=v.log)
+            elif isinstance(v, s.Integer):
+                cfg[k] = ot.suggest_int(k, v.lower, v.upper - 1, log=v.log)
+            elif isinstance(v, s.Categorical):
+                cfg[k] = ot.suggest_categorical(k, v.categories)
+            elif isinstance(v, s.Domain):
+                cfg[k] = v.sample(__import__("random").Random())
+            else:
+                cfg[k] = v
+        self._live[trial_id] = ot
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        ot = self._live.pop(trial_id, None)
+        if ot is None or self._study is None:
+            return
+        if error or not result or self.metric not in result:
+            self._study.tell(ot, state=__import__(
+                "optuna").trial.TrialState.FAIL)
+        else:
+            self._study.tell(ot, result[self.metric])

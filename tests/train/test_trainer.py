@@ -193,3 +193,23 @@ def test_trainer_restore(ray_session, storage_path):
     r2 = trainer2.fit()
     assert r2.error is None
     assert r2.metrics["step"] == 3
+
+
+def test_ragged_worker_finish(ray_session, storage_path):
+    """Workers reporting unequal counts must not hang the driver or
+    misattribute metrics (regression for the finished-worker poll)."""
+    def train_func():
+        import ray_tpu.train as train
+        rank = train.get_context().get_world_rank()
+        for i in range(2 if rank == 0 else 4):
+            train.report({"i": i, "rank": rank})
+
+    trainer = DataParallelTrainer(
+        train_func,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ragged", storage_path=storage_path))
+    result = trainer.fit()
+    assert result.error is None
+    # after rank 0 finishes, rank 1's results drive the loop to the end
+    assert result.metrics["i"] == 3
+    assert result.metrics["rank"] == 1

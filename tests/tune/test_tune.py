@@ -1,0 +1,274 @@
+"""Tune tests, modeled on the reference's ``python/ray/tune/tests``
+patterns: trainable stubs, scheduler-level unit tests, end-to-end Tuner
+runs on a local cluster."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import CheckpointConfig, RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler, MedianStoppingRule, PopulationBasedTraining)
+from ray_tpu.tune.search.variant_generator import generate_variants
+
+
+# ---------------------------------------------------------------- search
+def test_generate_variants_grid_and_samples():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.uniform(0.0, 1.0),
+        "arch": "fixed",
+    }
+    variants = list(generate_variants(space, num_samples=3, seed=0))
+    assert len(variants) == 6  # 2-point grid x 3 samples
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert all(0.0 <= v["wd"] <= 1.0 for v in variants)
+    assert all(v["arch"] == "fixed" for v in variants)
+
+
+def test_sample_domains():
+    import random
+    rng = random.Random(0)
+    assert 1 <= tune.randint(1, 10).sample(rng) < 10
+    assert tune.choice(["a", "b"]).sample(rng) in ("a", "b")
+    v = tune.qloguniform(1e-4, 1e-1, 1e-4).sample(rng)
+    assert abs(round(v / 1e-4) * 1e-4 - v) < 1e-9
+    assert tune.sample_from(lambda: 42).sample(rng) == 42
+
+
+# ------------------------------------------------------------ end-to-end
+def test_tuner_function_trainable(ray_session, tmp_path):
+    def objective(config):
+        score = -(config["x"] - 3.0) ** 2
+        for i in range(3):
+            tune.report({"score": score + i * 0.01})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1.0, 3.0, 5.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="fn", storage_path=str(tmp_path)))
+    results = tuner.fit()
+    assert len(results) == 3
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.metrics["config"]["x"] == 3.0
+    assert best.metrics["training_iteration"] == 3
+
+
+def test_tuner_class_trainable_with_checkpoint(ray_session, tmp_path):
+    class Quad(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.steps = 0
+
+        def step(self):
+            self.steps += 1
+            return {"score": -self.x ** 2 + self.steps}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "state.txt"), "w") as f:
+                f.write(str(self.steps))
+            return d
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "state.txt")) as f:
+                self.steps = int(f.read())
+
+    tuner = Tuner(
+        Quad,
+        param_space={"x": tune.grid_search([0.0, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="cls", storage_path=str(tmp_path),
+                             stop={"training_iteration": 4}))
+    results = tuner.fit()
+    assert results.num_errors == 0
+    best = results.get_best_result()
+    assert best.metrics["config"]["x"] == 0.0
+    assert best.checkpoint is not None
+    with open(os.path.join(best.checkpoint.path, "state.txt")) as f:
+        assert int(f.read()) == 4
+
+
+def test_asha_stops_bad_trials(ray_session, tmp_path):
+    def objective(config):
+        for i in range(20):
+            tune.report({"score": config["q"] * (i + 1)})
+
+    tuner = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([1.0, 0.9, 0.2, 0.1])},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=ASHAScheduler(max_t=20, grace_period=2,
+                                    reduction_factor=2),
+            max_concurrent_trials=2),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)))
+    results = tuner.fit()
+    assert results.num_errors == 0
+    iters = {r.metrics["config"]["q"]: r.metrics["training_iteration"]
+             for r in results}
+    # the best trial runs to max_t; at least one poor one is cut early
+    assert iters[1.0] == 20
+    assert min(iters.values()) < 20
+
+
+def test_median_stopping_rule_decisions():
+    from ray_tpu.tune.experiment import Trial
+    rule = MedianStoppingRule(metric="m", mode="max", grace_period=0,
+                              min_samples_required=1)
+    good, bad = Trial("good", {}), Trial("bad", {})
+    for t in range(1, 4):
+        assert rule.on_trial_result(
+            None, good, {"training_iteration": t, "m": 10.0}) == "CONTINUE"
+    d = None
+    for t in range(1, 4):
+        d = rule.on_trial_result(
+            None, bad, {"training_iteration": t, "m": 1.0})
+    assert d == "STOP"
+
+
+def test_pbt_exploits(ray_session, tmp_path):
+    class Walker(tune.Trainable):
+        def setup(self, config):
+            self.value = 0.0
+
+        def step(self):
+            self.value += self.config["rate"]
+            return {"score": self.value, "rate": self.config["rate"]}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "v.txt"), "w") as f:
+                f.write(str(self.value))
+            return d
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "v.txt")) as f:
+                self.value = float(f.read())
+
+        def reset_config(self, new_config):
+            self.config = new_config
+            return True
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"rate": [0.1, 1.0]},
+        quantile_fraction=0.5, resample_probability=0.0, seed=0)
+    tuner = Tuner(
+        Walker,
+        param_space={"rate": tune.grid_search([0.1, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path),
+                             stop={"training_iteration": 9}))
+    results = tuner.fit()
+    assert results.num_errors == 0
+    assert pbt.perturbation_count >= 1
+    # the exploited trial caught up: both trials end well above the
+    # slow-rate-only trajectory (9 * 0.1)
+    finals = sorted(r.metrics["score"] for r in results)
+    assert finals[0] > 2.0
+
+
+def test_tuner_restore_resumes_unfinished(ray_session, tmp_path):
+    class Counter(tune.Trainable):
+        def setup(self, config):
+            self.i = 0
+
+        def step(self):
+            self.i += 1
+            return {"count": self.i}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "i.txt"), "w") as f:
+                f.write(str(self.i))
+            return d
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "i.txt")) as f:
+                self.i = int(f.read())
+
+    tuner = Tuner(
+        Counter,
+        param_space={"a": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="count", mode="max"),
+        run_config=RunConfig(name="resume", storage_path=str(tmp_path),
+                             stop={"training_iteration": 3}))
+    results = tuner.fit()
+    assert results.num_errors == 0
+    exp_dir = os.path.join(str(tmp_path), "resume")
+    assert Tuner.can_restore(exp_dir)
+
+    tuner2 = Tuner.restore(exp_dir, Counter)
+    results2 = tuner2.fit()
+    # everything already terminated -> nothing re-runs, results retained
+    assert len(results2) == 2
+    assert all(r.metrics["count"] == 3 for r in results2)
+
+
+def test_trial_failure_retries(ray_session, tmp_path):
+    def flaky(config):
+        marker = config["marker"]
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("first attempt dies")
+        for i in range(2):
+            tune.report({"ok": 1})
+
+    from ray_tpu.air.config import FailureConfig
+    tuner = Tuner(
+        flaky,
+        param_space={"marker": str(tmp_path / "marker")},
+        tune_config=TuneConfig(metric="ok", mode="max"),
+        run_config=RunConfig(
+            name="flaky", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1)))
+    results = tuner.fit()
+    assert results.num_errors == 0
+    assert results[0].metrics["ok"] == 1
+
+
+def test_with_parameters_and_resources(ray_session, tmp_path):
+    data = list(range(100))
+
+    def objective(config, dataset=None):
+        tune.report({"n": len(dataset) + config["x"]})
+
+    bound = tune.with_parameters(objective, dataset=data)
+    bound = tune.with_resources(bound, {"CPU": 1})
+    results = tune.run(bound, config={"x": tune.grid_search([1])},
+                       metric="n", mode="max",
+                       storage_path=str(tmp_path), name="wp")
+    assert results[0].metrics["n"] == 101
+
+
+def test_tune_over_trainer(ray_session, tmp_path):
+    """Trainer-in-Tune: Tuner drives a DataParallelTrainer trainable,
+    reusing the trial placement group for the worker gang (reference
+    TrainTrainable, base_trainer.py:711)."""
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig, RunConfig
+
+    def train_func(config):
+        import ray_tpu.train as train
+        for i in range(2):
+            train.report({"loss": config["lr"] * (i + 1),
+                          "ws": train.get_context().get_world_size()})
+
+    trainer = DataParallelTrainer(
+        train_func,
+        train_loop_config={"lr": 1.0},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path / "inner")))
+    tuner = Tuner(
+        trainer,
+        param_space={"train_loop_config": {
+            "lr": tune.grid_search([0.5, 0.1])}},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="tot", storage_path=str(tmp_path)))
+    results = tuner.fit()
+    assert results.num_errors == 0, results.errors
+    best = results.get_best_result()
+    assert best.metrics["loss"] == pytest.approx(0.2)
+    assert best.metrics["ws"] == 2
