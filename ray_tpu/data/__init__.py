@@ -1,0 +1,55 @@
+"""ray_tpu.data: streaming distributed datasets
+(reference: ``python/ray/data/``).
+
+Public surface mirrors ``ray.data``: ``range``/``from_*``/``read_*``
+constructors, the lazy ``Dataset`` with fused streaming execution, and
+``DataContext``.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import (
+    ActorPoolStrategy, Dataset, MaterializedDataset)
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.datasource import (
+    from_arrow,
+    from_huggingface,
+    from_items,
+    from_numpy,
+    from_pandas,
+    from_torch,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+    read_tfrecords,
+)
+
+__all__ = [
+    "ActorPoolStrategy",
+    "Block",
+    "BlockAccessor",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "MaterializedDataset",
+    "from_arrow",
+    "from_huggingface",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "from_torch",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+    "read_tfrecords",
+]

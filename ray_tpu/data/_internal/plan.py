@@ -1,0 +1,284 @@
+"""Logical plan + fused streaming execution.
+
+Reference: ``python/ray/data/_internal/plan.py`` (ExecutionPlan),
+``logical/`` operators, and ``execution/streaming_executor.py:55``. The
+design keeps the reference's two key properties, re-expressed compactly:
+
+- **operator fusion**: consecutive one-to-one ops (read→map→filter…)
+  fuse into a single remote task per block (reference
+  ``logical/rules/operator_fusion.py``), so a ``read_parquet →
+  map_batches → filter`` chain costs one task per block, not three.
+- **streaming with backpressure**: blocks flow through the fused stages
+  as a pull-based iterator with a bounded number of in-flight tasks
+  (reference ``StreamingExecutor._scheduling_loop_step`` +
+  backpressure policies); downstream consumption paces submission.
+
+All-to-all ops (shuffle/sort/repartition) are barriers, as in the
+reference's exchange operators (``planner/exchange/``).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, _to_table
+from ray_tpu.data.context import DataContext
+
+
+# ---------------------------------------------------------------- ops
+@dataclass
+class ReadOp:
+    """Source: a list of zero-arg callables each producing a Block."""
+    tasks: List[Callable[[], Block]]
+    name: str = "Read"
+
+
+@dataclass
+class InputDataOp:
+    """Source: pre-materialized block refs."""
+    block_refs: List[Any]
+    name: str = "InputData"
+
+
+@dataclass
+class OneToOneOp:
+    """A per-block transform: fn(Block) -> Block. Fusable."""
+    fn: Callable[[Block], Block]
+    name: str = "Map"
+    # actor-pool compute (None = task pool)
+    actor_pool_size: Optional[int] = None
+    fn_constructor: Optional[Callable[[], Any]] = None
+
+
+@dataclass
+class AllToAllOp:
+    """Barrier op over the full materialized block list."""
+    fn: Callable[[List[Any]], List[Any]]  # refs -> refs
+    name: str = "AllToAll"
+
+
+@dataclass
+class LimitOp:
+    n: int
+    name: str = "Limit"
+
+
+@dataclass
+class UnionOp:
+    others: List["ExecutionPlan"]
+    name: str = "Union"
+
+
+class ExecutionPlan:
+    def __init__(self, source, ops: Optional[List[Any]] = None):
+        self.source = source  # ReadOp | InputDataOp
+        self.ops: List[Any] = ops or []
+
+    def with_op(self, op) -> "ExecutionPlan":
+        return ExecutionPlan(self.source, self.ops + [op])
+
+    def source_len(self) -> int:
+        if isinstance(self.source, ReadOp):
+            return len(self.source.tasks)
+        return len(self.source.block_refs)
+
+    def __repr__(self):
+        names = [getattr(self.source, "name", "?")] + [
+            op.name for op in self.ops]
+        return " -> ".join(names)
+
+
+# ----------------------------------------------------------- execution
+def _apply_chain(fns: List[Callable[[Block], Block]], item) -> Block:
+    """The fused stage body: run a producer or block through the chain
+    of one-to-one transforms. Runs remotely, one task per block."""
+    block = item() if callable(item) else item
+    for fn in fns:
+        block = fn(block)
+    return block
+
+
+class _ActorStage:
+    """Actor holding stateful transform constructors for an actor-pool
+    stage (reference ``ActorPoolMapOperator``; callable-class UDFs)."""
+
+    def __init__(self, constructors: List[Optional[Callable]]):
+        self._instances = [c() if c is not None else None
+                           for c in constructors]
+
+    def apply(self, fns: List[Callable], item) -> Block:
+        block = item() if callable(item) else item
+        for fn, inst in zip(fns, self._instances):
+            if inst is not None:
+                block = fn(block, inst)
+            else:
+                block = fn(block)
+        return block
+
+
+def _fuse(ops: List[Any]) -> List[Any]:
+    """Group consecutive OneToOneOps with compatible compute into fused
+    stages; barrier/limit ops pass through."""
+    fused: List[Any] = []
+    buf: List[OneToOneOp] = []
+
+    def flush():
+        if buf:
+            fused.append(list(buf))
+            buf.clear()
+
+    prev_pool: Optional[int] = None
+    for op in ops:
+        if isinstance(op, OneToOneOp):
+            if buf and op.actor_pool_size != prev_pool:
+                flush()
+            buf.append(op)
+            prev_pool = op.actor_pool_size
+        else:
+            flush()
+            fused.append(op)
+    flush()
+    return fused
+
+
+def execute_streaming(plan: ExecutionPlan,
+                      ctx: Optional[DataContext] = None
+                      ) -> Iterator[Any]:
+    """Yield output block refs, submitting at most
+    ``ctx.max_tasks_in_flight_per_operator`` tasks ahead of consumption."""
+    ctx = ctx or DataContext.get_current()
+
+    # Source items: callables (read tasks) or ready refs.
+    if isinstance(plan.source, ReadOp):
+        items: Iterator[Any] = iter(plan.source.tasks)
+        items_are_refs = False
+    else:
+        items = iter(plan.source.block_refs)
+        items_are_refs = True
+
+    stages = _fuse(plan.ops)
+    stream = _run_stages(items, items_are_refs, stages, ctx)
+    yield from stream
+
+
+def _run_stages(items: Iterator[Any], items_are_refs: bool,
+                stages: List[Any], ctx: DataContext) -> Iterator[Any]:
+    if not stages:
+        # Source only: materialize reads into refs.
+        if items_are_refs:
+            yield from items
+        else:
+            yield from _window_map(
+                items, lambda task: _remote_apply([], task), ctx)
+        return
+
+    stage, rest = stages[0], stages[1:]
+    if isinstance(stage, list):  # fused one-to-one stage
+        out = _run_fused_stage(items, items_are_refs, stage, ctx)
+        yield from _run_stages(out, True, rest, ctx)
+    elif isinstance(stage, AllToAllOp):
+        refs = list(_run_stages(items, items_are_refs, [], ctx))
+        out_refs = stage.fn(refs)
+        yield from _run_stages(iter(out_refs), True, rest, ctx)
+    elif isinstance(stage, LimitOp):
+        out = _run_limit(
+            _run_stages(items, items_are_refs, [], ctx), stage.n)
+        yield from _run_stages(out, True, rest, ctx)
+    elif isinstance(stage, UnionOp):
+        def chained():
+            yield from _run_stages(items, items_are_refs, [], ctx)
+            for other in stage.others:
+                yield from execute_streaming(other, ctx)
+        yield from _run_stages(chained(), True, rest, ctx)
+    else:
+        raise TypeError(f"Unknown stage: {stage!r}")
+
+
+_remote_apply_cached = None
+_remote_actor_cached = None
+
+
+def _get_remote_apply():
+    global _remote_apply_cached
+    if _remote_apply_cached is None:
+        _remote_apply_cached = ray_tpu.remote(num_cpus=1)(_apply_chain)
+    return _remote_apply_cached
+
+
+def _remote_apply(fns, item):
+    return _get_remote_apply().remote(fns, item)
+
+
+def _window_map(items: Iterator[Any], submit: Callable[[Any], Any],
+                ctx: DataContext) -> Iterator[Any]:
+    """Submit tasks keeping a bounded in-flight window; yield refs in
+    order (ordered streaming, like the reference's default)."""
+    window = ctx.max_tasks_in_flight_per_operator
+    inflight: List[Any] = []
+    for item in items:
+        inflight.append(submit(item))
+        if len(inflight) >= window:
+            yield inflight.pop(0)
+    while inflight:
+        yield inflight.pop(0)
+
+
+def _run_fused_stage(items: Iterator[Any], items_are_refs: bool,
+                     stage: List[OneToOneOp], ctx: DataContext
+                     ) -> Iterator[Any]:
+    pool_size = stage[0].actor_pool_size
+    if pool_size is None:
+        fns = [op.fn for op in stage]
+        yield from _window_map(
+            items, lambda item: _remote_apply(fns, item), ctx)
+        return
+    # Actor-pool stage: round-robin blocks over a pool of stage actors.
+    constructors = [op.fn_constructor for op in stage]
+    fns = [op.fn for op in stage]
+    actor_cls = ray_tpu.remote(num_cpus=1)(_ActorStage)
+    actors = [actor_cls.remote(constructors) for _ in range(pool_size)]
+    try:
+        i = 0
+        window = max(pool_size * 2, ctx.max_tasks_in_flight_per_operator)
+        inflight: List[Any] = []
+        for item in items:
+            actor = actors[i % pool_size]
+            i += 1
+            inflight.append(actor.apply.remote(fns, item))
+            if len(inflight) >= window:
+                yield inflight.pop(0)
+        while inflight:
+            yield inflight.pop(0)
+    finally:
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+def _num_rows(block: Block) -> int:
+    return block.num_rows
+
+
+def _slice_block(block: Block, n: int) -> Block:
+    return BlockAccessor(block).slice(0, n)
+
+
+def _run_limit(refs: Iterator[Any], n: int) -> Iterator[Any]:
+    remaining = n
+    rows_fn = ray_tpu.remote(num_cpus=1)(_num_rows)
+    slice_fn = ray_tpu.remote(num_cpus=1)(_slice_block)
+    for ref in refs:
+        if remaining <= 0:
+            break
+        rows = ray_tpu.get(rows_fn.remote(ref))
+        if rows <= remaining:
+            remaining -= rows
+            yield ref
+        else:
+            yield slice_fn.remote(ref, remaining)
+            remaining = 0

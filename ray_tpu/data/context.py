@@ -1,0 +1,32 @@
+"""DataContext: execution knobs (reference:
+``python/ray/data/context.py`` — ``DataContext.get_current()``)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    # Streaming backpressure: max map tasks in flight per operator.
+    max_tasks_in_flight_per_operator: int = 8
+    # Default batch format for map_batches/iter_batches.
+    default_batch_format: str = "numpy"
+    # Parallelism used by read_*/range when not given.
+    default_parallelism: int = 8
+    use_push_based_shuffle: bool = False
+    eager_free: bool = True
+
+    _current: "Optional[DataContext]" = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = DataContext()
+            return cls._current

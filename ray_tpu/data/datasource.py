@@ -1,0 +1,215 @@
+"""Read/write APIs: range, from_items/pandas/numpy, parquet/csv/json/
+text/tfrecords/binary files.
+
+Reference: ``python/ray/data/read_api.py`` + ``datasource/`` (parquet,
+csv, json, range, …). Each read resolves to N zero-arg read tasks (one
+per file / range shard); the fused executor runs read+transforms as one
+task per block.
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+import glob as glob_mod
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, BlockAccessor, _to_table
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, MaterializedDataset
+from ray_tpu.data._internal.plan import ExecutionPlan, InputDataOp, ReadOp
+
+
+def _make_dataset(tasks: List[Callable[[], Block]], name: str) -> Dataset:
+    return Dataset(ExecutionPlan(ReadOp(tasks, name=name)))
+
+
+def _resolve_paths(paths: Union[str, List[str]], suffixes) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.path.expanduser(p)
+        if os.path.isdir(p):
+            for suffix in suffixes:
+                out.extend(sorted(glob_mod.glob(
+                    os.path.join(p, f"**/*{suffix}"), recursive=True)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"No files found for {paths!r}")
+    return out
+
+
+# ------------------------------------------------------------- sources
+def range(n: int, *, parallelism: int = -1) -> Dataset:
+    """Integers [0, n) in column "id" (reference ``ray.data.range``)."""
+    ctx = DataContext.get_current()
+    p = parallelism if parallelism > 0 else min(
+        ctx.default_parallelism, max(1, n))
+    base, extra = divmod(n, p)
+
+    def make_task(start: int, count: int) -> Callable[[], Block]:
+        return lambda: pa.table(
+            {"id": np.arange(start, start + count, dtype=np.int64)})
+
+    tasks, start = [], 0
+    for i in builtins.range(p):
+        count = base + (1 if i < extra else 0)
+        tasks.append(make_task(start, count))
+        start += count
+    return _make_dataset(tasks, "Range")
+
+
+def range_tensor(n: int, *, shape: tuple = (1,),
+                 parallelism: int = -1) -> Dataset:
+    ds = range(n, parallelism=parallelism)
+    size = int(np.prod(shape))
+
+    def to_tensor(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        ids = batch["id"]
+        data = np.repeat(ids[:, None], size, axis=1).reshape(
+            (len(ids),) + shape)
+        return {"data": data}
+    return ds.map_batches(to_tensor, batch_format="numpy")
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    ctx = DataContext.get_current()
+    p = parallelism if parallelism > 0 else min(
+        ctx.default_parallelism, max(1, len(items)))
+    chunks = np.array_split(np.arange(len(items)), p)
+
+    def make_task(idx: np.ndarray) -> Callable[[], Block]:
+        chunk = [items[i] for i in idx]
+        def read() -> Block:
+            if chunk and isinstance(chunk[0], dict):
+                return pa.Table.from_pylist(chunk)
+            return pa.table({"item": pa.array(chunk)})
+        return read
+    return _make_dataset([make_task(c) for c in chunks if len(c)],
+                         "FromItems")
+
+
+def from_pandas(dfs) -> MaterializedDataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    import ray_tpu
+    refs = [ray_tpu.put(_to_table(df)) for df in dfs]
+    return MaterializedDataset(ExecutionPlan(InputDataOp(refs)))
+
+
+def from_numpy(arrays) -> MaterializedDataset:
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    import ray_tpu
+    refs = [ray_tpu.put(_to_table({"data": a})) for a in arrays]
+    return MaterializedDataset(ExecutionPlan(InputDataOp(refs)))
+
+
+def from_arrow(tables) -> MaterializedDataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    import ray_tpu
+    refs = [ray_tpu.put(t) for t in tables]
+    return MaterializedDataset(ExecutionPlan(InputDataOp(refs)))
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """Zero-copy-ish import of a HuggingFace datasets.Dataset (arrow)."""
+    table = hf_dataset.data.table if hasattr(hf_dataset, "data") else None
+    if table is None:
+        return from_items([dict(r) for r in hf_dataset])
+    return from_arrow(table.combine_chunks())
+
+
+def from_torch(torch_dataset) -> Dataset:
+    return from_items([{"item": torch_dataset[i]}
+                       for i in builtins.range(len(torch_dataset))])
+
+
+# --------------------------------------------------------------- files
+def _file_read_dataset(paths, suffixes, read_one: Callable[[str], Block],
+                       name: str) -> Dataset:
+    files = _resolve_paths(paths, suffixes)
+
+    def make_task(path: str) -> Callable[[], Block]:
+        return lambda: read_one(path)
+    return _make_dataset([make_task(f) for f in files], name)
+
+
+def read_parquet(paths, **kwargs) -> Dataset:
+    import pyarrow.parquet as pq
+    return _file_read_dataset(
+        paths, [".parquet"], lambda p: pq.read_table(p, **kwargs),
+        "ReadParquet")
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    from pyarrow import csv as pacsv
+    return _file_read_dataset(
+        paths, [".csv"], lambda p: pacsv.read_csv(p), "ReadCSV")
+
+
+def read_json(paths, **kwargs) -> Dataset:
+    from pyarrow import json as pajson
+    return _file_read_dataset(
+        paths, [".json", ".jsonl"], lambda p: pajson.read_json(p),
+        "ReadJSON")
+
+
+def read_text(paths, **kwargs) -> Dataset:
+    def read_one(p: str) -> Block:
+        with open(p, "r", errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return pa.table({"text": pa.array(lines)})
+    return _file_read_dataset(paths, [".txt"], read_one, "ReadText")
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      **kwargs) -> Dataset:
+    def read_one(p: str) -> Block:
+        with open(p, "rb") as f:
+            data = f.read()
+        cols: Dict[str, Any] = {"bytes": pa.array([data])}
+        if include_paths:
+            cols["path"] = pa.array([p])
+        return pa.table(cols)
+    return _file_read_dataset(paths, [""], read_one, "ReadBinary")
+
+
+def read_numpy(paths, **kwargs) -> Dataset:
+    def read_one(p: str) -> Block:
+        return _to_table({"data": np.load(p)})
+    return _file_read_dataset(paths, [".npy"], read_one, "ReadNumpy")
+
+
+def read_tfrecords(paths, **kwargs) -> Dataset:
+    raise NotImplementedError(
+        "read_tfrecords requires the tensorflow reader, which is gated "
+        "out of this build; convert to parquet or use read_binary_files.")
+
+
+# --------------------------------------------------------------- write
+def write_blocks(ds: Dataset, path: str, fmt: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    for i, block in enumerate(ds.iter_blocks()):
+        if block.num_rows == 0:
+            continue
+        out = os.path.join(path, f"part-{i:05d}.{fmt}")
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+            pq.write_table(block, out)
+        elif fmt == "csv":
+            from pyarrow import csv as pacsv
+            pacsv.write_csv(block, out)
+        elif fmt == "json":
+            block.to_pandas().to_json(out, orient="records", lines=True)
+        else:
+            raise ValueError(fmt)

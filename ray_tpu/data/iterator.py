@@ -1,0 +1,184 @@
+"""Batch iteration + streaming shards for Train workers.
+
+Reference: ``python/ray/data/iterator.py`` (DataIterator) and the
+``streaming_split``/``OutputSplitter`` path
+(``execution/operators/output_splitter.py``): a coordinator actor feeds
+block refs to N shard iterators round-robin, so Train workers pull
+blocks as they are produced — no full materialization barrier.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+def iter_batches_over_blocks(blocks: Iterator[Block],
+                             batch_size: Optional[int],
+                             batch_format: str,
+                             drop_last: bool = False,
+                             shuffle_buffer_size: Optional[int] = None,
+                             shuffle_seed: Optional[int] = None
+                             ) -> Iterator[Any]:
+    """Re-chunk a block stream into fixed-size batches; optional local
+    shuffle buffer (reference ``iter_batches`` semantics)."""
+    rng = np.random.default_rng(shuffle_seed)
+    carry: List[pa.Table] = []
+    carry_rows = 0
+    buffer: List[pa.Table] = []
+    buffer_rows = 0
+
+    def emit(table: pa.Table):
+        return BlockAccessor(table).to_batch(batch_format)
+
+    def drain_carry():
+        nonlocal carry, carry_rows
+        merged = BlockAccessor.concat(carry) if len(carry) != 1 else carry[0]
+        carry, carry_rows = [], 0
+        return merged
+
+    source: Iterator[pa.Table]
+    if shuffle_buffer_size:
+        def shuffled() -> Iterator[pa.Table]:
+            nonlocal buffer, buffer_rows
+            for b in blocks:
+                buffer.append(b)
+                buffer_rows += b.num_rows
+                while buffer_rows >= shuffle_buffer_size:
+                    merged = BlockAccessor.concat(buffer)
+                    perm = rng.permutation(merged.num_rows)
+                    merged = BlockAccessor(merged).take(perm)
+                    half = merged.num_rows // 2
+                    yield merged.slice(0, half)
+                    buffer = [merged.slice(half)]
+                    buffer_rows = merged.num_rows - half
+            if buffer:
+                merged = BlockAccessor.concat(buffer)
+                perm = rng.permutation(merged.num_rows)
+                yield BlockAccessor(merged).take(perm)
+        source = shuffled()
+    else:
+        source = blocks
+
+    if batch_size is None:
+        for b in source:
+            if b.num_rows:
+                yield emit(b)
+        return
+
+    for b in source:
+        if b.num_rows == 0:
+            continue
+        carry.append(b)
+        carry_rows += b.num_rows
+        while carry_rows >= batch_size:
+            merged = drain_carry()
+            n_full = merged.num_rows // batch_size
+            for i in range(n_full):
+                yield emit(merged.slice(i * batch_size, batch_size))
+            rest = merged.num_rows - n_full * batch_size
+            if rest:
+                carry = [merged.slice(n_full * batch_size)]
+                carry_rows = rest
+    if carry_rows and not drop_last:
+        yield emit(drain_carry())
+
+
+class _SplitCoordinator:
+    """Actor that routes block refs to shards, balancing assigned ROWS
+    greedily (imbalance bounded by one block) so lockstep SPMD consumers
+    stay within a block of each other (reference ``OutputSplitter``).
+    Only refs flow through the coordinator — blocks move peer-to-peer
+    from producer tasks to consuming workers."""
+
+    def __init__(self, plan_holder, n: int, equal: bool):
+        ds = plan_holder()
+        self._it = ds.iter_block_refs()
+        self._n = n
+        self._equal = equal
+        self._queues = [collections.deque() for _ in range(n)]
+        self._rows = [0] * n
+        self._exhausted = False
+        self._next_shard = 0
+
+    def _assign_one(self) -> bool:
+        try:
+            ref = next(self._it)
+        except StopIteration:
+            self._exhausted = True
+            return False
+        if self._equal:
+            from ray_tpu.data._internal import shuffle as sh
+            nrows = ray_tpu.get(sh._r(sh._rows).remote(ref))
+            shard = min(range(self._n), key=lambda i: self._rows[i])
+            self._rows[shard] += nrows
+        else:
+            shard = self._next_shard
+            self._next_shard = (self._next_shard + 1) % self._n
+        self._queues[shard].append(ref)
+        return True
+
+    def next_block_ref(self, shard_id: int):
+        """Returns the next block REF for this shard, or None when the
+        stream is exhausted."""
+        q = self._queues[shard_id]
+        while not q and not self._exhausted:
+            self._assign_one()
+        if not q:
+            return None
+        return q.popleft()
+
+
+class DataIterator:
+    """Per-worker shard handle; picklable (holds an actor handle)."""
+
+    def __init__(self, coordinator, shard_id: int):
+        self._coordinator = coordinator
+        self._shard_id = shard_id
+
+    def _iter_blocks(self) -> Iterator[Block]:
+        while True:
+            ref = ray_tpu.get(
+                self._coordinator.next_block_ref.remote(self._shard_id))
+            if ref is None:
+                return
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None,
+                     **_ignored) -> Iterator[Any]:
+        yield from iter_batches_over_blocks(
+            self._iter_blocks(), batch_size, batch_format, drop_last,
+            local_shuffle_buffer_size, local_shuffle_seed)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self._iter_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def materialize(self):
+        from ray_tpu.data.dataset import MaterializedDataset
+        from ray_tpu.data._internal.plan import ExecutionPlan, InputDataOp
+        refs = [ray_tpu.put(b) for b in self._iter_blocks()]
+        return MaterializedDataset(ExecutionPlan(InputDataOp(refs)))
+
+
+def make_streaming_shards(ds, n: int, equal: bool = True
+                          ) -> List[DataIterator]:
+    plan = ds._plan
+
+    def plan_holder():
+        from ray_tpu.data.dataset import Dataset
+        return Dataset(plan)
+
+    coord_cls = ray_tpu.remote(num_cpus=0.0)(_SplitCoordinator)
+    coordinator = coord_cls.remote(plan_holder, n, equal)
+    return [DataIterator(coordinator, i) for i in range(n)]

@@ -72,14 +72,30 @@ class BaseTrainer:
             lambda config: self.scaling_config.as_placement_group_factory())
         return trainable
 
+    # Trainer attributes sweepable from a Tune param_space (reference
+    # allows trainer __init__ kwargs as siblings of train_loop_config).
+    _SWEEPABLE_ATTRS = ("scaling_config", "run_config", "backend_config",
+                        "datasets", "metadata", "dataset_config")
+
     def _with_parameters(self, config: Dict[str, Any]) -> "BaseTrainer":
         import copy
         t = copy.copy(self)
-        # Reference convention: a trainer's param_space nests the loop
-        # config under "train_loop_config"; flat dicts merge directly.
-        overrides = config.get("train_loop_config", config)
+        overrides = dict(config)
+        loop = overrides.pop("train_loop_config", None)
+        for attr in self._SWEEPABLE_ATTRS:
+            if attr in overrides:
+                setattr(t, attr, overrides.pop(attr))
+        if loop is None:
+            # Flat dict: everything remaining is loop config.
+            loop = overrides
+            overrides = {}
+        if overrides:
+            raise ValueError(
+                f"Unknown trainer param_space keys: {sorted(overrides)}; "
+                f"nest hyperparameters under 'train_loop_config' or use "
+                f"one of {self._SWEEPABLE_ATTRS}")
         loop_cfg = dict(getattr(t, "train_loop_config", None) or {})
-        loop_cfg.update(overrides)
+        loop_cfg.update(loop)
         t.train_loop_config = loop_cfg
         return t
 

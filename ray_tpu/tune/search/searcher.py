@@ -23,7 +23,7 @@ class Searcher:
 
     def set_search_properties(self, metric: Optional[str],
                               mode: Optional[str],
-                              config: Dict) -> bool:
+                              config: Dict, **kwargs) -> bool:
         if metric:
             self.metric = metric
         if mode:
@@ -31,8 +31,13 @@ class Searcher:
         return True
 
     def suggest(self, trial_id: str) -> Optional[Dict]:
-        """Next config, or None when exhausted."""
+        """Next config; None = nothing available right now (the
+        controller re-asks later unless ``is_finished()``)."""
         raise NotImplementedError
+
+    def is_finished(self) -> bool:
+        """True when this searcher will never produce another config."""
+        return False
 
     def on_trial_result(self, trial_id: str, result: Dict) -> None:
         pass
@@ -55,6 +60,7 @@ class BasicVariantGenerator(Searcher):
         self._num_samples = 1
         self._variants = None
         self._seed = random_state
+        self._exhausted = False
         self.max_concurrent = max_concurrent
 
     def set_search_properties(self, metric, mode, config,
@@ -79,7 +85,11 @@ class BasicVariantGenerator(Searcher):
         try:
             return next(self._variants)
         except StopIteration:
+            self._exhausted = True
             return None
+
+    def is_finished(self) -> bool:
+        return self._exhausted
 
     @property
     def total_samples(self) -> int:
@@ -104,11 +114,18 @@ class ConcurrencyLimiter(Searcher):
 
     def suggest(self, trial_id: str) -> Optional[Dict]:
         if len(self._live) >= self.max_concurrent:
-            return None
+            return None  # transient — controller re-asks later
         cfg = self.searcher.suggest(trial_id)
         if cfg is not None:
             self._live.add(trial_id)
         return cfg
+
+    def is_finished(self) -> bool:
+        return self.searcher.is_finished()
+
+    @property
+    def total_samples(self):
+        return getattr(self.searcher, "total_samples", None)
 
     def on_trial_complete(self, trial_id, result=None, error=False):
         self._live.discard(trial_id)

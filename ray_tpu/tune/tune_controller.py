@@ -114,13 +114,23 @@ class TuneController:
         t = self.get_trial(trial_id)
         return t is not None and t.status == RUNNING
 
+    def _trial_limit(self) -> int:
+        """Total trials to create: the searcher's own count if it knows
+        it (grid x num_samples for BasicVariant), else num_samples —
+        bounding never-exhausting searchers like TPE."""
+        total = getattr(self.searcher, "total_samples", None)
+        return total if total else self.num_samples
+
     def _next_trial(self) -> Optional[Trial]:
-        if self._searcher_done:
+        if self._searcher_done or self._trial_counter >= self._trial_limit():
             return None
         trial_id = f"{self._trial_counter:05d}"
         config = self.searcher.suggest(trial_id)
         if config is None:
-            self._searcher_done = True
+            # Permanent exhaustion vs. "ask again later" (e.g. a
+            # ConcurrencyLimiter at capacity).
+            if self.searcher.is_finished():
+                self._searcher_done = True
             return None
         self._trial_counter += 1
         trial = Trial(trial_id, config, self.storage.experiment_name)
@@ -136,29 +146,32 @@ class TuneController:
         return factory if isinstance(factory, PlacementGroupFactory) \
             else None
 
+    def _create_actor(self, trial: Trial, config: Dict, pg):
+        """Build the trial's actor, honoring the resource request. With
+        an empty head bundle the group holds only worker bundles and the
+        trial actor runs outside it (reference tuner semantics)."""
+        factory = self._resource_request(config)
+        opts: Dict[str, Any] = {"num_cpus": 1.0}
+        if factory is not None and pg is not None \
+                and not factory.head_bundle_is_empty:
+            head = factory.bundles[0]
+            opts["num_cpus"] = float(head.get("CPU", 0.0))
+            if "TPU" in head:
+                opts["num_tpus"] = float(head["TPU"])
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy)
+            opts["scheduling_strategy"] = (
+                PlacementGroupSchedulingStrategy(
+                    pg, placement_group_bundle_index=0))
+        actor_cls = ray_tpu.remote(**opts)(_TrialActor)
+        return actor_cls.remote(
+            self.trainable_cls, config, pg,
+            self._trial_storage(trial).trial_dir)
+
     def _start_trial(self, trial: Trial) -> None:
         factory = self._resource_request(trial.config)
-        opts: Dict[str, Any] = {"num_cpus": 1.0}
-        pg = None
-        if factory is not None:
-            pg = factory()
-            if not factory.head_bundle_is_empty:
-                # Trial actor occupies the head bundle; with an empty
-                # head the group holds only worker bundles and the trial
-                # actor runs outside it (reference tuner semantics).
-                head = factory.bundles[0]
-                opts["num_cpus"] = float(head.get("CPU", 0.0))
-                if "TPU" in head:
-                    opts["num_tpus"] = float(head["TPU"])
-                from ray_tpu.util.scheduling_strategies import (
-                    PlacementGroupSchedulingStrategy)
-                opts["scheduling_strategy"] = (
-                    PlacementGroupSchedulingStrategy(
-                        pg, placement_group_bundle_index=0))
-        actor_cls = ray_tpu.remote(**opts)(_TrialActor)
-        trial.actor = actor_cls.remote(
-            self.trainable_cls, trial.config, pg,
-            self._trial_storage(trial).trial_dir)
+        pg = factory() if factory is not None else None
+        trial.actor = self._create_actor(trial, trial.config, pg)
         trial._pg = pg
         trial.status = RUNNING
         if trial.restore_pending is not None:
@@ -240,21 +253,15 @@ class TuneController:
                 ray_tpu.kill(target.actor)
             except Exception:
                 pass
-            factory = self._resource_request(new_config)
-            opts: Dict[str, Any] = {"num_cpus": 1.0}
-            pg = getattr(target, "_pg", None)
-            if factory is not None and pg is not None:
-                head = factory.bundles[0]
-                opts["num_cpus"] = float(head.get("CPU", 0.0))
-                from ray_tpu.util.scheduling_strategies import (
-                    PlacementGroupSchedulingStrategy)
-                opts["scheduling_strategy"] = (
-                    PlacementGroupSchedulingStrategy(
-                        pg, placement_group_bundle_index=0))
-            actor_cls = ray_tpu.remote(**opts)(_TrialActor)
-            target.actor = actor_cls.remote(
-                self.trainable_cls, new_config, pg)
-        ray_tpu.get(target.actor.restore.remote(src_ckpt))
+            target.actor = self._create_actor(
+                target, new_config, getattr(target, "_pg", None))
+        try:
+            ray_tpu.get(target.actor.restore.remote(src_ckpt))
+        except (TaskError, ActorError, ActorDiedError):
+            # A dead target must not unwind the whole experiment; its
+            # next train() future will fail and go through the normal
+            # max_failures machinery.
+            return
         target.config = new_config
 
     # -- stopping criteria -------------------------------------------
