@@ -19,7 +19,6 @@ reference's exchange operators (``planner/exchange/``).
 
 from __future__ import annotations
 
-import random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -51,6 +50,7 @@ class OneToOneOp:
     # actor-pool compute (None = task pool)
     actor_pool_size: Optional[int] = None
     fn_constructor: Optional[Callable[[], Any]] = None
+    num_cpus: Optional[float] = None
 
 
 @dataclass
@@ -197,19 +197,18 @@ def _run_stages(items: Iterator[Any], items_are_refs: bool,
         raise TypeError(f"Unknown stage: {stage!r}")
 
 
-_remote_apply_cached = None
-_remote_actor_cached = None
+_remote_apply_cached: Dict[float, Any] = {}
 
 
-def _get_remote_apply():
-    global _remote_apply_cached
-    if _remote_apply_cached is None:
-        _remote_apply_cached = ray_tpu.remote(num_cpus=1)(_apply_chain)
-    return _remote_apply_cached
+def _get_remote_apply(num_cpus: float = 1.0):
+    if num_cpus not in _remote_apply_cached:
+        _remote_apply_cached[num_cpus] = ray_tpu.remote(
+            num_cpus=num_cpus)(_apply_chain)
+    return _remote_apply_cached[num_cpus]
 
 
-def _remote_apply(fns, item):
-    return _get_remote_apply().remote(fns, item)
+def _remote_apply(fns, item, num_cpus: float = 1.0):
+    return _get_remote_apply(num_cpus).remote(fns, item)
 
 
 def _window_map(items: Iterator[Any], submit: Callable[[Any], Any],
@@ -230,16 +229,18 @@ def _run_fused_stage(items: Iterator[Any], items_are_refs: bool,
                      stage: List[OneToOneOp], ctx: DataContext
                      ) -> Iterator[Any]:
     pool_size = stage[0].actor_pool_size
+    stage_cpus = max((op.num_cpus or 1.0) for op in stage)
     if pool_size is None:
         fns = [op.fn for op in stage]
         yield from _window_map(
-            items, lambda item: _remote_apply(fns, item), ctx)
+            items, lambda item: _remote_apply(fns, item, stage_cpus), ctx)
         return
     # Actor-pool stage: round-robin blocks over a pool of stage actors.
     constructors = [op.fn_constructor for op in stage]
     fns = [op.fn for op in stage]
     actor_cls = ray_tpu.remote(num_cpus=1)(_ActorStage)
     actors = [actor_cls.remote(constructors) for _ in range(pool_size)]
+    submitted: List[Any] = []
     try:
         i = 0
         window = max(pool_size * 2, ctx.max_tasks_in_flight_per_operator)
@@ -247,12 +248,23 @@ def _run_fused_stage(items: Iterator[Any], items_are_refs: bool,
         for item in items:
             actor = actors[i % pool_size]
             i += 1
-            inflight.append(actor.apply.remote(fns, item))
+            ref = actor.apply.remote(fns, item)
+            submitted.append(ref)
+            inflight.append(ref)
             if len(inflight) >= window:
                 yield inflight.pop(0)
         while inflight:
             yield inflight.pop(0)
     finally:
+        # Yielded refs may not have been consumed yet — wait for every
+        # submitted task to finish (results outlive the actors in the
+        # object store) BEFORE tearing the pool down.
+        if submitted:
+            try:
+                ray_tpu.wait(submitted, num_returns=len(submitted),
+                             timeout=600)
+            except Exception:
+                pass
         for a in actors:
             try:
                 ray_tpu.kill(a)
@@ -260,18 +272,15 @@ def _run_fused_stage(items: Iterator[Any], items_are_refs: bool,
                 pass
 
 
-def _num_rows(block: Block) -> int:
-    return block.num_rows
-
-
 def _slice_block(block: Block, n: int) -> Block:
     return BlockAccessor(block).slice(0, n)
 
 
 def _run_limit(refs: Iterator[Any], n: int) -> Iterator[Any]:
+    from ray_tpu.data._internal import shuffle as sh
     remaining = n
-    rows_fn = ray_tpu.remote(num_cpus=1)(_num_rows)
-    slice_fn = ray_tpu.remote(num_cpus=1)(_slice_block)
+    rows_fn = sh._r(sh._rows)
+    slice_fn = sh._r(_slice_block)
     for ref in refs:
         if remaining <= 0:
             break

@@ -206,11 +206,11 @@ def sort(refs: List[Any], key: str, descending: bool = False) -> List[Any]:
     flat = sorted(x for s in samples for x in s)
     if not flat:
         return refs
+    # Bounds stay ASCENDING even for descending sorts (searchsorted
+    # requires it); _partition_by_bounds flips partition indices.
     bounds = [flat[int(len(flat) * (i + 1) / n_out)]
               for i in range(n_out - 1)
               if int(len(flat) * (i + 1) / n_out) < len(flat)]
-    if descending:
-        bounds = list(reversed(bounds))
     n_parts = len(bounds) + 1
     parts: List[List[Any]] = [[] for _ in range(n_parts)]
     for ref in refs:

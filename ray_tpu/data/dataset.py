@@ -80,6 +80,7 @@ class Dataset:
         batch_format = batch_format or ctx.default_batch_format
         fn_kwargs = fn_kwargs or {}
         is_class = isinstance(fn, type)
+        name = f"MapBatches({getattr(fn, '__name__', 'fn')})"
         if is_class and compute is None:
             compute = ActorPoolStrategy(size=2)
         ctor = None
@@ -92,15 +93,20 @@ class Dataset:
         block_fn = _make_map_batches_block_fn(
             fn, batch_size, batch_format, fn_args, fn_kwargs)
         op = OneToOneOp(
-            block_fn, name=f"MapBatches({getattr(fn, '__name__', 'fn')})",
+            block_fn, name=name,
             actor_pool_size=compute.size if compute else None,
-            fn_constructor=ctor)
+            fn_constructor=ctor,
+            num_cpus=num_cpus)
         return Dataset(self._plan.with_op(op))
 
     def map(self, fn, **kwargs) -> "Dataset":
         def block_fn(block: Block) -> Block:
             rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
-            return pa.Table.from_pylist(rows) if rows else pa.table({})
+            # Empty input: keep the input schema rather than degrading
+            # to a zero-column table (the output schema is unknowable
+            # without rows, and downstream concat promotes).
+            return pa.Table.from_pylist(rows) if rows \
+                else block.schema.empty_table()
         return Dataset(self._plan.with_op(
             OneToOneOp(block_fn, name="Map")))
 
@@ -108,7 +114,8 @@ class Dataset:
         def block_fn(block: Block) -> Block:
             rows = [o for r in BlockAccessor(block).iter_rows()
                     for o in fn(r)]
-            return pa.Table.from_pylist(rows) if rows else pa.table({})
+            return pa.Table.from_pylist(rows) if rows \
+                else block.schema.empty_table()
         return Dataset(self._plan.with_op(
             OneToOneOp(block_fn, name="FlatMap")))
 
@@ -242,10 +249,13 @@ class Dataset:
         return sum(ray_tpu.get([rows_fn.remote(r) for r in refs]))
 
     def schema(self) -> Optional[pa.Schema]:
+        last = None
         for block in self.iter_blocks():
-            if block.schema is not None and block.num_rows >= 0:
-                return block.schema
-        return None
+            if block.schema is not None and len(block.schema.names):
+                if block.num_rows > 0:
+                    return block.schema
+                last = block.schema
+        return last
 
     def columns(self) -> List[str]:
         s = self.schema()

@@ -42,6 +42,7 @@ def _resolve_paths(paths: Union[str, List[str]], suffixes) -> List[str]:
             out.extend(sorted(glob_mod.glob(p)))
         else:
             out.append(p)
+    out = [p for p in out if os.path.isfile(p)]
     if not out:
         raise FileNotFoundError(f"No files found for {paths!r}")
     return out
@@ -154,14 +155,14 @@ def read_parquet(paths, **kwargs) -> Dataset:
 def read_csv(paths, **kwargs) -> Dataset:
     from pyarrow import csv as pacsv
     return _file_read_dataset(
-        paths, [".csv"], lambda p: pacsv.read_csv(p), "ReadCSV")
+        paths, [".csv"], lambda p: pacsv.read_csv(p, **kwargs), "ReadCSV")
 
 
 def read_json(paths, **kwargs) -> Dataset:
     from pyarrow import json as pajson
     return _file_read_dataset(
-        paths, [".json", ".jsonl"], lambda p: pajson.read_json(p),
-        "ReadJSON")
+        paths, [".json", ".jsonl"],
+        lambda p: pajson.read_json(p, **kwargs), "ReadJSON")
 
 
 def read_text(paths, **kwargs) -> Dataset:

@@ -53,8 +53,7 @@ class GroupedData:
         return from_arrow(pa.Table.from_pylist(rows))
 
     def sum(self, on=None):
-        return self._agg(np.sum, self._cols(on), "_sum" if on is None
-                         else "_sum")
+        return self._agg(np.sum, self._cols(on), "_sum")
 
     def min(self, on=None):
         return self._agg(np.min, self._cols(on), "_min")

@@ -1,0 +1,39 @@
+"""ray_tpu.serve: model serving (reference: ``python/ray/serve/``).
+
+Public surface mirrors ``ray.serve``: ``@serve.deployment``,
+``serve.run``, DeploymentHandle composition, ``@serve.batch`` dynamic
+batching, queue-depth autoscaling, and a JSON-over-HTTP proxy.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    proxy_address,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.deployment import (
+    Application, AutoscalingConfig, Deployment, deployment)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "proxy_address",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

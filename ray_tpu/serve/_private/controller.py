@@ -1,0 +1,193 @@
+"""ServeController: the reconciling control plane.
+
+Reference: ``python/ray/serve/_private/controller.py:91``
+(``run_control_loop`` :365) + ``deployment_state.py:2462``
+(``DeploymentState.update``: reconcile target vs actual replicas) +
+``autoscaling_policy.py`` (queue-depth replica autoscaling). One
+controller actor owns all deployments of all apps: it starts/stops
+replica actors, restarts dead ones, probes queue depth for autoscaling,
+and versions replica membership so handles refresh lazily.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.replica import Replica
+
+CONTROLLER_NAME = "SERVE_CONTROLLER_ACTOR"
+
+
+class _DeploymentInfo:
+    def __init__(self, deployment, init_args, init_kwargs):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.target_num = deployment.num_replicas
+        self.replicas: List[Any] = []
+        self.version = 0
+        self.replica_counter = 0
+        self._last_scale_up = 0.0
+        self._last_scale_down = 0.0
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentInfo] = {}
+        self._routes: Dict[str, str] = {}  # route_prefix -> deployment
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._loop = threading.Thread(
+            target=self._control_loop, name="serve_control", daemon=True)
+        self._loop.start()
+
+    # -- deploy API ---------------------------------------------------
+    def deploy(self, name: str, deployment, init_args, init_kwargs,
+               route_prefix: Optional[str] = None) -> None:
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                info = _DeploymentInfo(deployment, init_args, init_kwargs)
+                self._deployments[name] = info
+            else:
+                info.deployment = deployment
+                info.init_args = init_args
+                info.init_kwargs = init_kwargs
+                info.target_num = deployment.num_replicas
+                # Version rollout: replace existing replicas.
+                self._scale_to(name, info, 0)
+            if route_prefix:
+                self._routes[route_prefix] = name
+            self._reconcile_one(name, info)
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            info = self._deployments.pop(name, None)
+            if info is not None:
+                self._scale_to(name, info, 0)
+            self._routes = {r: d for r, d in self._routes.items()
+                            if d != name}
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            for name, info in list(self._deployments.items()):
+                self._scale_to(name, info, 0)
+            self._deployments.clear()
+            self._routes.clear()
+
+    # -- handle/proxy API ---------------------------------------------
+    def get_version(self, name: str) -> int:
+        info = self._deployments.get(name)
+        return info.version if info else -1
+
+    def get_replicas(self, name: str) -> List[Any]:
+        info = self._deployments.get(name)
+        return list(info.replicas) if info else []
+
+    def get_routes(self) -> Dict[str, str]:
+        return dict(self._routes)
+
+    def list_deployments(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{
+                "name": name,
+                "num_replicas": len(info.replicas),
+                "target_num_replicas": info.target_num,
+                "autoscaling": info.deployment.autoscaling_config
+                is not None,
+            } for name, info in self._deployments.items()]
+
+    # -- reconciliation -----------------------------------------------
+    def _make_replica(self, name: str, info: _DeploymentInfo):
+        d = info.deployment
+        opts: Dict[str, Any] = {"max_concurrency":
+                                max(2, d.max_ongoing_requests)}
+        rao = dict(d.ray_actor_options)
+        opts["num_cpus"] = float(rao.pop("num_cpus", 1.0))
+        if "num_tpus" in rao:
+            opts["num_tpus"] = float(rao.pop("num_tpus"))
+        if "resources" in rao:
+            opts["resources"] = rao.pop("resources")
+        replica_id = f"{name}#{info.replica_counter}"
+        info.replica_counter += 1
+        actor_cls = ray_tpu.remote(**opts)(Replica)
+        return actor_cls.remote(
+            d.func_or_class, info.init_args, info.init_kwargs,
+            d.user_config, name, replica_id)
+
+    def _scale_to(self, name: str, info: _DeploymentInfo, n: int) -> None:
+        while len(info.replicas) > n:
+            replica = info.replicas.pop()
+            try:
+                ray_tpu.kill(replica)
+            except Exception:
+                pass
+            info.version += 1
+        while len(info.replicas) < n:
+            info.replicas.append(self._make_replica(name, info))
+            info.version += 1
+
+    def _reconcile_one(self, name: str, info: _DeploymentInfo) -> None:
+        self._scale_to(name, info, info.target_num)
+
+    def _control_loop(self) -> None:
+        tick = 0
+        while not self._stop.wait(0.5):
+            tick += 1
+            try:
+                with self._lock:
+                    items = list(self._deployments.items())
+                for name, info in items:
+                    if tick % 6 == 0:  # health probe ~every 3s
+                        self._health_check(name, info)
+                    self._autoscale(name, info)
+                    with self._lock:
+                        self._reconcile_one(name, info)
+            except Exception:
+                pass  # the loop must survive transient errors
+
+    def _health_check(self, name: str, info: _DeploymentInfo) -> None:
+        dead = []
+        for replica in info.replicas:
+            try:
+                ray_tpu.get(replica.check_health.remote(), timeout=30)
+            except Exception:
+                dead.append(replica)
+        if dead:
+            with self._lock:
+                for replica in dead:
+                    if replica in info.replicas:
+                        info.replicas.remove(replica)
+                        info.version += 1
+                    try:
+                        ray_tpu.kill(replica)
+                    except Exception:
+                        pass
+            # _reconcile_one (caller) restarts replacements.
+
+    def _autoscale(self, name: str, info: _DeploymentInfo) -> None:
+        cfg = info.deployment.autoscaling_config
+        if cfg is None or not info.replicas:
+            return
+        try:
+            ongoing = ray_tpu.get(
+                [r.num_ongoing_requests.remote() for r in info.replicas],
+                timeout=10)
+        except Exception:
+            return
+        avg = sum(ongoing) / len(ongoing)
+        now = time.time()
+        if avg > cfg.target_ongoing_requests and \
+                info.target_num < cfg.max_replicas and \
+                now - info._last_scale_up > cfg.upscale_delay_s:
+            info.target_num += 1
+            info._last_scale_up = now
+        elif avg < cfg.target_ongoing_requests / 2 and \
+                info.target_num > cfg.min_replicas and \
+                now - info._last_scale_down > cfg.downscale_delay_s:
+            info.target_num -= 1
+            info._last_scale_down = now

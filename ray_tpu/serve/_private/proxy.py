@@ -1,0 +1,84 @@
+"""HTTP proxy: JSON-over-HTTP ingress to deployments.
+
+Reference: ``python/ray/serve/_private/proxy.py`` (uvicorn/ASGI proxy on
+every node + ``ProxyRouter``). This build runs one threaded HTTP server
+actor: ``POST/GET {route_prefix}`` → route table from the controller →
+``handle.remote(json_body)`` → JSON response. Threaded (not ASGI)
+because replica calls are blocking object-store gets.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+class HTTPProxy:
+    def __init__(self, controller, host: str = "127.0.0.1",
+                 port: int = 8000):
+        from ray_tpu.serve.handle import DeploymentHandle
+        self._controller = controller
+        self._handles: Dict[str, DeploymentHandle] = {}
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def _handle(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length) if length else b""
+                    payload = json.loads(body) if body else None
+                    result = proxy._dispatch(self.path, payload)
+                    out = json.dumps(result).encode()
+                    self.send_response(200)
+                except KeyError:
+                    out = json.dumps({"error": "no route"}).encode()
+                    self.send_response(404)
+                except Exception as e:
+                    out = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            do_GET = do_POST = _handle
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="serve_http",
+            daemon=True)
+        self._thread.start()
+
+    def _dispatch(self, path: str, payload: Any) -> Any:
+        from ray_tpu.serve.handle import DeploymentHandle
+        routes = ray_tpu.get(self._controller.get_routes.remote())
+        # Longest-prefix match (reference ProxyRouter semantics).
+        match = None
+        for prefix in sorted(routes, key=len, reverse=True):
+            if path == prefix or path.startswith(
+                    prefix.rstrip("/") + "/") or prefix == "/":
+                match = prefix
+                break
+        if match is None:
+            raise KeyError(path)
+        name = routes[match]
+        if name not in self._handles:
+            self._handles[name] = DeploymentHandle(name, self._controller)
+        resp = self._handles[name].remote(payload) \
+            if payload is not None else self._handles[name].remote()
+        return resp.result(timeout_s=60)
+
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()

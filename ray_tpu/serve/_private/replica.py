@@ -1,0 +1,66 @@
+"""Replica: the actor executing user deployment code.
+
+Reference: ``python/ray/serve/_private/replica.py`` — wraps the user
+class/function, counts in-flight requests (the router probes this for
+power-of-two-choices), runs health checks, applies user_config
+reconfiguration. Function deployments get a synthesized callable class.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class Replica:
+    def __init__(self, func_or_class, init_args, init_kwargs,
+                 user_config=None, deployment_name: str = "",
+                 replica_id: str = ""):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self._num_ongoing = 0
+        self._num_total = 0
+        if isinstance(func_or_class, type):
+            self._instance = func_or_class(*init_args, **init_kwargs)
+        elif callable(func_or_class):
+            fn = func_or_class
+            class _FnWrapper:
+                def __call__(self, *a, **kw):
+                    return fn(*a, **kw)
+            self._instance = _FnWrapper()
+        else:
+            raise TypeError(f"Not deployable: {func_or_class!r}")
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    async def handle_request(self, method_name: str, *args, **kwargs):
+        self._num_ongoing += 1
+        self._num_total += 1
+        try:
+            method = getattr(self._instance, method_name)
+            out = method(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = await out
+            return out
+        finally:
+            self._num_ongoing -= 1
+
+    def num_ongoing_requests(self) -> int:
+        return self._num_ongoing
+
+    def reconfigure(self, user_config) -> None:
+        fn = getattr(self._instance, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    def check_health(self) -> bool:
+        fn = getattr(self._instance, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {"replica_id": self.replica_id,
+                "ongoing": self._num_ongoing,
+                "total": self._num_total}

@@ -1,0 +1,144 @@
+"""serve.run / serve.start / serve.shutdown — the public entry points.
+
+Reference: ``python/ray/serve/api.py`` (``serve.run`` :522). The
+controller is a named singleton actor; ``run`` walks the bound app DAG
+depth-first, deploying inner deployments first and substituting their
+DeploymentHandles into outer constructor args (model composition).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve._private.controller import (
+    CONTROLLER_NAME, ServeController)
+
+_proxy_actor = None
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        cls = ray_tpu.remote(num_cpus=0.5, name=CONTROLLER_NAME,
+                             lifetime="detached",
+                             max_concurrency=16)(ServeController)
+        return cls.remote()
+
+
+def _controller_or_none():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return None
+
+
+def run(target: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        _local_testing_mode: bool = False) -> DeploymentHandle:
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(
+            "serve.run expects a bound deployment (use .bind())")
+    controller = _get_or_create_controller()
+
+    apps: Dict[str, Application] = {}
+    target._collect(apps)  # topological: dependencies first
+
+    handles: Dict[str, DeploymentHandle] = {}
+    for dep_name, app in apps.items():
+        def sub(v):
+            if isinstance(v, Application):
+                return handles[v.deployment.name]
+            return v
+        init_args = tuple(sub(a) for a in app.init_args)
+        init_kwargs = {k: sub(v) for k, v in app.init_kwargs.items()}
+        is_ingress = dep_name == target.deployment.name
+        ray_tpu.get(controller.deploy.remote(
+            dep_name, app.deployment, init_args, init_kwargs,
+            route_prefix if is_ingress else None))
+        handles[dep_name] = DeploymentHandle(dep_name, controller)
+
+    handle = handles[target.deployment.name]
+    if blocking:  # pragma: no cover - interactive use
+        while True:
+            time.sleep(1)
+    return handle
+
+
+def start(http_options: Optional[Dict[str, Any]] = None, **kwargs) -> None:
+    """Start the HTTP proxy (reference ``serve.start``)."""
+    global _proxy_actor
+    http_options = http_options or {}
+    controller = _get_or_create_controller()
+    if _proxy_actor is None:
+        from ray_tpu.serve._private.proxy import HTTPProxy
+        cls = ray_tpu.remote(num_cpus=0.5,
+                             max_concurrency=16)(HTTPProxy)
+        _proxy_actor = cls.remote(
+            controller, http_options.get("host", "127.0.0.1"),
+            http_options.get("port", 8000))
+
+
+def proxy_address() -> Optional[str]:
+    if _proxy_actor is None:
+        return None
+    return ray_tpu.get(_proxy_actor.address.remote())
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    controller = _controller_or_none()
+    if controller is None:
+        raise RuntimeError("Serve is not running")
+    return DeploymentHandle(deployment_name, controller, app_name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = _controller_or_none()
+    if controller is None:
+        raise RuntimeError("Serve is not running")
+    routes = ray_tpu.get(controller.get_routes.remote())
+    for prefix, dep in routes.items():
+        return DeploymentHandle(dep, controller, name)
+    raise RuntimeError("No application deployed")
+
+
+def status() -> Dict[str, Any]:
+    controller = _controller_or_none()
+    if controller is None:
+        return {"deployments": []}
+    return {"deployments": ray_tpu.get(
+        controller.list_deployments.remote())}
+
+
+def delete(name: str) -> None:
+    controller = _controller_or_none()
+    if controller is not None:
+        ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def shutdown() -> None:
+    global _proxy_actor
+    controller = _controller_or_none()
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(controller)
+        except Exception:
+            pass
+    if _proxy_actor is not None:
+        try:
+            ray_tpu.get(_proxy_actor.stop.remote(), timeout=10)
+            ray_tpu.kill(_proxy_actor)
+        except Exception:
+            pass
+        _proxy_actor = None

@@ -1,0 +1,85 @@
+"""@serve.batch: dynamic request batching.
+
+Reference: ``python/ray/serve/batching.py`` — calls to the decorated
+async method are queued; a background flusher invokes the underlying
+function with a LIST of requests once ``max_batch_size`` accumulate or
+``batch_wait_timeout_s`` elapses, then fans results back out. On TPU
+replicas this is what keeps the MXU fed: one padded jitted call per
+batch instead of per request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = timeout_s
+        self._queue: Optional[asyncio.Queue] = None
+        self._task = None
+
+    def _ensure(self):
+        if self._queue is None:
+            self._queue = asyncio.Queue()
+            self._task = asyncio.get_event_loop().create_task(
+                self._flusher())
+
+    async def submit(self, instance, item):
+        self._ensure()
+        fut = asyncio.get_event_loop().create_future()
+        await self._queue.put((instance, item, fut))
+        return await fut
+
+    async def _flusher(self):
+        while True:
+            instance, item, fut = await self._queue.get()
+            batch = [(instance, item, fut)]
+            deadline = asyncio.get_event_loop().time() + self._timeout
+            while len(batch) < self._max:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(
+                        self._queue.get(), timeout=remaining))
+                except asyncio.TimeoutError:
+                    break
+            items = [b[1] for b in batch]
+            futs = [b[2] for b in batch]
+            try:
+                out = self._fn(batch[0][0], items)
+                if asyncio.iscoroutine(out):
+                    out = await out
+                if len(out) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function returned {len(out)} "
+                        f"results for {len(items)} requests")
+                for f, r in zip(futs, out):
+                    if not f.done():
+                        f.set_result(r)
+            except Exception as e:
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorate an async method taking a LIST of requests."""
+    def wrap(fn):
+        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+
+        @functools.wraps(fn)
+        async def wrapper(self, item):
+            return await queue.submit(self, item)
+
+        wrapper._batch_queue = queue
+        return wrapper
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,117 @@
+"""DeploymentHandle: client-side router with power-of-two-choices.
+
+Reference: ``python/ray/serve/handle.py`` + ``_private/router.py:259``
+and ``replica_scheduler/pow_2_scheduler.py:44`` — pick two candidate
+replicas, route to the less loaded. Load here is the handle's own
+outstanding-refs count per replica (completed refs are drained with a
+zero-timeout wait), refreshed replica membership comes from the
+controller when its version bumps (simplified LongPollHost).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like result of ``handle.remote()`` (reference
+    ``handle.py:DeploymentResponse``)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None):
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._route(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller,
+                 app_name: str = "default"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._controller = controller
+        self._version = -1
+        self._replicas: List[Any] = []
+        # replica index -> outstanding refs (drained lazily)
+        self._outstanding: Dict[int, List[Any]] = {}
+
+    # -- membership ---------------------------------------------------
+    def _refresh(self, force: bool = False) -> None:
+        version = ray_tpu.get(
+            self._controller.get_version.remote(self.deployment_name))
+        if version != self._version or force:
+            self._replicas = ray_tpu.get(
+                self._controller.get_replicas.remote(self.deployment_name))
+            self._version = version
+            self._outstanding = {i: [] for i in range(len(self._replicas))}
+
+    def _load(self, i: int) -> int:
+        refs = self._outstanding.setdefault(i, [])
+        if refs:
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=0)
+            self._outstanding[i] = list(pending)
+        return len(self._outstanding[i])
+
+    # -- routing ------------------------------------------------------
+    def _route(self, method: str, args, kwargs) -> DeploymentResponse:
+        self._refresh()
+        if not self._replicas:
+            raise RuntimeError(
+                f"Deployment {self.deployment_name!r} has no replicas")
+        # Unwrap chained responses so downstream gets values, not
+        # wrapper objects (reference: DeploymentResponse passing).
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: (v._to_object_ref()
+                      if isinstance(v, DeploymentResponse) else v)
+                  for k, v in kwargs.items()}
+        n = len(self._replicas)
+        if n == 1:
+            idx = 0
+        else:
+            i, j = random.sample(range(n), 2)
+            idx = i if self._load(i) <= self._load(j) else j
+        replica = self._replicas[idx]
+        try:
+            ref = replica.handle_request.remote(method, *args, **kwargs)
+        except Exception:
+            # Stale membership (dead replica): force-refresh and retry
+            # once on a fresh replica set.
+            self._refresh(force=True)
+            if not self._replicas:
+                raise
+            replica = self._replicas[idx % len(self._replicas)]
+            ref = replica.handle_request.remote(method, *args, **kwargs)
+        self._outstanding.setdefault(idx, []).append(ref)
+        return DeploymentResponse(ref)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._route("__call__", args, kwargs)
+
+    def __getattr__(self, name: str) -> _MethodCaller:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def options(self, **kwargs) -> "DeploymentHandle":
+        return self  # stream/multiplex options accepted for API parity
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self._controller, self.app_name))

@@ -217,3 +217,29 @@ def test_dataset_feeds_trainer(ray_session, tmp_path):
     # both workers together consumed every row exactly once; rank 0's
     # total is a subset
     assert 0 < result.metrics["total"] < sum(range(40))
+
+
+def test_actor_pool_refs_survive_pool_teardown(ray_session):
+    """Collecting refs first and getting later must work — pool actors
+    may only be torn down after their tasks finish (regression)."""
+    class Ident:
+        def __call__(self, batch):
+            return batch
+
+    ds = rd.range(24, parallelism=6).map_batches(
+        Ident, compute=rd.ActorPoolStrategy(size=2))
+    refs = list(ds.iter_block_refs())
+    blocks = ray_tpu.get(refs)
+    assert sum(b.num_rows for b in blocks) == 24
+    # downstream count() (which collects refs, then gets) also works
+    assert ds.count() == 24
+
+
+def test_sort_descending_partitions(ray_session):
+    ds = rd.range(60, parallelism=6).random_shuffle(seed=5) \
+        .sort("id", descending=True)
+    blocks = [b for b in ds.iter_blocks() if b.num_rows]
+    # range partitioning spreads rows over multiple reduce partitions
+    assert len(blocks) >= 3
+    vals = [v for b in blocks for v in b["id"].to_pylist()]
+    assert vals == sorted(vals, reverse=True)

@@ -1,0 +1,12 @@
+import pytest
+
+
+@pytest.fixture
+def serve_session():
+    import ray_tpu
+    from ray_tpu import serve
+    info = ray_tpu.init(num_cpus=8, _num_initial_workers=3,
+                        ignore_reinit_error=True)
+    yield info
+    serve.shutdown()
+    ray_tpu.shutdown()
