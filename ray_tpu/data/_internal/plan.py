@@ -238,7 +238,7 @@ def _run_fused_stage(items: Iterator[Any], items_are_refs: bool,
     # Actor-pool stage: round-robin blocks over a pool of stage actors.
     constructors = [op.fn_constructor for op in stage]
     fns = [op.fn for op in stage]
-    actor_cls = ray_tpu.remote(num_cpus=1)(_ActorStage)
+    actor_cls = ray_tpu.remote(num_cpus=stage_cpus)(_ActorStage)
     actors = [actor_cls.remote(constructors) for _ in range(pool_size)]
     submitted: List[Any] = []
     try:

@@ -37,7 +37,7 @@ def _concat(*blocks: Block) -> Block:
 
 def _concat_sorted(key: str, descending: bool, *blocks: Block) -> Block:
     merged = BlockAccessor.concat(list(blocks))
-    if merged.num_rows == 0:
+    if merged.num_rows == 0 or key not in merged.column_names:
         return merged
     order = "descending" if descending else "ascending"
     return merged.sort_by([(key, order)])
@@ -59,6 +59,11 @@ def _shuffle_rows(block: Block, seed: Optional[int]) -> Block:
 def _partition_by_bounds(block: Block, key: str, bounds: List[Any],
                          descending: bool) -> List[Block]:
     acc = BlockAccessor(block)
+    if key not in block.column_names:
+        # Schema-less empty block (e.g. a fully-filtered map output):
+        # contributes nothing to any partition.
+        empty = block.slice(0, 0)
+        return [empty for _ in range(len(bounds) + 1)]
     col = block[key].to_numpy(zero_copy_only=False)
     idx = np.searchsorted(np.asarray(bounds), col, side="right")
     if descending:
@@ -68,6 +73,8 @@ def _partition_by_bounds(block: Block, key: str, bounds: List[Any],
 
 
 def _sample_keys(block: Block, key: str, k: int) -> List[Any]:
+    if key not in block.column_names:
+        return []
     col = block[key].to_numpy(zero_copy_only=False)
     if len(col) == 0:
         return []

@@ -1,0 +1,29 @@
+"""ray_tpu.rllib: reinforcement learning (reference: ``rllib/``).
+
+JAX-native learner stack: Algorithm (a Tune Trainable) drives parallel
+EnvRunner actors and a jitted Learner/LearnerGroup. PPO is the flagship
+algorithm; PG the minimal baseline.
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env_runner import EnvRunner, compute_gae
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.pg import PG, PGConfig
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "EnvRunner",
+    "Learner",
+    "LearnerGroup",
+    "PG",
+    "PGConfig",
+    "PPO",
+    "PPOConfig",
+    "RLModule",
+    "RLModuleSpec",
+    "compute_gae",
+]

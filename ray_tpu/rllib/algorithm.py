@@ -1,0 +1,187 @@
+"""Algorithm: the RL training driver, a Tune Trainable.
+
+Reference: ``rllib/algorithms/algorithm.py:202`` (``step`` :810,
+``training_step`` :1633): sample in parallel from env-runner actors,
+update via the LearnerGroup, sync weights back, report
+episode-return metrics. Checkpointing via the Trainable protocol, so
+``Tuner(PPO, ...)`` works unchanged.
+"""
+
+from __future__ import annotations
+
+import pickle
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.rl_module import RLModuleSpec
+from ray_tpu.tune.trainable import Trainable
+
+
+def _resolve_env_creator(env, env_config) -> Callable[[], Any]:
+    if callable(env) and not isinstance(env, str):
+        return lambda: env(env_config)
+    if isinstance(env, str):
+        def make():
+            import gymnasium as gym
+            return gym.make(env, **env_config)
+        return make
+    raise ValueError(f"Cannot resolve env: {env!r}")
+
+
+class Algorithm(Trainable):
+    """Subclasses define ``loss_fn`` + ``loss_config`` via config."""
+
+    config_cls = AlgorithmConfig
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return cls.config_cls(algo_class=cls)
+
+    def __init__(self, config: Optional[AlgorithmConfig] = None, **kw):
+        if config is None:
+            config = self.get_default_config()
+        if isinstance(config, dict):
+            base = self.get_default_config()
+            for k, v in config.items():
+                setattr(base, k, v)
+            config = base
+        self._algo_config = config
+        super().__init__(config.to_dict())
+
+    # -- Trainable protocol -------------------------------------------
+    def setup(self, _cfg: Dict) -> None:
+        # Trainable.__init__ rebound self.config to the plain dict;
+        # expose the AlgorithmConfig object (reference behavior).
+        cfg = self.config = self._algo_config
+        env_creator = _resolve_env_creator(cfg.env, cfg.env_config)
+        probe = env_creator()
+        obs_space = probe.observation_space
+        act_space = probe.action_space
+        self.module_spec = RLModuleSpec(
+            observation_dim=int(np.prod(obs_space.shape)),
+            num_actions=int(act_space.n),
+            hiddens=tuple(cfg.model.get("fcnet_hiddens", (64, 64))))
+        try:
+            probe.close()
+        except Exception:
+            pass
+
+        spec = self.module_spec
+        loss_fn = self.loss_fn()
+        loss_config = self.loss_config()
+        lr, clip, seed = cfg.lr, cfg.grad_clip, cfg.seed
+
+        def make_learner() -> Learner:
+            return Learner(spec, loss_fn, learning_rate=lr,
+                           grad_clip=clip, seed=seed,
+                           loss_config=loss_config)
+
+        self.learner_group = LearnerGroup(
+            make_learner, num_learners=cfg.num_learners, seed=cfg.seed)
+        self._inference_module = spec.build()
+        self._cached_weights = None
+
+        n_runners = max(1, cfg.num_env_runners)
+        runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
+        self.env_runners = [
+            runner_cls.remote(env_creator, spec,
+                              cfg.num_envs_per_env_runner,
+                              cfg.gamma, getattr(cfg, "lambda_", 0.95),
+                              cfg.seed, i)
+            for i in range(n_runners)]
+        self._sync_weights()
+        self._timesteps = 0
+        self._return_window: List[float] = []
+
+    # Subclass hooks ---------------------------------------------------
+    def loss_fn(self) -> Callable:
+        raise NotImplementedError
+
+    def loss_config(self) -> Dict[str, Any]:
+        return {}
+
+    # ------------------------------------------------------------------
+    def _sync_weights(self) -> None:
+        self._cached_weights = self.learner_group.get_weights()
+        w_ref = ray_tpu.put(self._cached_weights)
+        ray_tpu.get([r.set_weights.remote(w_ref)
+                     for r in self.env_runners])
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        per_runner = max(1, cfg.train_batch_size
+                         // (len(self.env_runners)
+                             * cfg.num_envs_per_env_runner))
+        batches = ray_tpu.get(
+            [r.sample.remote(per_runner) for r in self.env_runners])
+        batch = {k: np.concatenate([b[k] for b in batches])
+                 for k in batches[0]}
+        self._timesteps += len(batch["obs"])
+
+        metrics = self.learner_group.update_from_batch(
+            batch, minibatch_size=cfg.minibatch_size,
+            num_epochs=cfg.num_epochs)
+        self._sync_weights()
+
+        returns: List[float] = []
+        for r in ray_tpu.get(
+                [r.episode_returns.remote() for r in self.env_runners]):
+            returns.extend(r)
+        self._return_window.extend(returns)
+        self._return_window = self._return_window[-100:]
+        mean_return = (float(np.mean(self._return_window))
+                       if self._return_window else float("nan"))
+        return {
+            "episode_return_mean": mean_return,
+            # legacy alias used by older tuned examples
+            "episode_reward_mean": mean_return,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "learner": metrics,
+        }
+
+    def train(self) -> Dict[str, Any]:
+        result = super().train()
+        result.setdefault("timesteps_total", self._timesteps)
+        return result
+
+    # -- checkpointing -------------------------------------------------
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        with open(os.path.join(checkpoint_dir, "algo_state.pkl"),
+                  "wb") as f:
+            pickle.dump({"weights": self.learner_group.get_weights(),
+                         "timesteps": self._timesteps}, f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algo_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_weights(state["weights"])
+        self._timesteps = state["timesteps"]
+        self._sync_weights()
+
+    def get_policy_weights(self):
+        return self.learner_group.get_weights()
+
+    def compute_single_action(self, obs: np.ndarray) -> int:
+        if self._cached_weights is None:
+            self._cached_weights = self.learner_group.get_weights()
+        action = self._inference_module.forward_inference(
+            self._cached_weights, np.asarray([obs]))
+        return int(action[0])
+
+    def cleanup(self) -> None:
+        for r in self.env_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.learner_group.shutdown()
+
+    stop = Trainable.stop

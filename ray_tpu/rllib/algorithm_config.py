@@ -1,0 +1,111 @@
+"""AlgorithmConfig: fluent RL configuration.
+
+Reference: ``rllib/algorithms/algorithm_config.py`` — chained
+``.environment().env_runners().training().learners()`` calls producing
+the Algorithm. ``build()`` returns the ready Algorithm instance.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Type
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[type] = None):
+        self.algo_class = algo_class
+        # environment
+        self.env: Any = None
+        self.env_config: Dict[str, Any] = {}
+        # env runners
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 1
+        self.rollout_fragment_length: int = 200
+        # training
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.train_batch_size: int = 4000
+        self.minibatch_size: Optional[int] = 128
+        self.num_epochs: int = 8
+        self.grad_clip: Optional[float] = 0.5
+        self.model: Dict[str, Any] = {"fcnet_hiddens": (64, 64)}
+        # learners
+        self.num_learners: int = 0
+        # debugging
+        self.seed: int = 0
+
+    # -- fluent sections (each returns self) ---------------------------
+    def environment(self, env=None, *, env_config: Optional[dict] = None
+                    ) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None,
+                    **_ignored) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    # Reference alias
+    rollouts = env_runners
+
+    def training(self, *, lr: Optional[float] = None,
+                 gamma: Optional[float] = None,
+                 train_batch_size: Optional[int] = None,
+                 minibatch_size: Optional[int] = None,
+                 num_epochs: Optional[int] = None,
+                 grad_clip: Optional[float] = None,
+                 model: Optional[dict] = None,
+                 **kwargs) -> "AlgorithmConfig":
+        for name, v in dict(lr=lr, gamma=gamma,
+                            train_batch_size=train_batch_size,
+                            minibatch_size=minibatch_size,
+                            num_epochs=num_epochs,
+                            grad_clip=grad_clip).items():
+            if v is not None:
+                setattr(self, name, v)
+        if model is not None:
+            self.model.update(model)
+        for k, v in kwargs.items():  # algo-specific knobs
+            setattr(self, k, v)
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None,
+                 **_ignored) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None,
+                  **_ignored) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def resources(self, **_ignored) -> "AlgorithmConfig":
+        return self
+
+    def framework(self, *_a, **_k) -> "AlgorithmConfig":
+        return self  # always JAX here
+
+    # -- build ----------------------------------------------------------
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in vars(self).items()
+                if k != "algo_class"}
+
+    def build(self):
+        if self.algo_class is None:
+            raise ValueError("No algo_class bound to this config")
+        return self.algo_class(config=self)

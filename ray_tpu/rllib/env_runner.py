@@ -1,0 +1,133 @@
+"""EnvRunner: CPU actors stepping (vectorized) gymnasium envs.
+
+Reference: ``rllib/evaluation/rollout_worker.py:159`` (``sample`` :653)
+/ the new ``env/env_runner.py`` API. Runners hold the env + a numpy
+copy of the policy params; ``sample()`` returns a flat rollout batch
+with GAE advantages already computed, so the learner's jitted update
+consumes it directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+
+
+def compute_gae(rewards: np.ndarray, values: np.ndarray,
+                dones: np.ndarray, last_value: float,
+                gamma: float, lam: float):
+    """Generalized advantage estimation over one rollout segment."""
+    T = len(rewards)
+    adv = np.zeros(T, np.float32)
+    last_gae = 0.0
+    for t in reversed(range(T)):
+        next_value = last_value if t == T - 1 else values[t + 1]
+        non_terminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * non_terminal - values[t]
+        last_gae = delta + gamma * lam * non_terminal * last_gae
+        adv[t] = last_gae
+    returns = adv + values
+    return adv, returns
+
+
+class EnvRunner:
+    """One rollout actor (spawn several for parallel sampling)."""
+
+    def __init__(self, env_creator: Callable[[], Any],
+                 module_spec: RLModuleSpec, num_envs: int = 1,
+                 gamma: float = 0.99, lambda_: float = 0.95,
+                 seed: int = 0, worker_index: int = 0):
+        import jax
+        self._envs = [env_creator() for _ in range(num_envs)]
+        self._module = module_spec.build()
+        self._params = None
+        self._gamma = gamma
+        self._lambda = lambda_
+        self._key = jax.random.PRNGKey(seed * 10_003 + worker_index)
+        self._obs = np.stack([
+            self._reset(e, seed * 7919 + worker_index * 131 + i)
+            for i, e in enumerate(self._envs)])
+        self._ep_returns = [0.0] * num_envs
+        self._completed: list = []
+
+    @staticmethod
+    def _reset(env, seed=None):
+        out = env.reset(seed=seed)
+        return out[0] if isinstance(out, tuple) else out
+
+    def set_weights(self, params) -> None:
+        self._params = params
+
+    def get_weights(self):
+        return self._params
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps per env; returns the flattened batch."""
+        import jax
+        assert self._params is not None, "set_weights first"
+        n_envs = len(self._envs)
+        obs_buf = np.zeros((num_steps, n_envs) + self._obs.shape[1:],
+                           np.float32)
+        act_buf = np.zeros((num_steps, n_envs), np.int64)
+        logp_buf = np.zeros((num_steps, n_envs), np.float32)
+        val_buf = np.zeros((num_steps, n_envs), np.float32)
+        rew_buf = np.zeros((num_steps, n_envs), np.float32)
+        done_buf = np.zeros((num_steps, n_envs), np.float32)
+
+        for t in range(num_steps):
+            self._key, sub = jax.random.split(self._key)
+            actions, logps, values = self._module.forward_exploration(
+                self._params, self._obs, sub)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = logps
+            val_buf[t] = values
+            for i, env in enumerate(self._envs):
+                out = env.step(int(actions[i]))
+                if len(out) == 5:
+                    obs, rew, terminated, truncated, _ = out
+                    done = terminated or truncated
+                else:  # old gym API
+                    obs, rew, done, _ = out
+                rew_buf[t, i] = rew
+                done_buf[t, i] = float(done)
+                self._ep_returns[i] += float(rew)
+                if done:
+                    self._completed.append(self._ep_returns[i])
+                    self._ep_returns[i] = 0.0
+                    obs = self._reset(env)
+                self._obs[i] = obs
+
+        # bootstrap values for the unfinished tails
+        self._key, sub = jax.random.split(self._key)
+        _, _, last_values = self._module.forward_exploration(
+            self._params, self._obs, sub)
+
+        adv = np.zeros_like(rew_buf)
+        ret = np.zeros_like(rew_buf)
+        for i in range(n_envs):
+            adv[:, i], ret[:, i] = compute_gae(
+                rew_buf[:, i], val_buf[:, i], done_buf[:, i],
+                float(last_values[i]), self._gamma, self._lambda)
+
+        flat = lambda arr: arr.reshape(  # noqa: E731
+            (num_steps * n_envs,) + arr.shape[2:])
+        return {
+            "obs": flat(obs_buf),
+            "actions": flat(act_buf),
+            "logp": flat(logp_buf),
+            "value_targets": flat(ret),
+            "advantages": flat(adv),
+        }
+
+    def episode_returns(self, clear: bool = True) -> list:
+        out = list(self._completed)
+        if clear:
+            self._completed = []
+        return out
+
+    def ping(self) -> bool:
+        return True

@@ -1,0 +1,55 @@
+"""Policy/value networks as pure JAX functions.
+
+Reference: ``rllib/models/`` (catalog + torch/tf networks) — here a
+single functional MLP family: params are dict pytrees, forwards are
+pure, so the whole learner update jits and the same params ship to CPU
+env-runners as numpy for rollout inference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_mlp(key, sizes: Sequence[int], scale: float = 1.0) -> List[Dict]:
+    layers = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        last = i == len(sizes) - 2
+        w_scale = (scale if last else 1.0) * np.sqrt(2.0 / fan_in)
+        layers.append({
+            "w": w_scale * jax.random.normal(
+                sub, (fan_in, fan_out), jnp.float32),
+            "b": jnp.zeros((fan_out,), jnp.float32),
+        })
+    return layers
+
+
+def mlp_forward(layers: List[Dict], x: jnp.ndarray,
+                activation=jax.nn.tanh) -> jnp.ndarray:
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(layers) - 1:
+            x = activation(x)
+    return x
+
+
+def init_actor_critic(key, obs_dim: int, num_actions: int,
+                      hiddens: Sequence[int] = (64, 64)) -> Dict:
+    k1, k2 = jax.random.split(key)
+    return {
+        "pi": init_mlp(k1, [obs_dim, *hiddens, num_actions], scale=0.01),
+        "vf": init_mlp(k2, [obs_dim, *hiddens, 1], scale=1.0),
+    }
+
+
+def actor_critic_forward(params: Dict, obs: jnp.ndarray
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (logits [B, A], value [B])."""
+    logits = mlp_forward(params["pi"], obs)
+    value = mlp_forward(params["vf"], obs)[..., 0]
+    return logits, value

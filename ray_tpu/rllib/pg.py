@@ -1,0 +1,46 @@
+"""Vanilla policy gradient (REINFORCE with baseline).
+
+Reference: the (contrib) PG algorithm — simplest on-policy baseline,
+sharing the PPO batch format/runner stack.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+
+
+def pg_loss(fwd_out, batch, *, vf_loss_coeff: float = 0.5):
+    logits = fwd_out["action_logits"]
+    values = fwd_out["vf_preds"]
+    logp_all = jax.nn.log_softmax(logits)
+    logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+    adv = batch["advantages"]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    policy_loss = -jnp.mean(logp * adv)
+    vf_loss = jnp.mean(jnp.square(values - batch["value_targets"]))
+    total = policy_loss + vf_loss_coeff * vf_loss
+    return total, {"policy_loss": policy_loss, "vf_loss": vf_loss}
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PG)
+        self.vf_loss_coeff: float = 0.5
+        self.lambda_: float = 1.0
+        self.num_epochs = 1
+
+
+class PG(Algorithm):
+    config_cls = PGConfig
+
+    def loss_fn(self):
+        return pg_loss
+
+    def loss_config(self) -> Dict[str, Any]:
+        return {"vf_loss_coeff": self.config.vf_loss_coeff}

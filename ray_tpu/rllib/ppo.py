@@ -1,0 +1,81 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Reference: ``rllib/algorithms/ppo/ppo.py`` + the torch loss in
+``ppo/torch/ppo_torch_learner.py`` — clip objective, value-function
+loss with clipping, entropy bonus, all under one ``jax.jit`` here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+
+
+def ppo_loss(fwd_out: Dict[str, jnp.ndarray],
+             batch: Dict[str, jnp.ndarray], *,
+             clip_param: float = 0.2,
+             vf_loss_coeff: float = 0.5,
+             entropy_coeff: float = 0.0,
+             vf_clip_param: float = 10.0):
+    logits = fwd_out["action_logits"]
+    values = fwd_out["vf_preds"]
+    logp_all = jax.nn.log_softmax(logits)
+    logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+
+    adv = batch["advantages"]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+    ratio = jnp.exp(logp - batch["logp"])
+    surr1 = ratio * adv
+    surr2 = jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv
+    policy_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+
+    vf_err = jnp.square(values - batch["value_targets"])
+    vf_loss = jnp.mean(jnp.clip(vf_err, 0.0, vf_clip_param ** 2))
+
+    entropy = -jnp.mean(
+        jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+
+    total = policy_loss + vf_loss_coeff * vf_loss \
+        - entropy_coeff * entropy
+    metrics = {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "mean_kl": jnp.mean(batch["logp"] - logp),
+    }
+    return total, metrics
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PPO)
+        self.clip_param: float = 0.2
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.0
+        self.vf_clip_param: float = 10.0
+        self.lambda_: float = 0.95
+        self.lr = 5e-5
+        self.num_epochs = 8
+        self.minibatch_size = 128
+
+
+class PPO(Algorithm):
+    config_cls = PPOConfig
+
+    def loss_fn(self):
+        return ppo_loss
+
+    def loss_config(self) -> Dict[str, Any]:
+        c = self.config
+        return {
+            "clip_param": c.clip_param,
+            "vf_loss_coeff": c.vf_loss_coeff,
+            "entropy_coeff": c.entropy_coeff,
+            "vf_clip_param": c.vf_clip_param,
+        }

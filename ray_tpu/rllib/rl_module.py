@@ -1,0 +1,68 @@
+"""RLModule: the policy abstraction shared by learner and env-runners.
+
+Reference: ``rllib/core/rl_module/rl_module.py`` —
+``forward_inference`` / ``forward_exploration`` / ``forward_train``
+over one parameter pytree. The train forward runs under ``jax.jit``
+inside the Learner; the exploration forward runs as plain numpy-in /
+numpy-out on CPU env-runner actors (no device requirement there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.models import actor_critic_forward, init_actor_critic
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    observation_dim: int
+    num_actions: int
+    hiddens: tuple = (64, 64)
+
+    def build(self) -> "RLModule":
+        return RLModule(self)
+
+
+class RLModule:
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+        self._jit_infer = jax.jit(self._infer)
+
+    def init(self, key) -> Dict:
+        return init_actor_critic(
+            key, self.spec.observation_dim, self.spec.num_actions,
+            self.spec.hiddens)
+
+    # -- train path (used inside the jitted learner update) -----------
+    def forward_train(self, params: Dict, obs: jnp.ndarray
+                      ) -> Dict[str, jnp.ndarray]:
+        logits, value = actor_critic_forward(params, obs)
+        return {"action_logits": logits, "vf_preds": value}
+
+    # -- rollout path --------------------------------------------------
+    @staticmethod
+    def _infer(params, obs, key):
+        logits, value = actor_critic_forward(params, obs)
+        action = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), action]
+        return action, logp, value
+
+    def forward_exploration(self, params: Dict, obs: np.ndarray,
+                            key) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]:
+        action, logp, value = self._jit_infer(
+            params, jnp.asarray(obs, jnp.float32), key)
+        return (np.asarray(action), np.asarray(logp), np.asarray(value))
+
+    def forward_inference(self, params: Dict, obs: np.ndarray
+                          ) -> np.ndarray:
+        logits, _ = actor_critic_forward(
+            params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(jnp.argmax(logits, axis=-1))

@@ -38,6 +38,7 @@ class ServeController:
     def __init__(self):
         self._deployments: Dict[str, _DeploymentInfo] = {}
         self._routes: Dict[str, str] = {}  # route_prefix -> deployment
+        self._apps: Dict[str, str] = {}    # app name -> ingress deploy
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._loop = threading.Thread(
@@ -46,7 +47,8 @@ class ServeController:
 
     # -- deploy API ---------------------------------------------------
     def deploy(self, name: str, deployment, init_args, init_kwargs,
-               route_prefix: Optional[str] = None) -> None:
+               route_prefix: Optional[str] = None,
+               app_name: Optional[str] = None) -> None:
         with self._lock:
             info = self._deployments.get(name)
             if info is None:
@@ -61,6 +63,8 @@ class ServeController:
                 self._scale_to(name, info, 0)
             if route_prefix:
                 self._routes[route_prefix] = name
+            if app_name:
+                self._apps[app_name] = name
             self._reconcile_one(name, info)
 
     def delete_deployment(self, name: str) -> None:
@@ -81,15 +85,29 @@ class ServeController:
 
     # -- handle/proxy API ---------------------------------------------
     def get_version(self, name: str) -> int:
-        info = self._deployments.get(name)
-        return info.version if info else -1
+        with self._lock:
+            info = self._deployments.get(name)
+            return info.version if info else -1
+
+    def get_membership(self, name: str):
+        """Atomic (version, replicas) snapshot — handles must never see
+        a replica list from a different version than they cache."""
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                return -1, []
+            return info.version, list(info.replicas)
 
     def get_replicas(self, name: str) -> List[Any]:
-        info = self._deployments.get(name)
-        return list(info.replicas) if info else []
+        return self.get_membership(name)[1]
 
     def get_routes(self) -> Dict[str, str]:
-        return dict(self._routes)
+        with self._lock:
+            return dict(self._routes)
+
+    def get_app_ingress(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            return self._apps.get(app_name)
 
     def list_deployments(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -61,7 +61,8 @@ def run(target: Application, *, name: str = "default",
         is_ingress = dep_name == target.deployment.name
         ray_tpu.get(controller.deploy.remote(
             dep_name, app.deployment, init_args, init_kwargs,
-            route_prefix if is_ingress else None))
+            route_prefix if is_ingress else None,
+            name if is_ingress else None))
         handles[dep_name] = DeploymentHandle(dep_name, controller)
 
     handle = handles[target.deployment.name]
@@ -103,10 +104,10 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
     controller = _controller_or_none()
     if controller is None:
         raise RuntimeError("Serve is not running")
-    routes = ray_tpu.get(controller.get_routes.remote())
-    for prefix, dep in routes.items():
-        return DeploymentHandle(dep, controller, name)
-    raise RuntimeError("No application deployed")
+    ingress = ray_tpu.get(controller.get_app_ingress.remote(name))
+    if ingress is None:
+        raise RuntimeError(f"No application named {name!r}")
+    return DeploymentHandle(ingress, controller, name)
 
 
 def status() -> Dict[str, Any]:

@@ -18,16 +18,31 @@ import ray_tpu
 
 class DeploymentResponse:
     """Future-like result of ``handle.remote()`` (reference
-    ``handle.py:DeploymentResponse``)."""
+    ``handle.py:DeploymentResponse``). Submission to a dead replica
+    only surfaces at get-time in this runtime, so the dead-replica
+    retry lives HERE: on actor death, the originating handle refreshes
+    membership and re-routes once."""
 
-    def __init__(self, ref):
+    def __init__(self, ref, retry=None):
         self._ref = ref
+        self._retry = retry  # () -> DeploymentResponse, single-shot
 
     def result(self, timeout_s: Optional[float] = None):
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        except Exception as e:
+            if self._retry is not None and _is_actor_death(e):
+                retry, self._retry = self._retry, None
+                return retry().result(timeout_s=timeout_s)
+            raise
 
     def _to_object_ref(self):
         return self._ref
+
+
+def _is_actor_death(e: BaseException) -> bool:
+    from ray_tpu.exceptions import ActorDiedError, ActorError
+    return isinstance(e, (ActorDiedError, ActorError))
 
 
 class _MethodCaller:
@@ -55,8 +70,11 @@ class DeploymentHandle:
         version = ray_tpu.get(
             self._controller.get_version.remote(self.deployment_name))
         if version != self._version or force:
-            self._replicas = ray_tpu.get(
-                self._controller.get_replicas.remote(self.deployment_name))
+            # Atomic snapshot: version and replica list must agree.
+            version, replicas = ray_tpu.get(
+                self._controller.get_membership.remote(
+                    self.deployment_name))
+            self._replicas = replicas
             self._version = version
             self._outstanding = {i: [] for i in range(len(self._replicas))}
 
@@ -88,18 +106,15 @@ class DeploymentHandle:
             i, j = random.sample(range(n), 2)
             idx = i if self._load(i) <= self._load(j) else j
         replica = self._replicas[idx]
-        try:
-            ref = replica.handle_request.remote(method, *args, **kwargs)
-        except Exception:
-            # Stale membership (dead replica): force-refresh and retry
-            # once on a fresh replica set.
-            self._refresh(force=True)
-            if not self._replicas:
-                raise
-            replica = self._replicas[idx % len(self._replicas)]
-            ref = replica.handle_request.remote(method, *args, **kwargs)
+        ref = replica.handle_request.remote(method, *args, **kwargs)
         self._outstanding.setdefault(idx, []).append(ref)
-        return DeploymentResponse(ref)
+
+        def retry_on_dead_replica():
+            # Membership was stale: resync and re-route once.
+            self._refresh(force=True)
+            return self._route(method, args, kwargs)
+
+        return DeploymentResponse(ref, retry=retry_on_dead_replica)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._route("__call__", args, kwargs)

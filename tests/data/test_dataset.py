@@ -243,3 +243,15 @@ def test_sort_descending_partitions(ray_session):
     assert len(blocks) >= 3
     vals = [v for b in blocks for v in b["id"].to_pylist()]
     assert vals == sorted(vals, reverse=True)
+
+
+def test_schema_changing_map_with_empty_blocks(ray_session):
+    """A filter that empties some blocks followed by a schema-changing
+    map must not break sort/groupby/schema (regression)."""
+    ds = rd.range(8, parallelism=4).filter(lambda r: r["id"] >= 4) \
+        .map(lambda r: {"y": r["id"]})
+    assert sorted(r["y"] for r in ds.sort("y").take_all()) == [4, 5, 6, 7]
+    counts = {r["y"]: r["count()"]
+              for r in ds.groupby("y").count().take_all()}
+    assert counts == {4: 1, 5: 1, 6: 1, 7: 1}
+    assert "y" in (ds.schema().names if ds.schema() else [])
