@@ -60,9 +60,14 @@ def init(address: Optional[str] = None,
          ignore_reinit_error: bool = False,
          log_to_driver: bool = True,
          _system_config: Optional[Dict[str, Any]] = None,
-         _num_initial_workers: Optional[int] = None) -> Dict[str, Any]:
+         _num_initial_workers: Optional[int] = None,
+         _session_dir: Optional[str] = None) -> Dict[str, Any]:
     """Start a cluster in-process (or connect to one via ``address``)."""
     global _head
+    if address is None:
+        # `ray-tpu submit` / external drivers point here via env var
+        # (reference analog: RAY_ADDRESS).
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
     if try_global_worker() is not None:
         if ignore_reinit_error:
             return {}
@@ -80,7 +85,7 @@ def init(address: Optional[str] = None,
     if address and address != "local":
         session_dir = address
     else:
-        session_dir = os.path.join(
+        session_dir = _session_dir or os.path.join(
             "/tmp/ray_tpu", f"session_{int(time.time())}_{uuid.uuid4().hex[:8]}")
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
         config.session_dir = session_dir

@@ -1,0 +1,210 @@
+"""The ``ray-tpu`` CLI.
+
+Reference: ``python/ray/scripts/scripts.py`` (``start`` :567, ``stop``
+:1043, ``submit`` :1577, status/memory/timeline/microbenchmark and the
+``ray list``/``ray summary`` state commands from ``state_cli.py``).
+Run as ``python -m ray_tpu.scripts.cli <command>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+LATEST = "/tmp/ray_tpu/latest_session"
+PIDFILE = "/tmp/ray_tpu/head.pid"
+
+
+def _default_address() -> str:
+    addr = os.environ.get("RAY_TPU_ADDRESS")
+    if addr:
+        return addr
+    if os.path.exists(LATEST):
+        with open(LATEST) as f:
+            return f.read().strip()
+    raise SystemExit(
+        "No running cluster found (start one with `ray-tpu start --head`"
+        " or set RAY_TPU_ADDRESS)")
+
+
+def _connect():
+    import ray_tpu
+    ray_tpu.init(address=_default_address())
+    return ray_tpu
+
+
+def cmd_start(args) -> None:
+    os.makedirs("/tmp/ray_tpu", exist_ok=True)
+    if args.head:
+        cmd = [sys.executable, "-m", "ray_tpu.scripts.head",
+               "--initial-workers", str(args.initial_workers)]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            cmd += ["--num-tpus", str(args.num_tpus)]
+        if args.resources:
+            cmd += ["--resources", args.resources]
+        log = open("/tmp/ray_tpu/head.log", "ab")
+        proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        with open(PIDFILE, "w") as f:
+            f.write(str(proc.pid))
+        for _ in range(100):
+            if os.path.exists(LATEST):
+                mtime = os.path.getmtime(LATEST)
+                if mtime >= time.time() - 60:
+                    break
+            time.sleep(0.2)
+        print(f"Started head (pid {proc.pid}); "
+              f"address: {_default_address()}")
+    else:
+        address = args.address or _default_address()
+        cmd = [sys.executable, "-m", "ray_tpu.core.node",
+               "--session-dir", address,
+               "--initial-workers", str(args.initial_workers)]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            cmd += ["--num-tpus", str(args.num_tpus)]
+        log = open("/tmp/ray_tpu/node.log", "ab")
+        proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        print(f"Started node (pid {proc.pid}) joined to {address}")
+
+
+def cmd_stop(args) -> None:
+    if os.path.exists(PIDFILE):
+        with open(PIDFILE) as f:
+            pid = int(f.read())
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"Stopped head (pid {pid})")
+        except ProcessLookupError:
+            print("Head already stopped")
+        os.remove(PIDFILE)
+    for f in (LATEST,):
+        if os.path.exists(f):
+            os.remove(f)
+
+
+def cmd_status(args) -> None:
+    ray_tpu = _connect()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    nodes = ray_tpu.nodes()
+    print(f"Nodes: {sum(1 for n in nodes if n['alive'])} alive "
+          f"/ {len(nodes)} total")
+    print("Resources:")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
+
+
+def cmd_list(args) -> None:
+    _connect()
+    from ray_tpu.util import state
+    fn = getattr(state, f"list_{args.what}", None)
+    if fn is None:
+        raise SystemExit(f"Cannot list {args.what!r}")
+    filters = []
+    for f in args.filter or []:
+        if "!=" in f:
+            k, v = f.split("!=", 1)
+            filters.append((k, "!=", v))
+        else:
+            k, v = f.split("=", 1)
+            filters.append((k, "=", v))
+    rows = fn(filters=filters, limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args) -> None:
+    _connect()
+    from ray_tpu.util import state
+    fn = getattr(state, f"summarize_{args.what}")
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_memory(args) -> None:
+    _connect()
+    from ray_tpu.util import state
+    print(json.dumps(state.summarize_objects(), indent=2))
+
+
+def cmd_timeline(args) -> None:
+    ray_tpu = _connect()
+    out = args.output or f"/tmp/ray_tpu/timeline_{int(time.time())}.json"
+    ray_tpu.timeline(filename=out)
+    print(f"Wrote Chrome trace to {out}")
+
+
+def cmd_submit(args) -> None:
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = args.address or _default_address()
+    raise SystemExit(subprocess.call(
+        [sys.executable, args.script] + args.script_args, env=env))
+
+
+def cmd_microbenchmark(args) -> None:
+    import ray_tpu
+    from ray_tpu.scripts.perf import main as perf_main
+    perf_main()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--resources", default=None)
+    sp.add_argument("--initial-workers", type=int, default=2)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the head started here")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resource summary")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("what", choices=[
+        "actors", "tasks", "objects", "nodes", "placement_groups",
+        "jobs", "workers"])
+    sp.add_argument("--filter", action="append")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="summarize cluster state")
+    sp.add_argument("what", choices=["tasks", "actors", "objects"])
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("memory", help="object store summary")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("timeline", help="dump Chrome trace")
+    sp.add_argument("--output", default=None)
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("submit", help="run a script against the cluster")
+    sp.add_argument("script")
+    sp.add_argument("script_args", nargs="*")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("microbenchmark", help="core perf suite")
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    args = p.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

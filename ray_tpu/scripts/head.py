@@ -1,0 +1,53 @@
+"""Head-node daemon entry: ``python -m ray_tpu.scripts.head``.
+
+Runs controller + node manager and blocks until signaled. Started by
+``ray-tpu start --head`` (reference analog:
+``python/ray/_private/services.py`` daemon spawning).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import uuid
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--session-dir", default=None)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--initial-workers", type=int, default=2)
+    args = p.parse_args()
+
+    import ray_tpu
+    # A head daemon must not inherit a driver's RAY_TPU_ADDRESS: it IS
+    # the cluster. --session-dir pins the session path if given.
+    os.environ.pop("RAY_TPU_ADDRESS", None)
+    info = ray_tpu.init(
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        resources=json.loads(args.resources),
+        _num_initial_workers=args.initial_workers,
+        _session_dir=args.session_dir)
+    # Publish the default address for `ray-tpu` subcommands and drivers.
+    os.makedirs("/tmp/ray_tpu", exist_ok=True)
+    with open("/tmp/ray_tpu/latest_session", "w") as f:
+        f.write(info["session_dir"])
+    print(f"ray_tpu head running; session_dir={info['session_dir']}")
+    sys.stdout.flush()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Core microbenchmark suite.
+
+Reference: ``python/ray/_private/ray_perf.py`` (run as ``ray
+microbenchmark``) — the numbers in BASELINE.md §"scalability envelope":
+sync/async task throughput, actor call throughput, put throughput.
+Prints one JSON line per metric with the reference baseline ratio.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict
+
+import numpy as np
+
+# Reference measured numbers (BASELINE.md, release_logs/2.9.2)
+BASELINES = {
+    "tasks_sync_per_s": 1046.0,
+    "tasks_async_per_s": 8159.0,
+    "actor_calls_sync_per_s": 2138.0,
+    "actor_calls_async_per_s": 9183.0,
+    "put_gib_per_s": 19.5,
+}
+
+
+def bench_tasks_sync(ray_tpu, n=200) -> float:
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    ray_tpu.get(nop.remote())  # warm worker + export
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(nop.remote())
+    return n / (time.perf_counter() - t0)
+
+
+def bench_tasks_async(ray_tpu, n=2000) -> float:
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    ray_tpu.get(nop.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(n)])
+    return n / (time.perf_counter() - t0)
+
+
+def bench_actor_sync(ray_tpu, n=500) -> float:
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return b"ok"
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(a.m.remote())
+    dt = time.perf_counter() - t0
+    ray_tpu.kill(a)
+    return n / dt
+
+
+def bench_actor_async(ray_tpu, n=5000) -> float:
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return b"ok"
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([a.m.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    ray_tpu.kill(a)
+    return n / dt
+
+
+def bench_put(ray_tpu, mb=64, iters=8) -> float:
+    data = np.random.default_rng(0).bytes(mb << 20)
+    ray_tpu.put(data)  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ray_tpu.put(data)
+    dt = time.perf_counter() - t0
+    return (mb * iters / 1024.0) / dt
+
+
+def main() -> Dict[str, float]:
+    import ray_tpu
+    started = False
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4, _num_initial_workers=2)
+        started = True
+    results = {
+        "tasks_sync_per_s": bench_tasks_sync(ray_tpu),
+        "tasks_async_per_s": bench_tasks_async(ray_tpu),
+        "actor_calls_sync_per_s": bench_actor_sync(ray_tpu),
+        "actor_calls_async_per_s": bench_actor_async(ray_tpu),
+        "put_gib_per_s": bench_put(ray_tpu),
+    }
+    for name, value in results.items():
+        print(json.dumps({
+            "metric": name, "value": round(value, 1),
+            "unit": "GiB/s" if "gib" in name else "1/s",
+            "vs_baseline": round(value / BASELINES[name], 3),
+        }))
+    if started:
+        ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    main()

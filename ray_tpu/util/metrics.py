@@ -1,0 +1,184 @@
+"""User-defined metrics: Counter / Gauge / Histogram + Prometheus text.
+
+Reference: ``python/ray/util/metrics.py`` (``Counter`` :137,
+``Histogram`` :181, ``Gauge`` :256) flowing through the C++
+OpenCensus pipeline to per-node Prometheus endpoints. Here a process-
+local registry aggregates and ``export_prometheus()`` /
+``serve_prometheus(port)`` expose the text format directly (one
+process = one scrape target; tags become labels).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
+
+
+def _label_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name.isidentifier():
+            raise ValueError(f"Invalid metric name {name!r}")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        out.update(tags or {})
+        return out
+
+    @property
+    def info(self) -> Dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys}
+
+    def _samples(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value <= 0:
+            raise ValueError("Counter.inc requires value > 0")
+        key = _label_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _samples(self) -> List[str]:
+        out = [f"# TYPE {self._name} counter"]
+        with self._lock:
+            for key, v in self._values.items():
+                out.append(f"{self._name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge(Metric):
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_label_key(self._merged(tags))] = float(value)
+
+    def _samples(self) -> List[str]:
+        out = [f"# TYPE {self._name} gauge"]
+        with self._lock:
+            for key, v in self._values.items():
+                out.append(f"{self._name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Histogram(Metric):
+    def __init__(self, name, description="", boundaries=None,
+                 tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._bounds = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = _label_key(self._merged(tags))
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self._bounds) + 1))
+            counts[bisect.bisect_left(self._bounds, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def _samples(self) -> List[str]:
+        out = [f"# TYPE {self._name} histogram"]
+        with self._lock:
+            for key, counts in self._counts.items():
+                cum = 0
+                for bound, c in zip(self._bounds, counts):
+                    cum += c
+                    out.append(
+                        f"{self._name}_bucket"
+                        f"{_fmt_labels(key, le=bound)} {cum}")
+                cum += counts[-1]
+                out.append(
+                    f'{self._name}_bucket{_fmt_labels(key, le="+Inf")} '
+                    f"{cum}")
+                out.append(f"{self._name}_count{_fmt_labels(key)} {cum}")
+                out.append(
+                    f"{self._name}_sum{_fmt_labels(key)} "
+                    f"{self._sums[key]}")
+        return out
+
+
+def _escape(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_labels(key: Tuple, le=None) -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in key]
+    if le is not None:
+        parts.append(f'le="{le}"')
+    if not parts:
+        return ""
+    return "{" + ",".join(parts) + "}"
+
+
+def export_prometheus() -> str:
+    """All registered metrics in Prometheus text exposition format."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry)
+    for m in metrics:
+        lines.extend(m._samples())
+    return "\n".join(lines) + "\n"
+
+
+_metrics_server = None
+
+
+def serve_prometheus(port: int = 0) -> int:
+    """Start a /metrics HTTP endpoint; returns the bound port."""
+    global _metrics_server
+    import threading as _t
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = export_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    _metrics_server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    _t.Thread(target=_metrics_server.serve_forever, daemon=True).start()
+    return _metrics_server.server_address[1]

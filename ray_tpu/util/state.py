@@ -1,0 +1,118 @@
+"""State API: queryable live cluster state.
+
+Reference: ``python/ray/util/state/api.py`` (``list_actors/tasks/
+objects/nodes/placement_groups/jobs``, ``summarize_*``) served by
+``dashboard/state_aggregator.py:141``; here the controller's state
+tables answer directly (single control plane, no fan-out needed).
+Filters are ``(key, predicate, value)`` tuples with ``=``/``!=``, as in
+the reference CLI.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.global_state import global_worker
+
+Filter = Tuple[str, str, Any]
+
+
+def _query(what: str, filters: Optional[List[Filter]] = None,
+           limit: int = 1000, detail: bool = False) -> List[dict]:
+    rows = global_worker().state_query(what)
+    if not isinstance(rows, list):
+        return rows
+    for key, op, value in (filters or []):
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"Unsupported predicate {op!r}")
+    return rows[:limit]
+
+
+def list_nodes(filters=None, limit: int = 1000, **kw) -> List[dict]:
+    return _query("nodes", filters, limit)
+
+
+def list_actors(filters=None, limit: int = 1000, **kw) -> List[dict]:
+    return _query("actors", filters, limit)
+
+
+def list_tasks(filters=None, limit: int = 1000, **kw) -> List[dict]:
+    return _query("tasks", filters, limit)
+
+
+def list_objects(filters=None, limit: int = 1000, **kw) -> List[dict]:
+    return _query("objects", filters, limit)
+
+
+def list_placement_groups(filters=None, limit: int = 1000,
+                          **kw) -> List[dict]:
+    return _query("placement_groups", filters, limit)
+
+
+def list_jobs(filters=None, limit: int = 1000, **kw) -> List[dict]:
+    return _query("jobs", filters, limit)
+
+
+def list_workers(filters=None, limit: int = 1000, **kw) -> List[dict]:
+    # Workers are surfaced per node (the controller tracks them there).
+    out = []
+    for n in _query("nodes", None, limit):
+        out.append({"node_id": n["node_id"],
+                    "num_workers": n["num_workers"]})
+    return out
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    by_state: Counter = Counter()
+    by_name: Dict[str, Counter] = {}
+    for t in list_tasks(limit=100_000):
+        state = t.get("state", "UNKNOWN")
+        by_state[state] += 1
+        name = t.get("name", "?")
+        by_name.setdefault(name, Counter())[state] += 1
+    return {"total": sum(by_state.values()),
+            "by_state": dict(by_state),
+            "by_func_name": {k: dict(v) for k, v in by_name.items()}}
+
+
+def summarize_actors() -> Dict[str, Any]:
+    by_state: Counter = Counter()
+    for a in list_actors(limit=100_000):
+        by_state[a.get("state", "UNKNOWN")] += 1
+    return {"total": sum(by_state.values()), "by_state": dict(by_state)}
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = list_objects(limit=100_000)
+    return {"total": len(objs),
+            "total_size_bytes": sum(o.get("size") or 0 for o in objs),
+            "inline": sum(1 for o in objs if o.get("inline")),
+            "errors": sum(1 for o in objs if o.get("has_error"))}
+
+
+def get_log(node_id: Optional[str] = None, pid: Optional[int] = None,
+            tail: int = 100) -> List[str]:
+    """Tail worker logs from the session dir (reference ``get_log``)."""
+    import glob
+    import os
+    w = global_worker()
+    session_dir = getattr(w, "session_dir", None)
+    if session_dir is None:
+        return []
+    pattern = os.path.join(session_dir, "logs", "worker-*.out")
+    lines: List[str] = []
+    import re
+    for path in sorted(glob.glob(pattern)):
+        if pid is not None:
+            nums = re.findall(r"\d+", os.path.basename(path))
+            if str(pid) not in nums:
+                continue
+        with open(path, errors="replace") as f:
+            lines.extend(f"{os.path.basename(path)}: {ln.rstrip()}"
+                         for ln in f.readlines()[-tail:])
+    return lines[-tail:]

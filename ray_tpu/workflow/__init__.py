@@ -1,0 +1,32 @@
+"""ray_tpu.workflow: durable DAG execution
+(reference: ``python/ray/workflow/``).
+
+``workflow.run(dag, workflow_id=...)`` executes a task DAG with every
+task output checkpointed to storage (``task_executor.py:50``,
+``WorkflowStorage`` :229); re-running or ``resume()`` after a crash
+skips completed tasks and replays only the rest.
+"""
+
+from ray_tpu.workflow.api import (
+    delete,
+    get_metadata,
+    get_output,
+    get_status,
+    init_storage,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "delete",
+    "get_metadata",
+    "get_output",
+    "get_status",
+    "init_storage",
+    "list_all",
+    "resume",
+    "run",
+    "run_async",
+]

@@ -1,0 +1,159 @@
+"""DAG + workflow tests (reference patterns:
+``python/ray/dag/tests``, ``python/ray/workflow/tests``)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu import workflow
+
+
+# ------------------------------------------------------------------ dag
+def test_function_dag(ray_session):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    with InputNode() as inp:
+        dag = mul.bind(add.bind(inp, 2), 10)
+    assert ray_tpu.get(dag.execute(3)) == 50
+    assert ray_tpu.get(dag.execute(0)) == 20
+
+
+def test_shared_subnode_executes_once(ray_session):
+    @ray_tpu.remote
+    def bump(x):
+        import time
+        return x + 1
+
+    @ray_tpu.remote
+    def pair(a, b):
+        return (a, b)
+
+    with InputNode() as inp:
+        shared = bump.bind(inp)
+        dag = pair.bind(shared, shared)
+    a, b = ray_tpu.get(dag.execute(1))
+    assert a == b == 2
+
+
+def test_input_attribute_nodes(ray_session):
+    @ray_tpu.remote
+    def combine(x, y):
+        return x * 100 + y
+
+    with InputNode() as inp:
+        dag = combine.bind(inp["x"], inp["y"])
+    assert ray_tpu.get(dag.execute(x=3, y=7)) == 307
+
+
+def test_actor_dag_and_multi_output(ray_session):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    with InputNode() as inp:
+        counter = Counter.bind(100)
+        n1 = counter.add.bind(inp)
+        n2 = counter.add.bind(inp)
+        dag = MultiOutputNode([n1, n2])
+    out = [ray_tpu.get(r) for r in dag.execute(5)]
+    # one fresh actor per execute; two sequential adds on it
+    assert out == [105, 110]
+
+
+def test_compiled_dag_reuses_actor(ray_session):
+    @ray_tpu.remote
+    class Stateful:
+        def __init__(self):
+            self.calls = 0
+
+        def tick(self, _):
+            self.calls += 1
+            return self.calls
+
+    with InputNode() as inp:
+        dag = Stateful.bind().tick.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        assert ray_tpu.get(compiled.execute(0)) == 1
+        assert ray_tpu.get(compiled.execute(0)) == 2  # same actor
+    finally:
+        compiled.teardown()
+    # uncompiled executes get a fresh actor each time
+    assert ray_tpu.get(dag.execute(0)) == 1
+
+
+# ------------------------------------------------------------- workflow
+def test_workflow_run_and_skip_completed(ray_session, tmp_path):
+    workflow.init_storage(str(tmp_path))
+    calls_file = str(tmp_path / "calls.txt")
+
+    @ray_tpu.remote
+    def record(x):
+        with open(calls_file, "a") as f:
+            f.write("x")
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    dag = add.bind(record.bind(5), 1)
+    assert workflow.run(dag, workflow_id="w1") == 11
+    assert workflow.get_status("w1") == "SUCCESSFUL"
+    # finished workflow: output returned without re-execution
+    assert workflow.run(dag, workflow_id="w1") == 11
+    with open(calls_file) as f:
+        assert f.read() == "x"
+
+
+def test_workflow_resume_after_failure(ray_session, tmp_path):
+    workflow.init_storage(str(tmp_path))
+    marker = str(tmp_path / "fail_once")
+
+    @ray_tpu.remote
+    def step_a():
+        return 10
+
+    @ray_tpu.remote
+    def flaky(x):
+        import os
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("boom")
+        return x + 5
+
+    dag = flaky.bind(step_a.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == "FAILED"
+    # resume re-runs only the failed task (step_a checkpoint reused)
+    assert workflow.resume("w2") == 15
+    assert workflow.get_status("w2") == "SUCCESSFUL"
+
+
+def test_workflow_list_and_delete(ray_session, tmp_path):
+    workflow.init_storage(str(tmp_path))
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="w3")
+    all_wfs = dict(workflow.list_all())
+    assert all_wfs.get("w3") == "SUCCESSFUL"
+    assert workflow.get_output("w3") == 1
+    workflow.delete("w3")
+    assert "w3" not in dict(workflow.list_all())

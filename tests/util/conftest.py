@@ -1,0 +1,10 @@
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_session():
+    import ray_tpu
+    info = ray_tpu.init(num_cpus=6, _num_initial_workers=2,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()

@@ -1,0 +1,27 @@
+"""Multi-node simulation test (reference ray_start_cluster fixture,
+``python/ray/tests/conftest.py:492``)."""
+
+import ray_tpu
+
+
+def test_cluster_utils_multi_node():
+    """Multi-node-on-one-machine (reference ray_start_cluster)."""
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster(head_node_args={"num_cpus": 2,
+                                      "_num_initial_workers": 1})
+    try:
+        cluster.add_node(num_cpus=2, resources={"side": 1},
+                         labels={"zone": "b"})
+        cluster.wait_for_nodes()
+        assert ray_tpu.cluster_resources().get("side") == 1
+
+        # task pinned to the added node via custom resource
+        @ray_tpu.remote(resources={"side": 1})
+        def where():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        node_id = ray_tpu.get(where.remote(), timeout=60)
+        head_id = ray_tpu.get_runtime_context().get_node_id()
+        assert node_id != head_id
+    finally:
+        cluster.shutdown()

@@ -1,0 +1,98 @@
+"""Tests for the state API, metrics, multiprocessing Pool, and the
+multi-node Cluster fixture (reference: ``python/ray/tests``
+``test_state_api*``, ``test_metrics*``, ``test_multiprocessing``,
+``test_multi_node*``)."""
+
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+from ray_tpu.util import metrics
+from ray_tpu.util.multiprocessing import Pool
+
+
+def test_state_lists(ray_session):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="state_test_actor").remote()
+    ray_tpu.get(a.ping.remote())
+    ray_tpu.get([f.remote() for _ in range(3)])
+
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+
+    actors = state.list_actors(
+        filters=[("name", "=", "state_test_actor")])
+    assert len(actors) == 1
+    assert actors[0]["state"] == "ALIVE"
+
+    tasks = state.list_tasks(limit=50)
+    assert any(t.get("name", "").startswith("f") for t in tasks)
+
+    summary = state.summarize_tasks()
+    assert summary["total"] > 0
+    asum = state.summarize_actors()
+    assert asum["total"] >= 1
+    osum = state.summarize_objects()
+    assert "total" in osum
+    ray_tpu.kill(a)
+
+
+def test_metrics_prometheus_export(ray_session):
+    c = metrics.Counter("test_requests", "reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    g = metrics.Gauge("test_depth")
+    g.set(7.5)
+    h = metrics.Histogram("test_latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    text = metrics.export_prometheus()
+    assert 'test_requests{route="/a"} 3.0' in text
+    assert "test_depth 7.5" in text
+    assert 'test_latency_bucket{le="0.1"} 1' in text
+    assert 'test_latency_bucket{le="+Inf"} 3' in text
+    assert "test_latency_sum" in text
+
+    port = metrics.serve_prometheus(0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+        body = resp.read().decode()
+    assert "test_depth 7.5" in body
+
+
+def test_multiprocessing_pool(ray_session):
+    def sq(x):
+        return x * x
+
+    with Pool(processes=2) as pool:
+        assert pool.map(sq, range(8)) == [i * i for i in range(8)]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        r = pool.apply_async(sq, (9,))
+        assert r.get(timeout=30) == 81
+        assert sorted(pool.imap_unordered(sq, range(4))) == [0, 1, 4, 9]
+
+
+def test_timeline_api(ray_session, tmp_path):
+    @ray_tpu.remote
+    def traced():
+        return 1
+
+    ray_tpu.get(traced.remote())
+    out = ray_tpu.timeline(filename=str(tmp_path / "trace.json"))
+    assert out.endswith("trace.json")
+    import json
+    events = json.load(open(out))
+    assert isinstance(events, list)
