@@ -98,13 +98,14 @@ class Cluster:
     def wait_for_nodes(self, timeout: float = 30) -> None:
         expect = 1 + len(self._nodes)
         deadline = time.time() + timeout
-        while time.time() < deadline:
+        while True:
             alive = [n for n in ray_tpu.nodes() if n["alive"]]
             if len(alive) >= expect:
                 return
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    f"expected {expect} alive nodes, have {len(alive)}")
             time.sleep(0.2)
-        raise TimeoutError(
-            f"expected {expect} alive nodes, have {len(alive)}")
 
     def shutdown(self) -> None:
         for node in list(self._nodes):

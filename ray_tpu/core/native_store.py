@@ -1,0 +1,359 @@
+"""Native-segment store/client: same API as ShmObjectStore/ShmClient,
+backed by the C++ single-segment allocator (``ray_tpu/_native``).
+
+One ``/dev/shm/<session>.seg`` holds the index + all object bytes, so
+create/seal/contains are shared-memory operations instead of per-object
+``open``/``ftruncate``/``mmap`` syscalls (the plasma property —
+``plasma_allocator.h`` — that the pure-Python store approximates with
+one file per object). Eviction/spill policy stays in the Python store
+class: the segment is the data plane.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError
+
+_SHM_ROOT = "/dev/shm"
+_FULL = 2 ** 64 - 1
+_EXISTS = 2 ** 64 - 2
+
+
+def _seg_path(session_name: str) -> str:
+    return os.path.join(_SHM_ROOT, f"{session_name}.seg")
+
+
+class _Segment:
+    """One mapped native segment (create or open)."""
+
+    def __init__(self, lib, session_name: str,
+                 capacity: Optional[int] = None, nslots: int = 65536):
+        self.lib = lib
+        self.path = _seg_path(session_name)
+        if capacity is not None:
+            self.handle = lib.ns_create(
+                self.path.encode(), capacity, nslots)
+            self.owner = True
+        else:
+            self.handle = lib.ns_open(self.path.encode())
+            self.owner = False
+        if not self.handle:
+            raise OSError(f"cannot map native segment {self.path}")
+        total = lib.ns_total_size(self.handle)
+        base = lib.ns_base(self.handle)
+        self._buf = (ctypes.c_char * total).from_address(base)
+        self.view = memoryview(self._buf).cast("B")
+
+    def alloc(self, oid: ObjectID, size: int) -> int:
+        return self.lib.ns_alloc(self.handle, oid.binary(), size)
+
+    def seal(self, oid: ObjectID) -> int:
+        return self.lib.ns_seal(self.handle, oid.binary())
+
+    def lookup(self, oid: ObjectID):
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        state = self.lib.ns_lookup(
+            self.handle, oid.binary(), ctypes.byref(off),
+            ctypes.byref(size))
+        return state, off.value, size.value
+
+    def delete(self, oid: ObjectID) -> int:
+        return self.lib.ns_delete(self.handle, oid.binary())
+
+    def evict(self, oid: ObjectID) -> int:
+        """Free only if unreferenced (never under a live reader)."""
+        return self.lib.ns_evict(self.handle, oid.binary())
+
+    def acquire(self, oid: ObjectID):
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        state = self.lib.ns_acquire(
+            self.handle, oid.binary(), os.getpid(), ctypes.byref(off),
+            ctypes.byref(size))
+        return state, off.value, size.value
+
+    def release(self, oid: ObjectID) -> None:
+        self.lib.ns_release(self.handle, oid.binary(), os.getpid())
+
+    def release_all(self) -> None:
+        self.lib.ns_release_all(self.handle, os.getpid())
+
+    def reap(self) -> int:
+        return self.lib.ns_reap(self.handle)
+
+    def stats(self):
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = ctypes.c_uint32()
+        self.lib.ns_stats(self.handle, ctypes.byref(used),
+                          ctypes.byref(cap), ctypes.byref(n))
+        return used.value, cap.value, n.value
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self.view.release()
+        except Exception:
+            pass
+        if self.handle:
+            self.lib.ns_close(self.handle)
+            self.handle = None
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+
+
+class NativeShmStore:
+    """Server side (node manager): eviction/spill authority over the
+    native segment. API-compatible with ``ShmObjectStore``."""
+
+    def __init__(self, session_name: str, capacity_bytes: int,
+                 spill_dir: Optional[str] = None, lib=None):
+        from ray_tpu import _native
+        self.lib = lib or _native.load()
+        assert self.lib is not None
+        self.session_name = session_name
+        self.capacity = capacity_bytes
+        # Physical segment is over-provisioned (tmpfs pages materialize
+        # only when touched) so a create that transiently overshoots the
+        # nominal capacity succeeds and eviction catches up at seal time
+        # — plasma's "fallback allocation" semantics.
+        self.seg = _Segment(self.lib, session_name,
+                            capacity=capacity_bytes * 2)
+        self.spill_dir = spill_dir
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._sealed: "OrderedDict[ObjectID, int]" = OrderedDict()
+        self._pinned: Dict[ObjectID, int] = {}
+        self._spilled: Dict[ObjectID, str] = {}
+
+    # --- bookkeeping (same contract as ShmObjectStore) ---
+    def on_sealed(self, object_id: ObjectID, size: int) -> None:
+        with self._lock:
+            self._sealed[object_id] = size
+            self._maybe_evict_locked()
+
+    def pin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._pinned[object_id] = self._pinned.get(object_id, 0) + 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            n = self._pinned.get(object_id, 0) - 1
+            if n <= 0:
+                self._pinned.pop(object_id, None)
+            else:
+                self._pinned[object_id] = n
+
+    def contains(self, object_id: ObjectID) -> bool:
+        state, _, _ = self.seg.lookup(object_id)
+        if state == 2:
+            return True
+        with self._lock:
+            return object_id in self._spilled
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._delete_locked(object_id)
+
+    def _delete_locked(self, object_id: ObjectID) -> None:
+        self._sealed.pop(object_id, None)
+        self.seg.delete(object_id)
+        spath = self._spilled.pop(object_id, None)
+        if spath:
+            try:
+                os.unlink(spath)
+            except FileNotFoundError:
+                pass
+
+    def _maybe_evict_locked(self) -> None:
+        # Evict against the NOMINAL capacity; the physical segment has
+        # headroom so in-flight creates don't fail while we catch up.
+        used, _, _ = self.seg.stats()
+        if used <= self.capacity:
+            return
+        for oid in list(self._sealed.keys()):
+            used, _, _ = self.seg.stats()
+            if used <= self.capacity * 0.8:
+                break
+            if oid in self._pinned:
+                continue
+            if self.spill_dir:
+                self._spill_locked(oid)
+            elif self.seg.evict(oid) > 0:
+                self._sealed.pop(oid, None)
+
+    def _spill_locked(self, object_id: ObjectID) -> None:
+        state, off, size = self.seg.lookup(object_id)
+        if state != 2:
+            return
+        dst = os.path.join(self.spill_dir, object_id.hex())
+        with open(dst, "wb") as f:
+            f.write(self.seg.view[off:off + size])
+        if self.seg.evict(object_id) == 0:
+            # A live reader holds the extent; leave it resident (its
+            # spilled copy is redundant but harmless).
+            try:
+                os.unlink(dst)
+            except FileNotFoundError:
+                pass
+            return
+        self._sealed.pop(object_id, None)
+        self._spilled[object_id] = dst
+
+    def maybe_restore(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            spath = self._spilled.get(object_id)
+            if spath is None:
+                state, _, _ = self.seg.lookup(object_id)
+                return state == 2
+            size = os.stat(spath).st_size
+            off = self.seg.alloc(object_id, size)
+            if off == _FULL:
+                # Make room: evict other unreferenced residents, then
+                # retry once (the Python store's restore never fails on
+                # capacity either).
+                for other in list(self._sealed.keys()):
+                    if other != object_id and other not in self._pinned \
+                            and self.seg.evict(other) > 0:
+                        self._sealed.pop(other, None)
+                        off = self.seg.alloc(object_id, size)
+                        if off != _FULL:
+                            break
+            if off in (_FULL, _EXISTS):
+                return off == _EXISTS
+            with open(spath, "rb") as f:
+                f.readinto(self.seg.view[off:off + size])
+            self.seg.seal(object_id)
+            os.unlink(spath)
+            self._spilled.pop(object_id, None)
+            self._sealed[object_id] = size
+            return True
+
+    def reap_dead_readers(self) -> int:
+        """Release references held by dead PIDs (crash cleanup;
+        plasma's disconnected-client path). Called from the node
+        manager's heartbeat."""
+        return self.seg.reap()
+
+    def stats(self) -> dict:
+        used, _, n = self.seg.stats()
+        with self._lock:
+            return {
+                "used_bytes": used,
+                "capacity_bytes": self.capacity,
+                "num_objects": n,
+                "num_spilled": len(self._spilled),
+                "num_pinned": len(self._pinned),
+                "native": True,
+            }
+
+    def destroy(self) -> None:
+        with self._lock:
+            for spath in self._spilled.values():
+                try:
+                    os.unlink(spath)
+                except FileNotFoundError:
+                    pass
+            self._spilled.clear()
+        self.seg.close(unlink=True)
+
+
+class NativeShmClient:
+    """Worker/driver side: zero-copy create/seal/get on the segment.
+    API-compatible with ``ShmClient``."""
+
+    def __init__(self, session_name: str, lib=None):
+        from ray_tpu import _native
+        self.lib = lib or _native.load()
+        assert self.lib is not None
+        self.session_name = session_name
+        self._seg: Optional[_Segment] = None
+        self._acquired: Dict[ObjectID, int] = {}
+        self._lock = threading.Lock()
+
+    def _segment(self, timeout: float = 10.0) -> _Segment:
+        with self._lock:
+            if self._seg is None:
+                deadline = time.monotonic() + timeout
+                while True:
+                    try:
+                        self._seg = _Segment(self.lib, self.session_name)
+                        break
+                    except OSError:
+                        if time.monotonic() >= deadline:
+                            raise
+                        time.sleep(0.01)
+            return self._seg
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        seg = self._segment()
+        off = seg.alloc(object_id, size)
+        if off == _EXISTS:
+            raise FileExistsError(object_id.hex())
+        if off == _FULL:
+            raise ObjectStoreFullError(
+                f"native store full creating {object_id.hex()} "
+                f"({size} bytes)")
+        size = max(size, 1)
+        return seg.view[off:off + size]
+
+    def seal(self, object_id: ObjectID) -> int:
+        size = self._segment().seal(object_id)
+        return 0 if size == _FULL else size
+
+    def put_bytes(self, object_id: ObjectID, data) -> int:
+        view = self.create(object_id, len(data))
+        view[: len(data)] = data
+        return self.seal(object_id)
+
+    def get_view(self, object_id: ObjectID,
+                 timeout: float = 0.0) -> Optional[memoryview]:
+        """Zero-copy view; takes a read reference so the extent cannot
+        be reused under us. Balanced by release()/close(); references
+        of crashed processes are reaped by the node manager."""
+        seg = self._segment()
+        deadline = time.monotonic() + timeout
+        while True:
+            state, off, size = seg.acquire(object_id)
+            if state == 2:
+                with self._lock:
+                    self._acquired[object_id] = \
+                        self._acquired.get(object_id, 0) + 1
+                return seg.view[off:off + size]
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.001)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        state, _, _ = self._segment().lookup(object_id)
+        return state == 2
+
+    def release(self, object_id: ObjectID) -> None:
+        with self._lock:
+            n = self._acquired.get(object_id, 0)
+            if n <= 0 or self._seg is None:
+                return
+            if n == 1:
+                self._acquired.pop(object_id, None)
+            else:
+                self._acquired[object_id] = n - 1
+        self._seg.release(object_id)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._seg is not None:
+                self._seg.release_all()
+                self._acquired.clear()
+                self._seg.close()
+                self._seg = None

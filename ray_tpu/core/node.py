@@ -28,7 +28,7 @@ import zmq
 from ray_tpu.core import protocol as P
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
-from ray_tpu.core.shm_store import ShmClient, ShmObjectStore
+from ray_tpu.core.shm_store import make_client, make_store
 
 logger = logging.getLogger(__name__)
 
@@ -56,10 +56,10 @@ class NodeManager:
                                * self.config.object_store_memory_fraction)
             except Exception:
                 capacity = 2 << 30
-        self.store = ShmObjectStore(
+        self.store = make_store(
             self.shm_session, capacity,
             spill_dir=os.path.join(self.config.spill_dir, self.node_id.hex()[:8]))
-        self.shm = ShmClient(self.shm_session)
+        self.shm = make_client(self.shm_session)
 
         self.workers: Dict[bytes, subprocess.Popen] = {}  # identity -> proc
         self._workers_lock = threading.Lock()
@@ -214,6 +214,14 @@ class NodeManager:
     def _heartbeat_loop(self) -> None:
         period = self.config.health_check_period_ms / 1000.0
         while not self._stopped.wait(period):
+            # Native store: reclaim read-references held by dead PIDs
+            # (plasma's disconnected-client cleanup).
+            reap = getattr(self.store, "reap_dead_readers", None)
+            if reap is not None:
+                try:
+                    reap()
+                except Exception:
+                    pass
             stats = self.store.stats()
             try:
                 import psutil

@@ -29,7 +29,7 @@ from ray_tpu.core.memory_store import InProcessStore
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.reference_counter import ReferenceCounter
 from ray_tpu.core.serialization import SerializationContext, SerializedObject
-from ray_tpu.core.shm_store import ShmClient
+from ray_tpu.core.shm_store import make_client
 from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.exceptions import GetTimeoutError
 
@@ -61,7 +61,7 @@ class Runtime:
         self.memory_store = InProcessStore()
         self.reference_counter = ReferenceCounter(self._flush_ref_deltas)
         self.serialization = SerializationContext(self)
-        self.shm = ShmClient(shm_session) if shm_session else None
+        self.shm = make_client(shm_session) if shm_session else None
         self.shm_session = shm_session
 
         # object_id(bytes) -> result meta {"inline"|"node_id"/"size"|"error"}

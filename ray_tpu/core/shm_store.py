@@ -200,6 +200,33 @@ class ShmObjectStore:
             pass
 
 
+def make_store(session_name: str, capacity_bytes: int,
+               spill_dir: Optional[str] = None):
+    """Store factory: native C++ segment when buildable, else the
+    Python file-per-object store."""
+    from ray_tpu import _native
+    if _native.load() is not None:
+        try:
+            from ray_tpu.core.native_store import NativeShmStore
+            return NativeShmStore(session_name, capacity_bytes,
+                                  spill_dir=spill_dir)
+        except OSError:
+            pass
+    return ShmObjectStore(session_name, capacity_bytes,
+                          spill_dir=spill_dir)
+
+
+def make_client(session_name: str):
+    """Client factory: the segment file's existence marks a native-store
+    session (the node manager creates it before workers/drivers join)."""
+    from ray_tpu import _native
+    seg = os.path.join(_SHM_ROOT, f"{session_name}.seg")
+    if os.path.exists(seg) and _native.load() is not None:
+        from ray_tpu.core.native_store import NativeShmClient
+        return NativeShmClient(session_name)
+    return ShmClient(session_name)
+
+
 class ShmClient:
     """Worker/driver-side client: create+seal and zero-copy get by name.
 

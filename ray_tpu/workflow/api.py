@@ -131,12 +131,16 @@ def _task_ids(root: DAGNode) -> Dict[int, str]:
 
 def _execute_durable(root: DAGNode, storage: _WorkflowStorage,
                      args, kwargs) -> Any:
-    """Bottom-up: every FunctionNode's VALUE is computed (or loaded from
-    its checkpoint) and pre-seeded into the execution cache, then the
-    normal DAG resolution runs over the cached values."""
+    """Two phases. Submit: walk bottom-up; checkpointed tasks are seeded
+    as values, the rest are submitted immediately with upstream REFS as
+    args — independent branches run in parallel, exactly like plain
+    ``dag.execute``. Checkpoint: persist each task's output in
+    completion order, so everything that finished before a failure is
+    durable for ``resume``."""
     from ray_tpu.dag.nodes import _resolve
     ids = _task_ids(root)
     ctx = _ExecutionContext(args, kwargs)
+    submitted = {}  # ref -> (task_id, cache_key)
 
     def visit(node):
         if not isinstance(node, DAGNode):
@@ -148,12 +152,25 @@ def _execute_durable(root: DAGNode, storage: _WorkflowStorage,
             if storage.has(task_id):
                 ctx.cache[id(node)] = storage.load(task_id)
             else:
-                # deps are already cached as values by this walk
-                value = ray_tpu.get(_resolve(node, ctx))
-                storage.save(task_id, value)
-                ctx.cache[id(node)] = value
+                ref = _resolve(node, ctx)  # submit; args may be refs
+                submitted[ref] = (task_id, id(node))
 
     visit(root)
+    first_error: Optional[BaseException] = None
+    pending = list(submitted)
+    while pending:
+        done, pending = ray_tpu.wait(pending, num_returns=1)
+        ref = done[0]
+        task_id, key = submitted[ref]
+        try:
+            value = ray_tpu.get(ref)
+        except BaseException as e:
+            first_error = first_error or e
+            continue
+        storage.save(task_id, value)
+        ctx.cache[key] = value
+    if first_error is not None:
+        raise first_error
     out = _resolve(root, ctx)
     if isinstance(out, list):
         out = [ray_tpu.get(o) if _is_ref(o) else o for o in out]

@@ -1,0 +1,201 @@
+"""Native segment store tests (C++ data plane), mirroring the plasma
+semantics the reference tests in
+``src/ray/object_manager/plasma/test/`` cover: create/seal/get,
+duplicate create, capacity, delete/reuse, cross-process visibility."""
+
+import multiprocessing
+import os
+import uuid
+
+import pytest
+
+from ray_tpu import _native
+from ray_tpu.core.ids import ObjectID
+
+lib = _native.load()
+pytestmark = pytest.mark.skipif(lib is None, reason="no native lib")
+
+
+@pytest.fixture
+def session():
+    from ray_tpu.core.native_store import NativeShmStore, _seg_path
+    name = f"raytpu-test-{uuid.uuid4().hex[:8]}"
+    store = NativeShmStore(name, 1 << 20)
+    yield name, store
+    store.destroy()
+
+
+def _oid():
+    return ObjectID.from_random()
+
+
+def test_create_seal_get_roundtrip(session):
+    from ray_tpu.core.native_store import NativeShmClient
+    name, store = session
+    client = NativeShmClient(name)
+    oid = _oid()
+    data = b"hello native store" * 100
+    view = client.create(oid, len(data))
+    view[:] = data
+    assert client.seal(oid) == len(data)
+    got = client.get_view(oid)
+    assert bytes(got) == data
+    assert client.contains(oid)
+    assert store.contains(oid)
+    client.close()
+
+
+def test_unsealed_not_visible(session):
+    from ray_tpu.core.native_store import NativeShmClient
+    name, _ = session
+    client = NativeShmClient(name)
+    oid = _oid()
+    client.create(oid, 10)
+    assert client.get_view(oid, timeout=0.05) is None
+    assert not client.contains(oid)
+    client.seal(oid)
+    assert client.contains(oid)
+    client.close()
+
+
+def test_duplicate_create_raises(session):
+    from ray_tpu.core.native_store import NativeShmClient
+    name, _ = session
+    client = NativeShmClient(name)
+    oid = _oid()
+    client.put_bytes(oid, b"x")
+    with pytest.raises(FileExistsError):
+        client.create(oid, 5)
+    client.close()
+
+
+def test_capacity_and_delete_reuse(session):
+    from ray_tpu.core.native_store import NativeShmClient
+    from ray_tpu.exceptions import ObjectStoreFullError
+    name, store = session
+    client = NativeShmClient(name)
+    big = (1 << 20) - 4096
+    # physical segment = 2x nominal (fallback-allocation headroom):
+    # two "big" objects fit, the third does not.
+    a, b = _oid(), _oid()
+    client.put_bytes(a, b"a" * big)
+    client.put_bytes(b, b"b" * big)
+    with pytest.raises(ObjectStoreFullError):
+        client.create(_oid(), big)
+    store.delete(a)
+    c = _oid()
+    client.put_bytes(c, b"c" * big)  # space reused after delete
+    assert bytes(client.get_view(c))[:1] == b"c"
+    client.close()
+
+
+def test_many_objects_index(session):
+    from ray_tpu.core.native_store import NativeShmClient
+    name, store = session
+    client = NativeShmClient(name)
+    oids = [_oid() for _ in range(500)]
+    for i, oid in enumerate(oids):
+        client.put_bytes(oid, str(i).encode())
+    for i, oid in enumerate(oids):
+        assert bytes(client.get_view(oid)) == str(i).encode()
+    used, cap, n = store.seg.stats()
+    assert n == 500
+    # the gets above hold read references: release them, then delete
+    for oid in oids:
+        client.release(oid)
+    for oid in oids:
+        store.delete(oid)
+    used, cap, n = store.seg.stats()
+    assert n == 0 and used == 0
+    client.close()
+
+
+def test_delete_under_live_reader_is_safe(session):
+    """A deleted object's extent must NOT be reused while a reader holds
+    a zero-copy view (zombie semantics); it is reclaimed on release."""
+    from ray_tpu.core.native_store import NativeShmClient
+    name, store = session
+    client = NativeShmClient(name)
+    oid = _oid()
+    data = b"A" * 4096
+    client.put_bytes(oid, data)
+    view = client.get_view(oid)          # holds a reference
+    store.delete(oid)                    # zombie, not freed
+    # new allocations cannot land on the zombie's extent
+    other = _oid()
+    client.put_bytes(other, b"B" * 4096)
+    assert bytes(view) == data           # reader's bytes intact
+    assert client.get_view(other, timeout=1) is not None
+    used_before = store.seg.stats()[0]
+    client.release(oid)                  # last ref -> extent freed
+    assert store.seg.stats()[0] < used_before
+    client.close()
+
+
+def test_reap_dead_reader(session):
+    """References of a crashed process are reclaimed by the reaper."""
+    from ray_tpu.core.native_store import NativeShmClient
+    name, store = session
+    oid = _oid()
+
+    def child(name, oid_bin):
+        from ray_tpu.core.native_store import NativeShmClient
+        from ray_tpu.core.ids import ObjectID
+        c = NativeShmClient(name)
+        c.put_bytes(ObjectID(oid_bin), b"z" * 1024)
+        c.get_view(ObjectID(oid_bin))    # acquire, then die hard
+        os._exit(0)
+
+    ctx = multiprocessing.get_context("fork")
+    proc = ctx.Process(target=child, args=(name, oid.binary()))
+    proc.start()
+    proc.join(timeout=60)
+    store.on_sealed(oid, 1024)
+    store.delete(oid)                    # zombie: dead child's ref
+    used_zombie = store.seg.stats()[0]
+    assert store.reap_dead_readers() >= 1
+    assert store.seg.stats()[0] < used_zombie
+
+
+def _child_put(name, oid_bin, data):
+    from ray_tpu.core.native_store import NativeShmClient
+    client = NativeShmClient(name)
+    client.put_bytes(ObjectID(oid_bin), data)
+    client.close()
+
+
+def test_cross_process_visibility(session):
+    from ray_tpu.core.native_store import NativeShmClient
+    name, _ = session
+    oid = _oid()
+    data = b"written by child process"
+    proc = multiprocessing.get_context("spawn").Process(
+        target=_child_put, args=(name, oid.binary(), data))
+    proc.start()
+    proc.join(timeout=60)
+    assert proc.exitcode == 0
+    client = NativeShmClient(name)
+    assert bytes(client.get_view(oid, timeout=5)) == data
+    client.close()
+
+
+def test_spill_and_restore(tmp_path):
+    from ray_tpu.core.native_store import NativeShmClient, NativeShmStore
+    name = f"raytpu-test-{uuid.uuid4().hex[:8]}"
+    store = NativeShmStore(name, 64 * 1024, spill_dir=str(tmp_path))
+    client = NativeShmClient(name)
+    try:
+        oids = []
+        for i in range(8):
+            oid = _oid()
+            client.put_bytes(oid, bytes([i]) * (16 * 1024))
+            store.on_sealed(oid, 16 * 1024)
+            oids.append(oid)
+        # capacity forced spills of LRU objects
+        assert store.stats()["num_spilled"] > 0
+        first = oids[0]
+        assert store.maybe_restore(first)
+        assert bytes(client.get_view(first, timeout=5))[:1] == bytes([0])
+    finally:
+        client.close()
+        store.destroy()
