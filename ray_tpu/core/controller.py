@@ -60,6 +60,11 @@ class PendingTask:
     submitted_at: float = 0.0
     deps_remaining: Set[bytes] = field(default_factory=set)
     transfers_remaining: Set[bytes] = field(default_factory=set)
+    #: Scheduling-class key (reference: SchedulingClass in task_spec.h —
+    #: tasks with identical resource shapes share feasibility): tasks whose
+    #: key failed to place in a drain are skipped wholesale, making the
+    #: drain O(#shapes + #dispatched) instead of O(#queued).
+    shape_key: Optional[tuple] = None
 
 
 @dataclass
@@ -83,6 +88,10 @@ class Controller:
         self.sock = self.ctx.socket(zmq.ROUTER)
         self.sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
         self.sock.setsockopt(zmq.LINGER, 0)
+        # unbounded per-peer queues: result bursts (thousands of TASK_RESULT
+        # pushes to one owner) must not be silently dropped at the HWM
+        self.sock.setsockopt(zmq.SNDHWM, 0)
+        self.sock.setsockopt(zmq.RCVHWM, 0)
         self.addr = P.socket_path(session_dir)
         self.sock.bind(self.addr)
         # wakeup channel for cross-thread sends
@@ -92,6 +101,10 @@ class Controller:
         self._wake_send.connect(f"inproc://ctl-wake-{id(self)}")
         self._send_q: Deque[Tuple[bytes, bytes, bytes]] = collections.deque()
         self._send_lock = threading.Lock()
+        # per-peer outbox for loop-thread sends: flushed once per event-loop
+        # cycle as MSG_BATCH frames — amortizes pickling + syscalls over a
+        # burst without adding latency (flush happens before the next poll)
+        self._outbox: Dict[bytes, List[Tuple[bytes, Any]]] = {}
 
         self.scheduler = ClusterResourceScheduler()
         self.refs = GlobalRefTable(self._on_refcount_zero)
@@ -112,7 +125,9 @@ class Controller:
         self.subs: Dict[str, Set[bytes]] = collections.defaultdict(set)
 
         self.tasks: Dict[bytes, PendingTask] = {}    # task_id -> PendingTask
-        self.task_queue: Deque[bytes] = collections.deque()
+        # ready tasks grouped by scheduling class; dict preserves insertion
+        # order so classes are drained round-robin-by-arrival
+        self.ready_queues: Dict[tuple, Deque[bytes]] = {}
         self.dep_waiters: Dict[bytes, Set[bytes]] = collections.defaultdict(set)   # object -> task_ids
         self.local_waiters: Dict[bytes, List[Tuple[bytes, bytes]]] = collections.defaultdict(list)  # object -> [(identity, rid)]
         self.worker_running: Dict[bytes, bytes] = {}  # worker identity -> task_id
@@ -151,7 +166,7 @@ class Controller:
         poller.register(self._wake_recv, zmq.POLLIN)
         while not self._shutdown.is_set():
             try:
-                events = dict(poller.poll(timeout=100))
+                events = dict(poller.poll(timeout=1000))
             except zmq.ZMQError:
                 break
             if self._wake_recv in events:
@@ -172,6 +187,7 @@ class Controller:
                     except Exception:
                         logger.exception("controller: error handling %s",
                                          frames[1] if len(frames) > 1 else frames)
+            self._flush_outbox()
             self._drain_sends()
         try:
             self.sock.close(0)
@@ -181,20 +197,40 @@ class Controller:
             pass
 
     def _send(self, identity: bytes, mtype: bytes, payload: Any) -> None:
-        """Thread-safe send (queued onto the loop thread)."""
-        blob = P.dumps(payload)
+        """Thread-safe send. Loop-thread sends are buffered per peer and
+        flushed at the end of the handling cycle (order-preserving);
+        cross-thread sends are marshaled through the wake channel."""
         if threading.current_thread() is self._thread:
-            try:
-                self.sock.send_multipart([identity, mtype, blob], zmq.NOBLOCK)
-            except zmq.ZMQError:
-                logger.warning("controller: drop %s to %s", mtype, identity.hex()[:8])
+            box = self._outbox.get(identity)
+            if box is None:
+                box = self._outbox[identity] = []
+            box.append((mtype, payload))
         else:
+            blob = P.dumps(payload)
             with self._send_lock:
                 self._send_q.append((identity, mtype, blob))
             try:
                 self._wake_send.send(b"", zmq.NOBLOCK)
             except zmq.ZMQError:
                 pass
+
+    def _flush_outbox(self) -> None:
+        if not self._outbox:
+            return
+        outbox, self._outbox = self._outbox, {}
+        for identity, msgs in outbox.items():
+            try:
+                if len(msgs) == 1:
+                    mtype, payload = msgs[0]
+                    self.sock.send_multipart(
+                        [identity, mtype, P.dumps(payload)], zmq.NOBLOCK)
+                else:
+                    self.sock.send_multipart(
+                        [identity, P.MSG_BATCH, P.dumps({"msgs": msgs})],
+                        zmq.NOBLOCK)
+            except zmq.ZMQError:
+                logger.warning("controller: drop %d msgs to %s", len(msgs),
+                               identity.hex()[:8])
 
     def _drain_sends(self) -> None:
         while True:
@@ -214,6 +250,17 @@ class Controller:
     # ------------------------------------------------------------- dispatch
     def _handle(self, frames: List[bytes]) -> None:
         identity, mtype, payload = frames[0], frames[1], P.loads(frames[2])
+        if mtype == P.MSG_BATCH:
+            for sub_type, sub_payload in payload["msgs"]:
+                try:
+                    self._dispatch_msg(identity, sub_type, sub_payload)
+                except Exception:
+                    logger.exception("controller: error in batched %s",
+                                     sub_type)
+            return
+        self._dispatch_msg(identity, mtype, payload)
+
+    def _dispatch_msg(self, identity: bytes, mtype: bytes, payload: Any) -> None:
         handler = self._HANDLERS.get(mtype)
         if handler is None:
             logger.warning("controller: unknown message %s", mtype)
@@ -294,8 +341,7 @@ class Controller:
                 continue
             t.deps_remaining.discard(object_id_b)
             if t.state == "PENDING_DEPS" and not t.deps_remaining:
-                t.state = "QUEUED"
-                self.task_queue.append(task_id)
+                self._enqueue_ready(task_id, t)
             elif t.state == "PENDING_TRANSFER":
                 t.transfers_remaining.discard(object_id_b)
                 if not t.transfers_remaining:
@@ -392,7 +438,17 @@ class Controller:
                 self._reply(identity, rid, {"error": err})
 
     # --------------------------------------------------------------- tasks
-    def _h_submit_task(self, identity: bytes, m: dict) -> None:
+    def _h_submit_batch(self, identity: bytes, m: dict) -> None:
+        """Pipelined submission: many specs in one message (reference:
+        lease reuse + pipelined submission, direct_task_transport.h:157 —
+        here the batching is at the wire layer). One schedule drain for the
+        whole batch."""
+        for spec in m["specs"]:
+            self._h_submit_task(identity, {"spec": spec}, defer_schedule=True)
+        self._maybe_schedule()
+
+    def _h_submit_task(self, identity: bytes, m: dict,
+                       defer_schedule: bool = False) -> None:
         spec: TaskSpec = m["spec"]
         if spec.is_actor_task:
             self._submit_actor_task(identity, spec)
@@ -417,9 +473,9 @@ class Controller:
                 if e is not None and e.lineage_task is not None:
                     self._reconstruct(e)
         if not t.deps_remaining:
-            t.state = "QUEUED"
-            self.task_queue.append(tid)
-            self._maybe_schedule()
+            self._enqueue_ready(tid, t)
+            if not defer_schedule:
+                self._maybe_schedule()
 
     @staticmethod
     def _sched_res(spec: TaskSpec) -> Dict[str, float]:
@@ -431,42 +487,67 @@ class Controller:
             return {}
         return spec.resources
 
-    def _maybe_schedule(self) -> None:
-        """Drain the resource queue (reference:
-        ClusterTaskManager::ScheduleAndDispatchTasks)."""
-        if not self.task_queue:
-            self._maybe_place_pgs()
-            return
-        requeue: List[bytes] = []
-        while self.task_queue:
-            tid = self.task_queue.popleft()
-            t = self.tasks.get(tid)
-            if t is None:
-                continue
-            node_id = self.scheduler.pick_node(
-                self._sched_res(t.spec), t.spec.scheduling_strategy)
-            if node_id is None:
-                requeue.append(tid)
-                continue
-            t.node_id = node_id
-            self.task_table[tid]["state"] = "PENDING_NODE_ASSIGNMENT"
-            # phase 2: ensure deps local to the chosen node
-            node_b = node_id.binary()
-            for _, oid in t.spec.arg_refs:
-                b = oid.binary()
-                e = self.objects.get(b)
-                if e is None or e.inline is not None or e.error is not None:
-                    continue
-                if node_b not in e.locations:
-                    t.transfers_remaining.add(b)
-                    self.dep_waiters[b].add(tid)
-                    self._start_transfer(b, node_b)
-            if t.transfers_remaining:
-                t.state = "PENDING_TRANSFER"
+    def _enqueue_ready(self, tid: bytes, t: PendingTask) -> None:
+        """Mark a task ready and file it under its scheduling class."""
+        t.state = "QUEUED"
+        if t.shape_key is None:
+            strat = t.spec.scheduling_strategy
+            if strat.kind in ("DEFAULT", "SPREAD"):
+                t.shape_key = (strat.kind,
+                               tuple(sorted(self._sched_res(t.spec).items())))
             else:
-                self._dispatch(tid)
-        self.task_queue.extend(requeue)
+                # node-affinity / PG / label strategies are evaluated
+                # per-task: give each its own class
+                t.shape_key = (tid,)
+        q = self.ready_queues.get(t.shape_key)
+        if q is None:
+            q = self.ready_queues[t.shape_key] = collections.deque()
+        q.append(tid)
+
+    def _maybe_schedule(self) -> None:
+        """Drain the ready queues (reference:
+        ClusterTaskManager::ScheduleAndDispatchTasks). A scheduling class
+        that fails to place blocks only itself, and the drain costs
+        O(#classes + #dispatched) — not O(#queued tasks)."""
+        if self.ready_queues:
+            empties = []
+            for key, q in self.ready_queues.items():
+                while q:
+                    tid = q[0]
+                    t = self.tasks.get(tid)
+                    if t is None or t.state != "QUEUED":
+                        q.popleft()
+                        continue
+                    node_id = self.scheduler.pick_node(
+                        self._sched_res(t.spec), t.spec.scheduling_strategy)
+                    if node_id is None:
+                        break  # class infeasible right now; try next class
+                    q.popleft()
+                    self._assign_node(tid, t, node_id)
+                if not q:
+                    empties.append(key)
+            for key in empties:
+                del self.ready_queues[key]
         self._maybe_place_pgs()
+
+    def _assign_node(self, tid: bytes, t: PendingTask, node_id: NodeID) -> None:
+        t.node_id = node_id
+        self.task_table[tid]["state"] = "PENDING_NODE_ASSIGNMENT"
+        # phase 2: ensure deps local to the chosen node
+        node_b = node_id.binary()
+        for _, oid in t.spec.arg_refs:
+            b = oid.binary()
+            e = self.objects.get(b)
+            if e is None or e.inline is not None or e.error is not None:
+                continue
+            if node_b not in e.locations:
+                t.transfers_remaining.add(b)
+                self.dep_waiters[b].add(tid)
+                self._start_transfer(b, node_b)
+        if t.transfers_remaining:
+            t.state = "PENDING_TRANSFER"
+        else:
+            self._dispatch(tid)
 
     def _dispatch(self, tid: bytes) -> None:
         t = self.tasks.get(tid)
@@ -477,8 +558,23 @@ class Controller:
             self._handle_task_failure(tid, "node died before dispatch")
             return
         if not node.idle_workers:
-            # ask the node to start a worker; re-dispatch when it registers
-            if node.starting_workers < 1 + len(node.all_workers):
+            # ask the node to start a worker; re-dispatch when it registers.
+            # The pool of TASK workers is capped at the node's CPU count
+            # (reference: worker_pool.cc sizes to num_cpus) — more workers
+            # than cores just adds scheduler churn. Actor-pinned workers are
+            # dedicated (reference: dedicated actor workers) and do NOT
+            # count against the cap, else long-lived actors starve tasks.
+            cap = max(1, int(node.resources.total.get("CPU", 1)))
+            task_workers = sum(1 for w in node.all_workers
+                               if w not in self.worker_actors)
+            # zero-footprint tasks (num_cpus=0, placement-group bundles) are
+            # admitted by the scheduler without consuming CPU, so demand can
+            # legitimately exceed the cap — every admitted task must get a
+            # worker eventually or gang workloads deadlock (reference:
+            # a granted lease always gets a worker).
+            waiting = len(node.stats.get("wait_worker") or ()) + 1
+            if node.starting_workers + task_workers < cap or \
+                    node.starting_workers < waiting:
                 node.starting_workers += 1
                 self._send(node.identity, P.TASK_ASSIGN, {"start_worker": True})
             t.state = "QUEUED_WORKER"
@@ -534,15 +630,13 @@ class Controller:
         if row is not None:
             row["state"] = "FAILED" if m.get("error") else "FINISHED"
             row["finished_at"] = time.time()
-        is_actor_task = False
-        spec = t.spec if t else m.get("spec")
         if t is not None:
+            is_actor_task = t.spec.is_actor_task
             is_actor_creation = t.spec.is_actor_creation
         else:
+            is_actor_task = bool(m.get("is_actor_task"))
             is_actor_creation = False
         actor_id_b = self.worker_actors.get(identity)
-        if spec is not None and spec.is_actor_task:
-            is_actor_task = True
 
         # retry path (reference: TaskManager::RetryTaskIfPossible)
         if m.get("error") is not None and t is not None and t.retries_left > 0 \
@@ -551,13 +645,12 @@ class Controller:
             if t.node_id is not None:
                 self.scheduler.release(t.node_id, self._sched_res(t.spec))
                 t.node_id = None
-            t.state = "QUEUED"
             t.worker = None
             t.transfers_remaining.clear()
             self.tasks[tid] = t
             if not (is_actor_creation or actor_id_b):
                 self._return_worker(identity)
-            self.task_queue.append(tid)
+            self._enqueue_ready(tid, t)
             self._maybe_schedule()
             return
 
@@ -604,17 +697,11 @@ class Controller:
 
     def _find_owner_identity(self, t: Optional[PendingTask], m: dict,
                              default: bytes) -> Optional[bytes]:
-        owner_wid = None
+        # DEALER identities ARE binary worker ids in this design, so the
+        # owner's WorkerID routes directly — no directory scan needed.
         if t is not None and t.spec.owner is not None:
-            owner_wid = t.spec.owner.binary()
-        elif m.get("owner"):
-            owner_wid = m["owner"]
-        if owner_wid is None:
-            return None
-        for identity, info in self.peers.items():
-            if info.get("id") == owner_wid or identity == owner_wid:
-                return identity
-        return owner_wid  # identities ARE worker ids in this design
+            return t.spec.owner.binary()
+        return m.get("owner")
 
     def _return_worker(self, identity: bytes) -> None:
         info = self.peers.get(identity)
@@ -640,11 +727,10 @@ class Controller:
             self.scheduler.release(t.node_id, self._sched_res(t.spec))
         if retriable and t.retries_left > 0:
             t.retries_left -= 1
-            t.state = "QUEUED"
             t.worker = None
             t.node_id = None
             t.transfers_remaining.clear()
-            self.task_queue.append(tid)
+            self._enqueue_ready(tid, t)
             self._maybe_schedule()
             return
         self.tasks.pop(tid, None)
@@ -686,10 +772,12 @@ class Controller:
         from ray_tpu.exceptions import TaskCancelledError
         if t.state in ("PENDING_DEPS", "QUEUED", "PENDING_TRANSFER", "QUEUED_WORKER"):
             self.tasks.pop(tid, None)
-            try:
-                self.task_queue.remove(tid)
-            except ValueError:
-                pass
+            q = self.ready_queues.get(t.shape_key or ())
+            if q is not None:
+                try:
+                    q.remove(tid)
+                except ValueError:
+                    pass
             if t.node_id is not None:
                 self.scheduler.release(t.node_id, self._sched_res(t.spec))
             err = P.dumps(TaskCancelledError(t.spec.task_id))
@@ -1081,6 +1169,7 @@ class Controller:
     _HANDLERS = {
         P.REGISTER: _h_register,
         P.SUBMIT_TASK: _h_submit_task,
+        P.SUBMIT_BATCH: _h_submit_batch,
         P.TASK_DONE: _h_task_done,
         P.CANCEL_TASK: _h_cancel_task,
         P.CREATE_ACTOR: _h_create_actor,

@@ -15,7 +15,21 @@ Sizes (bytes):
 from __future__ import annotations
 
 import os
+import random
 import threading
+
+# /dev/urandom syscalls cost ~100us in sandboxed environments; ID minting is
+# on the task-submission hot path, so draw from a process-local PRNG seeded
+# once from the OS (fork-safe: reseeded per pid).
+_rng_state = threading.local()
+
+
+def _rand_bytes(n: int) -> bytes:
+    st = getattr(_rng_state, "v", None)
+    if st is None or st[0] != os.getpid():
+        st = (os.getpid(), random.Random(os.urandom(32)))
+        _rng_state.v = st
+    return st[1].getrandbits(n * 8).to_bytes(n, "little")
 
 JOB_ID_SIZE = 4
 ACTOR_ID_SIZE = 16
@@ -46,7 +60,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls) -> "BaseID":
-        return cls(os.urandom(cls.SIZE))
+        return cls(_rand_bytes(cls.SIZE))
 
     @classmethod
     def nil(cls) -> "BaseID":
@@ -102,7 +116,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "ActorID":
-        return cls(job_id.binary() + os.urandom(_ACTOR_UNIQUE))
+        return cls(job_id.binary() + _rand_bytes(_ACTOR_UNIQUE))
 
     def job_id(self) -> JobID:
         return JobID(self._bytes[:JOB_ID_SIZE])
@@ -115,11 +129,11 @@ class TaskID(BaseID):
     @classmethod
     def for_normal_task(cls, job_id: JobID) -> "TaskID":
         return cls(ActorID.nil().binary()[:ACTOR_ID_SIZE - JOB_ID_SIZE]
-                   + job_id.binary() + os.urandom(_TASK_UNIQUE))
+                   + job_id.binary() + _rand_bytes(_TASK_UNIQUE))
 
     @classmethod
     def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
-        return cls(actor_id.binary() + os.urandom(_TASK_UNIQUE))
+        return cls(actor_id.binary() + _rand_bytes(_TASK_UNIQUE))
 
     @classmethod
     def for_driver(cls, job_id: JobID) -> "TaskID":
@@ -172,4 +186,4 @@ class PlacementGroupID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "PlacementGroupID":
-        return cls(job_id.binary() + os.urandom(_PG_UNIQUE))
+        return cls(job_id.binary() + _rand_bytes(_PG_UNIQUE))

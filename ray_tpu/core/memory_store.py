@@ -87,6 +87,19 @@ class InProcessStore:
                 return
         callback(value, error)
 
+    def remove_callback(self, object_id: ObjectID, callback: Callable) -> None:
+        """Unregister an ``on_ready`` hook (waiters with expired timeouts)."""
+        with self._lock:
+            lst = self._callbacks.get(object_id)
+            if lst is None:
+                return
+            try:
+                lst.remove(callback)
+            except ValueError:
+                pass
+            if not lst:
+                self._callbacks.pop(object_id, None)
+
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
             self._objects.pop(object_id, None)

@@ -127,7 +127,7 @@ class NodeManager:
         poller.register(self.sock, zmq.POLLIN)
         while not self._stopped.is_set():
             try:
-                events = dict(poller.poll(timeout=100))
+                events = dict(poller.poll(timeout=1000))
             except zmq.ZMQError:
                 break
             if self.sock not in events:
@@ -143,6 +143,13 @@ class NodeManager:
                     logger.exception("node: error handling %s", frames[0])
 
     def _handle(self, mtype: bytes, m: dict) -> None:
+        if mtype == P.MSG_BATCH:
+            for sub_type, sub_payload in m["msgs"]:
+                try:
+                    self._handle(sub_type, sub_payload)
+                except Exception:
+                    logger.exception("node: error in batched %s", sub_type)
+            return
         if mtype == P.TASK_ASSIGN:
             if m.get("start_worker"):
                 self._start_worker()
@@ -199,7 +206,7 @@ class NodeManager:
             self.workers[worker_id.binary()] = proc
 
     def _reaper_loop(self) -> None:
-        while not self._stopped.wait(0.2):
+        while not self._stopped.wait(0.5):
             dead = []
             with self._workers_lock:
                 for identity, proc in list(self.workers.items()):

@@ -14,9 +14,11 @@ per-peer delivery, async send queues, and broker routing by identity.
 
 from __future__ import annotations
 
+import itertools
+import os
 import pickle
+import struct
 import threading
-import uuid
 from typing import Any, Dict, Optional
 
 # ---- message types ----
@@ -26,6 +28,7 @@ REGISTER_REPLY = b"REGR"
 SHUTDOWN = b"BYE"
 # tasks
 SUBMIT_TASK = b"SUB"         # {spec}
+SUBMIT_BATCH = b"SBB"        # {specs: [spec, ...]} — pipelined submission
 TASK_ASSIGN = b"ASG"         # controller->node {spec}
 TASK_DISPATCH = b"DSP"       # node->worker {spec}
 TASK_DONE = b"DON"           # worker->controller {task_id, results, error}
@@ -62,6 +65,7 @@ PUBSUB = b"PUB"              # {channel, data} fanout
 SUBSCRIBE = b"SSC"           # {channel}
 GENERIC_REPLY = b"RPL"
 ERROR_REPLY = b"ERR"
+MSG_BATCH = b"MBB"           # {msgs: [(mtype, payload), ...]} — wire batching
 
 _DUMPS_PROTO = 5
 
@@ -75,25 +79,44 @@ def loads(data: bytes) -> Any:
 
 
 class ReplyWaiter:
-    """Correlates request/reply over the async socket pump."""
+    """Correlates request/reply over the async socket pump.
+
+    Two modes per request: blocking (``new_request()`` + ``wait()``) and
+    callback (``new_request(callback=...)``) — the callback runs on the
+    pump thread when the reply lands, so it must not block (reference:
+    the ClientCallManager completion-queue callbacks, rpc/client_call.h).
+    """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._events: Dict[bytes, threading.Event] = {}
         self._replies: Dict[bytes, Any] = {}
+        self._callbacks: Dict[bytes, Any] = {}
 
-    def new_request(self) -> bytes:
-        rid = uuid.uuid4().bytes
+    _rid_counter = itertools.count(1)
+
+    def new_request(self, callback=None) -> bytes:
+        # rids only need per-process uniqueness (replies are routed by peer
+        # identity); a counter avoids a urandom syscall per RPC
+        rid = struct.pack("<QQ", os.getpid(), next(self._rid_counter))
         with self._lock:
-            self._events[rid] = threading.Event()
+            if callback is not None:
+                self._callbacks[rid] = callback
+            else:
+                self._events[rid] = threading.Event()
         return rid
 
     def fulfill(self, rid: bytes, reply: Any) -> bool:
         with self._lock:
-            ev = self._events.get(rid)
-            if ev is None:
-                return False
-            self._replies[rid] = reply
+            cb = self._callbacks.pop(rid, None)
+            if cb is None:
+                ev = self._events.get(rid)
+                if ev is None:
+                    return False
+                self._replies[rid] = reply
+        if cb is not None:
+            cb(reply)
+            return True
         ev.set()
         return True
 

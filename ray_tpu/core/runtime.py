@@ -17,7 +17,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from queue import SimpleQueue
+from queue import Empty, SimpleQueue
 
 import zmq
 
@@ -68,7 +68,7 @@ class Runtime:
         self._meta: Dict[bytes, dict] = {}
         self._meta_lock = threading.Lock()
         self._completion_cbs: Dict[bytes, List[Callable]] = {}
-        self._pending_locations: Dict[bytes, bytes] = {}  # object -> rid
+        self._pending_locations: Dict[bytes, float] = {}  # object -> probe ts
 
         self.replies = P.ReplyWaiter()
         self._put_counter = 0
@@ -101,8 +101,27 @@ class Runtime:
         self.sock = self.ctx.socket(zmq.DEALER)
         self.sock.setsockopt(zmq.IDENTITY, self.worker_id.binary())
         self.sock.setsockopt(zmq.LINGER, 0)
+        # unbounded queues: a burst of task results must never be dropped
+        # at the HWM (the control plane has no retransmit)
+        self.sock.setsockopt(zmq.SNDHWM, 0)
+        self.sock.setsockopt(zmq.RCVHWM, 0)
         self.sock.connect(P.socket_path(session_dir))
         self._send_lock = threading.Lock()
+        # all sends go through one flusher thread: preserves FIFO order,
+        # moves pickling off the caller's critical path, and coalesces
+        # consecutive task submissions into SUBMIT_BATCH messages
+        # (reference: pipelined submission, direct_task_transport.h:157)
+        self._out_q: "SimpleQueue[Optional[Tuple[bytes, Any]]]" = SimpleQueue()
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         name=f"{kind}-flush", daemon=True)
+        self._flusher.start()
+        # wake channel so shutdown can interrupt the pump's long poll and
+        # join it before closing the DEALER (zmq sockets are not
+        # thread-safe; close must not race poll/recv)
+        self._pump_wake_recv = self.ctx.socket(zmq.PULL)
+        self._pump_wake_recv.bind(f"inproc://pump-wake-{id(self)}")
+        self._pump_wake_send = self.ctx.socket(zmq.PUSH)
+        self._pump_wake_send.connect(f"inproc://pump-wake-{id(self)}")
         self._pump = threading.Thread(target=self._pump_loop,
                                       name=f"{kind}-pump", daemon=True)
         self._pump.start()
@@ -127,9 +146,63 @@ class Runtime:
 
     # ------------------------------------------------------------ transport
     def _send(self, mtype: bytes, payload: Any) -> None:
-        blob = P.dumps(payload)
+        self._out_q.put((mtype, payload))
+
+    def _sock_send(self, mtype: bytes, blob: bytes) -> None:
         with self._send_lock:
             self.sock.send_multipart([mtype, blob])
+
+    def _flush_loop(self) -> None:
+        while True:
+            try:
+                item = self._out_q.get()
+            except Exception:
+                return
+            batch = [item]
+            while len(batch) < 512:
+                try:
+                    batch.append(self._out_q.get_nowait())
+                except Empty:
+                    break
+            stop = False
+            msgs: List[Tuple[bytes, Any]] = []
+            specs: List = []
+
+            def close_specs() -> None:
+                if len(specs) == 1:
+                    msgs.append((P.SUBMIT_TASK, {"spec": specs[0]}))
+                elif specs:
+                    msgs.append((P.SUBMIT_BATCH, {"specs": list(specs)}))
+                specs.clear()
+
+            for it in batch:
+                if it is None:
+                    stop = True
+                    break
+                mtype, payload = it
+                if mtype == P.SUBMIT_TASK:
+                    specs.append(payload["spec"])
+                    continue
+                close_specs()
+                msgs.append((mtype, payload))
+            close_specs()
+            try:
+                if len(msgs) == 1:
+                    self._sock_send(msgs[0][0], P.dumps(msgs[0][1]))
+                elif msgs:
+                    self._sock_send(P.MSG_BATCH, P.dumps({"msgs": msgs}))
+            except Exception:
+                # one bad payload must not discard the whole batch: retry
+                # each message individually, dropping only the culprit
+                for mtype, payload in msgs:
+                    try:
+                        self._sock_send(mtype, P.dumps(payload))
+                    except Exception:
+                        if not self._stopped.is_set():
+                            logger.exception(
+                                "%s: dropping unsendable %s", self.kind, mtype)
+            if stop:
+                return
 
     def request(self, mtype: bytes, payload: dict,
                 timeout: Optional[float] = None) -> dict:
@@ -144,11 +217,20 @@ class Runtime:
     def _pump_loop(self) -> None:
         poller = zmq.Poller()
         poller.register(self.sock, zmq.POLLIN)
+        poller.register(self._pump_wake_recv, zmq.POLLIN)
+        # long idle timeout: poll wakes instantly on traffic; frequent
+        # timer wakeups across many processes starve small hosts
         while not self._stopped.is_set():
             try:
-                events = dict(poller.poll(timeout=100))
+                events = dict(poller.poll(timeout=1000))
             except zmq.ZMQError:
                 break
+            if self._pump_wake_recv in events:
+                try:
+                    while True:
+                        self._pump_wake_recv.recv(zmq.NOBLOCK)
+                except zmq.ZMQError:
+                    pass
             if self.sock not in events:
                 continue
             while True:
@@ -162,6 +244,14 @@ class Runtime:
                     logger.exception("%s: error handling %s", self.kind, frames[0])
 
     def _on_message(self, mtype: bytes, m: dict) -> None:
+        if mtype == P.MSG_BATCH:
+            for sub_type, sub_payload in m["msgs"]:
+                try:
+                    self._on_message(sub_type, sub_payload)
+                except Exception:
+                    logger.exception("%s: error in batched %s", self.kind,
+                                     sub_type)
+            return
         if mtype == P.GENERIC_REPLY:
             self.replies.fulfill(m["rid"], m["data"])
         elif mtype == P.ERROR_REPLY:
@@ -212,8 +302,18 @@ class Runtime:
         self.flush_timeline()
         self._stopped.set()
         self._cb_queue.put(None)
+        # sentinel after the final enqueues: FIFO guarantees they flush
+        self._out_q.put(None)
+        self._flusher.join(timeout=2.0)
+        try:
+            self._pump_wake_send.send(b"", zmq.NOBLOCK)
+        except Exception:
+            pass
+        self._pump.join(timeout=2.0)
         try:
             self.sock.close(0)
+            self._pump_wake_recv.close(0)
+            self._pump_wake_send.close(0)
         except Exception:
             pass
         if self.shm:
@@ -306,14 +406,7 @@ class Runtime:
         # us; otherwise ask the controller (async; reply lands in the memory
         # store as _MetaReady). Block with the caller's timeout either way.
         if ref.owner is None or ref.owner != self.worker_id:
-            with self._meta_lock:
-                probing = b in self._pending_locations
-                if not probing:
-                    self._pending_locations[b] = b
-            if not probing:
-                rid = self.replies.new_request()
-                threading.Thread(target=self._bg_location_probe,
-                                 args=(b, rid), daemon=True).start()
+            self._ensure_location_probe(b)
         value = self.memory_store.get(oid, timeout)
         if isinstance(value, _MetaReady):
             value = self._materialize(oid, value.meta)
@@ -364,63 +457,85 @@ class Runtime:
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None,
              fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        """Event-driven wait: one ``on_ready`` hook per pending ref trips a
+        counter; no polling loop, no per-ref threads (reference:
+        CoreWorker::Wait's fused memory-store/plasma waiter,
+        core_worker.cc:1807)."""
         if num_returns > len(refs):
             raise ValueError(
                 f"num_returns ({num_returns}) exceeds the number of refs "
                 f"({len(refs)})")
-        deadline = None if timeout is None else time.monotonic() + timeout
-        pending = list(refs)
+        done = threading.Event()
+        lock = threading.Lock()
+        ready_flags = [False] * len(refs)
+        count = [0]
+        hooked: List[Tuple[ObjectID, Callable]] = []
+
+        def _mark(i: int) -> None:
+            with lock:
+                if ready_flags[i]:
+                    return
+                ready_flags[i] = True
+                count[0] += 1
+                if count[0] >= num_returns:
+                    done.set()
+
+        for i, ref in enumerate(refs):
+            oid = ref.id()
+            b = oid.binary()
+            with self._meta_lock:
+                have_meta = b in self._meta
+            if have_meta or self.memory_store.contains(oid):
+                _mark(i)
+                continue
+            cb = (lambda i: lambda value, error: _mark(i))(i)
+            hooked.append((oid, cb))
+            self.memory_store.on_ready(oid, cb)
+            if ref.owner is None or ref.owner != self.worker_id:
+                self._ensure_location_probe(b)
+        with lock:
+            if count[0] >= num_returns:
+                done.set()
+        done.wait(timeout)
+        for oid, cb in hooked:
+            self.memory_store.remove_callback(oid, cb)
         ready: List[ObjectRef] = []
-        asked = set()
-        while len(ready) < num_returns:
-            still = []
-            for ref in pending:
-                if self._is_ready(ref, asked):
+        pending: List[ObjectRef] = []
+        with lock:
+            for i, ref in enumerate(refs):
+                if ready_flags[i] and len(ready) < num_returns:
                     ready.append(ref)
-                    if len(ready) >= num_returns:
-                        still.extend(p for p in pending if p is not ref and p not in ready)
-                        break
                 else:
-                    still.append(ref)
-            pending = [r for r in still if r not in ready]
-            if len(ready) >= num_returns:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            time.sleep(0.002)
+                    pending.append(ref)
         return ready, pending
 
-    def _is_ready(self, ref: ObjectRef, asked: set) -> bool:
-        oid = ref.id()
-        if self.memory_store.contains(oid):
-            return True
+    def _ensure_location_probe(self, object_id_b: bytes) -> None:
+        """Ask the controller (once) where an object lives; the reply lands
+        in the meta table + memory store from the pump thread. The
+        controller holds the request server-side until the object exists,
+        so this doubles as a remote-completion subscription. A stale probe
+        (no reply within the retry window — e.g. the message was dropped)
+        is re-issued rather than wedging the object forever; the abandoned
+        ReplyWaiter callback entry is bounded to one per window."""
+        now = time.monotonic()
         with self._meta_lock:
-            if oid.binary() in self._meta:
-                return True
-        b = oid.binary()
-        if b not in asked:
-            asked.add(b)
-            # fire-and-forget location query; reply fulfilled into meta
-            rid = self.replies.new_request()
-            threading.Thread(
-                target=self._bg_location_probe, args=(b, rid), daemon=True).start()
-        return False
+            if object_id_b in self._meta:
+                return
+            started = self._pending_locations.get(object_id_b)
+            if started is not None and \
+                    now - started < self.config.rpc_timeout_s * 4:
+                return
+            self._pending_locations[object_id_b] = now
 
-    def _bg_location_probe(self, object_id_b: bytes, rid: bytes) -> None:
-        try:
-            payload = {"object_id": object_id_b, "rid": rid,
-                       "want_node": self.node_id.binary()}
-            self._send(P.GET_LOCATION, payload)
-            # bounded wait so abandoned probes don't leak threads forever
-            reply = self.replies.wait(rid, 600.0)
+        def on_reply(reply, b=object_id_b):
             with self._meta_lock:
-                self._meta[object_id_b] = reply
-            self.memory_store.put(ObjectID(object_id_b), _MetaReady(reply))
-        except Exception:
-            pass
-        finally:
-            with self._meta_lock:
-                self._pending_locations.pop(object_id_b, None)
+                self._meta[b] = reply
+                self._pending_locations.pop(b, None)
+            self.memory_store.put(ObjectID(b), _MetaReady(reply))
+
+        rid = self.replies.new_request(callback=on_reply)
+        self._send(P.GET_LOCATION, {"object_id": object_id_b, "rid": rid,
+                                    "want_node": self.node_id.binary()})
 
     def register_completion_callback(self, ref: ObjectRef, cb: Callable) -> None:
         oid = ref.id()
@@ -474,10 +589,7 @@ class Runtime:
         for _, oid in spec.arg_refs:
             self.reference_counter.add_submitted_task_ref(oid)
         self.reference_counter.flush()
-        if spec.is_actor_task:
-            self._send(P.SUBMIT_TASK, {"spec": spec})
-        else:
-            self._send(P.SUBMIT_TASK, {"spec": spec})
+        self._send(P.SUBMIT_TASK, {"spec": spec})
         self._record_event(spec, "submitted")
         return refs
 

@@ -139,10 +139,34 @@ class SerializationContext:
         return self.deserialize(meta, buffers)
 
 
+_OOB_BYTES_THRESHOLD = 4096
+
+
+class _OOBBytes:
+    """Ships a large bytes/bytearray payload out-of-band: the pickle stream
+    carries only a reconstructor; the payload rides as a zero-copy
+    PickleBuffer (one memcpy into shm at write, one back out at get —
+    instead of an extra full copy through the pickle stream)."""
+
+    __slots__ = ("ctor", "value")
+
+    def __init__(self, ctor, value):
+        self.ctor = ctor
+        self.value = value
+
+    def __reduce_ex__(self, protocol):
+        return self.ctor, (pickle.PickleBuffer(self.value),)
+
+
 def _pre_serialize(value):
     """Convert device-resident jax arrays to host numpy so the object store
     stays host-side (TPU HBM is not host-mappable; SURVEY.md §7 hard part 4).
-    The array round-trips back to device via ``jax.device_put`` on use."""
+    The array round-trips back to device via ``jax.device_put`` on use.
+    Large raw bytes go out-of-band (see _OOBBytes)."""
+    if type(value) is bytes and len(value) > _OOB_BYTES_THRESHOLD:
+        return _OOBBytes(bytes, value)
+    if type(value) is bytearray and len(value) > _OOB_BYTES_THRESHOLD:
+        return _OOBBytes(bytearray, value)
     import sys
     jax = sys.modules.get("jax")
     if jax is not None and isinstance(value, jax.Array):

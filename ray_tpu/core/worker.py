@@ -67,7 +67,7 @@ class WorkerExecutor:
     def run_loop(self) -> None:
         while not self._stop:
             try:
-                m = self._queue.get(timeout=0.1)
+                m = self._queue.get(timeout=0.5)
             except queue.Empty:
                 if self.runtime._stopped.is_set():
                     break
@@ -170,7 +170,9 @@ class WorkerExecutor:
             "error": error_blob,
             "retriable": retriable,
             "owner": spec.owner.binary() if spec.owner else None,
-            "spec": spec if spec.is_actor_task else None,
+            # flag only — re-shipping the whole spec (args blob included)
+            # on every actor call would tax the hot path
+            "is_actor_task": spec.is_actor_task,
         })
         self.runtime.record_span(
             spec.name or spec.function.qualname, start, time.time() - start,
@@ -255,9 +257,24 @@ class WorkerExecutor:
                 sys.path.insert(0, wd)
 
 
+def _orphan_watchdog(parent_pid: int) -> None:
+    """Exit when the spawning node manager's process dies (reference:
+    workers poll raylet liveness and die with it — core_worker.cc
+    CheckForRayletFailure). Workers start in their own session, so no
+    SIGHUP arrives; without this they outlive dead clusters."""
+    while True:
+        time.sleep(2.0)
+        if os.getppid() != parent_pid:
+            logging.getLogger(__name__).warning(
+                "node manager process died; worker exiting")
+            os._exit(1)
+
+
 def main() -> None:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s: %(message)s")
+    threading.Thread(target=_orphan_watchdog, args=(os.getppid(),),
+                     daemon=True).start()
     # Honor an explicit platform override before any task imports jax.
     # (Env-var JAX_PLATFORMS alone is not enough in environments whose
     # sitecustomize re-pins it at interpreter start — tests set

@@ -79,6 +79,19 @@ def bench_actor_async(ray_tpu, n=5000) -> float:
 
 
 def bench_put(ray_tpu, mb=64, iters=8) -> float:
+    """Matches the reference's single_client_put_gigabytes workload
+    (ray_perf.py puts numpy arrays; pickle-5 ships them out-of-band)."""
+    data = np.random.default_rng(0).integers(
+        0, 255, size=(mb << 20,), dtype=np.uint8)
+    ray_tpu.put(data)  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ray_tpu.put(data)
+    dt = time.perf_counter() - t0
+    return (mb * iters / 1024.0) / dt
+
+
+def bench_put_bytes(ray_tpu, mb=64, iters=8) -> float:
     data = np.random.default_rng(0).bytes(mb << 20)
     ray_tpu.put(data)  # warm
     t0 = time.perf_counter()
@@ -94,18 +107,30 @@ def main() -> Dict[str, float]:
     if not ray_tpu.is_initialized():
         ray_tpu.init(num_cpus=4, _num_initial_workers=2)
         started = True
-    results = {
-        "tasks_sync_per_s": bench_tasks_sync(ray_tpu),
-        "tasks_async_per_s": bench_tasks_async(ray_tpu),
-        "actor_calls_sync_per_s": bench_actor_sync(ray_tpu),
-        "actor_calls_async_per_s": bench_actor_async(ray_tpu),
-        "put_gib_per_s": bench_put(ray_tpu),
-    }
+    def settle():
+        # let ref-delta GC churn from the previous phase drain so phases
+        # are isolated (the reference runs each ray_perf phase separately)
+        import gc
+        gc.collect()
+        time.sleep(1.0)
+
+    results = {}
+    for name, fn in (
+            ("tasks_sync_per_s", bench_tasks_sync),
+            ("tasks_async_per_s", bench_tasks_async),
+            ("actor_calls_sync_per_s", bench_actor_sync),
+            ("actor_calls_async_per_s", bench_actor_async),
+            ("put_gib_per_s", bench_put),
+            ("put_bytes_gib_per_s", bench_put_bytes),
+    ):
+        results[name] = fn(ray_tpu)
+        settle()
     for name, value in results.items():
+        base = BASELINES.get(name)
         print(json.dumps({
             "metric": name, "value": round(value, 1),
             "unit": "GiB/s" if "gib" in name else "1/s",
-            "vs_baseline": round(value / BASELINES[name], 3),
+            "vs_baseline": round(value / base, 3) if base else None,
         }))
     if started:
         ray_tpu.shutdown()
