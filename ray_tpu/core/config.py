@@ -44,6 +44,12 @@ class Config:
     spill_dir: str = "/tmp/ray_tpu/spill"
     #: Start spilling when the store passes this fraction of capacity.
     object_spilling_threshold: float = 0.8
+    #: Bytes of the store segment to prefault at startup (background).
+    #: Faulted pages make first-touch puts memcpy-class. Deliberately small:
+    #: populated tmpfs pages are committed RAM, and several node managers
+    #: can share one host (cluster_utils tests) — large objects are instead
+    #: prefaulted per-create, and recycled extents stay warm.
+    object_store_prefault_bytes: int = 256 << 20
 
     # --- scheduler (reference: hybrid_scheduling_policy.h) ---
     #: Pack onto a node until its critical-resource utilization crosses this
@@ -67,6 +73,11 @@ class Config:
     actor_max_restarts: int = 0
     #: Lease/worker reuse idle timeout (reference: idle_worker_killing).
     idle_worker_kill_s: float = 60.0
+    #: Tasks kept in flight per leased worker (reference: pipelined lease
+    #: reuse, direct_task_transport.h:157 OnWorkerIdle) — the worker
+    #: executes serially from its local queue, so the lease holds ONE
+    #: resource allocation regardless of depth.
+    dispatch_pipeline_depth: int = 8
     #: Max workers a node will start per CPU if unspecified.
     workers_per_cpu: int = 1
 

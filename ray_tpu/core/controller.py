@@ -68,6 +68,23 @@ class PendingTask:
 
 
 @dataclass
+class Lease:
+    """A worker leased to one scheduling class (reference: worker leases,
+    ``direct_task_transport.h`` — ``OnWorkerIdle`` pipelines queued tasks of
+    the same scheduling key onto an already-leased worker). The lease holds
+    exactly one resource allocation; up to ``dispatch_pipeline_depth`` tasks
+    ride it concurrently (executed serially worker-side)."""
+    worker: bytes
+    node_b: bytes
+    shape_key: tuple
+    resources: Dict[str, float]
+    inflight: Set[bytes] = field(default_factory=set)
+    #: worker is blocked in a ray.get inside a task: its cpu is released
+    #: and the pipeline is not refilled until it unblocks
+    blocked: bool = False
+
+
+@dataclass
 class NodeInfo:
     node_id: NodeID
     identity: bytes
@@ -114,6 +131,9 @@ class Controller:
         self.objects: Dict[bytes, ObjectEntry] = {}
         self.actors: Dict[bytes, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}
+        # callers long-polling for an actor's worker address (direct calls)
+        self.actor_addr_waiters: Dict[bytes, List[Tuple[bytes, bytes]]] = \
+            collections.defaultdict(list)
         self.actor_queues: Dict[bytes, Deque[Tuple[bytes, TaskSpec]]] = {}
         self.actor_workers: Dict[bytes, bytes] = {}   # actor_id -> worker identity
         self.worker_actors: Dict[bytes, bytes] = {}   # worker identity -> actor_id
@@ -128,9 +148,10 @@ class Controller:
         # ready tasks grouped by scheduling class; dict preserves insertion
         # order so classes are drained round-robin-by-arrival
         self.ready_queues: Dict[tuple, Deque[bytes]] = {}
+        self.leases: Dict[bytes, Lease] = {}          # worker identity -> lease
+        self.class_leases: Dict[tuple, Set[bytes]] = collections.defaultdict(set)
         self.dep_waiters: Dict[bytes, Set[bytes]] = collections.defaultdict(set)   # object -> task_ids
         self.local_waiters: Dict[bytes, List[Tuple[bytes, bytes]]] = collections.defaultdict(list)  # object -> [(identity, rid)]
-        self.worker_running: Dict[bytes, bytes] = {}  # worker identity -> task_id
         self.task_table: Dict[bytes, dict] = {}       # state-API rows
         self.task_events: List[dict] = []
         self.jobs: Dict[bytes, dict] = {}
@@ -492,7 +513,10 @@ class Controller:
         t.state = "QUEUED"
         if t.shape_key is None:
             strat = t.spec.scheduling_strategy
-            if strat.kind in ("DEFAULT", "SPREAD"):
+            if t.spec.is_actor_creation:
+                # never pipelined onto a shared lease (pins its worker)
+                t.shape_key = (tid,)
+            elif strat.kind in ("DEFAULT", "SPREAD"):
                 t.shape_key = (strat.kind,
                                tuple(sorted(self._sched_res(t.spec).items())))
             else:
@@ -504,6 +528,38 @@ class Controller:
             q = self.ready_queues[t.shape_key] = collections.deque()
         q.append(tid)
 
+    def _lease_depth(self, key: Optional[tuple]) -> int:
+        # SPREAD classes don't pipeline (piling tasks on one worker would
+        # defeat the strategy); DEFAULT classes ride the full depth
+        if key and key[0] == "SPREAD":
+            return 1
+        return max(1, self.config.dispatch_pipeline_depth)
+
+    def _refill_lease(self, lease: Lease) -> None:
+        """Pipeline tasks of the lease's scheduling class onto its worker up
+        to the configured depth — no new resource acquisition, no pick_node
+        (reference: OnWorkerIdle). The single refill path for every caller."""
+        q = self.ready_queues.get(lease.shape_key)
+        if not q or lease.blocked:
+            return
+        depth = self._lease_depth(lease.shape_key)
+        while q and len(lease.inflight) < depth:
+            tid = q.popleft()
+            t = self.tasks.get(tid)
+            if t is None or t.state != "QUEUED":
+                continue
+            self._dispatch_on_lease(lease, tid, t)
+
+    def _fill_leases_for_class(self, key: tuple, q: Deque[bytes]) -> None:
+        for w in list(self.class_leases.get(key, ())):
+            if not q:
+                return
+            lease = self.leases.get(w)
+            if lease is None:
+                self.class_leases[key].discard(w)
+                continue
+            self._refill_lease(lease)
+
     def _maybe_schedule(self) -> None:
         """Drain the ready queues (reference:
         ClusterTaskManager::ScheduleAndDispatchTasks). A scheduling class
@@ -512,6 +568,7 @@ class Controller:
         if self.ready_queues:
             empties = []
             for key, q in self.ready_queues.items():
+                self._fill_leases_for_class(key, q)
                 while q:
                     tid = q[0]
                     t = self.tasks.get(tid)
@@ -596,11 +653,42 @@ class Controller:
 
     def _dispatch_to_worker(self, tid: bytes, node: NodeInfo, worker: bytes) -> None:
         t = self.tasks[tid]
-        t.worker = worker
+        if t.spec.is_actor_creation:
+            t.worker = worker
+            t.state = "RUNNING"
+            self.task_table[tid].update(
+                state="RUNNING", node=t.node_id.hex() if t.node_id else None,
+                started_at=time.time())
+            self._send_dispatch(worker, t)
+            aid = t.spec.actor_id.binary()
+            info = self.actors.get(aid)
+            if info is not None:
+                info.state = "STARTING"
+                info.node_id = t.node_id
+            self.actor_workers[aid] = worker
+            self.worker_actors[worker] = aid
+            return
+        # open a lease: the task's resource acquisition (made at pick_node)
+        # transfers to the lease and is released when the lease closes
+        lease = Lease(worker=worker, node_b=node.node_id.binary(),
+                      shape_key=t.shape_key or (tid,),
+                      resources=self._sched_res(t.spec))
+        self.leases[worker] = lease
+        self.class_leases[lease.shape_key].add(worker)
+        self._dispatch_on_lease(lease, tid, t)
+        self._refill_lease(lease)
+
+    def _dispatch_on_lease(self, lease: Lease, tid: bytes, t: PendingTask) -> None:
+        t.node_id = NodeID(lease.node_b)
+        t.worker = lease.worker
         t.state = "RUNNING"
-        self.worker_running[worker] = tid
+        lease.inflight.add(tid)
         self.task_table[tid].update(state="RUNNING", node=t.node_id.hex(),
                                     started_at=time.time())
+        self._send_dispatch(lease.worker, t)
+
+    def _send_dispatch(self, worker: bytes, t: PendingTask) -> None:
+        """Message assembly + send only — callers own all state mutation."""
         inline_args = {}
         errors = {}
         for _, oid in t.spec.arg_refs:
@@ -613,23 +701,48 @@ class Controller:
                 inline_args[oid.binary()] = e.inline
         self._send(worker, P.TASK_DISPATCH, {
             "spec": t.spec, "inline_args": inline_args, "arg_errors": errors})
-        if t.spec.is_actor_creation:
-            aid = t.spec.actor_id.binary()
-            info = self.actors.get(aid)
-            if info is not None:
-                info.state = "STARTING"
-                info.node_id = t.node_id
-            self.actor_workers[aid] = worker
-            self.worker_actors[worker] = aid
+
+    def _lease_housekeeping(self, worker: bytes, lease: Lease) -> None:
+        """After a completion on a leased worker: refill its pipeline from
+        the class queue, or close the lease when the class has drained."""
+        self._refill_lease(lease)
+        if not lease.inflight and not lease.blocked and \
+                not self.ready_queues.get(lease.shape_key):
+            self._close_lease(worker, lease)
+
+    def _close_lease(self, worker: bytes, lease: Lease) -> None:
+        self.leases.pop(worker, None)
+        peers = self.class_leases.get(lease.shape_key)
+        if peers is not None:
+            peers.discard(worker)
+            if not peers:
+                self.class_leases.pop(lease.shape_key, None)
+        node = self.nodes.get(lease.node_b)
+        if node is not None and node.alive and not lease.blocked:
+            # a blocked lease already released its allocation
+            self.scheduler.release(NodeID(lease.node_b), lease.resources)
+        self._return_worker(worker)
 
     def _h_task_done(self, identity: bytes, m: dict) -> None:
         tid = m["task_id"]
         t = self.tasks.pop(tid, None)
-        self.worker_running.pop(identity, None)
+        lease = self.leases.get(identity)
+        if lease is not None:
+            lease.inflight.discard(tid)
         row = self.task_table.get(tid)
         if row is not None:
             row["state"] = "FAILED" if m.get("error") else "FINISHED"
             row["finished_at"] = time.time()
+        elif m.get("is_actor_task"):
+            # direct actor call: first (and only) controller sighting
+            aid_hex = None
+            a = self.worker_actors.get(identity)
+            if a is not None:
+                aid_hex = ActorID(a).hex()
+            self.task_table[tid] = {
+                "task_id": TaskID(tid).hex(), "type": "ACTOR_TASK",
+                "state": "FAILED" if m.get("error") else "FINISHED",
+                "actor_id": aid_hex, "finished_at": time.time()}
         if t is not None:
             is_actor_task = t.spec.is_actor_task
             is_actor_creation = t.spec.is_actor_creation
@@ -638,19 +751,41 @@ class Controller:
             is_actor_creation = False
         actor_id_b = self.worker_actors.get(identity)
 
+        # direct actor call that failed retriably: re-route using the spec
+        # the worker shipped (no controller-side PendingTask exists)
+        if m.get("error") is not None and t is None and m.get("retriable") \
+                and m.get("spec") is not None:
+            spec: TaskSpec = m["spec"]
+            if spec.max_retries != 0:
+                if spec.max_retries > 0:
+                    spec.max_retries -= 1
+                self._submit_actor_task(m.get("owner") or identity, spec)
+                return
+
         # retry path (reference: TaskManager::RetryTaskIfPossible)
         if m.get("error") is not None and t is not None and t.retries_left > 0 \
                 and m.get("retriable", False):
             t.retries_left -= 1
-            if t.node_id is not None:
+            if t.spec.is_actor_task:
+                # actor tasks re-route to the actor's worker, never the
+                # normal-task scheduler
+                t.spec.max_retries = t.retries_left
+                self._submit_actor_task(
+                    self._find_owner_identity(t, m, identity) or identity,
+                    t.spec)
+                return
+            if lease is None and t.node_id is not None:
+                # leased tasks don't own resources (the lease does)
                 self.scheduler.release(t.node_id, self._sched_res(t.spec))
-                t.node_id = None
+            t.node_id = None
             t.worker = None
             t.transfers_remaining.clear()
             self.tasks[tid] = t
-            if not (is_actor_creation or actor_id_b):
-                self._return_worker(identity)
             self._enqueue_ready(tid, t)
+            if lease is not None:
+                self._lease_housekeeping(identity, lease)
+            elif not (is_actor_creation or actor_id_b):
+                self._return_worker(identity)
             self._maybe_schedule()
             return
 
@@ -658,6 +793,17 @@ class Controller:
         owner = (t.spec.owner.binary() if t and t.spec.owner else m.get("owner"))
         results_meta = []
         for r in m.get("results", []):
+            if self.refs.is_released(r["object_id"]):
+                # the owner already dropped every reference (its direct
+                # TASK_RESULT beat this TASK_DONE): recording the location
+                # would resurrect a dead entry and pin the extent forever —
+                # free it at the producing node instead
+                if r.get("node_id"):
+                    node = self.nodes.get(r["node_id"])
+                    if node is not None:
+                        self._send(node.identity, P.FREE_OBJECT,
+                                   {"object_id": r["object_id"]})
+                continue
             e = self._entry(r["object_id"])
             e.owner = m.get("owner_identity", identity)
             e.size = r.get("size", 0)
@@ -675,22 +821,30 @@ class Controller:
                                  "size": r.get("size", 0),
                                  "error": m.get("error")})
         # resource release + worker return (actors hold their resources for
-        # life; failed creations are released in _on_actor_created)
-        if t is not None and t.node_id is not None and not is_actor_task \
-                and not is_actor_creation:
-            self.scheduler.release(t.node_id, self._sched_res(t.spec))
-        if not is_actor_creation and actor_id_b is None:
-            self._return_worker(identity)
+        # life; failed creations are released in _on_actor_created).
+        # Leased workers: top up the pipeline from the class queue, close
+        # the lease when both pipeline and queue drain.
+        if lease is not None:
+            self._lease_housekeeping(identity, lease)
+        else:
+            if t is not None and t.node_id is not None and not is_actor_task \
+                    and not is_actor_creation:
+                self.scheduler.release(t.node_id, self._sched_res(t.spec))
+            if not is_actor_creation and actor_id_b is None:
+                self._return_worker(identity)
 
         # actor creation completion
         if is_actor_creation and t is not None:
             self._on_actor_created(t, identity, error=m.get("error"))
 
-        # notify the owner so its memory store resolves the future
-        owner_identity = self._find_owner_identity(t, m, identity)
-        if owner_identity is not None:
-            self._send(owner_identity, P.TASK_RESULT, {
-                "task_id": tid, "results": results_meta, "error": m.get("error")})
+        # notify the owner so its memory store resolves the future — unless
+        # the worker already pushed the result over the direct channel
+        if not m.get("owner_notified"):
+            owner_identity = self._find_owner_identity(t, m, identity)
+            if owner_identity is not None:
+                self._send(owner_identity, P.TASK_RESULT, {
+                    "task_id": tid, "results": results_meta,
+                    "error": m.get("error")})
         for r in m.get("results", []):
             self._object_created(r["object_id"])
         self._maybe_schedule()
@@ -719,11 +873,13 @@ class Controller:
         node.idle_workers.append(identity)
 
     def _handle_task_failure(self, tid: bytes, reason: str,
-                             retriable: bool = True) -> None:
+                             retriable: bool = True,
+                             release_resources: bool = True) -> None:
         t = self.tasks.get(tid)
         if t is None:
             return
-        if t.node_id is not None:
+        if t.node_id is not None and release_resources and \
+                t.worker not in self.leases:
             self.scheduler.release(t.node_id, self._sched_res(t.spec))
         if retriable and t.retries_left > 0:
             t.retries_left -= 1
@@ -792,13 +948,18 @@ class Controller:
                 self._send(owner_identity, P.TASK_RESULT,
                            {"task_id": tid, "results": results, "error": err})
         elif t.worker is not None:
-            # running: interrupt the worker process (SIGINT; SIGKILL if force)
-            info = self.peers.get(t.worker, {})
-            node = self.nodes.get(info.get("node_id") or b"")
-            if node is not None:
-                self._send(node.identity, P.CANCEL_TASK, {
-                    "pid": node.all_workers.get(t.worker, {}).get("pid"),
-                    "force": m.get("force", False)})
+            # dispatched: tell the worker to skip it if still queued
+            # worker-side, or interrupt itself if it is the running task
+            # (pipelined leases mean a blind SIGINT could hit a neighbour)
+            self._send(t.worker, P.CANCEL_QUEUED,
+                       {"task_id": tid, "force": m.get("force", False)})
+            if m.get("force"):
+                info = self.peers.get(t.worker, {})
+                node = self.nodes.get(info.get("node_id") or b"")
+                if node is not None:
+                    self._send(node.identity, P.CANCEL_TASK, {
+                        "pid": node.all_workers.get(t.worker, {}).get("pid"),
+                        "force": True})
 
     # -------------------------------------------------------------- actors
     def _h_create_actor(self, identity: bytes, m: dict) -> None:
@@ -834,6 +995,9 @@ class Controller:
             self._return_worker(worker)
             if t.node_id is not None:
                 self.scheduler.release(t.node_id, self._sched_res(t.spec))
+            self._publish(f"actor:{t.spec.actor_id.hex()}",
+                          {"state": "DEAD", "actor_id": aid})
+            self._answer_actor_addr_waiters(aid)
             return
         info.state = "ALIVE"
         if not t.spec.hold_resources and t.node_id is not None:
@@ -842,6 +1006,7 @@ class Controller:
         info.worker_id = WorkerID(worker) if len(worker) == WorkerID.SIZE else None
         self._publish(f"actor:{t.spec.actor_id.hex()}",
                       {"state": "ALIVE", "actor_id": aid})
+        self._answer_actor_addr_waiters(aid)
         q = self.actor_queues.get(aid)
         while q:
             caller, spec = q.popleft()
@@ -911,6 +1076,42 @@ class Controller:
             if node is not None:
                 self._send(node.identity, P.KILL_ACTOR, {
                     "pid": node.all_workers.get(worker, {}).get("pid")})
+
+    def _h_actor_addr(self, identity: bytes, m: dict) -> None:
+        """Address long-poll for the direct actor-call path: answer when the
+        actor is ALIVE (its worker identity doubles as its direct-channel
+        address), immediately if it is already dead."""
+        aid = m["actor_id"]
+        info = self.actors.get(aid)
+        worker = self.actor_workers.get(aid)
+        if info is None or info.state == "DEAD":
+            from ray_tpu.exceptions import ActorDiedError
+            cause = info.death_cause if info else "unknown actor"
+            self._reply(identity, m["rid"], {
+                "dead": True,
+                "error": P.dumps(ActorDiedError(ActorID(aid), cause))})
+        elif info.state == "ALIVE" and worker is not None:
+            self._reply(identity, m["rid"], {"worker": worker})
+        else:
+            self.actor_addr_waiters[aid].append((identity, m["rid"]))
+
+    def _answer_actor_addr_waiters(self, aid: bytes) -> None:
+        waiters = self.actor_addr_waiters.pop(aid, [])
+        if not waiters:
+            return
+        info = self.actors.get(aid)
+        worker = self.actor_workers.get(aid)
+        if info is not None and info.state == "ALIVE" and worker is not None:
+            for identity, rid in waiters:
+                self._reply(identity, rid, {"worker": worker})
+        elif info is None or info.state == "DEAD":
+            from ray_tpu.exceptions import ActorDiedError
+            cause = info.death_cause if info else "unknown actor"
+            blob = P.dumps(ActorDiedError(ActorID(aid), cause))
+            for identity, rid in waiters:
+                self._reply(identity, rid, {"dead": True, "error": blob})
+        else:  # still pending (e.g. RESTARTING): keep waiting
+            self.actor_addr_waiters[aid] = waiters
 
     def _h_get_actor(self, identity: bytes, m: dict) -> None:
         key = (m.get("namespace", ""), m["name"])
@@ -998,6 +1199,48 @@ class Controller:
         self._maybe_schedule()
 
     # ------------------------------------------------------ cluster health
+    def _h_notify_blocked(self, identity: bytes, m: dict) -> None:
+        """A worker's serial thread blocked in ray.get inside a task:
+        release the lease's cpu so dependent work can run (reference:
+        NotifyDirectCallTaskBlocked → raylet releases cpu resources)."""
+        lease = self.leases.get(identity)
+        if lease is None or lease.blocked:
+            return
+        lease.blocked = True
+        node = self.nodes.get(lease.node_b)
+        if node is not None and node.alive:
+            self.scheduler.release(NodeID(lease.node_b), lease.resources)
+        self._maybe_schedule()
+
+    def _h_notify_unblocked(self, identity: bytes, m: dict) -> None:
+        lease = self.leases.get(identity)
+        if lease is None or not lease.blocked:
+            return
+        lease.blocked = False
+        # re-acquire, allowing transient oversubscription (the reference
+        # resumes the task immediately too; availability self-corrects as
+        # other tasks release)
+        self.scheduler.force_acquire(NodeID(lease.node_b), lease.resources)
+        self._lease_housekeeping(identity, lease)
+
+    def _h_task_handback(self, identity: bytes, m: dict) -> None:
+        """A blocking worker returned its unstarted pipeline tasks."""
+        requeued = False
+        for spec in m.get("specs", ()):
+            tid = spec.task_id.binary()
+            t = self.tasks.get(tid)
+            if t is None or t.worker != identity or t.state != "RUNNING":
+                continue
+            lease = self.leases.get(identity)
+            if lease is not None:
+                lease.inflight.discard(tid)
+            t.worker = None
+            t.node_id = None
+            self._enqueue_ready(tid, t)
+            requeued = True
+        if requeued:
+            self._maybe_schedule()
+
     def _h_heartbeat(self, identity: bytes, m: dict) -> None:
         node = self.nodes.get(m["node_id"])
         if node is not None:
@@ -1015,8 +1258,17 @@ class Controller:
             except ValueError:
                 pass
         self.peers.pop(worker_identity, None)
-        self.worker_running.pop(worker_identity, None)
         aid = self.worker_actors.pop(worker_identity, None)
+        # close any lease first: its single resource allocation is released
+        # here, so per-task failure handling must not release again
+        lease = self.leases.pop(worker_identity, None)
+        if lease is not None:
+            peers_set = self.class_leases.get(lease.shape_key)
+            if peers_set is not None:
+                peers_set.discard(worker_identity)
+            lnode = self.nodes.get(lease.node_b)
+            if lnode is not None and lnode.alive and not lease.blocked:
+                self.scheduler.release(NodeID(lease.node_b), lease.resources)
         # fail/retry every in-flight task dispatched to that worker
         for tid, t in list(self.tasks.items()):
             if t.worker != worker_identity:
@@ -1027,7 +1279,8 @@ class Controller:
                 # actor restart path owns resubmission (below)
                 self.tasks.pop(tid, None)
             else:
-                self._handle_task_failure(tid, "worker died during execution")
+                self._handle_task_failure(tid, "worker died during execution",
+                                          release_resources=lease is None)
         if aid is not None:
             self._on_actor_died(aid, worker_identity)
         self._maybe_schedule()
@@ -1065,6 +1318,7 @@ class Controller:
             info.death_cause = "worker process died"
             self._publish(f"actor:{info.actor_id.hex()}",
                           {"state": "DEAD", "actor_id": aid})
+            self._answer_actor_addr_waiters(aid)
             from ray_tpu.exceptions import ActorDiedError
             err = P.dumps(ActorDiedError(info.actor_id, info.death_cause))
             self._fail_actor_queue(aid, err)
@@ -1175,6 +1429,7 @@ class Controller:
         P.CREATE_ACTOR: _h_create_actor,
         P.KILL_ACTOR: _h_kill_actor,
         P.GET_ACTOR: _h_get_actor,
+        P.ACTOR_ADDR: _h_actor_addr,
         P.PUT_OBJECT: _h_put_object,
         P.GET_LOCATION: _h_get_location,
         P.PUSH_OBJECT: _h_push_object,
@@ -1186,6 +1441,9 @@ class Controller:
         P.REMOVE_PG: _h_remove_pg,
         P.HEARTBEAT: _h_heartbeat,
         P.WORKER_EXIT: _h_worker_exit,
+        P.NOTIFY_BLOCKED: _h_notify_blocked,
+        P.NOTIFY_UNBLOCKED: _h_notify_unblocked,
+        P.TASK_HANDBACK: _h_task_handback,
         P.STATE_QUERY: _h_state_query,
         P.TIMELINE_EVENTS: _h_timeline,
         P.SUBSCRIBE: _h_subscribe,

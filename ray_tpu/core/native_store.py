@@ -25,6 +25,25 @@ _SHM_ROOT = "/dev/shm"
 _FULL = 2 ** 64 - 1
 _EXISTS = 2 ** 64 - 2
 
+_PAGE = 4096
+_MADV_POPULATE_WRITE = 23  # linux 5.14+: prefault + PTE setup in one call
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+def _madvise_populate(base: int, off: int, size: int) -> None:
+    """Fault a range in eagerly. Writing through fresh tmpfs pages costs a
+    trap per 4 KiB (~5x bandwidth loss measured); one madvise populates the
+    range at kernel speed. On resident pages it only fills PTEs (cheap), so
+    this is safe to call on every create. Errors (old kernels) are
+    ignored — the copy then faults lazily as before."""
+    start = (base + off) & ~(_PAGE - 1)
+    end = base + off + size
+    try:
+        _libc.madvise(ctypes.c_void_p(start),
+                      ctypes.c_size_t(end - start), _MADV_POPULATE_WRITE)
+    except Exception:
+        pass
+
 
 def _seg_path(session_name: str) -> str:
     return os.path.join(_SHM_ROOT, f"{session_name}.seg")
@@ -48,6 +67,8 @@ class _Segment:
             raise OSError(f"cannot map native segment {self.path}")
         total = lib.ns_total_size(self.handle)
         base = lib.ns_base(self.handle)
+        self.base = base
+        self.total = total
         self._buf = (ctypes.c_char * total).from_address(base)
         self.view = memoryview(self._buf).cast("B")
 
@@ -136,6 +157,24 @@ class NativeShmStore:
         self._sealed: "OrderedDict[ObjectID, int]" = OrderedDict()
         self._pinned: Dict[ObjectID, int] = {}
         self._spilled: Dict[ObjectID, str] = {}
+        # Background prefault (bounded): once tmpfs pages exist, every
+        # client mapping reaches memcpy-class put bandwidth; unfaulted
+        # tails are handled per-create by _madvise_populate.
+        from ray_tpu.core.config import get_config
+        budget = min(self.seg.total,
+                     get_config().object_store_prefault_bytes)
+        if budget > 0:
+            t = threading.Thread(target=self._prefault, args=(budget,),
+                                 name="store-prefault", daemon=True)
+            t.start()
+
+    def _prefault(self, budget: int) -> None:
+        chunk = 256 << 20
+        for off in range(0, budget, chunk):
+            if self.seg.handle is None:
+                return
+            _madvise_populate(self.seg.base, off,
+                              min(chunk, budget - off))
 
     # --- bookkeeping (same contract as ShmObjectStore) ---
     def on_sealed(self, object_id: ObjectID, size: int) -> None:
@@ -306,6 +345,12 @@ class NativeShmClient:
                 f"native store full creating {object_id.hex()} "
                 f"({size} bytes)")
         size = max(size, 1)
+        if size >= 1 << 20:
+            # prefault large extents so the serializer's memcpy doesn't
+            # eat a page trap per 4 KiB (plasma gets this for free from
+            # dlmalloc recycling; our recycled extents do too — this
+            # covers first-touch)
+            _madvise_populate(seg.base, off, size)
         return seg.view[off:off + size]
 
     def seal(self, object_id: ObjectID) -> int:

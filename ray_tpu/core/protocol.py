@@ -40,6 +40,16 @@ ACTOR_UPDATE = b"AUP"        # controller->subscribers {actor_id, state, ...}
 SUBMIT_ACTOR_TASK = b"SAT"
 KILL_ACTOR = b"KIL"
 GET_ACTOR = b"GAC"           # lookup by name
+ACTOR_ADDR = b"AAD"          # caller->controller {actor_id} -> {worker}|{dead}
+                             # (long-poll: held until the actor is ALIVE)
+ACTOR_CALL = b"ACL"          # caller->actor worker DIRECT {spec}
+CANCEL_QUEUED = b"CQD"       # ->worker direct {task_id, force}
+# blocked-worker protocol (reference: NotifyDirectCallTaskBlocked /
+# NotifyUnblocked — a worker blocked in ray.get releases its cpu and
+# returns its unstarted pipeline so the cluster can make progress)
+NOTIFY_BLOCKED = b"NBK"      # worker->controller {task_id}
+NOTIFY_UNBLOCKED = b"NUB"    # worker->controller {}
+TASK_HANDBACK = b"HBK"       # worker->controller {specs: [...]}
 # objects
 PUT_OBJECT = b"PUT"          # seal notification {object_id, node_id, size, owner}
 FREE_OBJECT = b"FRE"         # controller->node {object_id}

@@ -20,6 +20,7 @@ pinning (``task_manager.h:432``) lives controller-side as well.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Callable, Dict, Optional
 
 from ray_tpu.core.ids import ObjectID
@@ -99,6 +100,13 @@ class GlobalRefTable:
         self._lock = threading.Lock()
         self._counts: Dict[bytes, int] = {}
         self._ever_positive: Dict[bytes, bool] = {}
+        #: Recently-released ids (bounded FIFO). Needed because a worker's
+        #: TASK_DONE races the owner's release deltas on separate sockets:
+        #: without a tombstone the controller would resurrect an object
+        #: entry whose refcount already hit zero and pin its shm extent
+        #: forever (the zero event never fires twice).
+        self._released: "OrderedDict[bytes, None]" = OrderedDict()
+        self._released_cap = 65536
         self._on_zero = on_zero
 
     def apply_deltas(self, deltas: Dict[bytes, int]) -> None:
@@ -112,10 +120,20 @@ class GlobalRefTable:
                     self._counts.pop(key, None)
                     if self._ever_positive.pop(key, False):
                         zeroed.append(ObjectID(key))
+                        self._released[key] = None
+                        while len(self._released) > self._released_cap:
+                            self._released.popitem(last=False)
                 else:
                     self._counts[key] = n
+                    self._released.pop(key, None)
         for oid in zeroed:
             self._on_zero(oid)
+
+    def is_released(self, object_id_b: bytes) -> bool:
+        """True if this object's refcount already hit zero (it must not be
+        resurrected by a late completion record)."""
+        with self._lock:
+            return object_id_b in self._released
 
     def count(self, object_id: ObjectID) -> int:
         with self._lock:

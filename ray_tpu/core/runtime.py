@@ -21,6 +21,7 @@ from queue import Empty, SimpleQueue
 
 import zmq
 
+from ray_tpu.core import direct as D
 from ray_tpu.core import protocol as P
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import (
@@ -81,6 +82,10 @@ class Runtime:
         self._current_actor_id: Optional[ActorID] = None
 
         self.dispatch_handler: Optional[Callable[[dict], None]] = None
+        #: Installed by WorkerExecutor: called when the executing thread is
+        #: about to block on a remote result / when it resumes (reference:
+        #: CoreWorker NotifyDirectCallTaskBlocked, core_worker.cc)
+        self.block_notifier = None
         self._early_dispatches: List[dict] = []
         self.pubsub_handlers: Dict[str, List[Callable]] = {}
         self.pg_events: Dict[bytes, dict] = {}
@@ -107,6 +112,23 @@ class Runtime:
         self.sock.setsockopt(zmq.RCVHWM, 0)
         self.sock.connect(P.socket_path(session_dir))
         self._send_lock = threading.Lock()
+        # direct peer channel (reference: direct_actor_transport.h — actor
+        # calls and task results move worker<->worker without the broker).
+        # The ROUTER is recv-only (pump thread); outgoing peer DEALERs are
+        # owned by the flusher thread.
+        D.ensure_dir(session_dir)
+        self.direct_sock = self.ctx.socket(zmq.ROUTER)
+        self.direct_sock.setsockopt(zmq.LINGER, 0)
+        self.direct_sock.setsockopt(zmq.SNDHWM, 0)
+        self.direct_sock.setsockopt(zmq.RCVHWM, 0)
+        self.direct_sock.bind(D.direct_addr(session_dir, self.worker_id.binary()))
+        self._peer_socks: Dict[bytes, list] = {}  # flusher-owned: [sock, last_used]
+        self._last_peer_prune = time.time()
+        # client-side actor submitter state machine (reference:
+        # CoreWorkerDirectActorTaskSubmitter: per-actor connection state +
+        # pending queue, direct_actor_task_submitter.h)
+        self._actors: Dict[bytes, dict] = {}
+        self._actors_lock = threading.Lock()
         # all sends go through one flusher thread: preserves FIFO order,
         # moves pickling off the caller's critical path, and coalesces
         # consecutive task submissions into SUBMIT_BATCH messages
@@ -146,11 +168,49 @@ class Runtime:
 
     # ------------------------------------------------------------ transport
     def _send(self, mtype: bytes, payload: Any) -> None:
-        self._out_q.put((mtype, payload))
+        self._out_q.put((None, mtype, payload))
+
+    def _send_direct(self, target: bytes, mtype: bytes, payload: Any) -> None:
+        """Queue a message for a peer's direct channel (``target`` is the
+        peer's identity bytes). Same-process sends short-circuit."""
+        if target == self.worker_id.binary():
+            try:
+                self._on_message(mtype, payload)
+            except Exception:
+                logger.exception("%s: error in local direct %s", self.kind, mtype)
+            return
+        self._out_q.put((target, mtype, payload))
 
     def _sock_send(self, mtype: bytes, blob: bytes) -> None:
         with self._send_lock:
             self.sock.send_multipart([mtype, blob])
+
+    def _peer_sock(self, target: bytes) -> "zmq.Socket":
+        """Flusher-thread-only: lazily connected DEALER to a peer ROUTER."""
+        ent = self._peer_socks.get(target)
+        if ent is None:
+            s = self.ctx.socket(zmq.DEALER)
+            s.setsockopt(zmq.IDENTITY, self.worker_id.binary())
+            s.setsockopt(zmq.LINGER, 0)
+            s.setsockopt(zmq.SNDHWM, 0)
+            s.connect(D.direct_addr(self.session_dir, target))
+            ent = self._peer_socks[target] = [s, time.time()]
+        else:
+            ent[1] = time.time()
+        return ent[0]
+
+    def _prune_peer_socks(self, idle_s: float = 120.0) -> None:
+        """Flusher-thread-only. ipc connects never fail, so a DEALER to a
+        dead peer would otherwise queue messages forever (SNDHWM=0) and the
+        socket itself leak; idle-pruning bounds both."""
+        now = time.time()
+        for target in [t for t, (_, used) in self._peer_socks.items()
+                       if now - used > idle_s]:
+            sock, _ = self._peer_socks.pop(target)
+            try:
+                sock.close(0)
+            except Exception:
+                pass
 
     def _flush_loop(self) -> None:
         while True:
@@ -165,44 +225,59 @@ class Runtime:
                 except Empty:
                     break
             stop = False
-            msgs: List[Tuple[bytes, Any]] = []
+            # per-target ordered message lists; None = controller
+            boxes: Dict[Optional[bytes], List[Tuple[bytes, Any]]] = {}
             specs: List = []
 
             def close_specs() -> None:
+                box = boxes.setdefault(None, [])
                 if len(specs) == 1:
-                    msgs.append((P.SUBMIT_TASK, {"spec": specs[0]}))
+                    box.append((P.SUBMIT_TASK, {"spec": specs[0]}))
                 elif specs:
-                    msgs.append((P.SUBMIT_BATCH, {"specs": list(specs)}))
+                    box.append((P.SUBMIT_BATCH, {"specs": list(specs)}))
                 specs.clear()
 
             for it in batch:
                 if it is None:
                     stop = True
                     break
-                mtype, payload = it
-                if mtype == P.SUBMIT_TASK:
+                target, mtype, payload = it
+                if target is None and mtype == P.SUBMIT_TASK:
                     specs.append(payload["spec"])
                     continue
-                close_specs()
-                msgs.append((mtype, payload))
+                if target is None:
+                    close_specs()
+                boxes.setdefault(target, []).append((mtype, payload))
             close_specs()
-            try:
-                if len(msgs) == 1:
-                    self._sock_send(msgs[0][0], P.dumps(msgs[0][1]))
-                elif msgs:
-                    self._sock_send(P.MSG_BATCH, P.dumps({"msgs": msgs}))
-            except Exception:
-                # one bad payload must not discard the whole batch: retry
-                # each message individually, dropping only the culprit
-                for mtype, payload in msgs:
-                    try:
-                        self._sock_send(mtype, P.dumps(payload))
-                    except Exception:
-                        if not self._stopped.is_set():
-                            logger.exception(
-                                "%s: dropping unsendable %s", self.kind, mtype)
+            for target, msgs in boxes.items():
+                self._flush_box(target, msgs)
+            if time.time() - self._last_peer_prune > 30.0:
+                self._last_peer_prune = time.time()
+                self._prune_peer_socks()
             if stop:
                 return
+
+    def _flush_box(self, target: Optional[bytes],
+                   msgs: List[Tuple[bytes, Any]]) -> None:
+        if not msgs:
+            return
+        send = self._sock_send if target is None else \
+            (lambda mt, blob: self._peer_sock(target).send_multipart([mt, blob]))
+        try:
+            if len(msgs) == 1:
+                send(msgs[0][0], P.dumps(msgs[0][1]))
+            else:
+                send(P.MSG_BATCH, P.dumps({"msgs": msgs}))
+        except Exception:
+            # one bad payload must not discard the whole batch: retry
+            # each message individually, dropping only the culprit
+            for mtype, payload in msgs:
+                try:
+                    send(mtype, P.dumps(payload))
+                except Exception:
+                    if not self._stopped.is_set():
+                        logger.exception(
+                            "%s: dropping unsendable %s", self.kind, mtype)
 
     def request(self, mtype: bytes, payload: dict,
                 timeout: Optional[float] = None) -> dict:
@@ -217,6 +292,7 @@ class Runtime:
     def _pump_loop(self) -> None:
         poller = zmq.Poller()
         poller.register(self.sock, zmq.POLLIN)
+        poller.register(self.direct_sock, zmq.POLLIN)
         poller.register(self._pump_wake_recv, zmq.POLLIN)
         # long idle timeout: poll wakes instantly on traffic; frequent
         # timer wakeups across many processes starve small hosts
@@ -231,17 +307,29 @@ class Runtime:
                         self._pump_wake_recv.recv(zmq.NOBLOCK)
                 except zmq.ZMQError:
                     pass
-            if self.sock not in events:
-                continue
-            while True:
-                try:
-                    frames = self.sock.recv_multipart(zmq.NOBLOCK)
-                except zmq.ZMQError:
-                    break
-                try:
-                    self._on_message(frames[0], P.loads(frames[1]))
-                except Exception:
-                    logger.exception("%s: error handling %s", self.kind, frames[0])
+            if self.sock in events:
+                while True:
+                    try:
+                        frames = self.sock.recv_multipart(zmq.NOBLOCK)
+                    except zmq.ZMQError:
+                        break
+                    try:
+                        self._on_message(frames[0], P.loads(frames[1]))
+                    except Exception:
+                        logger.exception("%s: error handling %s", self.kind,
+                                         frames[0])
+            if self.direct_sock in events:
+                while True:
+                    try:
+                        frames = self.direct_sock.recv_multipart(zmq.NOBLOCK)
+                    except zmq.ZMQError:
+                        break
+                    try:
+                        # [sender identity, mtype, payload]
+                        self._on_message(frames[1], P.loads(frames[2]))
+                    except Exception:
+                        logger.exception("%s: error handling direct %s",
+                                         self.kind, frames[1])
 
     def _on_message(self, mtype: bytes, m: dict) -> None:
         if mtype == P.MSG_BATCH:
@@ -258,7 +346,9 @@ class Runtime:
             self.replies.fulfill(m["rid"], {"__error__": True, "data": m["data"]})
         elif mtype == P.TASK_RESULT:
             self._on_task_result(m)
-        elif mtype == P.TASK_DISPATCH:
+        elif mtype in (P.TASK_DISPATCH, P.ACTOR_CALL, P.CANCEL_QUEUED):
+            if mtype == P.CANCEL_QUEUED:
+                m = dict(m, cancel_queued=True)
             if self.dispatch_handler is not None:
                 self.dispatch_handler(m)
             else:
@@ -312,6 +402,10 @@ class Runtime:
         self._pump.join(timeout=2.0)
         try:
             self.sock.close(0)
+            self.direct_sock.close(0)
+            for s, _ in self._peer_socks.values():
+                s.close(0)
+            self._peer_socks.clear()
             self._pump_wake_recv.close(0)
             self._pump_wake_send.close(0)
         except Exception:
@@ -342,17 +436,23 @@ class Runtime:
         serialized = self.serialization.serialize(value)
         size = serialized.total_bytes()
         b = oid.binary()
-        self.memory_store.put(oid, value)
         if size <= self.config.max_inline_object_size or self.shm is None:
+            # small objects live in the in-process store (reference policy:
+            # memory_store.h holds <100 KB objects only)
+            self.memory_store.put(oid, value)
             blob = serialized.to_bytes()
             meta = {"object_id": b, "inline": blob, "size": size}
             if notify:
                 self._send(P.PUT_OBJECT, {"object_id": b, "inline": blob})
         else:
+            # large objects live ONLY in shm — duplicating the value in
+            # process memory would double the footprint of every big put
+            # (local gets deserialize zero-copy from the sealed extent)
             view = self.shm.create(oid, size)
             serialized.write_to(view)
             self.shm.seal(oid)
             meta = {"object_id": b, "node_id": self.node_id.binary(), "size": size}
+            self.seed_meta(b, meta)
             if notify:
                 self._send(P.PUT_OBJECT, {
                     "object_id": b, "node_id": self.node_id.binary(), "size": size})
@@ -363,6 +463,12 @@ class Runtime:
             self._meta[object_id_b] = meta
 
     def _on_task_result(self, m: dict) -> None:
+        aid = m.get("actor_id")
+        if aid is not None:
+            with self._actors_lock:
+                st = self._actors.get(aid)
+                if st is not None:
+                    st["inflight"].pop(m.get("task_id"), None)
         for r in m.get("results", []):
             b = r["object_id"]
             with self._meta_lock:
@@ -407,10 +513,30 @@ class Runtime:
         # store as _MetaReady). Block with the caller's timeout either way.
         if ref.owner is None or ref.owner != self.worker_id:
             self._ensure_location_probe(b)
-        value = self.memory_store.get(oid, timeout)
+        token = self._enter_blocked()
+        try:
+            value = self.memory_store.get(oid, timeout)
+        finally:
+            self._exit_blocked(token)
         if isinstance(value, _MetaReady):
             value = self._materialize(oid, value.meta)
         return value
+
+    def _enter_blocked(self) -> bool:
+        """Blocked-worker protocol: a task about to wait on a remote result
+        hands its unstarted pipeline back and releases its cpu so the
+        cluster keeps making progress (avoids nested-task deadlock)."""
+        nb = self.block_notifier
+        if nb is None:
+            return False
+        tid = getattr(self._task_ctx, "task_id", None)
+        if tid is None or tid == self._driver_task_id:
+            return False
+        return nb.on_block()
+
+    def _exit_blocked(self, token: bool) -> None:
+        if token and self.block_notifier is not None:
+            self.block_notifier.on_unblock()
 
     def _materialize(self, oid: ObjectID, meta: dict):
         if meta.get("error") is not None:
@@ -496,7 +622,12 @@ class Runtime:
         with lock:
             if count[0] >= num_returns:
                 done.set()
-        done.wait(timeout)
+        if not done.is_set():
+            token = self._enter_blocked()
+            try:
+                done.wait(timeout)
+            finally:
+                self._exit_blocked(token)
         for oid, cb in hooked:
             self.memory_store.remove_callback(oid, cb)
         ready: List[ObjectRef] = []
@@ -554,6 +685,13 @@ class Runtime:
             # RPCs that only the pump can fulfill
             self._cb_queue.put(lambda: materialize_and_call(value, error))
 
+        # large own puts live only in shm (meta seeded, store empty):
+        # complete immediately instead of waiting on a store event
+        with self._meta_lock:
+            meta = self._meta.get(oid.binary())
+        if meta is not None and not self.memory_store.contains(oid):
+            wrapper(_MetaReady(meta), None)
+            return
         self.memory_store.on_ready(oid, wrapper)
 
     # ---------------------------------------------------------- submission
@@ -589,17 +727,178 @@ class Runtime:
         for _, oid in spec.arg_refs:
             self.reference_counter.add_submitted_task_ref(oid)
         self.reference_counter.flush()
-        self._send(P.SUBMIT_TASK, {"spec": spec})
+        if spec.is_actor_task:
+            self._submit_actor_task(spec)
+        else:
+            self._send(P.SUBMIT_TASK, {"spec": spec})
         self._record_event(spec, "submitted")
         return refs
+
+    # ------------------------------------------------- direct actor calls
+    def _submit_actor_task(self, spec: TaskSpec) -> None:
+        """Client-side actor submitter (reference:
+        CoreWorkerDirectActorTaskSubmitter, direct_actor_task_submitter.h):
+        queue until the actor's worker address resolves, then push calls
+        directly to that worker — the controller is only consulted for the
+        address (long-poll held until ALIVE) and for liveness pubsub."""
+        aid = spec.actor_id.binary()
+        action = None  # ("direct", worker) | ("dead", err) | "queued"
+        with self._actors_lock:
+            st = self._actors.get(aid)
+            if st is None:
+                st = self._actors[aid] = {
+                    "state": "RESOLVING", "worker": None, "queue": [],
+                    "inflight": {}, "error": None}
+                st["queue"].append(spec)
+                action = "resolve"
+            elif st["state"] == "DIRECT":
+                st["inflight"][spec.task_id.binary()] = spec
+                action = ("direct", st["worker"])
+            elif st["state"] == "DEAD":
+                action = ("dead", st["error"])
+            else:  # RESOLVING
+                st["queue"].append(spec)
+                action = "queued"
+        if action == "resolve":
+            self._resolve_actor(aid)
+        elif isinstance(action, tuple) and action[0] == "direct":
+            self._send_direct(action[1], P.ACTOR_CALL, {"spec": spec})
+        elif isinstance(action, tuple) and action[0] == "dead":
+            self._fail_actor_task_local(spec, action[1])
+
+    def _resolve_actor(self, aid: bytes) -> None:
+        hexid = ActorID(aid).hex()
+        channel = f"actor:{hexid}"
+        if channel not in self.pubsub_handlers:
+            self.subscribe(channel,
+                           lambda ch, data, aid=aid: self._on_actor_update(aid, data))
+        rid = self.replies.new_request(
+            callback=lambda reply, aid=aid: self._on_actor_addr(aid, reply))
+        self._send(P.ACTOR_ADDR, {"actor_id": aid, "rid": rid})
+
+    def _on_actor_addr(self, aid: bytes, reply: Any) -> None:
+        """Pump-thread callback: the controller answered the address
+        long-poll (actor ALIVE on some worker, or dead)."""
+        to_send: List[TaskSpec] = []
+        to_fail: List[TaskSpec] = []
+        err = None
+        worker = None
+        with self._actors_lock:
+            st = self._actors.get(aid)
+            if st is None or st["state"] == "DEAD":
+                return
+            bad = not isinstance(reply, dict) or reply.get("__error__") \
+                or reply.get("dead")
+            if bad:
+                from ray_tpu.exceptions import ActorDiedError
+                if isinstance(reply, dict) and reply.get("error"):
+                    err = P.loads(reply["error"])
+                else:
+                    err = ActorDiedError(ActorID(aid), "actor is dead")
+                st["state"] = "DEAD"
+                st["error"] = err
+                to_fail = st["queue"] + list(st["inflight"].values())
+                st["queue"] = []
+                st["inflight"] = {}
+            else:
+                worker = reply["worker"]
+                st["state"] = "DIRECT"
+                st["worker"] = worker
+                to_send = st["queue"]
+                st["queue"] = []
+                for s in to_send:
+                    st["inflight"][s.task_id.binary()] = s
+        for s in to_send:
+            self._send_direct(worker, P.ACTOR_CALL, {"spec": s})
+        for s in to_fail:
+            self._fail_actor_task_local(s, err)
+
+    def _on_actor_update(self, aid: bytes, data: Any) -> None:
+        """Actor liveness pubsub: flip the submitter state machine."""
+        state = (data or {}).get("state")
+        if state == "RESTARTING":
+            to_fail: List[TaskSpec] = []
+            need_resolve = False
+            with self._actors_lock:
+                st = self._actors.get(aid)
+                if st is None or st["state"] == "DEAD":
+                    return
+                st["state"] = "RESOLVING"
+                st["worker"] = None
+                # inflight calls may or may not have executed; resubmit only
+                # those the user marked retriable (reference semantics:
+                # max_task_retries>0 => at-least-once across restarts)
+                retry = [s for s in st["inflight"].values()
+                         if s.max_retries != 0]
+                to_fail = [s for s in st["inflight"].values()
+                           if s.max_retries == 0]
+                st["inflight"] = {}
+                st["queue"] = retry + st["queue"]
+                need_resolve = True
+            from ray_tpu.exceptions import ActorDiedError
+            for s in to_fail:
+                self._fail_actor_task_local(
+                    s, ActorDiedError(ActorID(aid),
+                                      "actor restarting; task not retriable"))
+            if need_resolve:
+                self._resolve_actor(aid)
+        elif state == "DEAD":
+            from ray_tpu.exceptions import ActorDiedError
+            err = ActorDiedError(ActorID(aid), "actor died")
+            with self._actors_lock:
+                st = self._actors.get(aid)
+                if st is None or st["state"] == "DEAD":
+                    return
+                st["state"] = "DEAD"
+                st["error"] = err
+                to_fail = st["queue"] + list(st["inflight"].values())
+                st["queue"] = []
+                st["inflight"] = {}
+            for s in to_fail:
+                self._fail_actor_task_local(s, err)
+
+    def _fail_actor_task_local(self, spec: TaskSpec, err) -> None:
+        """The owner fails its own futures — no server round-trip."""
+        blob = P.dumps(err)
+        for oid in spec.return_ids():
+            meta = {"object_id": oid.binary(), "error": blob}
+            with self._meta_lock:
+                self._meta[oid.binary()] = meta
+            self.memory_store.put(oid, _MetaReady(meta))
 
     def create_actor(self, spec: TaskSpec) -> None:
         spec.owner = self.worker_id
         self.request(P.CREATE_ACTOR, {"spec": spec})
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
-        self._send(P.CANCEL_TASK, {"task_id": ref.id().task_id().binary(),
-                                   "force": force})
+        tid_b = ref.id().task_id().binary()
+        # direct actor call: in flight → cancel at the worker; still queued
+        # client-side (address unresolved) → unqueue and fail locally (the
+        # broker never saw the call, so CANCEL_TASK there would no-op and
+        # the call would run anyway once the address arrived)
+        worker = None
+        queued_spec = None
+        with self._actors_lock:
+            for st in self._actors.values():
+                if tid_b in st["inflight"]:
+                    worker = st["worker"]
+                    break
+                for i, s in enumerate(st["queue"]):
+                    if s.task_id.binary() == tid_b:
+                        queued_spec = st["queue"].pop(i)
+                        break
+                if queued_spec is not None:
+                    break
+        if queued_spec is not None:
+            from ray_tpu.exceptions import TaskCancelledError
+            self._fail_actor_task_local(
+                queued_spec, TaskCancelledError(queued_spec.task_id))
+            return
+        if worker is not None:
+            self._send_direct(worker, P.CANCEL_QUEUED,
+                              {"task_id": tid_b, "force": force})
+            return
+        self._send(P.CANCEL_TASK, {"task_id": tid_b, "force": force})
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._send(P.KILL_ACTOR, {"actor_id": actor_id.binary(),

@@ -189,6 +189,16 @@ class ClusterResourceScheduler:
             if n is not None:
                 n.release(demand)
 
+    def force_acquire(self, node_id: NodeID, demand: Dict[str, float]) -> None:
+        """Unconditional acquisition for a resuming blocked worker: may
+        drive availability transiently negative (visible backpressure that
+        self-corrects as other tasks release)."""
+        with self._lock:
+            n = self.nodes.get(node_id)
+            if n is not None:
+                for k, v in demand.items():
+                    n.available[k] = n.available.get(k, 0.0) - v
+
     # ---- placement groups (reference: bundle_scheduling_policy.h +
     # gcs_placement_group_scheduler.h 2PC; single-authority here) ----
     def reserve_placement_group(self, spec: PlacementGroupSpec) -> bool:

@@ -29,7 +29,7 @@ import cloudpickle
 
 from ray_tpu.core import protocol as P
 from ray_tpu.core.global_state import set_global_worker
-from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
+from ray_tpu.core.ids import NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.runtime import Runtime, _ArgPlaceholder
 from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.exceptions import TaskCancelledError, TaskError
@@ -48,11 +48,103 @@ class WorkerExecutor:
         self._async_loop: Optional[asyncio.AbstractEventLoop] = None
         self._async_sema: Optional[asyncio.Semaphore] = None
         self._stop = False
+        #: cancelled task ids -> expiry timestamp (math.inf once matched to
+        #: a queued/running task; finite for cancels that matched nothing,
+        #: which are kept briefly to cover the dequeue-to-mark window and
+        #: then dropped so the map stays bounded)
+        self._cancelled: Dict[bytes, float] = {}
+        #: task id executing on the MAIN thread only — pool/asyncio actor
+        #: threads never publish here (a SIGINT raised off the running
+        #: thread would corrupt unrelated serial state)
+        self._current_tid: Optional[bytes] = None
+        self._main_ident = threading.get_ident()
+        self._block_depth = 0  # main thread blocked in ray.get inside task
+        #: serializes the pump thread's dispatch-vs-blocked decision against
+        #: on_block's queue drain (without it a dispatch passing the depth
+        #: check could land in the queue after the drain and wedge behind
+        #: the blocked serial thread)
+        self._block_lock = threading.Lock()
         self.runtime.set_dispatch_handler(self._on_dispatch)
+        self.runtime.block_notifier = self
+        self._install_cancel_handler()
+
+    def _install_cancel_handler(self) -> None:
+        """SIGINT delivery is asynchronous: by the time the signal lands the
+        cancelled task may have finished and a pipelined neighbour started.
+        A targeted handler only raises when the interrupted task really is
+        the cancelled one; stray/late signals are ignored instead of
+        killing the worker (reference semantics: ray.cancel interrupts the
+        task, never the worker process)."""
+        import signal
+
+        def handler(signum, frame):
+            tid = self._current_tid
+            if tid is not None and tid in self._cancelled:
+                raise TaskCancelledError(TaskID(tid))
+
+        try:
+            signal.signal(signal.SIGINT, handler)
+        except ValueError:
+            pass  # not on the main thread (driver-embedded executor)
+
+    # ------------------------------------------- blocked-worker protocol
+    def on_block(self) -> bool:
+        """The serial executor thread is about to wait on a remote result
+        (reference: NotifyDirectCallTaskBlocked). Hand unstarted pipeline
+        tasks back to the controller so they run elsewhere, and let the
+        controller release this lease's cpu while we wait. Only the serial
+        thread stalls its queue; concurrent/async actor threads blocking
+        don't (their peers keep executing), so they skip the protocol."""
+        if threading.get_ident() != self._main_ident:
+            return False
+        with self._block_lock:
+            self._block_depth += 1
+            if self._block_depth > 1:
+                return True
+            # NOTIFY_BLOCKED must precede the handback (FIFO): the
+            # controller marks the lease blocked first, so the requeued
+            # tasks cannot be pipelined straight back onto this worker
+            self.runtime._send(P.NOTIFY_BLOCKED,
+                               {"task_id": self._current_tid})
+            if self.actor_instance is None:
+                handback = []
+                while True:
+                    try:
+                        m = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    spec = m.get("spec")
+                    if spec is not None and not spec.is_actor_task \
+                            and not spec.is_actor_creation:
+                        handback.append(spec)
+                    else:
+                        self._queue.put(m)
+                if handback:
+                    self.runtime._send(P.TASK_HANDBACK, {"specs": handback})
+        return True
+
+    def on_unblock(self) -> None:
+        with self._block_lock:
+            self._block_depth -= 1
+            if self._block_depth == 0:
+                self.runtime._send(P.NOTIFY_UNBLOCKED, {})
 
     # dispatch arrives on the pump thread; queue for the main thread
     def _on_dispatch(self, m: dict) -> None:
+        if m.get("cancel_queued"):
+            self._on_cancel(m)
+            return
         spec: TaskSpec = m["spec"]
+        if not spec.is_actor_task and not spec.is_actor_creation:
+            # a dispatch racing our NOTIFY_BLOCKED would wedge behind the
+            # blocked serial thread — bounce it straight back (the lock
+            # makes bounce-vs-drain atomic against on_block)
+            with self._block_lock:
+                if self._block_depth > 0:
+                    self.runtime._send(P.TASK_HANDBACK, {"specs": [spec]})
+                    return
+                self._queue.put(m)
+            return
         if self.actor_instance is not None and spec.is_actor_task and (
                 self.actor_spec.max_concurrency > 1 or self.actor_spec.is_async_actor):
             # concurrent/async actors bypass the serial queue
@@ -64,6 +156,40 @@ class WorkerExecutor:
         else:
             self._queue.put(m)
 
+    def _on_cancel(self, m: dict) -> None:
+        import math
+        now = time.time()
+        # purge expired unmatched cancels so the map stays bounded
+        for k in [k for k, exp in self._cancelled.items() if exp < now]:
+            self._cancelled.pop(k, None)
+        tid = m["task_id"]
+        # mark first so a task popped concurrently sees the flag at the
+        # top of _execute, then decide how to deliver the cancel
+        self._cancelled[tid] = math.inf
+        if self._current_tid == tid:
+            # interrupt user code on the main thread (reference:
+            # SIGINT-based ray.cancel of a running task); the targeted
+            # handler ignores the signal if the task finishes first.
+            # Running concurrent/async actor tasks never publish
+            # _current_tid — like the reference, they are not
+            # interruptible once started.
+            import signal
+            try:
+                os.kill(os.getpid(), signal.SIGINT)
+            except Exception:
+                pass
+            return
+        with self._queue.mutex:
+            queued = any(item.get("spec") is not None
+                         and item["spec"].task_id.binary() == tid
+                         for item in self._queue.queue)
+        if not queued and self._current_tid != tid:
+            # probably already completed (dispatch and cancel ride the same
+            # FIFO channel) — but the task may sit in the window between
+            # run_loop's dequeue and _execute publishing _current_tid, so
+            # keep the marker briefly instead of dropping it outright
+            self._cancelled[tid] = now + 5.0
+
     def run_loop(self) -> None:
         while not self._stop:
             try:
@@ -72,7 +198,25 @@ class WorkerExecutor:
                 if self.runtime._stopped.is_set():
                     break
                 continue
-            self._execute(m)
+            try:
+                self._execute(m)
+            except (KeyboardInterrupt, TaskCancelledError):
+                # backstop for a cancel signal landing in the gap before
+                # _execute's try block: report the cancel instead of
+                # letting the interrupt kill the worker / drop the task
+                logger.warning("cancel interrupt outside task body")
+                spec = m.get("spec")
+                if spec is not None:
+                    err = P.dumps(TaskCancelledError(spec.task_id))
+                    self.runtime._send(P.TASK_DONE, {
+                        "task_id": spec.task_id.binary(),
+                        "results": [{"object_id": oid.binary()}
+                                    for oid in spec.return_ids()],
+                        "error": err, "retriable": False,
+                        "owner": spec.owner.binary() if spec.owner else None,
+                        "owner_notified": False,
+                        "is_actor_task": spec.is_actor_task,
+                    })
 
     # --------------------------------------------------------- execution
     def _load_function(self, key: str):
@@ -116,13 +260,20 @@ class WorkerExecutor:
 
     def _execute(self, m: dict) -> None:
         spec: TaskSpec = m["spec"]
+        tid_b = spec.task_id.binary()
         self.runtime.current_task_id = spec.task_id
+        on_main = threading.get_ident() == self._main_ident
+        if on_main:
+            self._current_tid = tid_b
         start = time.time()
         error_blob = None
         retriable = True
         results = []
         values: Optional[list] = None
         try:
+            if tid_b in self._cancelled:
+                self._cancelled.pop(tid_b, None)
+                raise TaskCancelledError(spec.task_id)
             args, kwargs = self._resolve_args(
                 spec, m.get("inline_args") or {}, m.get("arg_errors") or {})
             if spec.is_actor_creation:
@@ -140,6 +291,9 @@ class WorkerExecutor:
         except KeyboardInterrupt:
             error_blob = P.dumps(TaskCancelledError(spec.task_id))
             retriable = False
+        except TaskCancelledError as e:
+            error_blob = P.dumps(e)
+            retriable = False
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, TaskError):
                 err = e
@@ -150,6 +304,11 @@ class WorkerExecutor:
             retriable = bool(spec.retry_exceptions)
             logger.warning("task %s failed:\n%s", spec.name,
                            err.traceback_str if hasattr(err, "traceback_str") else err)
+        # user code is done: step out of the cancel window NOW so a late
+        # SIGINT cannot interrupt result storage / the TASK_DONE send
+        if on_main:
+            self._current_tid = None
+        self._cancelled.pop(tid_b, None)
         if error_blob is None:
             for i, value in enumerate(values):
                 oid = ObjectID.for_task_return(spec.task_id, i + 1)
@@ -164,16 +323,40 @@ class WorkerExecutor:
         if error_blob is not None:
             results = [{"object_id": oid.binary()}
                        for oid in spec.return_ids()]
-        self.runtime._send(P.TASK_DONE, {
-            "task_id": spec.task_id.binary(),
+        # Result meta goes DIRECT to the owner (reference: task replies go
+        # straight to the submitting core worker, not through the GCS);
+        # TASK_DONE to the controller keeps the object directory / task
+        # table / lease accounting consistent, off the latency path.
+        # Retriable errors are NOT final — the controller owns the retry
+        # decision, so those defer to its TASK_RESULT forward.
+        owner_b = spec.owner.binary() if spec.owner else None
+        may_retry = (error_blob is not None and retriable
+                     and spec.max_retries != 0)
+        direct_ok = owner_b is not None and not may_retry
+        if direct_ok:
+            self.runtime._send_direct(owner_b, P.TASK_RESULT, {
+                "task_id": tid_b,
+                "results": [dict(r, error=error_blob) for r in results],
+                "error": error_blob,
+                "actor_id": spec.actor_id.binary() if spec.is_actor_task
+                else None,
+            })
+        done = {
+            "task_id": tid_b,
             "results": results,
             "error": error_blob,
             "retriable": retriable,
-            "owner": spec.owner.binary() if spec.owner else None,
+            "owner": owner_b,
+            "owner_notified": direct_ok,
             # flag only — re-shipping the whole spec (args blob included)
             # on every actor call would tax the hot path
             "is_actor_task": spec.is_actor_task,
-        })
+        }
+        if may_retry and spec.is_actor_task:
+            # direct actor calls have no controller-side PendingTask; ship
+            # the spec so the controller can re-route the retry
+            done["spec"] = spec
+        self.runtime._send(P.TASK_DONE, done)
         self.runtime.record_span(
             spec.name or spec.function.qualname, start, time.time() - start,
             task_id=spec.task_id.hex())

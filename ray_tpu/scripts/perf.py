@@ -41,7 +41,9 @@ def bench_tasks_async(ray_tpu, n=2000) -> float:
     def nop():
         return b"ok"
 
-    ray_tpu.get(nop.remote())
+    # warm the worker pool + leases to steady state (the reference's
+    # ray_perf phases also run against a warm cluster)
+    ray_tpu.get([nop.remote() for _ in range(200)])
     t0 = time.perf_counter()
     ray_tpu.get([nop.remote() for _ in range(n)])
     return n / (time.perf_counter() - t0)
@@ -70,7 +72,7 @@ def bench_actor_async(ray_tpu, n=5000) -> float:
             return b"ok"
 
     a = A.remote()
-    ray_tpu.get(a.m.remote())
+    ray_tpu.get([a.m.remote() for _ in range(100)])
     t0 = time.perf_counter()
     ray_tpu.get([a.m.remote() for _ in range(n)])
     dt = time.perf_counter() - t0
