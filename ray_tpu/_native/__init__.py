@@ -8,14 +8,26 @@ Set ``RAY_TPU_NATIVE_STORE=0`` to force the fallback.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
 from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_HERE, "libnativestore.so")
 _SRC_PATH = os.path.join(_HERE, "store.cpp")
+
+
+def _lib_path() -> str:
+    """Build artifact keyed by a source hash: editing store.cpp naturally
+    invalidates the old binary (mtime comparison breaks under git checkout,
+    which restores old mtimes), and no binary is ever committed."""
+    with open(_SRC_PATH, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(_HERE, f"libnativestore-{digest}.so")
+
+
+_LIB_PATH = _lib_path()
 
 _lock = threading.Lock()
 _lib = None
@@ -39,6 +51,16 @@ def _build() -> bool:
             pass
         return False
     os.replace(tmp, _LIB_PATH)
+    # reap binaries for older source revisions (processes that still have
+    # one mapped keep it alive via the inode; the name can go)
+    cur = os.path.basename(_LIB_PATH)
+    for name in os.listdir(_HERE):
+        if name.startswith("libnativestore") and name.endswith(".so") \
+                and name != cur:
+            try:
+                os.unlink(os.path.join(_HERE, name))
+            except OSError:
+                pass
     return True
 
 
@@ -51,9 +73,7 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("RAY_TPU_NATIVE_STORE", "1") == "0":
             return None
-        if not os.path.exists(_LIB_PATH) or (
-                os.path.getmtime(_LIB_PATH) <
-                os.path.getmtime(_SRC_PATH)):
+        if not os.path.exists(_LIB_PATH):
             if not _build():
                 return None
         try:
@@ -89,6 +109,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.ns_release_all.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.ns_reap.restype = ctypes.c_uint32
         lib.ns_reap.argtypes = [ctypes.c_void_p]
+        lib.ns_recover.argtypes = [ctypes.c_void_p]
         lib.ns_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64),

@@ -11,6 +11,7 @@
 // Build: g++ -O2 -shared -fPIC -o libnativestore.so store.cpp -lpthread
 // ABI: every function is extern "C", loaded via ctypes.
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
@@ -100,14 +101,92 @@ uint64_t HashId(const uint8_t* id) {
 
 uint64_t AlignUp(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
 
+void Free(Handle* h, uint64_t off, uint64_t size);
+void FreeSlot(Handle* h, Slot* s);
+
+// A process died holding the lock, possibly mid-mutation: the slot table
+// is the source of truth (each slot is written id-first, state-last), so
+// rebuild every piece of derived allocator state from it — drop slots
+// with impossible geometry, recompute bump/used/nobjects, reconstruct the
+// freelist from the gaps between live extents, and clear reader-ledger
+// entries that point at freed/corrupt slots. Anything the dead process
+// half-allocated but never published in a slot is reclaimed by the
+// recomputed bump/freelist.
+void RecoverAllocator(Handle* h) {
+  Header* hdr = h->hdr;
+  uint32_t nlive = 0;
+  FreeExtent* live = new FreeExtent[hdr->nslots];
+  uint64_t used = 0;
+  uint32_t nobjects = 0;
+  for (uint32_t i = 0; i < hdr->nslots; i++) {
+    Slot* s = &h->slots[i];
+    if (s->state == kFree) continue;
+    uint64_t asize = AlignUp(s->size ? s->size : 1);
+    if (s->state > kZombie || s->off > hdr->capacity ||
+        asize > hdr->capacity - s->off) {
+      // torn write: demote to tombstone (probe chain stays intact)
+      s->state = kFree;
+      s->probe = 1;
+      s->refcnt = 0;
+      continue;
+    }
+    live[nlive].off = s->off;
+    live[nlive].size = asize;
+    nlive++;
+    used += asize;
+    if (s->state == kBuilding || s->state == kSealed) nobjects++;
+  }
+  std::sort(live, live + nlive,
+            [](const FreeExtent& a, const FreeExtent& b) {
+              return a.off < b.off;
+            });
+  uint64_t cursor = 0;
+  uint32_t nfree = 0;
+  for (uint32_t i = 0; i < nlive; i++) {
+    if (live[i].off > cursor && nfree < kMaxFree) {
+      h->freelist[nfree].off = cursor;
+      h->freelist[nfree].size = live[i].off - cursor;
+      nfree++;
+    }
+    uint64_t end = live[i].off + live[i].size;
+    if (end > cursor) cursor = end;
+  }
+  hdr->bump = cursor;
+  hdr->nfree = nfree;
+  hdr->used = used;
+  hdr->nobjects = nobjects;
+  delete[] live;
+  // Rebuild per-slot refcounts from the ledger (a crash between the
+  // ledger increment and the slot increment would otherwise skew them
+  // forever), then reclaim zombies nobody references anymore.
+  for (uint32_t i = 0; i < hdr->nslots; i++) {
+    if (h->slots[i].state != kFree) h->slots[i].refcnt = 0;
+  }
+  for (uint32_t i = 0; i < kMaxReaders; i++) {
+    Reader* r = &h->readers[i];
+    if (r->pid == 0) continue;
+    if (r->slot >= hdr->nslots || h->slots[r->slot].state == kFree) {
+      r->pid = 0;
+      r->count = 0;
+      continue;
+    }
+    h->slots[r->slot].refcnt += r->count;
+  }
+  for (uint32_t i = 0; i < hdr->nslots; i++) {
+    Slot* s = &h->slots[i];
+    if (s->state == kZombie && s->refcnt == 0) FreeSlot(h, s);
+  }
+}
+
 class Locker {
  public:
   explicit Locker(Handle* h) : h_(h) {
     int rc = pthread_mutex_lock(&h_->hdr->mutex);
     if (rc == EOWNERDEAD) {
-      // A crashed worker died holding the lock; state is still
-      // consistent because we only mutate under short critical
-      // sections — mark recovered and continue.
+      // A crashed process died holding the lock mid-critical-section:
+      // rebuild derived allocator state from the slot table before
+      // declaring the mutex consistent.
+      RecoverAllocator(h_);
       pthread_mutex_consistent(&h_->hdr->mutex);
     }
   }
@@ -464,6 +543,13 @@ uint32_t ns_reap(void* handle) {
     }
   }
   return reaped;
+}
+
+// Test/diagnostic hook: force the EOWNERDEAD recovery path.
+void ns_recover(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  RecoverAllocator(h);
 }
 
 void ns_stats(void* handle, uint64_t* used, uint64_t* capacity,

@@ -18,7 +18,9 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import (
     Application, AutoscalingConfig, Deployment, deployment)
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle, DeploymentResponse, DeploymentResponseGenerator)
+from ray_tpu.serve._private.replica import get_multiplexed_model_id
 
 __all__ = [
     "Application",
@@ -26,11 +28,13 @@ __all__ = [
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "batch",
     "delete",
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
     "proxy_address",
     "run",
     "shutdown",

@@ -2,15 +2,16 @@
 
 Reference: ``python/ray/serve/handle.py`` + ``_private/router.py:259``
 and ``replica_scheduler/pow_2_scheduler.py:44`` — pick two candidate
-replicas, route to the less loaded. Load here is the handle's own
+replicas, route to the less loaded. Load here is the router's own
 outstanding-refs count per replica (completed refs are drained with a
-zero-timeout wait), refreshed replica membership comes from the
-controller when its version bumps (simplified LongPollHost).
+zero-timeout wait) plus live streams, refreshed replica membership comes
+from the controller when its version bumps (simplified LongPollHost).
 """
 
 from __future__ import annotations
 
 import random
+import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -45,51 +46,175 @@ def _is_actor_death(e: BaseException) -> bool:
     return isinstance(e, (ActorDiedError, ActorError))
 
 
+class DeploymentResponseGenerator:
+    """Consumer-paced streaming response (reference:
+    ``handle.py:DeploymentResponseGenerator`` for
+    ``options(stream=True)``): the replica holds the live generator;
+    chunks are pulled in small batches as the consumer iterates. An
+    abandoned generator cancels itself on GC so the replica's live
+    stream (and its ongoing-count) is not leaked."""
+
+    def __init__(self, replica, stream_id: str, start_ref, router, rkey):
+        self._replica = replica
+        self._stream_id = stream_id
+        self._start_ref = start_ref  # raises here if the method blew up
+        self._router = router
+        self._rkey = rkey
+        self._buf: List[Any] = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._start_ref is not None:
+            try:
+                ray_tpu.get(self._start_ref)
+            except BaseException:
+                self._finish()
+                raise
+            self._start_ref = None
+        while not self._buf:
+            if self._done:
+                raise StopIteration
+            try:
+                items, done = ray_tpu.get(
+                    self._replica.next_chunks.remote(self._stream_id))
+            except BaseException:
+                self._finish()
+                raise
+            self._buf.extend(items)
+            if done:
+                self._finish()
+        return self._buf.pop(0)
+
+    def _finish(self) -> None:
+        if not self._done:
+            self._done = True
+            self._router.stream_finished(self._rkey)
+
+    def cancel(self) -> None:
+        if not self._done:
+            self._finish()
+            self._replica.cancel_stream.remote(self._stream_id)
+
+    def __del__(self):
+        try:
+            self.cancel()
+        except Exception:
+            pass
+
+
+class _Router:
+    """Shared routing state: membership, per-replica load, model
+    affinity. One _Router is shared by a handle and every configured
+    copy made via ``options()``, so load tracking spans them all."""
+
+    def __init__(self, deployment_name: str, controller):
+        self.deployment_name = deployment_name
+        self.controller = controller
+        self.version = -1
+        self.replicas: List[Any] = []
+        # stable replica key (actor id hex) -> outstanding unary refs
+        self.outstanding: Dict[bytes, List[Any]] = {}
+        # stable replica key -> live stream count
+        self.streams: Dict[bytes, int] = {}
+        # model id -> stable replica key (soft affinity, reference:
+        # multiplexed model routing in replica_scheduler)
+        self.model_affinity: Dict[str, bytes] = {}
+
+    @staticmethod
+    def _key(replica) -> bytes:
+        aid = getattr(replica, "_actor_id", None)
+        return aid.binary() if aid is not None else id(replica)
+
+    def refresh(self, force: bool = False) -> None:
+        version = ray_tpu.get(
+            self.controller.get_version.remote(self.deployment_name))
+        if version != self.version or force:
+            # Atomic snapshot: version and replica list must agree.
+            version, replicas = ray_tpu.get(
+                self.controller.get_membership.remote(self.deployment_name))
+            self.replicas = replicas
+            self.version = version
+            live = {self._key(r) for r in replicas}
+            # stable keys survive a membership change for replicas that
+            # remain; state for removed replicas is dropped, and affinity
+            # to a vanished replica is invalidated rather than silently
+            # pointing at a different one
+            self.outstanding = {k: v for k, v in self.outstanding.items()
+                                if k in live}
+            self.streams = {k: v for k, v in self.streams.items()
+                            if k in live}
+            self.model_affinity = {m: k for m, k in
+                                   self.model_affinity.items() if k in live}
+
+    def load(self, replica) -> int:
+        k = self._key(replica)
+        refs = self.outstanding.setdefault(k, [])
+        if refs:
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=0)
+            self.outstanding[k] = list(pending)
+        return len(self.outstanding[k]) + self.streams.get(k, 0)
+
+    def pick(self, model_id: Optional[str]):
+        """Returns (replica, stable_key)."""
+        n = len(self.replicas)
+        by_key = {self._key(r): r for r in self.replicas}
+        if model_id is not None:
+            k = self.model_affinity.get(model_id)
+            if k is not None and k in by_key:
+                # soft affinity: keep one model's requests on one replica
+                # so its weights stay resident
+                return by_key[k], k
+        if n == 1:
+            replica = self.replicas[0]
+        else:
+            i, j = random.sample(range(n), 2)
+            a, b = self.replicas[i], self.replicas[j]
+            replica = a if self.load(a) <= self.load(b) else b
+        k = self._key(replica)
+        if model_id is not None:
+            self.model_affinity[model_id] = k
+        return replica, k
+
+    def stream_started(self, k: bytes) -> None:
+        self.streams[k] = self.streams.get(k, 0) + 1
+
+    def stream_finished(self, k: bytes) -> None:
+        n = self.streams.get(k, 0) - 1
+        if n > 0:
+            self.streams[k] = n
+        else:
+            self.streams.pop(k, None)
+
+
 class _MethodCaller:
     def __init__(self, handle: "DeploymentHandle", method: str):
         self._handle = handle
         self._method = method
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         return self._handle._route(self._method, args, kwargs)
 
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
-                 app_name: str = "default"):
+                 app_name: str = "default", _router: Optional[_Router] = None,
+                 _stream: bool = False, _model_id: Optional[str] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._controller = controller
-        self._version = -1
-        self._replicas: List[Any] = []
-        # replica index -> outstanding refs (drained lazily)
-        self._outstanding: Dict[int, List[Any]] = {}
-
-    # -- membership ---------------------------------------------------
-    def _refresh(self, force: bool = False) -> None:
-        version = ray_tpu.get(
-            self._controller.get_version.remote(self.deployment_name))
-        if version != self._version or force:
-            # Atomic snapshot: version and replica list must agree.
-            version, replicas = ray_tpu.get(
-                self._controller.get_membership.remote(
-                    self.deployment_name))
-            self._replicas = replicas
-            self._version = version
-            self._outstanding = {i: [] for i in range(len(self._replicas))}
-
-    def _load(self, i: int) -> int:
-        refs = self._outstanding.setdefault(i, [])
-        if refs:
-            ready, pending = ray_tpu.wait(
-                refs, num_returns=len(refs), timeout=0)
-            self._outstanding[i] = list(pending)
-        return len(self._outstanding[i])
+        self._router = _router or _Router(deployment_name, controller)
+        self._stream = _stream
+        self._model_id = _model_id
 
     # -- routing ------------------------------------------------------
-    def _route(self, method: str, args, kwargs) -> DeploymentResponse:
-        self._refresh()
-        if not self._replicas:
+    def _route(self, method: str, args, kwargs):
+        r = self._router
+        r.refresh()
+        if not r.replicas:
             raise RuntimeError(
                 f"Deployment {self.deployment_name!r} has no replicas")
         # Unwrap chained responses so downstream gets values, not
@@ -99,24 +224,31 @@ class DeploymentHandle:
         kwargs = {k: (v._to_object_ref()
                       if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
-        n = len(self._replicas)
-        if n == 1:
-            idx = 0
+        replica, rkey = r.pick(self._model_id)
+        if self._stream:
+            stream_id = uuid.uuid4().hex
+            ctx = {"multiplexed_model_id": self._model_id or ""}
+            start = replica.start_stream.remote(
+                stream_id, ctx, method, *args, **kwargs)
+            r.stream_started(rkey)
+            return DeploymentResponseGenerator(
+                replica, stream_id, start, r, rkey)
+        if self._model_id is not None:
+            ctx = {"multiplexed_model_id": self._model_id}
+            ref = replica.handle_request_ctx.remote(
+                ctx, method, *args, **kwargs)
         else:
-            i, j = random.sample(range(n), 2)
-            idx = i if self._load(i) <= self._load(j) else j
-        replica = self._replicas[idx]
-        ref = replica.handle_request.remote(method, *args, **kwargs)
-        self._outstanding.setdefault(idx, []).append(ref)
+            ref = replica.handle_request.remote(method, *args, **kwargs)
+        r.outstanding.setdefault(rkey, []).append(ref)
 
         def retry_on_dead_replica():
             # Membership was stale: resync and re-route once.
-            self._refresh(force=True)
+            r.refresh(force=True)
             return self._route(method, args, kwargs)
 
         return DeploymentResponse(ref, retry=retry_on_dead_replica)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         return self._route("__call__", args, kwargs)
 
     def __getattr__(self, name: str) -> _MethodCaller:
@@ -124,9 +256,27 @@ class DeploymentHandle:
             raise AttributeError(name)
         return _MethodCaller(self, name)
 
-    def options(self, **kwargs) -> "DeploymentHandle":
-        return self  # stream/multiplex options accepted for API parity
+    def options(self, *, stream: bool = False,
+                multiplexed_model_id: Optional[str] = None,
+                **kwargs) -> "DeploymentHandle":
+        """Configured copy of this handle (reference: handle.options).
+        Unknown options raise rather than silently no-op."""
+        if kwargs:
+            raise TypeError(
+                f"unsupported handle options: {sorted(kwargs)}")
+        return DeploymentHandle(
+            self.deployment_name, self._controller, self.app_name,
+            _router=self._router, _stream=stream,
+            _model_id=multiplexed_model_id)
 
     def __reduce__(self):
-        return (DeploymentHandle,
-                (self.deployment_name, self._controller, self.app_name))
+        # options survive pickling; router state is rebuilt on the far
+        # side (membership is fetched fresh there anyway)
+        return (_rebuild_handle,
+                (self.deployment_name, self._controller, self.app_name,
+                 self._stream, self._model_id))
+
+
+def _rebuild_handle(deployment_name, controller, app_name, stream, model_id):
+    return DeploymentHandle(deployment_name, controller, app_name,
+                            _stream=stream, _model_id=model_id)

@@ -169,3 +169,56 @@ def test_status_and_delete(serve_session):
     serve.delete("Thing")
     deps = {d["name"] for d in serve.status()["deployments"]}
     assert "Thing" not in deps
+
+
+def test_streaming_response(serve_session):
+    @serve.deployment
+    class Streamer:
+        def gen(self, n):
+            for i in range(n):
+                yield i * 10
+
+    h = serve.run(Streamer.bind())
+    gen = h.options(stream=True).gen.remote(5)
+    assert list(gen) == [0, 10, 20, 30, 40]
+    # request context is visible inside the generator body
+    @serve.deployment
+    class CtxStreamer:
+        def gen(self):
+            yield serve.get_multiplexed_model_id()
+
+    hc = serve.run(CtxStreamer.bind(), name="ctxstream")
+    out = list(hc.options(stream=True, multiplexed_model_id="mm-1")
+               .gen.remote())
+    assert out == ["mm-1"]
+    # early break cancels the replica-side stream instead of leaking it
+    gen2 = h.options(stream=True).gen.remote(1000)
+    next(gen2)
+    gen2.cancel()
+    # a non-generator method under stream=True must raise at consumption
+    @serve.deployment
+    class NotAGen:
+        def __call__(self):
+            return 42
+
+    h2 = serve.run(NotAGen.bind(), name="notagen")
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        list(h2.options(stream=True).remote())
+
+
+def test_multiplexed_model_id(serve_session):
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self):
+            return serve.get_multiplexed_model_id()
+
+    h = serve.run(Model.bind())
+    out = h.options(multiplexed_model_id="m-7").remote().result(timeout_s=60)
+    assert out == "m-7"
+    # plain calls see an empty model id
+    assert h.remote().result(timeout_s=60) == ""
+    # unknown handle options raise instead of silently no-oping
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        h.options(bogus_option=1)
