@@ -86,6 +86,14 @@ class Config:
     session_dir: str = ""
     #: msgpack/pickle wire chunk size for large transfers.
     transfer_chunk_bytes: int = 8 * 1024 * 1024
+    #: Pull-manager admission budget: total bytes of concurrently
+    #: in-flight inbound object pulls (reference: pull_manager.h retry
+    #: budget). At least one pull is always admitted.
+    max_inflight_pull_bytes: int = 256 << 20
+    #: Fail a pull (and report the stale location) after this long.
+    pull_timeout_s: float = 60.0
+    #: Source-side flow control: max unacked chunks per outbound stream.
+    stream_window_chunks: int = 4
     #: Timeout for control-plane RPCs (s).
     rpc_timeout_s: float = 60.0
 

@@ -160,7 +160,7 @@ class Controller:
         self._shutdown = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._health_thread: Optional[threading.Thread] = None
-        self._transfers: Dict[Tuple[bytes, bytes], bool] = {}  # (object, dest_node) -> in-flight
+        self._transfers: Dict[Tuple[bytes, bytes], int] = {}  # (object, dest_node) -> attempt
 
     # ------------------------------------------------------------------ run
     def start(self) -> None:
@@ -347,6 +347,9 @@ class Controller:
         if m.get("node_id"):
             e.locations.add(m["node_id"])
             e.size = m.get("size", e.size)
+            # transfer (if any) completed: allow future re-pulls to this
+            # node after it frees its copy
+            self._transfers.pop((m["object_id"], m["node_id"]), None)
         if m.get("error") is not None:
             e.error = m["error"]
         self._object_created(m["object_id"])
@@ -413,10 +416,17 @@ class Controller:
                                     "size": e.size})
 
     def _start_transfer(self, object_id_b: bytes, dest_node: bytes) -> None:
-        """Chunked object copy between node stores (equivalent of
-        ObjectManager::Push, object_manager.h:206; routed via the broker)."""
+        """Ask the destination node to pull the object from a holder.
+        The controller hands out the source address ONLY — the bytes move
+        node-to-node over the direct channel (reference: the pull manager
+        lives on the receiving object manager, pull_manager.h:52, and
+        chunks never transit the GCS)."""
+        self._begin_transfer(object_id_b, dest_node, attempt=1)
+
+    def _begin_transfer(self, object_id_b: bytes, dest_node: bytes,
+                        attempt: int) -> None:
         key = (object_id_b, dest_node)
-        if self._transfers.get(key):
+        if key in self._transfers:
             return
         e = self.objects.get(object_id_b)
         if e is None or not e.locations:
@@ -426,16 +436,46 @@ class Controller:
         dest = self.nodes.get(dest_node)
         if src_node is None or dest is None:
             return
-        self._transfers[key] = True
-        self._send(src_node.identity, P.PULL_OBJECT, {
-            "object_id": object_id_b, "dest_node": dest_node,
-            "dest_identity": dest.identity})
+        self._transfers[key] = attempt
+        self._send(dest.identity, P.PULL_OBJECT, {
+            "object_id": object_id_b, "src_identity": src_node.identity,
+            "src_node": src, "size": e.size})
 
-    def _h_push_object(self, identity: bytes, m: dict) -> None:
-        """Relay a push chunk from source node to destination node."""
-        dest = self.nodes.get(m["dest_node"])
-        if dest is not None:
-            self._send(dest.identity, P.PUSH_OBJECT, m)
+    def _h_pull_failed(self, identity: bytes, m: dict) -> None:
+        """A destination node could not pull an object. If the SOURCE
+        reported it missing (stale_src), drop that location; dest-local
+        causes (timeout, store pressure) keep the holder. Retry from a
+        holder up to a cap, then reconstruct via lineage or fail every
+        waiter with ObjectLostError — never leave them hanging."""
+        b = m["object_id"]
+        e = self.objects.get(b)
+        peer = self.peers.get(identity, {})
+        dest_node = peer.get("node_id")
+        attempts = 0
+        if dest_node is not None:
+            attempts = self._transfers.pop((b, dest_node), 0)
+        if e is None:
+            return
+        src = m.get("src_node")
+        if src is not None and m.get("stale_src"):
+            e.locations.discard(src)
+        if dest_node is None:
+            return
+        if e.locations and attempts < 5:
+            self._begin_transfer(b, dest_node, attempts + 1)
+        elif e.lineage_task is not None:
+            self._reconstruct(e)
+        else:
+            self._fail_object_waiters(b, e)
+
+    def _fail_object_waiters(self, b: bytes, e: ObjectEntry) -> None:
+        from ray_tpu.exceptions import ObjectLostError
+        err = P.dumps(ObjectLostError(e.object_id))
+        for identity, rid in self.local_waiters.pop(b, []):
+            self._reply(identity, rid, {"error": err})
+        for tid in list(self.dep_waiters.pop(b, ())):
+            self._handle_task_failure(
+                tid, f"object {ObjectID(b).hex()[:12]} lost in transfer")
 
     def _h_ref_deltas(self, identity: bytes, m: dict) -> None:
         self.refs.apply_deltas(m["deltas"])
@@ -1432,7 +1472,7 @@ class Controller:
         P.ACTOR_ADDR: _h_actor_addr,
         P.PUT_OBJECT: _h_put_object,
         P.GET_LOCATION: _h_get_location,
-        P.PUSH_OBJECT: _h_push_object,
+        P.PULL_FAILED: _h_pull_failed,
         P.REF_DELTAS: _h_ref_deltas,
         P.KV_OP: _h_kv,
         P.EXPORT_FUNCTION: _h_export_function,

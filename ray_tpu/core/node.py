@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 
 import zmq
 
+from ray_tpu.core import direct as D
 from ray_tpu.core import protocol as P
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
@@ -74,9 +75,28 @@ class NodeManager:
         self.sock.setsockopt(zmq.LINGER, 0)
         self.sock.connect(P.socket_path(session_dir))
         self._send_lock = threading.Lock()
+        # direct peer channel: object chunks move node-to-node here and
+        # NEVER transit the controller (reference: object_manager.h:206
+        # pushes between object managers; GCS sees only locations)
+        D.ensure_dir(session_dir)
+        self.direct_sock = self.ctx.socket(zmq.ROUTER)
+        self.direct_sock.setsockopt(zmq.LINGER, 0)
+        self.direct_sock.setsockopt(zmq.SNDHWM, 0)
+        self.direct_sock.setsockopt(zmq.RCVHWM, 0)
+        self.direct_sock.bind(D.direct_addr(session_dir, self.identity))
+        self._peer_socks: Dict[bytes, zmq.Socket] = {}  # loop-thread-only
         self._threads: List[threading.Thread] = []
         self.num_initial_workers = num_initial_workers
         self._incoming: Dict[bytes, dict] = {}
+        # pull manager (reference: pull_manager.h:52): bytes-budgeted
+        # admission so a burst of pulls can't blow out the local store
+        self._pull_queue: List[dict] = []
+        self._pulling: Dict[bytes, dict] = {}   # object_id -> pull state
+        self._pull_bytes_inflight = 0
+        # source-side outbound streams, windowed by receiver acks so a
+        # huge object never sits fully buffered in zmq send queues
+        self._outgoing: Dict[tuple, dict] = {}  # (requester, oid) -> state
+        self._peer_last_used: Dict[bytes, float] = {}
 
     # ------------------------------------------------------------------ run
     def start(self) -> None:
@@ -112,6 +132,10 @@ class NodeManager:
                     pass
         try:
             self.sock.close(0)
+            self.direct_sock.close(0)
+            for s in self._peer_socks.values():
+                s.close(0)
+            self._peer_socks.clear()
         except Exception:
             pass
         self.shm.close()
@@ -125,22 +149,65 @@ class NodeManager:
     def _loop(self) -> None:
         poller = zmq.Poller()
         poller.register(self.sock, zmq.POLLIN)
+        poller.register(self.direct_sock, zmq.POLLIN)
         while not self._stopped.is_set():
             try:
                 events = dict(poller.poll(timeout=1000))
             except zmq.ZMQError:
                 break
-            if self.sock not in events:
-                continue
-            while True:
+            if self.sock in events:
+                while True:
+                    try:
+                        frames = self.sock.recv_multipart(zmq.NOBLOCK)
+                    except zmq.ZMQError:
+                        break
+                    try:
+                        self._handle(frames[0], P.loads(frames[1]))
+                    except Exception:
+                        logger.exception("node: error handling %s", frames[0])
+            if self.direct_sock in events:
+                while True:
+                    try:
+                        frames = self.direct_sock.recv_multipart(zmq.NOBLOCK)
+                    except zmq.ZMQError:
+                        break
+                    try:
+                        # [sender identity, mtype, payload]
+                        self._handle_direct(frames[0], frames[1],
+                                            P.loads(frames[2]))
+                    except Exception:
+                        logger.exception("node: error in direct %s",
+                                         frames[1])
+            self._check_pull_timeouts()
+
+    def _peer_sock(self, target: bytes) -> "zmq.Socket":
+        """Loop-thread-only: lazily connected DEALER to a peer node's
+        direct ROUTER."""
+        s = self._peer_socks.get(target)
+        if s is None:
+            s = self.ctx.socket(zmq.DEALER)
+            s.setsockopt(zmq.IDENTITY, self.identity)
+            s.setsockopt(zmq.LINGER, 0)
+            s.setsockopt(zmq.SNDHWM, 0)
+            s.connect(D.direct_addr(self.session_dir, target))
+            self._peer_socks[target] = s
+        self._peer_last_used[target] = time.monotonic()
+        return s
+
+    def _send_direct(self, target: bytes, mtype: bytes, payload) -> None:
+        self._peer_sock(target).send_multipart([mtype, P.dumps(payload)])
+
+    def _prune_peer_socks(self, idle_s: float = 120.0) -> None:
+        now = time.monotonic()
+        for target in [t for t, used in self._peer_last_used.items()
+                       if now - used > idle_s]:
+            self._peer_last_used.pop(target, None)
+            s = self._peer_socks.pop(target, None)
+            if s is not None:
                 try:
-                    frames = self.sock.recv_multipart(zmq.NOBLOCK)
-                except zmq.ZMQError:
-                    break
-                try:
-                    self._handle(frames[0], P.loads(frames[1]))
+                    s.close(0)
                 except Exception:
-                    logger.exception("node: error handling %s", frames[0])
+                    pass
 
     def _handle(self, mtype: bytes, m: dict) -> None:
         if mtype == P.MSG_BATCH:
@@ -158,9 +225,7 @@ class NodeManager:
             self.shm.release(oid)
             self.store.delete(oid)
         elif mtype == P.PULL_OBJECT:
-            self._push_object(m)
-        elif mtype == P.PUSH_OBJECT:
-            self._receive_push(m)
+            self._enqueue_pull(m)
         elif mtype == P.CANCEL_TASK:
             pid = m.get("pid")
             if pid:
@@ -239,44 +304,188 @@ class NodeManager:
                 "node_id": self.node_id.binary(), "stats": stats})
 
     # ----------------------------------------------------------- transfers
-    def _push_object(self, m: dict) -> None:
-        """Source side of a transfer: stream local object to dest node."""
-        oid = ObjectID(m["object_id"])
+    # Receiving side drives (reference: pull_manager.h:52 — the puller
+    # admits work against a byte budget); the controller only names the
+    # source. Chunks ride the direct node-to-node channel.
+    def _handle_direct(self, sender: bytes, mtype: bytes, m: dict) -> None:
+        if mtype == P.PULL_REQUEST:
+            self._start_stream(sender, m)
+        elif mtype == P.PUSH_OBJECT:
+            self._receive_push(sender, m)
+        elif mtype == P.CHUNK_ACK:
+            self._on_chunk_ack(sender, m)
+        elif mtype == P.PULL_FAILED:
+            # the SOURCE says the object is gone there: stale location
+            self._pull_failed(m["object_id"], m.get("src_node"),
+                              stale_src=True)
+
+    def _enqueue_pull(self, m: dict) -> None:
+        b = m["object_id"]
+        if b in self._pulling or self.store.contains(ObjectID(b)):
+            return
+        self._pull_queue.append(m)
+        self._drain_pull_queue()
+
+    def _drain_pull_queue(self) -> None:
+        budget = self.config.max_inflight_pull_bytes
+        while self._pull_queue:
+            m = self._pull_queue[0]
+            size = max(1, int(m.get("size") or 1))
+            if self._pulling and \
+                    self._pull_bytes_inflight + size > budget:
+                return  # admission: wait for an in-flight pull to finish
+            self._pull_queue.pop(0)
+            b = m["object_id"]
+            if b in self._pulling or self.store.contains(ObjectID(b)):
+                continue
+            self._pulling[b] = {
+                "src_identity": m["src_identity"], "src_node": m.get("src_node"),
+                "size": size, "deadline": time.monotonic() +
+                self.config.pull_timeout_s}
+            self._pull_bytes_inflight += size
+            self._send_direct(m["src_identity"], P.PULL_REQUEST,
+                              {"object_id": b})
+
+    def _finish_pull(self, b: bytes) -> None:
+        st = self._pulling.pop(b, None)
+        if st is not None:
+            self._pull_bytes_inflight -= st["size"]
+        self._drain_pull_queue()
+
+    def _abort_incoming(self, b: bytes) -> None:
+        """Drop a partial in-flight assembly so a later retry can create
+        the allocation afresh (a half-written unsealed extent would make
+        every retry fail at shm.create)."""
+        st = self._incoming.pop(b, None)
+        if st is not None:
+            oid = ObjectID(b)
+            try:
+                self.shm.release(oid)
+            except Exception:
+                pass
+            try:
+                self.shm.delete(oid)
+            except Exception:
+                pass
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass
+
+    def _pull_failed(self, b: bytes, src_node, stale_src: bool) -> None:
+        if b not in self._pulling and b not in self._incoming:
+            return  # late failure for a pull already finished/aborted
+        self._abort_incoming(b)
+        self._finish_pull(b)
+        # stale_src=True only when the SOURCE reported the object missing;
+        # dest-local causes (timeout, store pressure) must not make the
+        # controller discard a perfectly good holder
+        self._send(P.PULL_FAILED, {"object_id": b, "src_node": src_node,
+                                   "stale_src": stale_src})
+
+    def _check_pull_timeouts(self) -> None:
+        now = time.monotonic()
+        if self._pulling:
+            for b, st in list(self._pulling.items()):
+                if now > st["deadline"]:
+                    logger.warning("pull of %s timed out",
+                                   ObjectID(b).hex()[:12])
+                    self._pull_failed(b, st.get("src_node"),
+                                      stale_src=False)
+        if self._outgoing:
+            for key, st in list(self._outgoing.items()):
+                if now - st["last_activity"] > self.config.pull_timeout_s:
+                    self._close_stream(key)
+        self._prune_peer_socks()
+
+    # Source side (reference: ObjectManager::Push): windowed streaming —
+    # at most stream_window_chunks unacked chunks per stream, so a huge
+    # object never sits fully buffered in the sender's zmq queue and the
+    # loop thread is never blocked for the whole object.
+    def _start_stream(self, requester: bytes, m: dict) -> None:
+        b = m["object_id"]
+        oid = ObjectID(b)
         self.store.maybe_restore(oid)
         view = self.shm.get_view(oid, timeout=2.0)
         if view is None:
             logger.warning("pull for missing object %s", oid.hex()[:12])
+            self._send_direct(requester, P.PULL_FAILED, {
+                "object_id": b, "src_node": self.node_id.binary()})
             return
         chunk = self.config.transfer_chunk_bytes
         total = len(view)
-        nchunks = max(1, (total + chunk - 1) // chunk)
-        for i in range(nchunks):
-            part = bytes(view[i * chunk:(i + 1) * chunk])
-            self._send(P.PUSH_OBJECT, {
-                "object_id": m["object_id"], "dest_node": m["dest_node"],
-                "seq": i, "nchunks": nchunks, "total": total, "data": part})
-        self.shm.release(oid)
+        st = {
+            "oid": oid, "view": view, "total": total,
+            "nchunks": max(1, (total + chunk - 1) // chunk),
+            "next_seq": 0, "unacked": 0,
+            "last_activity": time.monotonic(),
+        }
+        self._outgoing[(requester, b)] = st
+        self._pump_stream(requester, b, st)
 
-    def _receive_push(self, m: dict) -> None:
+    def _pump_stream(self, requester: bytes, b: bytes, st: dict) -> None:
+        chunk = self.config.transfer_chunk_bytes
+        window = self.config.stream_window_chunks
+        while st["next_seq"] < st["nchunks"] and st["unacked"] < window:
+            i = st["next_seq"]
+            part = bytes(st["view"][i * chunk:(i + 1) * chunk])
+            self._send_direct(requester, P.PUSH_OBJECT, {
+                "object_id": b, "seq": i, "nchunks": st["nchunks"],
+                "total": st["total"], "data": part})
+            st["next_seq"] += 1
+            st["unacked"] += 1
+        st["last_activity"] = time.monotonic()
+        if st["next_seq"] >= st["nchunks"] and st["unacked"] <= 0:
+            self._close_stream((requester, b))
+
+    def _on_chunk_ack(self, sender: bytes, m: dict) -> None:
+        key = (sender, m["object_id"])
+        st = self._outgoing.get(key)
+        if st is None:
+            return
+        st["unacked"] -= m.get("n", 1)
+        self._pump_stream(sender, m["object_id"], st)
+
+    def _close_stream(self, key: tuple) -> None:
+        st = self._outgoing.pop(key, None)
+        if st is not None:
+            self.shm.release(st["oid"])
+
+    def _receive_push(self, sender: bytes, m: dict) -> None:
         """Destination side: assemble chunks, seal, announce location."""
         b = m["object_id"]
         oid = ObjectID(b)
+        # flow control: ack regardless of outcome so the source's window
+        # drains even for duplicate/late chunks
+        self._send_direct(sender, P.CHUNK_ACK, {"object_id": b, "n": 1})
         if self.store.contains(oid):
+            self._finish_pull(b)
+            return
+        pull = self._pulling.get(b)
+        if pull is None or sender != pull["src_identity"]:
+            # no active pull from this source (it timed out / was retried
+            # from elsewhere): ignoring the chunk also prevents orphan
+            # partial allocations nobody would ever complete
             return
         st = self._incoming.get(b)
         if st is None:
             view = self.shm.create(oid, m["total"])
-            st = {"view": view, "received": 0}
+            st = {"view": view, "seqs": set()}
             self._incoming[b] = st
         chunk = self.config.transfer_chunk_bytes
         off = m["seq"] * chunk
         data = m["data"]
         st["view"][off:off + len(data)] = data
-        st["received"] += 1
-        if st["received"] >= m["nchunks"]:
+        # distinct-seq tracking: duplicate deliveries (source retry after
+        # a timeout race) must not count toward completion
+        st["seqs"].add(m["seq"])
+        if pull is not None:
+            pull["deadline"] = time.monotonic() + self.config.pull_timeout_s
+        if len(st["seqs"]) >= m["nchunks"]:
             self.shm.seal(oid)
             self.store.on_sealed(oid, m["total"])
             del self._incoming[b]
+            self._finish_pull(b)
             self._send(P.PUT_OBJECT, {
                 "object_id": b, "node_id": self.node_id.binary(),
                 "size": m["total"]})

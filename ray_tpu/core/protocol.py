@@ -54,8 +54,11 @@ TASK_HANDBACK = b"HBK"       # worker->controller {specs: [...]}
 PUT_OBJECT = b"PUT"          # seal notification {object_id, node_id, size, owner}
 FREE_OBJECT = b"FRE"         # controller->node {object_id}
 GET_LOCATION = b"LOC"        # {object_id} -> {node_id|None, inline|None}
-PULL_OBJECT = b"PUL"         # node->node via controller: request transfer
-PUSH_OBJECT = b"PSH"         # chunked object payload
+PULL_OBJECT = b"PUL"         # controller->dest node: pull this object
+PULL_REQUEST = b"PRQ"        # dest->src node DIRECT: stream it to me
+PUSH_OBJECT = b"PSH"         # src->dest node DIRECT: chunked payload
+PULL_FAILED = b"PLF"         # src->dest direct / dest->controller: pull failed
+CHUNK_ACK = b"CAK"           # dest->src DIRECT: chunk received (flow control)
 REF_DELTAS = b"RFD"          # {deltas: {bytes: int}}
 # kv / functions
 KV_OP = b"KVO"               # {op: put|get|del|keys|exists, ns, key, value}

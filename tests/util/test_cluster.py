@@ -25,3 +25,45 @@ def test_cluster_utils_multi_node():
         assert node_id != head_id
     finally:
         cluster.shutdown()
+
+
+def test_p2p_object_transfer_bypasses_controller():
+    """A large object produced on one node and consumed on another moves
+    peer-to-peer over the nodes' direct channels (reference:
+    object_manager.h:206) — the controller has no PUSH_OBJECT route at
+    all, so bytes cannot transit it."""
+    import ray_tpu.core.protocol as P
+    from ray_tpu.core.controller import Controller
+    from ray_tpu.cluster_utils import Cluster
+
+    # the broker must not even have a handler for chunk frames
+    assert not hasattr(Controller, "_h_push_object")
+
+    cluster = Cluster(head_node_args={"num_cpus": 2,
+                                      "_num_initial_workers": 1})
+    try:
+        cluster.add_node(num_cpus=2, resources={"side": 1})
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote(resources={"side": 1})
+        def produce(n):
+            import numpy as np
+            return np.full((n,), 7, dtype=np.uint8)
+
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        head_id = ray_tpu.get_runtime_context().get_node_id()
+
+        @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=head_id, soft=False))
+        def consume(arr):
+            got = ray_tpu.get_runtime_context().get_node_id()
+            return int(arr[0]) + int(arr[-1]), arr.nbytes, got
+
+        # 64 MiB crosses node boundaries through the pull manager
+        ref = produce.remote(64 << 20)
+        out, nbytes, where = ray_tpu.get(consume.remote(ref), timeout=180)
+        assert out == 14 and nbytes == 64 << 20
+        assert where == head_id  # really consumed on the other node
+    finally:
+        cluster.shutdown()
