@@ -14,6 +14,7 @@ from __future__ import annotations
 import atexit
 import inspect
 import json
+import logging
 import os
 import time
 import uuid
@@ -41,8 +42,23 @@ class _HeadProcess:
                                 num_initial_workers=num_initial_workers,
                                 config=config)
         self.node.start()
+        self.dashboard = None
+        if config.dashboard_enabled:
+            try:
+                from ray_tpu.dashboard.head import DashboardHead
+                self.dashboard = DashboardHead(
+                    session_dir, self.controller,
+                    port=config.dashboard_port)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "dashboard failed to start; continuing without it")
 
     def stop(self):
+        try:
+            if self.dashboard is not None:
+                self.dashboard.stop()
+        except Exception:
+            pass
         try:
             self.node.stop()
         finally:

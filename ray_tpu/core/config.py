@@ -94,6 +94,12 @@ class Config:
     pull_timeout_s: float = 60.0
     #: Source-side flow control: max unacked chunks per outbound stream.
     stream_window_chunks: int = 4
+
+    # --- dashboard / job REST (reference: dashboard/head.py) ---
+    dashboard_enabled: bool = True
+    #: 0 picks an ephemeral port; the chosen address is written to
+    #: <session_dir>/dashboard.json.
+    dashboard_port: int = 0
     #: Timeout for control-plane RPCs (s).
     rpc_timeout_s: float = 60.0
 

@@ -117,6 +117,7 @@ class Controller:
         self._wake_send = self.ctx.socket(zmq.PUSH)
         self._wake_send.connect(f"inproc://ctl-wake-{id(self)}")
         self._send_q: Deque[Tuple[bytes, bytes, bytes]] = collections.deque()
+        self._call_q: Deque = collections.deque()  # marshaled loop calls
         self._send_lock = threading.Lock()
         # per-peer outbox for loop-thread sends: flushed once per event-loop
         # cycle as MSG_BATCH frames — amortizes pickling + syscalls over a
@@ -197,6 +198,7 @@ class Controller:
                     except zmq.ZMQError:
                         break
             self._drain_sends()
+            self._drain_calls()
             if self.sock in events:
                 for _ in range(1000):
                     try:
@@ -216,6 +218,47 @@ class Controller:
             self._wake_send.close(0)
         except Exception:
             pass
+
+    def call_on_loop(self, fn, timeout: float = 10.0):
+        """Run ``fn()`` on the controller loop thread and return its
+        result. All controller state is owned by that single thread
+        (mirroring the GCS's one io_context) — cross-thread readers like
+        the dashboard must marshal through here rather than iterate live
+        dicts."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        done = threading.Event()
+        box: list = [None, None]
+
+        def run():
+            try:
+                box[0] = fn()
+            except BaseException as e:  # noqa: BLE001
+                box[1] = e
+            done.set()
+
+        with self._send_lock:
+            self._call_q.append(run)
+        try:
+            self._wake_send.send(b"", zmq.NOBLOCK)
+        except zmq.ZMQError:
+            pass
+        if not done.wait(timeout):
+            raise TimeoutError("controller loop busy")
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
+
+    def _drain_calls(self) -> None:
+        while self._call_q:
+            try:
+                run = self._call_q.popleft()
+            except IndexError:
+                break
+            try:
+                run()
+            except Exception:
+                logger.exception("controller: error in marshaled call")
 
     def _send(self, identity: bytes, mtype: bytes, payload: Any) -> None:
         """Thread-safe send. Loop-thread sends are buffered per peer and

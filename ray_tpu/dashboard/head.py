@@ -1,0 +1,181 @@
+"""Dashboard head: JSON-over-HTTP API in the head process.
+
+Reference: ``python/ray/dashboard/head.py:81`` (aiohttp app aggregating
+module routes) + ``modules/job/job_head.py`` (the /api/jobs/ REST
+surface the Job SDK talks to). Route shapes match the reference's job
+API so a reference SDK user finds the same contract; cluster state comes
+straight from the controller's state tables instead of per-node agents.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import urlparse
+
+from ray_tpu.dashboard.job_manager import JobManager
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardHead:
+    def __init__(self, session_dir: str, controller, port: int = 0):
+        self.session_dir = session_dir
+        self.controller = controller
+        self.job_manager = JobManager(session_dir)
+        handler = _make_handler(self)
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.address = f"http://127.0.0.1:{self.server.server_address[1]}"
+        try:
+            # discoverable by external clients / the CLI (reference analog:
+            # the dashboard URL recorded in the GCS + ray.init() banner);
+            # written BEFORE serving so a failure here can't leak a live
+            # server with no handle to stop it
+            with open(os.path.join(session_dir, "dashboard.json"), "w") as f:
+                json.dump({"address": self.address}, f)
+        except Exception:
+            self.server.server_close()
+            raise
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name="dashboard-http",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.job_manager.shutdown()
+        try:
+            self.server.shutdown()
+            self.server.server_close()
+        except Exception:
+            pass
+
+    # ----------------------------------------------------- cluster state
+    def cluster_status(self) -> dict:
+        # controller state is single-thread-owned: snapshot on its loop
+        return self.controller.call_on_loop(self._cluster_status_locked)
+
+    def _cluster_status_locked(self) -> dict:
+        c = self.controller
+        nodes = []
+        for node in c.nodes.values():
+            nodes.append({
+                "node_id": node.node_id.hex(),
+                "alive": node.alive,
+                "resources_total": dict(node.resources.total),
+                "resources_available": dict(node.resources.available),
+                "num_workers": len(node.all_workers),
+            })
+        states: dict = {}
+        for row in c.task_table.values():
+            states[row.get("state", "?")] = \
+                states.get(row.get("state", "?"), 0) + 1
+        return {
+            "nodes": nodes,
+            "num_actors": len(c.actors),
+            "num_objects": len(c.objects),
+            "task_states": states,
+            "num_pending_tasks": len(c.tasks),
+        }
+
+
+def _make_handler(head: DashboardHead):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            logger.debug("dashboard: " + fmt, *args)
+
+        # -- helpers --
+        def _json(self, obj: Any, code: int = 200) -> None:
+            blob = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def _text(self, text: str, code: int = 200) -> None:
+            blob = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length") or 0)
+            if not n:
+                return {}
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        def _job_id_from(self, path: str) -> Optional[str]:
+            parts = [p for p in path.split("/") if p]
+            # /api/jobs/<id>[/logs|/stop]
+            return parts[2] if len(parts) >= 3 else None
+
+        # -- routes --
+        def do_GET(self):
+            path = urlparse(self.path).path.rstrip("/")
+            try:
+                if path == "/api/jobs":
+                    self._json(head.job_manager.list_jobs())
+                elif path == "/api/version":
+                    from ray_tpu import __version__
+                    self._json({"version": __version__,
+                                "ray_tpu_session": head.session_dir})
+                elif path == "/api/cluster_status":
+                    self._json(head.cluster_status())
+                elif path.startswith("/api/jobs/") and path.endswith("/logs"):
+                    jid = self._job_id_from(path)
+                    if head.job_manager.get_job_info(jid) is None:
+                        self._json({"error": f"job {jid!r} not found"}, 404)
+                    else:
+                        self._json(
+                            {"logs": head.job_manager.get_job_logs(jid)})
+                elif path.startswith("/api/jobs/"):
+                    jid = self._job_id_from(path)
+                    info = head.job_manager.get_job_info(jid)
+                    if info is None:
+                        self._json({"error": f"job {jid!r} not found"}, 404)
+                    else:
+                        self._json(info)
+                else:
+                    self._json({"error": "not found"}, 404)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("dashboard GET %s", path)
+                self._json({"error": str(e)}, 500)
+
+        def do_POST(self):
+            path = urlparse(self.path).path.rstrip("/")
+            try:
+                if path == "/api/jobs":
+                    body = self._body()
+                    if not body.get("entrypoint"):
+                        self._json({"error": "entrypoint is required"}, 400)
+                        return
+                    jid = head.job_manager.submit_job(
+                        entrypoint=body["entrypoint"],
+                        submission_id=body.get("submission_id"),
+                        metadata=body.get("metadata"),
+                        runtime_env=body.get("runtime_env"))
+                    self._json({"submission_id": jid})
+                elif path.startswith("/api/jobs/") and path.endswith("/stop"):
+                    jid = self._job_id_from(path)
+                    try:
+                        stopped = head.job_manager.stop_job(jid)
+                        self._json({"stopped": stopped})
+                    except KeyError:
+                        self._json({"error": f"job {jid!r} not found"}, 404)
+                else:
+                    self._json({"error": "not found"}, 404)
+            except ValueError as e:
+                self._json({"error": str(e)}, 400)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("dashboard POST %s", path)
+                self._json({"error": str(e)}, 500)
+
+    return Handler

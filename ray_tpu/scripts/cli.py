@@ -155,6 +155,49 @@ def cmd_microbenchmark(args) -> None:
     perf_main()
 
 
+def _job_client(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    addr = getattr(args, "address", None)
+    if addr is None and not os.environ.get("RAY_TPU_DASHBOARD_ADDRESS"):
+        os.environ.setdefault("RAY_TPU_SESSION_DIR", _default_address())
+    return JobSubmissionClient(addr)
+
+
+def cmd_job(args) -> None:
+    """`ray-tpu job ...` — REST job API (reference: `ray job` CLI,
+    dashboard/modules/job/cli.py)."""
+    import shlex
+    client = _job_client(args)
+    if args.job_cmd == "submit":
+        jid = client.submit_job(
+            # shlex.join keeps each argv element intact through the job
+            # manager's `sh -c` re-parse (plain join would corrupt
+            # arguments with spaces/quotes)
+            entrypoint=shlex.join(args.entrypoint),
+            runtime_env=json.loads(args.runtime_env)
+            if args.runtime_env else None)
+        print(jid)
+        if not args.no_wait:
+            try:
+                status = client.wait_until_status(
+                    jid, timeout_s=args.timeout)
+            except TimeoutError:
+                print(f"Job {jid} still running after {args.timeout}s "
+                      f"(check later with `ray-tpu job status {jid}`)")
+                raise SystemExit(2)
+            sys.stdout.write(client.get_job_logs(jid))
+            print(f"Job {jid}: {status}")
+            raise SystemExit(0 if status == "SUCCEEDED" else 1)
+    elif args.job_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2, default=str))
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.submission_id))
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.submission_id))
+
+
 def main() -> None:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -201,6 +244,23 @@ def main() -> None:
 
     sp = sub.add_parser("microbenchmark", help="core perf suite")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("job", help="job submission REST API")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    jp = jsub.add_parser("submit", help="submit an entrypoint command")
+    jp.add_argument("entrypoint", nargs="+")
+    jp.add_argument("--address", default=None)
+    jp.add_argument("--runtime-env", default=None,
+                    help='JSON, e.g. {"env_vars": {"K": "V"}}')
+    jp.add_argument("--no-wait", action="store_true")
+    jp.add_argument("--timeout", type=float, default=600.0)
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("submission_id")
+        jp.add_argument("--address", default=None)
+    jp = jsub.add_parser("list")
+    jp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_job)
 
     args = p.parse_args()
     args.fn(args)

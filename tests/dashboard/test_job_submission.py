@@ -1,0 +1,84 @@
+"""Job REST API end-to-end (reference: dashboard/modules/job/tests/
+test_job_manager.py shapes: submit → poll status → fetch logs → stop)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=2, _num_initial_workers=1,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _dashboard_address(info):
+    with open(os.path.join(info["session_dir"], "dashboard.json")) as f:
+        return json.load(f)["address"]
+
+
+def test_job_submit_end_to_end(cluster, tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+    addr = _dashboard_address(cluster)
+    client = JobSubmissionClient(addr)
+
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 3\n"
+        "print('answer:', ray_tpu.get(f.remote(14), timeout=120))\n"
+        "ray_tpu.shutdown()\n")
+    jid = client.submit_job(
+        entrypoint=f"python {script}",
+        metadata={"team": "tpu"},
+        runtime_env={"env_vars": {"JOB_TEST_VAR": "yes"}})
+    status = client.wait_until_status(jid, timeout_s=180)
+    logs = client.get_job_logs(jid)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "answer: 42" in logs
+    info = client.get_job_info(jid)
+    assert info["metadata"] == {"team": "tpu"}
+    assert info["driver_exit_code"] == 0
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == jid for j in jobs)
+
+
+def test_job_failure_and_stop(cluster, tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+    client = JobSubmissionClient(_dashboard_address(cluster))
+
+    jid = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    assert client.wait_until_status(jid, timeout_s=60) == JobStatus.FAILED
+    assert client.get_job_info(jid)["driver_exit_code"] == 3
+
+    jid2 = client.submit_job(entrypoint="sleep 600")
+    deadline = time.time() + 30
+    while client.get_job_status(jid2) == JobStatus.PENDING \
+            and time.time() < deadline:
+        time.sleep(0.1)
+    assert client.stop_job(jid2) is True
+    assert client.wait_until_status(jid2, timeout_s=30) == JobStatus.STOPPED
+    # stopping a terminal job is a no-op
+    assert client.stop_job(jid2) is False
+    # unknown job -> 404 surfaced as error
+    with pytest.raises(RuntimeError):
+        client.get_job_info("nope")
+
+
+def test_cluster_status_endpoint(cluster):
+    addr = _dashboard_address(cluster)
+    with urllib.request.urlopen(addr + "/api/cluster_status",
+                                timeout=10) as resp:
+        out = json.loads(resp.read())
+    assert out["nodes"] and out["nodes"][0]["alive"]
+    assert "resources_total" in out["nodes"][0]
