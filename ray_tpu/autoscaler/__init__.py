@@ -1,0 +1,16 @@
+"""Autoscaler: scale node pools to pending demand.
+
+Reference: ``python/ray/autoscaler/`` (v1 StandardAutoscaler + providers).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerMonitor, NodeTypeConfig, StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+
+__all__ = [
+    "AutoscalerMonitor",
+    "FakeNodeProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+    "StandardAutoscaler",
+]

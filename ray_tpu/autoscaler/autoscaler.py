@@ -1,0 +1,221 @@
+"""StandardAutoscaler: demand-driven scale-up, idle-driven scale-down.
+
+Reference: ``python/ray/autoscaler/_private/autoscaler.py:172``
+(StandardAutoscaler.update: read LoadMetrics, bin-pack pending demand
+onto available node types, launch up to max, terminate idle) and
+``_private/monitor.py:126`` (the loop driving update). Differences by
+design: demand comes straight from the controller's ready queues and
+pending placement groups (single scheduling authority — no LoadMetrics
+gossip), and utilization joins on NodeID instead of ip addresses.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+class NodeTypeConfig:
+    """One scalable node flavor (reference: available_node_types entries
+    in the cluster YAML)."""
+
+    def __init__(self, name: str, resources: Dict[str, float],
+                 min_workers: int = 0, max_workers: int = 10):
+        self.name = name
+        self.resources = dict(resources)
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+
+
+def _fits(node_resources: Dict[str, float],
+          demand: Dict[str, float]) -> bool:
+    return all(node_resources.get(k, 0.0) >= v
+               for k, v in demand.items() if v > 0)
+
+
+class StandardAutoscaler:
+    def __init__(self, controller, provider: NodeProvider,
+                 node_types: List[NodeTypeConfig],
+                 idle_timeout_s: float = 60.0,
+                 max_launch_batch: int = 5):
+        self.controller = controller
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self.idle_timeout_s = idle_timeout_s
+        self.max_launch_batch = max_launch_batch
+        self._idle_since: Dict[str, float] = {}  # provider node id -> ts
+
+    # ------------------------------------------------------------ update
+    def update(self) -> Dict[str, Any]:
+        """One reconcile pass; returns what it did (for tests/monitor
+        logs). Reference: StandardAutoscaler.update."""
+        snap = self.controller.call_on_loop(self._snapshot)
+        launched = self._scale_up(snap)
+        terminated = self._scale_down(snap)
+        return {"launched": launched, "terminated": terminated,
+                "pending_demand": len(snap["demand"])}
+
+    def _snapshot(self) -> dict:
+        """Controller-loop-thread: pending demand + per-node busyness."""
+        c = self.controller
+        demand: List[Dict[str, float]] = []
+        for key, q in c.ready_queues.items():
+            for tid in q:
+                t = c.tasks.get(tid)
+                if t is not None and t.state == "QUEUED":
+                    demand.append(c._sched_res(t.spec))
+        for _, spec in c.pending_pgs:
+            demand.extend(b.resources for b in spec.bundles)
+        busy_nodes = set()
+        for lease in c.leases.values():
+            busy_nodes.add(lease.node_b)
+        for info in c.actors.values():
+            if info.state != "DEAD" and info.node_id is not None:
+                busy_nodes.add(info.node_id.binary())
+        alive = {nb for nb, n in c.nodes.items() if n.alive}
+        return {"demand": demand, "busy_nodes": busy_nodes,
+                "alive_nodes": alive}
+
+    def _provider_nodes_by_type(self) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {name: [] for name in self.node_types}
+        for nid in self.provider.non_terminated_nodes():
+            out.setdefault(self.provider.node_type(nid), []).append(nid)
+        return out
+
+    def _scale_up(self, snap: dict) -> List[str]:
+        """First-fit bin-pack unplaceable demand onto hypothetical new
+        nodes (reference: resource_demand_scheduler.get_nodes_to_launch,
+        simplified to first-fit like its binpacking core)."""
+        by_type = self._provider_nodes_by_type()
+        launched: List[str] = []
+        # eagerly maintain min_workers (reference: the autoscaler launches
+        # to min_workers even with zero demand)
+        for t in self.node_types.values():
+            while len(by_type.get(t.name, ())) + \
+                    sum(1 for x in launched
+                        if self.provider.node_type(x) == t.name) \
+                    < t.min_workers:
+                launched.append(self.provider.create_node(
+                    t.name, t.resources))
+        demand = [d for d in snap["demand"] if d]
+        if not demand:
+            return launched
+        planned: List[NodeTypeConfig] = []
+        # capacity already launched but not yet registered (starting
+        # nodes are invisible to the scheduler, so queued demand they
+        # will absorb must not trigger duplicate launches)
+        planned_room: List[Dict[str, float]] = [
+            dict(self.provider.node_resources(nid))
+            for nids in by_type.values() for nid in nids
+            if self.provider.internal_id(nid) not in snap["alive_nodes"]]
+        for d in demand:
+            placed = False
+            for room in planned_room:
+                if _fits(room, d):
+                    for k, v in d.items():
+                        room[k] = room.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in self.node_types.values():
+                existing = len(by_type.get(t.name, ()))
+                already = sum(1 for p in planned if p.name == t.name)
+                if existing + already >= t.max_workers:
+                    continue
+                if _fits(t.resources, d):
+                    planned.append(t)
+                    room = dict(t.resources)
+                    for k, v in d.items():
+                        room[k] = room.get(k, 0.0) - v
+                    planned_room.append(room)
+                    break
+            # demand no type can satisfy is skipped (the reference logs
+            # an infeasible warning; scheduler keeps it queued)
+        for t in planned[:self.max_launch_batch]:
+            nid = self.provider.create_node(t.name, t.resources)
+            logger.info("autoscaler: launched %s (%s)", nid, t.name)
+            launched.append(nid)
+        return launched
+
+    def _scale_down(self, snap: dict) -> List[str]:
+        now = time.monotonic()
+        terminated = []
+        by_type = self._provider_nodes_by_type()
+        for t in self.node_types.values():
+            nodes = by_type.get(t.name, [])
+            for nid in nodes:
+                internal = self.provider.internal_id(nid)
+                joined = internal in snap["alive_nodes"]
+                busy = internal in snap["busy_nodes"]
+                if busy or not joined:
+                    # not-yet-joined nodes are starting up, not idle
+                    self._idle_since.pop(nid, None)
+                    continue
+                since = self._idle_since.setdefault(nid, now)
+                if now - since < self.idle_timeout_s:
+                    continue
+                if len(nodes) - len([x for x in terminated
+                                     if x in nodes]) <= t.min_workers:
+                    continue
+                # drain atomically on the controller loop: mark the node
+                # unschedulable iff still idle there (reference: DrainNode
+                # precedes termination) — closes the race where a lease
+                # lands between our snapshot and the SIGTERM
+                if not self.controller.call_on_loop(
+                        lambda b=internal: self._drain_if_idle(b)):
+                    self._idle_since.pop(nid, None)
+                    continue
+                logger.info("autoscaler: terminating idle node %s", nid)
+                self.provider.terminate_node(nid)
+                self._idle_since.pop(nid, None)
+                terminated.append(nid)
+        return terminated
+
+    def _drain_if_idle(self, node_b: bytes) -> bool:
+        """Controller-loop-thread: mark draining unless work holds the
+        node. Returns True when the node is safe to terminate."""
+        from ray_tpu.core.ids import NodeID
+        c = self.controller
+        busy = any(l.node_b == node_b for l in c.leases.values()) or any(
+            info.state != "DEAD" and info.node_id is not None
+            and info.node_id.binary() == node_b
+            for info in c.actors.values())
+        if busy:
+            return False
+        c.scheduler.set_draining(NodeID(node_b), True)
+        return True
+
+
+class AutoscalerMonitor:
+    """Background loop driving update() (reference: monitor.py:126)."""
+
+    def __init__(self, autoscaler: StandardAutoscaler,
+                 interval_s: float = 5.0):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscaler-monitor", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.autoscaler.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

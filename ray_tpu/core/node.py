@@ -534,11 +534,16 @@ def main() -> None:
     p.add_argument("--resources", default="{}")
     p.add_argument("--labels", default="{}")
     p.add_argument("--initial-workers", type=int, default=0)
+    p.add_argument("--node-id", default=None,
+                   help="hex NodeID (autoscaler providers pre-assign one "
+                        "to join provider inventory with cluster state)")
     args = p.parse_args()
     import json
     res = detect_resources(args.num_cpus, args.num_tpus,
                            json.loads(args.resources))
     nm = NodeManager(args.session_dir, res, labels=json.loads(args.labels),
+                     node_id=NodeID.from_hex(args.node_id)
+                     if args.node_id else None,
                      num_initial_workers=args.initial_workers)
     nm.start()
     nm.run_forever()

@@ -38,7 +38,8 @@ EPS = 1e-9
 
 
 class NodeResources:
-    __slots__ = ("node_id", "total", "available", "labels", "alive", "idle")
+    __slots__ = ("node_id", "total", "available", "labels", "alive", "idle",
+                 "draining")
 
     def __init__(self, node_id: NodeID, total: Dict[str, float],
                  labels: Optional[Dict[str, str]] = None):
@@ -48,6 +49,9 @@ class NodeResources:
         self.labels = labels or {}
         self.alive = True
         self.idle = True
+        #: autoscaler is about to terminate this node: place nothing new
+        #: (reference: DrainNode RPC before termination, node_manager.cc)
+        self.draining = False
 
     def feasible(self, demand: Dict[str, float]) -> bool:
         return all(self.total.get(k, 0.0) + EPS >= v for k, v in demand.items())
@@ -127,7 +131,13 @@ class ClusterResourceScheduler:
             return self._pick_hybrid(demand)
 
     def _alive_nodes(self) -> List[NodeResources]:
-        return [n for n in self.nodes.values() if n.alive]
+        return [n for n in self.nodes.values() if n.alive and not n.draining]
+
+    def set_draining(self, node_id: NodeID, draining: bool) -> None:
+        with self._lock:
+            n = self.nodes.get(node_id)
+            if n is not None:
+                n.draining = draining
 
     def _acquire(self, node: NodeResources, demand: Dict[str, float]) -> Optional[NodeID]:
         return node.node_id if node.acquire(demand) else None
