@@ -163,6 +163,77 @@ class Controller:
         self._health_thread: Optional[threading.Thread] = None
         self._transfers: Dict[Tuple[bytes, bytes], int] = {}  # (object, dest_node) -> attempt
 
+        # durable state (reference: gcs store client + redis tables);
+        # everything not recovered here is re-announced via RECONNECT
+        from ray_tpu.core.persistence import ControllerStore
+        self.store = ControllerStore(session_dir)
+        #: incarnation id: peers re-announce AT MOST ONCE per controller
+        #: generation (a second RECONNECT for the same generation must not
+        #: double-apply absolute refcounts or resubmit tasks twice)
+        self.generation = os.urandom(8)
+        self._reconnect_sent: Dict[bytes, float] = {}
+        #: worker re-registrations that raced ahead of their node's
+        #: re-registration; replayed when the node arrives
+        self._orphan_workers: Dict[bytes, List[Tuple[bytes, dict]]] = \
+            collections.defaultdict(list)
+        self._started_at = time.monotonic()
+        self._recovered_actors: Set[bytes] = set()
+        self._recover()
+
+    # ------------------------------------------------- durable state
+    def _durable_state(self) -> dict:
+        return {
+            "kv": {ns: dict(d) for ns, d in self.kv.items()},
+            "functions": dict(self.functions),
+            "named_actors": [
+                (info.namespace, info.name, info.spec)
+                for aid, info in self.actors.items()
+                if info.name and info.state != "DEAD"],
+            "job_counter": self._job_counter,
+        }
+
+    def _recover(self) -> None:
+        snap, ops = self.store.load()
+        state = snap or {"kv": {}, "functions": {},
+                         "named_actors": [], "job_counter": 0}
+        for ns, d in state["kv"].items():
+            self.kv[ns].update(d)
+        self.functions.update(state["functions"])
+        self._job_counter = state["job_counter"]
+        named = {(ns, name): spec
+                 for ns, name, spec in state["named_actors"]}
+        for op in ops:
+            kind = op[0]
+            if kind == "kv_put":
+                self.kv[op[1]][op[2]] = op[3]
+            elif kind == "kv_del":
+                self.kv[op[1]].pop(op[2], None)
+            elif kind == "fn":
+                self.functions[op[1]] = op[2]
+            elif kind == "actor":
+                spec = op[1]
+                named[(spec.namespace, spec.actor_name)] = spec
+            elif kind == "actor_dead":
+                named = {k: s for k, s in named.items()
+                         if s.actor_id.binary() != op[1]}
+            elif kind == "job_counter":
+                self._job_counter = max(self._job_counter, op[1])
+        for (ns, name), spec in named.items():
+            aid = spec.actor_id.binary()
+            # RESTARTING until the hosting worker re-announces itself
+            # (or the health loop's grace window expires it)
+            self.actors[aid] = ActorInfo(
+                actor_id=spec.actor_id, spec=spec, state="RESTARTING",
+                name=name, namespace=ns)
+            self.named_actors[(ns, name)] = aid
+            self.actor_queues.setdefault(aid, collections.deque())
+            self._recovered_actors.add(aid)
+        if snap is not None or ops:
+            logger.info(
+                "controller: recovered %d kv namespaces, %d functions, "
+                "%d named actors", len(self.kv), len(self.functions),
+                len(named))
+
     # ------------------------------------------------------------------ run
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, name="controller", daemon=True)
@@ -181,6 +252,7 @@ class Controller:
             pass
         if self._thread:
             self._thread.join(timeout=5)
+        self.store.close()
 
     def _run(self) -> None:
         poller = zmq.Poller()
@@ -325,6 +397,14 @@ class Controller:
         self._dispatch_msg(identity, mtype, payload)
 
     def _dispatch_msg(self, identity: bytes, mtype: bytes, payload: Any) -> None:
+        if identity not in self.peers and mtype != P.REGISTER:
+            # a peer from before a controller restart: process its message
+            # (handlers tolerate unknown senders) and ask it to re-announce
+            # itself (reference: raylet reconnect, node_manager.cc:1114)
+            now = time.monotonic()
+            if now - self._reconnect_sent.get(identity, 0.0) > 2.0:
+                self._reconnect_sent[identity] = now
+                self._send(identity, P.RECONNECT, {"gen": self.generation})
         handler = self._HANDLERS.get(mtype)
         if handler is None:
             logger.warning("controller: unknown message %s", mtype)
@@ -338,28 +418,71 @@ class Controller:
                                 "pid": m.get("pid")}
         if kind == "node":
             node_id = NodeID(m["node_id"])
-            res = NodeResources(node_id, m["resources"], m.get("labels") or {})
-            info = NodeInfo(node_id=node_id, identity=identity, resources=res,
-                            last_heartbeat=time.monotonic())
-            self.nodes[node_id.binary()] = info
-            self.scheduler.add_node(res)
-            self._publish("node", {"event": "added", "node_id": m["node_id"],
-                                   "resources": m["resources"]})
+            existing = self.nodes.get(node_id.binary())
+            if existing is not None and existing.identity == identity:
+                # re-registration after a controller restart: keep the
+                # NodeInfo we may have partially rebuilt
+                existing.last_heartbeat = time.monotonic()
+                info = existing
+            else:
+                res = NodeResources(node_id, m["resources"],
+                                    m.get("labels") or {})
+                info = NodeInfo(node_id=node_id, identity=identity,
+                                resources=res,
+                                last_heartbeat=time.monotonic())
+                self.nodes[node_id.binary()] = info
+                self.scheduler.add_node(res)
+                self._publish("node", {"event": "added",
+                                       "node_id": m["node_id"],
+                                       "resources": m["resources"]})
+            # reconnect re-announce: objects the node's store still holds
+            # repopulate the object directory (reference: raylet reconnect
+            # resends its object table, node_manager.cc:1114)
+            for b, size in m.get("objects") or []:
+                e = self._entry(b)
+                e.locations.add(node_id.binary())
+                e.size = e.size or size
+                # wake anything already parked on this object (resubmitted
+                # tasks in dep_waiters, blocked gets in local_waiters)
+                self._object_created(b)
+            # replay worker registrations that raced ahead of this node's
+            for wid, wm in self._orphan_workers.pop(node_id.binary(), []):
+                self._h_register(wid, wm)
         elif kind == "worker":
             nid = m["node_id"]
             node = self.nodes.get(nid)
-            if node is not None:
+            if node is None:
+                # its node's (re-)registration hasn't arrived yet — stash,
+                # else the worker is lost from the pool forever
+                self._orphan_workers[nid].append((identity, m))
+                return
+            if identity not in node.all_workers:
                 node.all_workers[identity] = {"pid": m.get("pid"),
                                               "worker_id": m.get("id")}
                 node.starting_workers = max(0, node.starting_workers - 1)
-                node.idle_workers.append(identity)
-                self._drain_waiting_tasks(node)
+                if m.get("actor_id") is None and not m.get("busy"):
+                    # mid-task workers return to the idle pool at their
+                    # TASK_DONE (transient resource over-admission until
+                    # then self-corrects)
+                    node.idle_workers.append(identity)
+                    self._drain_waiting_tasks(node)
+            if m.get("actor_id") is not None:
+                self._restore_actor_binding(m["actor_id"], identity,
+                                            m.get("node_id"))
         elif kind == "driver":
-            self._job_counter += 1
-            job_id = JobID.from_int(self._job_counter)
-            self.jobs[job_id.binary()] = {
-                "job_id": job_id.hex(), "pid": m.get("pid"),
-                "start_time": time.time(), "status": "RUNNING"}
+            if m.get("job_id"):
+                # reconnecting driver keeps its job identity
+                job_id = JobID(m["job_id"])
+                self.jobs.setdefault(job_id.binary(), {
+                    "job_id": job_id.hex(), "pid": m.get("pid"),
+                    "start_time": time.time(), "status": "RUNNING"})
+            else:
+                self._job_counter += 1
+                self.store.append(("job_counter", self._job_counter))
+                job_id = JobID.from_int(self._job_counter)
+                self.jobs[job_id.binary()] = {
+                    "job_id": job_id.hex(), "pid": m.get("pid"),
+                    "start_time": time.time(), "status": "RUNNING"}
             self.peers[identity]["job_id"] = job_id.binary()
             self._send(identity, P.REGISTER_REPLY, {
                 "job_id": job_id.binary(),
@@ -372,6 +495,30 @@ class Controller:
         self._send(identity, P.REGISTER_REPLY, {"ok": True,
                                                 "config": self.config.to_json()})
         self._maybe_schedule()
+
+    def _restore_actor_binding(self, aid: bytes, worker: bytes,
+                               node_b: Optional[bytes]) -> None:
+        """A surviving actor worker re-announced itself after a controller
+        restart: rebind the actor to its worker and flip it ALIVE."""
+        self.actor_workers[aid] = worker
+        self.worker_actors[worker] = aid
+        self._recovered_actors.discard(aid)
+        info = self.actors.get(aid)
+        if info is None or info.state == "ALIVE":
+            return
+        info.state = "ALIVE"
+        if node_b is not None:
+            info.node_id = NodeID(node_b)
+            info.worker_id = WorkerID(worker) \
+                if len(worker) == WorkerID.SIZE else None
+            if info.spec is not None and info.spec.hold_resources:
+                # the live actor still occupies its resources; the node's
+                # fresh registration reset availability, so re-take them
+                self.scheduler.force_acquire(
+                    NodeID(node_b), self._sched_res(info.spec))
+        self._publish(f"actor:{info.actor_id.hex()}",
+                      {"state": "ALIVE", "actor_id": aid})
+        self._answer_actor_addr_waiters(aid)
 
     # ------------------------------------------------------------- objects
     def _entry(self, object_id_b: bytes) -> ObjectEntry:
@@ -1058,6 +1205,9 @@ class Controller:
                             ok=False)
                 return
             self.named_actors[key] = aid
+            # named actors are durable: get_actor must resolve them after
+            # a controller restart (their worker re-announces the binding)
+            self.store.append(("actor", spec))
         self.actors[aid] = info
         self.actor_queues[aid] = collections.deque()
         self._reply(identity, m["rid"], {"ok": True})
@@ -1221,11 +1371,15 @@ class Controller:
                 self._reply(identity, m["rid"], {"added": False})
                 return
             table[m["key"]] = m["value"]
+            self.store.append(("kv_put", ns, m["key"], m["value"]))
+            self.store.maybe_compact(self._durable_state)
             self._reply(identity, m["rid"], {"added": True})
         elif op == "get":
             self._reply(identity, m["rid"], {"value": table.get(m["key"])})
         elif op == "del":
             existed = table.pop(m["key"], None) is not None
+            if existed:
+                self.store.append(("kv_del", ns, m["key"]))
             self._reply(identity, m["rid"], {"deleted": existed})
         elif op == "exists":
             self._reply(identity, m["rid"], {"exists": m["key"] in table})
@@ -1235,6 +1389,8 @@ class Controller:
                         {"keys": [k for k in table if k.startswith(prefix)]})
 
     def _h_export_function(self, identity: bytes, m: dict) -> None:
+        if m["key"] not in self.functions:
+            self.store.append(("fn", m["key"], m["blob"]))
         self.functions[m["key"]] = m["blob"]
         if m.get("rid"):
             self._reply(identity, m["rid"], {"ok": True})
@@ -1324,6 +1480,9 @@ class Controller:
         if requeued:
             self._maybe_schedule()
 
+    def _h_ping(self, identity: bytes, m: dict) -> None:
+        pass  # the unknown-peer check in _dispatch_msg does the work
+
     def _h_heartbeat(self, identity: bytes, m: dict) -> None:
         node = self.nodes.get(m["node_id"])
         if node is not None:
@@ -1407,6 +1566,7 @@ class Controller:
             self._fail_actor_queue(aid, err)
             if info.name:
                 self.named_actors.pop((info.namespace, info.name), None)
+                self.store.append(("actor_dead", aid))
 
     def _health_loop(self) -> None:
         cfg = self.config
@@ -1419,6 +1579,28 @@ class Controller:
                 if node.alive and node.last_heartbeat and \
                         now - node.last_heartbeat > threshold:
                     self._on_node_dead(node)
+            # recovered named actors whose workers never re-announced
+            # within the grace window died during the controller's
+            # downtime: run the normal death/restart state machine so
+            # get_actor waiters aren't parked forever
+            if self._recovered_actors and \
+                    now - self._started_at > max(15.0, threshold):
+                stale = list(self._recovered_actors)
+                self._recovered_actors.clear()
+                for aid in stale:
+                    try:
+                        self.call_on_loop(
+                            lambda a=aid: self._expire_recovered_actor(a))
+                    except Exception:
+                        logger.exception("recovered-actor expiry failed")
+
+    def _expire_recovered_actor(self, aid: bytes) -> None:
+        info = self.actors.get(aid)
+        if info is not None and info.state == "RESTARTING":
+            logger.warning(
+                "recovered actor %s never re-announced; declaring its "
+                "worker dead", ActorID(aid).hex()[:12])
+            self._on_actor_died(aid, b"")
 
     def _on_node_dead(self, node: NodeInfo) -> None:
         logger.warning("node %s declared dead", node.node_id.hex()[:12])
@@ -1523,6 +1705,7 @@ class Controller:
         P.CREATE_PG: _h_create_pg,
         P.REMOVE_PG: _h_remove_pg,
         P.HEARTBEAT: _h_heartbeat,
+        P.PING: _h_ping,
         P.WORKER_EXIT: _h_worker_exit,
         P.NOTIFY_BLOCKED: _h_notify_blocked,
         P.NOTIFY_UNBLOCKED: _h_notify_unblocked,

@@ -285,6 +285,14 @@ class NativeShmStore:
         manager's heartbeat."""
         return self.seg.reap()
 
+    def contents(self):
+        """[(object_id_binary, size)] of every sealed (incl. spilled)
+        object — the node re-announces these to a restarted controller."""
+        with self._lock:
+            out = [(oid.binary(), sz) for oid, sz in self._sealed.items()]
+            out.extend((oid.binary(), 0) for oid in self._spilled)
+            return out
+
     def stats(self) -> dict:
         used, _, n = self.seg.stats()
         with self._lock:

@@ -99,11 +99,15 @@ class NodeManager:
         self._peer_last_used: Dict[bytes, float] = {}
 
     # ------------------------------------------------------------------ run
-    def start(self) -> None:
+    def _register_with_controller(self) -> None:
         self._send(P.REGISTER, {
             "kind": "node", "id": self.identity,
             "node_id": self.node_id.binary(), "resources": self.resources,
-            "labels": self.labels, "pid": os.getpid()})
+            "labels": self.labels, "pid": os.getpid(),
+            "objects": self.store.contents()})
+
+    def start(self) -> None:
+        self._register_with_controller()
         for t in (threading.Thread(target=self._loop, name="node-loop", daemon=True),
                   threading.Thread(target=self._heartbeat_loop, name="node-hb", daemon=True),
                   threading.Thread(target=self._reaper_loop, name="node-reaper", daemon=True)):
@@ -239,6 +243,18 @@ class NodeManager:
                 try:
                     os.kill(pid, signal.SIGKILL)
                 except ProcessLookupError:
+                    pass
+        elif mtype == P.RECONNECT:
+            # controller restarted: re-announce this node + its objects,
+            # and relay to our workers over their direct channels (the
+            # fresh ROUTER cannot address them until they speak first)
+            self._register_with_controller()
+            with self._workers_lock:
+                worker_ids = list(self.workers.keys())
+            for wid in worker_ids:
+                try:
+                    self._send_direct(wid, P.RECONNECT, {})
+                except Exception:
                     pass
         elif mtype == P.SHUTDOWN:
             self._stopped.set()

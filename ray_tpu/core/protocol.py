@@ -59,6 +59,8 @@ PULL_REQUEST = b"PRQ"        # dest->src node DIRECT: stream it to me
 PUSH_OBJECT = b"PSH"         # src->dest node DIRECT: chunked payload
 PULL_FAILED = b"PLF"         # src->dest direct / dest->controller: pull failed
 CHUNK_ACK = b"CAK"           # dest->src DIRECT: chunk received (flow control)
+RECONNECT = b"RCN"           # controller->peer: re-register + re-announce
+                             # (sent after a controller restart)
 REF_DELTAS = b"RFD"          # {deltas: {bytes: int}}
 # kv / functions
 KV_OP = b"KVO"               # {op: put|get|del|keys|exists, ns, key, value}
@@ -70,6 +72,8 @@ REMOVE_PG = b"RPG"
 PG_UPDATE = b"PGU"
 # cluster
 HEARTBEAT = b"HBT"           # node->controller {node_id, available, total, stats}
+PING = b"PNG"                # driver->controller liveness poke: lets a
+                             # restarted controller ask it to RECONNECT
 NODE_UPDATE = b"NUP"
 WORKER_EXIT = b"WEX"
 STATE_QUERY = b"STQ"         # {what, filters} -> rows

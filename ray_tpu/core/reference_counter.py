@@ -88,6 +88,16 @@ class ReferenceCounter:
         with self._lock:
             return self._local.get(object_id, 0) + self._submitted.get(object_id, 0)
 
+    def all_counts(self) -> Dict[bytes, int]:
+        """Aggregate live counts, for re-seeding a restarted controller's
+        global table (its counts died with it)."""
+        with self._lock:
+            out: Dict[bytes, int] = {}
+            for table in (self._local, self._submitted):
+                for oid, n in table.items():
+                    out[oid.binary()] = out.get(oid.binary(), 0) + n
+            return out
+
 
 class GlobalRefTable:
     """Controller-side aggregate (the deletion authority).

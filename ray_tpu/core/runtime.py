@@ -82,6 +82,10 @@ class Runtime:
         self._current_actor_id: Optional[ActorID] = None
 
         self.dispatch_handler: Optional[Callable[[dict], None]] = None
+        #: WorkerExecutor hook: True while a task is queued/running (a
+        #: reconnecting busy worker must not rejoin the idle pool)
+        self.busy_probe: Optional[Callable[[], bool]] = None
+        self._reconnect_gen: Optional[bytes] = None
         #: Installed by WorkerExecutor: called when the executing thread is
         #: about to block on a remote result / when it resumes (reference:
         #: CoreWorker NotifyDirectCallTaskBlocked, core_worker.cc)
@@ -129,6 +133,10 @@ class Runtime:
         # pending queue, direct_actor_task_submitter.h)
         self._actors: Dict[bytes, dict] = {}
         self._actors_lock = threading.Lock()
+        # normal-task specs we own that have not completed (resubmitted to
+        # a restarted controller on RECONNECT)
+        self._inflight_specs: Dict[bytes, TaskSpec] = {}
+        self._inflight_lock = threading.Lock()
         # all sends go through one flusher thread: preserves FIFO order,
         # moves pickling off the caller's critical path, and coalesces
         # consecutive task submissions into SUBMIT_BATCH messages
@@ -147,6 +155,16 @@ class Runtime:
         self._pump = threading.Thread(target=self._pump_loop,
                                       name=f"{kind}-pump", daemon=True)
         self._pump.start()
+        if kind == "driver":
+            # liveness poke: an idle driver otherwise never speaks, so a
+            # restarted controller could never ask it to RECONNECT (and
+            # its in-flight submissions would hang forever)
+            threading.Thread(target=self._ping_loop, name="driver-ping",
+                             daemon=True).start()
+
+    def _ping_loop(self) -> None:
+        while not self._stopped.wait(2.0):
+            self._send(P.PING, {})
 
     @property
     def current_task_id(self) -> TaskID:
@@ -366,6 +384,8 @@ class Runtime:
             with self.pg_cond:
                 self.pg_events[m["pg_id"]] = m
                 self.pg_cond.notify_all()
+        elif mtype == P.RECONNECT:
+            self._on_reconnect(m.get("gen"))
         elif mtype == P.SHUTDOWN:
             self._stopped.set()
 
@@ -374,10 +394,24 @@ class Runtime:
         while self._early_dispatches:
             handler(self._early_dispatches.pop(0))
 
+    def _register_msg(self) -> dict:
+        m = {"kind": self.kind, "id": self.worker_id.binary(),
+             "node_id": self.node_id.binary(), "pid": os.getpid()}
+        if self._current_actor_id is not None:
+            m["actor_id"] = self._current_actor_id.binary()
+        if self.busy_probe is not None:
+            try:
+                m["busy"] = bool(self.busy_probe())
+            except Exception:
+                pass
+        if self.kind == "driver" and self._register_ev.is_set():
+            # re-registration keeps the assigned job identity (the default
+            # job 0 before first registration must NOT be claimed)
+            m["job_id"] = self.job_id.binary()
+        return m
+
     def register(self, timeout: float = 30.0) -> dict:
-        self._send(P.REGISTER, {
-            "kind": self.kind, "id": self.worker_id.binary(),
-            "node_id": self.node_id.binary(), "pid": os.getpid()})
+        self._send(P.REGISTER, self._register_msg())
         if not self._register_ev.wait(timeout):
             raise TimeoutError("could not connect to controller")
         reply = self._register_reply
@@ -386,6 +420,38 @@ class Runtime:
             self._driver_task_id = TaskID.for_driver(self.job_id)
             self.current_task_id = self._driver_task_id
         return reply
+
+    def _on_reconnect(self, gen: Optional[bytes]) -> None:
+        """The controller restarted and lost its volatile state: re-send
+        everything it needs from us, in one FIFO burst — identity first,
+        then subscriptions, our live refcounts, and every unfinished task
+        we own (reference: core workers/raylets resubscribe + resubmit on
+        GCS restart; gcs_client reconnection path). At most once per
+        controller generation: refcounts are absolute and tasks must not
+        resubmit twice."""
+        if gen is not None and gen == self._reconnect_gen:
+            return
+        self._reconnect_gen = gen
+        logger.info("%s: controller restarted; re-announcing", self.kind)
+        self._send(P.REGISTER, self._register_msg())
+        for channel in list(self.pubsub_handlers):
+            if channel != "*":
+                self._send(P.SUBSCRIBE, {"channel": channel})
+        counts = self.reference_counter.all_counts()
+        if counts:
+            self._send(P.REF_DELTAS, {"deltas": counts})
+        with self._inflight_lock:
+            specs = list(self._inflight_specs.values())
+        for spec in specs:
+            self._send(P.SUBMIT_TASK, {"spec": spec})
+        # actor address long-polls in flight at the crash died with the
+        # old controller's waiter lists: re-issue them or every call
+        # queued behind RESOLVING hangs forever
+        with self._actors_lock:
+            resolving = [aid for aid, st in self._actors.items()
+                         if st["state"] == "RESOLVING"]
+        for aid in resolving:
+            self._resolve_actor(aid)
 
     def shutdown(self) -> None:
         self.reference_counter.flush()
@@ -448,9 +514,15 @@ class Runtime:
             # large objects live ONLY in shm — duplicating the value in
             # process memory would double the footprint of every big put
             # (local gets deserialize zero-copy from the sealed extent)
-            view = self.shm.create(oid, size)
-            serialized.write_to(view)
-            self.shm.seal(oid)
+            try:
+                view = self.shm.create(oid, size)
+                serialized.write_to(view)
+                self.shm.seal(oid)
+            except FileExistsError:
+                # duplicate execution (at-least-once after a controller
+                # restart resubmitted a task that was already running):
+                # the object is already here — keep the first copy
+                pass
             meta = {"object_id": b, "node_id": self.node_id.binary(), "size": size}
             self.seed_meta(b, meta)
             if notify:
@@ -469,6 +541,9 @@ class Runtime:
                 st = self._actors.get(aid)
                 if st is not None:
                     st["inflight"].pop(m.get("task_id"), None)
+        if m.get("task_id") is not None:
+            with self._inflight_lock:
+                self._inflight_specs.pop(m["task_id"], None)
         for r in m.get("results", []):
             b = r["object_id"]
             with self._meta_lock:
@@ -730,6 +805,11 @@ class Runtime:
         if spec.is_actor_task:
             self._submit_actor_task(spec)
         else:
+            # owner-side pending record: a restarted controller has no
+            # task table, so WE resubmit on RECONNECT (reference: the
+            # owning core worker holds the spec, not the GCS)
+            with self._inflight_lock:
+                self._inflight_specs[spec.task_id.binary()] = spec
             self._send(P.SUBMIT_TASK, {"spec": spec})
         self._record_event(spec, "submitted")
         return refs

@@ -177,6 +177,14 @@ class ShmObjectStore:
             self._used += size
             return True
 
+    def contents(self):
+        """[(object_id_binary, size)] of every sealed (incl. spilled)
+        object — the node re-announces these to a restarted controller."""
+        with self._lock:
+            out = [(oid.binary(), sz) for oid, sz in self._sealed.items()]
+            out.extend((oid.binary(), 0) for oid in self._spilled)
+            return out
+
     def stats(self) -> dict:
         with self._lock:
             return {

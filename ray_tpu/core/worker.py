@@ -66,6 +66,8 @@ class WorkerExecutor:
         self._block_lock = threading.Lock()
         self.runtime.set_dispatch_handler(self._on_dispatch)
         self.runtime.block_notifier = self
+        self.runtime.busy_probe = \
+            lambda: self._current_tid is not None or not self._queue.empty()
         self._install_cancel_handler()
 
     def _install_cancel_handler(self) -> None:
