@@ -28,25 +28,53 @@ class JaxConfig(BackendConfig):
     # Force-enable/disable jax.distributed.initialize; None = auto
     # (enabled iff the group spans >1 node).
     distributed: Optional[bool] = None
-    coordinator_port: int = 8476
+    #: 0 picks a free port on the coordinator at start time.
+    coordinator_port: int = 0
+    #: Per-process device count override (CPU testing: N virtual devices
+    #: per worker process; real TPU hosts leave this None — the runtime
+    #: discovers the host's chips).
+    local_device_count: Optional[int] = None
 
     @property
     def backend_cls(self) -> Type["_JaxBackend"]:
         return _JaxBackend
 
 
-def _get_coordinator_ip() -> str:
+def _coordinator_address(port: int) -> str:
+    """Rank-0-side: one RPC returns ip:port. A port of 0 probes a free
+    one here — the bind is released before jax re-binds it, so a racing
+    process could steal it; probing on the same host immediately before
+    initialize keeps that window as small as it can be without jax
+    accepting a pre-bound socket."""
     import socket
-    return socket.gethostbyname(socket.gethostname())
+    ip = socket.gethostbyname(socket.gethostname())
+    if port == 0:
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+    return f"{ip}:{port}"
 
 
 def _setup_jax_distributed(coordinator_address: str, num_processes: int,
-                           process_id: int) -> None:
+                           process_id: int,
+                           local_device_count: Optional[int] = None) -> None:
     """Runs on each worker before train_func (reference analog:
-    ``_setup_torch_process_group`` torch/config.py:64)."""
+    ``_setup_torch_process_group`` torch/config.py:64). Must complete
+    before the worker's first jax backend init: XLA_FLAGS and the
+    coordination service only apply to an uninitialized runtime."""
     os.environ["RAY_TPU_JAX_COORDINATOR"] = coordinator_address
     os.environ["RAY_TPU_JAX_NUM_PROCESSES"] = str(num_processes)
     os.environ["RAY_TPU_JAX_PROCESS_ID"] = str(process_id)
+    if local_device_count is not None:
+        # replace any inherited count (test harnesses export a
+        # driver-wide value that is wrong for per-process workers)
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(
+            f"--xla_force_host_platform_device_count={local_device_count}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    # platform pinning already happened at worker startup
+    # (ray_tpu.core.worker.main honors RAY_TPU_JAX_PLATFORM)
     import jax
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -73,14 +101,14 @@ class _JaxBackend(Backend):
             use_distributed = n_nodes > 1
         if not use_distributed:
             return
-        coordinator = worker_group.execute_single(
-            0, _get_coordinator_ip)
-        address = f"{coordinator}:{backend_config.coordinator_port}"
+        address = worker_group.execute_single(
+            0, _coordinator_address, backend_config.coordinator_port)
         futures = []
         for rank, worker in enumerate(worker_group.workers):
             futures.append(worker.execute.remote(
                 _setup_jax_distributed, address,
-                len(worker_group), rank))
+                len(worker_group), rank,
+                backend_config.local_device_count))
         import ray_tpu
         ray_tpu.get(futures)
 

@@ -1,0 +1,115 @@
+"""Multi-process SPMD training proof (reference shape:
+``python/ray/train/torch/config.py:64-116`` — N separate trainer
+processes rendezvous and train one model): two ray_tpu worker PROCESSES
+each own 4 virtual CPU devices, rendezvous through JaxConfig /
+jax.distributed.initialize, and train gptj-tiny FSDP through JaxTrainer.
+Loss trajectory must match a single-process run on the same 8-device
+mesh with identical seed/data."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.train.jax import JaxConfig
+
+N_STEPS = 4
+GLOBAL_BATCH = 8
+SEQ = 32
+SEED = 7
+
+
+def _batches():
+    rng = np.random.RandomState(1234)
+    return [rng.randint(1, 512, size=(GLOBAL_BATCH, SEQ)).astype(np.int32)
+            for _ in range(N_STEPS)]
+
+
+def _train_losses_multiprocess(storage_path):
+    """2 worker processes x 4 devices, FSDP over the 8-device mesh."""
+
+    def train_func(config):
+        import jax
+        import numpy as np
+        import ray_tpu.train as train
+        from ray_tpu.models.registry import get_config
+        from ray_tpu.models.training import make_train_step
+        from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+        from ray_tpu.parallel.sharding import FSDP_RULES
+        from jax.sharding import NamedSharding
+
+        assert jax.process_count() == 2
+        assert jax.device_count() == 8
+        cfg = get_config("gptj-tiny")
+        mesh = build_mesh(MeshSpec(fsdp=4, tp=2), jax.devices())
+        bundle = make_train_step(cfg, mesh, rules=FSDP_RULES,
+                                 learning_rate=1e-2)
+        state = bundle.init(seed=config["seed"])
+        rng = np.random.RandomState(1234)
+        per_proc = config["global_batch"] // jax.process_count()
+        lo = jax.process_index() * per_proc
+        sharding = NamedSharding(mesh, bundle.batch_spec.spec) \
+            if hasattr(bundle.batch_spec, "spec") else bundle.batch_spec
+        losses = []
+        for _ in range(config["n_steps"]):
+            full = rng.randint(
+                1, 512, size=(config["global_batch"], config["seq"])
+            ).astype(np.int32)
+            local = full[lo:lo + per_proc]
+            ids = jax.make_array_from_process_local_data(
+                sharding, local)
+            mask = jax.make_array_from_process_local_data(
+                sharding, np.ones_like(local, dtype=np.float32))
+            state, metrics = bundle.step(
+                state, {"input_ids": ids, "loss_mask": mask})
+            losses.append(float(metrics["loss"]))
+        train.report({"losses": losses})
+
+    trainer = JaxTrainer(
+        train_func,
+        train_loop_config={"seed": SEED, "n_steps": N_STEPS,
+                           "global_batch": GLOBAL_BATCH, "seq": SEQ},
+        jax_config=JaxConfig(distributed=True, local_device_count=4),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="mp-spmd", storage_path=storage_path))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    return result.metrics["losses"]
+
+
+def _train_losses_single_process():
+    import jax
+    from ray_tpu.models.registry import get_config
+    from ray_tpu.models.training import make_train_step
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.parallel.sharding import FSDP_RULES
+
+    cfg = get_config("gptj-tiny")
+    mesh = build_mesh(MeshSpec(fsdp=4, tp=2), jax.devices())
+    bundle = make_train_step(cfg, mesh, rules=FSDP_RULES,
+                             learning_rate=1e-2)
+    state = bundle.init(seed=SEED)
+    losses = []
+    for ids in _batches():
+        state, metrics = bundle.step(
+            state, {"input_ids": ids,
+                    "loss_mask": np.ones_like(ids, dtype=np.float32)})
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+def test_multiprocess_fsdp_matches_single_process(tmp_path):
+    info = ray_tpu.init(num_cpus=4, _num_initial_workers=0,
+                        ignore_reinit_error=True)
+    try:
+        mp_losses = _train_losses_multiprocess(str(tmp_path / "results"))
+        sp_losses = _train_losses_single_process()
+        assert len(mp_losses) == N_STEPS
+        # same model, same seed, same data, same math — sharded across
+        # processes vs one process only changes collective reduction
+        # order, so trajectories agree to float tolerance
+        np.testing.assert_allclose(mp_losses, sp_losses, rtol=2e-4)
+        # the optimizer is really stepping (not a frozen/replayed state)
+        assert len(set(round(x, 6) for x in mp_losses)) == N_STEPS
+    finally:
+        ray_tpu.shutdown()
