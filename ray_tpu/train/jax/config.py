@@ -40,31 +40,23 @@ class JaxConfig(BackendConfig):
         return _JaxBackend
 
 
-def _coordinator_address(port: int) -> str:
-    """Rank-0-side: one RPC returns ip:port. A port of 0 probes a free
-    one here — the bind is released before jax re-binds it, so a racing
-    process could steal it; probing on the same host immediately before
-    initialize keeps that window as small as it can be without jax
-    accepting a pre-bound socket."""
-    import socket
-    ip = socket.gethostbyname(socket.gethostname())
-    if port == 0:
-        with socket.socket() as s:
-            s.bind(("", 0))
-            port = s.getsockname()[1]
-    return f"{ip}:{port}"
-
-
-def _setup_jax_distributed(coordinator_address: str, num_processes: int,
-                           process_id: int,
+def _setup_jax_distributed(rendezvous_key: bytes, port: int,
+                           num_processes: int, process_id: int,
                            local_device_count: Optional[int] = None) -> None:
     """Runs on each worker before train_func (reference analog:
-    ``_setup_torch_process_group`` torch/config.py:64). Must complete
-    before the worker's first jax backend init: XLA_FLAGS and the
-    coordination service only apply to an uninitialized runtime."""
-    os.environ["RAY_TPU_JAX_COORDINATOR"] = coordinator_address
-    os.environ["RAY_TPU_JAX_NUM_PROCESSES"] = str(num_processes)
-    os.environ["RAY_TPU_JAX_PROCESS_ID"] = str(process_id)
+    ``_setup_torch_process_group`` torch/config.py:64 — rank 0 publishes
+    the rendezvous, everyone joins). Rank 0 probes its port (0 = free)
+    and publishes ip:port to the cluster KV IN THE SAME PROCESS that
+    immediately binds it via jax.distributed.initialize, so there is no
+    cross-RPC window for another process to steal the port; followers
+    poll the KV. Must run before the worker's first jax backend init:
+    XLA_FLAGS and the coordination service only apply to an
+    uninitialized runtime."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.core.global_state import global_worker
+
     if local_device_count is not None:
         # replace any inherited count (test harnesses export a
         # driver-wide value that is wrong for per-process workers)
@@ -76,8 +68,42 @@ def _setup_jax_distributed(coordinator_address: str, num_processes: int,
     # platform pinning already happened at worker startup
     # (ray_tpu.core.worker.main honors RAY_TPU_JAX_PLATFORM)
     import jax
+    try:
+        from jax._src import xla_bridge
+        if getattr(xla_bridge, "_backends", None):
+            raise RuntimeError(
+                "jax backend already initialized in this worker process; "
+                "distributed setup (XLA_FLAGS / coordination service) "
+                "cannot apply. Use fresh training workers.")
+    except ImportError:
+        pass
+    w = global_worker()
+    if process_id == 0:
+        import socket
+        ip = socket.gethostbyname(socket.gethostname())
+        if port == 0:
+            with socket.socket() as s:
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+        address = f"{ip}:{port}"
+        w.kv_put(rendezvous_key, address.encode(), ns="__train__")
+    else:
+        deadline = time.monotonic() + 60.0
+        address = None
+        while time.monotonic() < deadline:
+            raw = w.kv_get(rendezvous_key, ns="__train__")
+            if raw:
+                address = raw.decode()
+                break
+            time.sleep(0.05)
+        if address is None:
+            raise TimeoutError("rank 0 never published the jax "
+                               "coordinator address")
+    os.environ["RAY_TPU_JAX_COORDINATOR"] = address
+    os.environ["RAY_TPU_JAX_NUM_PROCESSES"] = str(num_processes)
+    os.environ["RAY_TPU_JAX_PROCESS_ID"] = str(process_id)
     jax.distributed.initialize(
-        coordinator_address=coordinator_address,
+        coordinator_address=address,
         num_processes=num_processes,
         process_id=process_id)
 
@@ -92,24 +118,25 @@ def _shutdown_jax_distributed() -> None:
 
 class _JaxBackend(Backend):
     def on_start(self, worker_group, backend_config: JaxConfig) -> None:
-        metas = worker_group.fetch_metadata()
+        worker_group.fetch_metadata()  # refresh even if previously set
         worker_group.sort_workers_by_node()
-        metas = worker_group.metadata
-        n_nodes = len({m.node_ip for m in metas})
+        n_nodes = len({m.node_ip for m in worker_group.metadata})
         use_distributed = backend_config.distributed
         if use_distributed is None:
             use_distributed = n_nodes > 1
         if not use_distributed:
             return
-        address = worker_group.execute_single(
-            0, _coordinator_address, backend_config.coordinator_port)
+        import uuid
+
+        import ray_tpu
+        key = f"jax-coord-{uuid.uuid4().hex[:12]}".encode()
         futures = []
         for rank, worker in enumerate(worker_group.workers):
             futures.append(worker.execute.remote(
-                _setup_jax_distributed, address,
+                _setup_jax_distributed, key,
+                backend_config.coordinator_port,
                 len(worker_group), rank,
                 backend_config.local_device_count))
-        import ray_tpu
         ray_tpu.get(futures)
 
     def on_shutdown(self, worker_group, backend_config: JaxConfig) -> None:

@@ -1,0 +1,111 @@
+"""AOT-compile the REAL GPT-J-6B config sharded on a virtual v5e-64 mesh
+(BASELINE.json north star: GPT-J-6B full fine-tune, ZeRO-3 -> GSPMD FSDP
+on a 64-chip pod). The full train step must lower with fsdp=16 x tp=4
+shardings, and the sharded state must fit v5e HBM (16 GiB/chip) with
+ample headroom for activations.
+
+Runs in a subprocess: the 64-device virtual CPU platform must be
+configured before the jax backend initializes, and the test session
+already pinned 8 devices."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = r"""
+import os, json
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+sys.path.insert(0, os.environ["RAY_TPU_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from ray_tpu.models.registry import get_config
+from ray_tpu.models.training import make_train_step
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_tpu.parallel.sharding import FSDP_RULES
+
+cfg = get_config("gptj-6b")
+mesh = build_mesh(MeshSpec(fsdp=16, tp=4), jax.devices())
+bundle = make_train_step(cfg, mesh, rules=FSDP_RULES)
+state_shapes = jax.eval_shape(lambda k: bundle.init_fn(k),
+                              jax.random.PRNGKey(0))
+
+# analytic per-device bytes of the resident state (params + optimizer),
+# honoring the actual shardings make_train_step assigned
+def per_device_bytes(shapes, shardings):
+    total = 0
+    for leaf, sh in zip(jax.tree.leaves(shapes), jax.tree.leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "shard_shape"))):
+        shard = sh.shard_shape(leaf.shape) if hasattr(sh, "shard_shape") \
+            else leaf.shape
+        n = 1
+        for d in shard:
+            n *= d
+        total += n * leaf.dtype.itemsize
+    return total
+
+n_params = sum(x.size for x in jax.tree.leaves(state_shapes["params"]))
+state_bytes = per_device_bytes(state_shapes, bundle.state_shardings)
+
+batch = {"input_ids": jax.ShapeDtypeStruct((16, 2048), jnp.int32),
+         "loss_mask": jax.ShapeDtypeStruct((16, 2048), jnp.float32)}
+lowered = bundle.step_fn.lower(state_shapes, batch)
+hlo = lowered.as_text()
+compiled = lowered.compile()
+# GSPMD inserts collectives during partitioning, so look at the
+# compiled HLO (the stablehlo above only carries sharding annotations)
+chlo = compiled.as_text()
+ma = compiled.memory_analysis()
+peak = (ma.argument_size_in_bytes + ma.temp_size_in_bytes +
+        ma.output_size_in_bytes - ma.alias_size_in_bytes)
+
+print(json.dumps({
+    "xla_peak_bytes": int(peak),
+    "xla_temp_bytes": int(ma.temp_size_in_bytes),
+    "n_params": int(n_params),
+    "n_devices": jax.device_count(),
+    "state_bytes_per_device": int(state_bytes),
+    "lowered_bytes": len(hlo),
+    "has_all_gather": "all-gather" in chlo,
+    "has_reduce": ("reduce-scatter" in chlo) or ("all-reduce" in chlo),
+}))
+"""
+
+
+@pytest.mark.parametrize("_", [0])
+def test_gptj6b_aot_lowers_and_fits_v5e(_, tmp_path):
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    script = tmp_path / "aot.py"
+    script.write_text(_SCRIPT)
+    env = dict(os.environ)
+    env["RAY_TPU_REPO"] = repo
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, str(script)], env=env,
+                         capture_output=True, timeout=420)
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+    stats = json.loads(out.stdout.decode().strip().splitlines()[-1])
+
+    # the real 6B: EleutherAI GPT-J is ~6.05e9 params
+    assert 5.8e9 < stats["n_params"] < 6.3e9
+    assert stats["n_devices"] == 64
+    # fp32 master params + adam mu/nu sharded over the whole mesh:
+    # ~73 GB global /64 ~ 1.14 GiB resident per chip; assert the sharding
+    # really divides it (not replicated) and leaves v5e HBM headroom
+    v5e_hbm = 16 << 30
+    assert stats["state_bytes_per_device"] < 2 << 30, \
+        f"state per device {stats['state_bytes_per_device'] / 2**30:.2f} GiB"
+    assert stats["state_bytes_per_device"] < v5e_hbm // 4
+    # the lowered program is a genuine SPMD step (collectives present)
+    assert stats["lowered_bytes"] > 10_000
+    assert stats["has_all_gather"] and stats["has_reduce"], \
+        "no collectives in the lowered 6B step - sharding rules broken"
+    # XLA's own accounting of the compiled per-device program (arguments
+    # + temporaries + non-aliased outputs) fits v5e HBM with headroom
+    assert stats["xla_peak_bytes"] < v5e_hbm // 2, \
+        f"xla peak {stats['xla_peak_bytes'] / 2**30:.2f} GiB"

@@ -36,7 +36,6 @@ def _train_losses_multiprocess(storage_path):
         from ray_tpu.models.training import make_train_step
         from ray_tpu.parallel.mesh import MeshSpec, build_mesh
         from ray_tpu.parallel.sharding import FSDP_RULES
-        from jax.sharding import NamedSharding
 
         assert jax.process_count() == 2
         assert jax.device_count() == 8
@@ -48,8 +47,7 @@ def _train_losses_multiprocess(storage_path):
         rng = np.random.RandomState(1234)
         per_proc = config["global_batch"] // jax.process_count()
         lo = jax.process_index() * per_proc
-        sharding = NamedSharding(mesh, bundle.batch_spec.spec) \
-            if hasattr(bundle.batch_spec, "spec") else bundle.batch_spec
+        sharding = bundle.batch_spec  # NamedSharding over this mesh
         losses = []
         for _ in range(config["n_steps"]):
             full = rng.randint(
