@@ -95,6 +95,19 @@ class Config:
     #: Source-side flow control: max unacked chunks per outbound stream.
     stream_window_chunks: int = 4
 
+    # --- OOM defense (reference: memory_monitor.h:52 +
+    # worker_killing_policy.h:34) ---
+    #: Kill workers when node memory passes this fraction; <=0 disables.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 500
+    #: Consecutive breaches required before killing (debounces spikes).
+    memory_monitor_breaches: int = 2
+    #: OOM kills retry from their own budget (reference: task_oom_retries
+    #: is separate from max_retries), with a delay so a saturated node
+    #: gets time to clear before the task lands again.
+    task_oom_retries: int = 15
+    oom_retry_delay_s: float = 1.0
+
     # --- dashboard / job REST (reference: dashboard/head.py) ---
     dashboard_enabled: bool = True
     #: 0 picks an ephemeral port; the chosen address is written to

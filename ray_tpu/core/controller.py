@@ -65,6 +65,9 @@ class PendingTask:
     #: key failed to place in a drain are skipped wholesale, making the
     #: drain O(#shapes + #dispatched) instead of O(#queued).
     shape_key: Optional[tuple] = None
+    #: OOM kills draw from their own budget (reference: task_oom_retries),
+    #: not max_retries; -1 = uninitialized (filled from config on first use)
+    oom_retries_left: int = -1
 
 
 @dataclass
@@ -897,6 +900,9 @@ class Controller:
                 info.node_id = t.node_id
             self.actor_workers[aid] = worker
             self.worker_actors[worker] = aid
+            # the node's OOM killer should prefer stateless task workers
+            self._send(node.identity, P.WORKER_PINNED,
+                       {"worker_identity": worker})
             return
         # open a lease: the task's resource acquisition (made at pick_node)
         # transfers to the lease and is released when the lease closes
@@ -1104,14 +1110,35 @@ class Controller:
 
     def _handle_task_failure(self, tid: bytes, reason: str,
                              retriable: bool = True,
-                             release_resources: bool = True) -> None:
+                             release_resources: bool = True,
+                             exc: Optional[BaseException] = None,
+                             oom: bool = False) -> None:
         t = self.tasks.get(tid)
         if t is None:
             return
         if t.node_id is not None and release_resources and \
                 t.worker not in self.leases:
             self.scheduler.release(t.node_id, self._sched_res(t.spec))
-        if retriable and t.retries_left > 0:
+        if oom:
+            # OOM kills spend their own budget, with a delay so the node
+            # can shed pressure before the task lands again — transient
+            # spikes must not burn max_retries (reference: OOM retry
+            # policy is separate, memory_monitor + task_manager)
+            if t.oom_retries_left < 0:
+                t.oom_retries_left = self.config.task_oom_retries
+            if t.oom_retries_left > 0:
+                t.oom_retries_left -= 1
+                t.worker = None
+                t.node_id = None
+                t.transfers_remaining.clear()
+                timer = threading.Timer(
+                    self.config.oom_retry_delay_s,
+                    lambda: self.call_on_loop(
+                        lambda: self._requeue_after_oom(tid, t)))
+                timer.daemon = True
+                timer.start()
+                return
+        elif retriable and t.retries_left > 0:
             t.retries_left -= 1
             t.worker = None
             t.node_id = None
@@ -1121,7 +1148,8 @@ class Controller:
             return
         self.tasks.pop(tid, None)
         from ray_tpu.exceptions import TaskError
-        err = P.dumps(TaskError(t.spec.name or str(t.spec.function), reason))
+        err = P.dumps(exc if exc is not None else
+                      TaskError(t.spec.name or str(t.spec.function), reason))
         results_meta = []
         for oid in t.spec.return_ids():
             e = self._entry(oid.binary())
@@ -1499,6 +1527,12 @@ class Controller:
                 node.idle_workers.remove(worker_identity)
             except ValueError:
                 pass
+        elif node is not None and m.get("requested"):
+            # a worker WE requested died before registering: it was still
+            # counted as starting — without this, waiting tasks never get
+            # a replacement (node-initiated initial workers were never
+            # counted, so those must not decrement)
+            node.starting_workers = max(0, node.starting_workers - 1)
         self.peers.pop(worker_identity, None)
         aid = self.worker_actors.pop(worker_identity, None)
         # close any lease first: its single resource allocation is released
@@ -1512,6 +1546,7 @@ class Controller:
             if lnode is not None and lnode.alive and not lease.blocked:
                 self.scheduler.release(NodeID(lease.node_b), lease.resources)
         # fail/retry every in-flight task dispatched to that worker
+        oom = m.get("reason") == "oom"
         for tid, t in list(self.tasks.items()):
             if t.worker != worker_identity:
                 continue
@@ -1520,11 +1555,32 @@ class Controller:
             elif t.spec.is_actor_creation:
                 # actor restart path owns resubmission (below)
                 self.tasks.pop(tid, None)
+            elif oom:
+                # memory-monitor kill: retries from the OOM budget with
+                # backoff, surfacing OutOfMemoryError once exhausted
+                from ray_tpu.exceptions import OutOfMemoryError
+                self._handle_task_failure(
+                    tid, "worker killed by the node memory monitor",
+                    release_resources=lease is None, oom=True,
+                    exc=OutOfMemoryError(
+                        f"task {t.spec.name or ''} was killed by the node "
+                        f"memory monitor: node memory usage exceeded "
+                        f"{self.config.memory_usage_threshold:.0%}"))
             else:
                 self._handle_task_failure(tid, "worker died during execution",
                                           release_resources=lease is None)
         if aid is not None:
             self._on_actor_died(aid, worker_identity)
+        # tasks already queued for a worker on this node must not strand:
+        # the dead worker can't serve them and nothing else re-requests
+        # a replacement (common under the OOM killer)
+        if node is not None and node.alive:
+            waiting = node.stats.get("wait_worker")
+            if waiting and not node.idle_workers \
+                    and node.starting_workers < len(waiting):
+                node.starting_workers += 1
+                self._send(node.identity, P.TASK_ASSIGN,
+                           {"start_worker": True})
         self._maybe_schedule()
 
     def _on_actor_worker_died(self, worker_identity: bytes, tid: bytes) -> None:
@@ -1593,6 +1649,12 @@ class Controller:
                             lambda a=aid: self._expire_recovered_actor(a))
                     except Exception:
                         logger.exception("recovered-actor expiry failed")
+
+    def _requeue_after_oom(self, tid: bytes, t: PendingTask) -> None:
+        if self.tasks.get(tid) is not t:
+            return  # cancelled/failed while the backoff timer ran
+        self._enqueue_ready(tid, t)
+        self._maybe_schedule()
 
     def _expire_recovered_actor(self, aid: bytes) -> None:
         info = self.actors.get(aid)

@@ -63,6 +63,10 @@ class NodeManager:
         self.shm = make_client(self.shm_session)
 
         self.workers: Dict[bytes, subprocess.Popen] = {}  # identity -> proc
+        self._worker_started: Dict[bytes, float] = {}     # identity -> ts
+        self._oom_killed: Dict[bytes, bool] = {}          # identity -> True
+        self._requested_workers: set = set()   # controller-requested ids
+        self._pinned_workers: set = set()      # actor hosts (OOM-deprioritized)
         self._workers_lock = threading.Lock()
         self._stopped = threading.Event()
 
@@ -110,11 +114,13 @@ class NodeManager:
         self._register_with_controller()
         for t in (threading.Thread(target=self._loop, name="node-loop", daemon=True),
                   threading.Thread(target=self._heartbeat_loop, name="node-hb", daemon=True),
-                  threading.Thread(target=self._reaper_loop, name="node-reaper", daemon=True)):
+                  threading.Thread(target=self._reaper_loop, name="node-reaper", daemon=True),
+                  threading.Thread(target=self._memory_monitor_loop,
+                                   name="node-memmon", daemon=True)):
             t.start()
             self._threads.append(t)
         for _ in range(self.num_initial_workers):
-            self._start_worker()
+            self._start_worker(requested=False)
 
     def stop(self) -> None:
         self._stopped.set()
@@ -223,7 +229,7 @@ class NodeManager:
             return
         if mtype == P.TASK_ASSIGN:
             if m.get("start_worker"):
-                self._start_worker()
+                self._start_worker(requested=True)
         elif mtype == P.FREE_OBJECT:
             oid = ObjectID(m["object_id"])
             self.shm.release(oid)
@@ -244,6 +250,8 @@ class NodeManager:
                     os.kill(pid, signal.SIGKILL)
                 except ProcessLookupError:
                     pass
+        elif mtype == P.WORKER_PINNED:
+            self._pinned_workers.add(m["worker_identity"])
         elif mtype == P.RECONNECT:
             # controller restarted: re-announce this node + its objects,
             # and relay to our workers over their direct channels (the
@@ -260,7 +268,7 @@ class NodeManager:
             self._stopped.set()
 
     # ------------------------------------------------------------- workers
-    def _start_worker(self) -> None:
+    def _start_worker(self, requested: bool = True) -> None:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         env.update(self.worker_env)
@@ -285,6 +293,11 @@ class NodeManager:
             start_new_session=True)
         with self._workers_lock:
             self.workers[worker_id.binary()] = proc
+            self._worker_started[worker_id.binary()] = time.monotonic()
+            if requested:
+                # controller-requested: its starting_workers count must be
+                # repaired if this worker dies before registering
+                self._requested_workers.add(worker_id.binary())
 
     def _reaper_loop(self) -> None:
         while not self._stopped.wait(0.5):
@@ -294,10 +307,95 @@ class NodeManager:
                     if proc.poll() is not None:
                         dead.append(identity)
                         del self.workers[identity]
+                        self._worker_started.pop(identity, None)
+                        self._pinned_workers.discard(identity)
             for identity in dead:
                 self._send(P.WORKER_EXIT, {
                     "worker_identity": identity,
-                    "node_id": self.node_id.binary()})
+                    "node_id": self.node_id.binary(),
+                    "requested": identity in self._requested_workers,
+                    "reason": "oom"
+                    if self._oom_killed.pop(identity, False) else None})
+                self._requested_workers.discard(identity)
+
+    # ------------------------------------------------------- OOM defense
+    def _memory_fraction(self) -> Optional[float]:
+        try:
+            import psutil
+            return psutil.virtual_memory().percent / 100.0
+        except Exception:
+            return None
+
+    def _memory_monitor_loop(self) -> None:
+        """Reference: MemoryMonitor (memory_monitor.h:52) polls node
+        usage; above the threshold a worker is killed by policy. The
+        policy here is the reference's LIFO heuristic
+        (worker_killing_policy.h:34 — newest-started worker loses the
+        least progress; its task is failed as retriable OOM so the
+        scheduler can re-run it when pressure clears)."""
+        threshold = self.config.memory_usage_threshold
+        if threshold <= 0:
+            return
+        try:
+            import psutil  # noqa: F401
+        except ImportError:
+            logger.warning("psutil unavailable: OOM defense disabled")
+            return
+        period = self.config.memory_monitor_refresh_ms / 1000.0
+        breaches = 0
+        while not self._stopped.wait(period):
+            frac = self._memory_fraction()
+            if frac is None:
+                continue  # transient read failure; keep monitoring
+            if frac <= threshold:
+                breaches = 0
+                continue
+            breaches += 1
+            if breaches < self.config.memory_monitor_breaches:
+                continue
+            breaches = 0
+            self._kill_one_worker_for_oom(frac)
+
+    def _kill_one_worker_for_oom(self, frac: float) -> None:
+        now = time.monotonic()
+        with self._workers_lock:
+            # workers still booting (interpreter + imports take seconds)
+            # haven't had a chance to take work — killing them reclaims
+            # nothing and can starve the cluster into never executing
+            # anything
+            candidates = [w for w in self.workers
+                          if now - self._worker_started.get(w, now) > 5.0]
+            if not candidates:
+                return
+            # stateless task workers go before actor hosts (reference:
+            # worker_killing_policy prefers retriable work — killing an
+            # actor loses its state for the same reclaimed bytes)
+            task_workers = [w for w in candidates
+                            if w not in self._pinned_workers]
+            pool = task_workers or candidates
+
+            def rss(w):
+                try:
+                    import psutil
+                    return psutil.Process(self.workers[w].pid) \
+                        .memory_info().rss
+                except Exception:
+                    return 0
+            # newest first in 10s buckets (loses least progress), actual
+            # RSS breaking ties toward the memory hog
+            victim = max(pool, key=lambda w: (
+                int(self._worker_started.get(w, 0.0) // 10), rss(w)))
+            proc = self.workers[victim]
+            self._oom_killed[victim] = True
+        logger.warning(
+            "memory usage %.0f%% above threshold %.0f%%: killing newest "
+            "worker %s (pid %s)", frac * 100,
+            self.config.memory_usage_threshold * 100,
+            victim[:6].hex(), proc.pid)
+        try:
+            proc.kill()
+        except Exception:
+            pass
 
     def _heartbeat_loop(self) -> None:
         period = self.config.health_check_period_ms / 1000.0

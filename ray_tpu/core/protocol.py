@@ -72,6 +72,7 @@ REMOVE_PG = b"RPG"
 PG_UPDATE = b"PGU"
 # cluster
 HEARTBEAT = b"HBT"           # node->controller {node_id, available, total, stats}
+WORKER_PINNED = b"WPN"       # controller->node {worker_identity}: hosts an actor
 PING = b"PNG"                # driver->controller liveness poke: lets a
                              # restarted controller ask it to RECONNECT
 NODE_UPDATE = b"NUP"
