@@ -171,6 +171,13 @@ def remote(*args, **options):
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None):
+    # compiled-DAG channel results resolve locally (reference:
+    # CompiledDAGRef is accepted by ray.get, scalar or in lists)
+    if hasattr(refs, "__dag_local_value__"):
+        return refs.__dag_local_value__(timeout)
+    if isinstance(refs, (list, tuple)) and any(
+            hasattr(r, "__dag_local_value__") for r in refs):
+        return [get(r, timeout=timeout) for r in refs]
     return global_worker().get(refs, timeout=timeout)
 
 

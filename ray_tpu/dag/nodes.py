@@ -180,21 +180,173 @@ class MultiOutputNode(DAGNode):
         return [_resolve(a, ctx) for a in self._bound_args]
 
 
+def _channel_stage_loop(instance, in_reader, out_chan, method):
+    """Runs ON the stage actor for the pipeline's lifetime: read from the
+    upstream channel, execute the bound method, write downstream — zero
+    control-plane messages per item (reference: compiled-DAG actors block
+    on mutable-object channels, experimental_mutable_object_manager.h).
+
+    Items travel as ("ok", value) / ("err", exception) envelopes: a stage
+    exception flows down the chain to the driver's get() instead of
+    silently wedging the pipeline; the stage keeps serving later items."""
+    from ray_tpu.experimental.channel import ChannelClosed
+    fn = getattr(instance, method)
+    try:
+        while True:
+            try:
+                tag, value = in_reader.read()
+            except ChannelClosed:
+                out_chan.close()
+                return "closed"
+            if tag == "ok":
+                try:
+                    out_chan.write(("ok", fn(value)))
+                    continue
+                except ValueError:
+                    raise  # oversized result: a channel-config error
+                except BaseException as e:  # noqa: BLE001
+                    out_chan.write(("err", e))
+                    continue
+            out_chan.write((tag, value))  # pass an upstream error along
+    finally:
+        in_reader.close()
+
+
+class CompiledDAGRef:
+    """Result handle of a channel-pipeline execute (reference:
+    ``CompiledDAGRef`` — resolved via ``ray.get``)."""
+
+    def __init__(self, pipeline: "_ChannelPipeline", seq: int):
+        self._pipeline = pipeline
+        self._seq = seq
+
+    def __dag_local_value__(self, timeout: Optional[float] = None):
+        return self._pipeline._value_for(self._seq, timeout)
+
+    def get(self, timeout: Optional[float] = None):
+        return self.__dag_local_value__(timeout)
+
+
+class _ChannelPipeline:
+    """Linear actor chain wired with mutable shm channels: one write at
+    the head, one read at the tail, per execution — stages stream through
+    shared memory with no per-hop RPC or object-store traffic."""
+
+    def __init__(self, actors: List[Any], methods: List[str],
+                 capacity: int):
+        from ray_tpu.experimental.channel import Channel
+        self.chans = [Channel(capacity) for _ in range(len(actors) + 1)]
+        self._loops = []
+        for i, (actor, method) in enumerate(zip(actors, methods)):
+            self._loops.append(actor.__ray_call__.remote(
+                _channel_stage_loop, self.chans[i].reader(0),
+                self.chans[i + 1], method))
+        self._out = self.chans[-1].reader(0)
+        self._next_submit = 0
+        self._next_read = 0
+        self._done: Dict[int, Any] = {}
+
+    #: results buffered for out-of-order gets; dropped refs must not
+    #: accumulate forever
+    _MAX_BUFFERED = 4096
+
+    def execute(self, value, timeout: Optional[float] = None
+                ) -> CompiledDAGRef:
+        seq = self._next_submit
+        self._next_submit += 1
+        self.chans[0].write(("ok", value), timeout)
+        return CompiledDAGRef(self, seq)
+
+    def _value_for(self, seq: int, timeout: Optional[float]):
+        while seq not in self._done:
+            if self._next_read > seq:
+                raise RuntimeError("compiled DAG result already consumed "
+                                   "or evicted")
+            tag, value = self._out.read(timeout)
+            self._done[self._next_read] = (tag, value)
+            self._next_read += 1
+            if len(self._done) > self._MAX_BUFFERED:
+                self._done.pop(min(self._done))  # oldest dropped ref
+        tag, value = self._done.pop(seq)
+        if tag == "err":
+            raise value
+        return value
+
+    def teardown(self) -> None:
+        try:
+            self.chans[0].close()
+        except TimeoutError:
+            pass  # a wedged stage: actors are killed by CompiledDAG
+        try:
+            ray_tpu.get(self._loops, timeout=10)
+        except Exception:
+            pass
+        self._out.close()
+        for ch in self.chans:
+            ch.destroy()
+
+
 class CompiledDAG:
-    """Repeat-execution form: actors are created ONCE and reused across
-    executions, and the topological order is precomputed (reference
-    ``compiled_dag_node.py:141`` — which additionally uses zero-copy
-    mutable-plasma channels; actor reuse is the part that matters for
-    throughput here)."""
+    """Repeat-execution form (reference ``compiled_dag_node.py:141``).
+    A linear chain of bound actor methods over one input compiles to a
+    mutable-channel pipeline: every hop moves through shared memory with
+    zero per-call control-plane messages. Other shapes keep the
+    persistent-actor fast path (actors created once, RPC per hop)."""
+
+    #: per-value channel capacity for compiled pipelines
+    channel_capacity: int = 1 << 20
 
     def __init__(self, root: DAGNode):
         self._root = root
         self._lock = threading.Lock()
         self._persistent_actors: Dict[int, Any] = {}
+        self._pipeline: Optional[_ChannelPipeline] = None
+        self._pipeline_checked = False
+
+    def _try_build_pipeline(self) -> Optional[_ChannelPipeline]:
+        """Detect InputNode -> m1 -> m2 -> ... (each stage a single-arg
+        bound actor method whose data dependency is the previous stage)."""
+        chain: List[ClassMethodNode] = []
+        node = self._root
+        while isinstance(node, ClassMethodNode):
+            if node._bound_kwargs or len(node._bound_args) != 2:
+                return None
+            if not isinstance(node._bound_args[0], ClassNode):
+                return None
+            chain.append(node)
+            node = node._bound_args[1]
+        if not isinstance(node, InputNode) or not chain:
+            return None
+        # each stage needs its own actor: two loops on one serial actor
+        # would deadlock (the second never starts)
+        class_nodes = [id(n._bound_args[0]) for n in chain]
+        if len(set(class_nodes)) != len(class_nodes):
+            return None
+        chain.reverse()
+        ctx = _ExecutionContext((), {})
+        ctx.actors = self._persistent_actors
+        actors = [n._bound_args[0]._apply(ctx) for n in chain]
+        methods = [n._method for n in chain]
+        return _ChannelPipeline(actors, methods, self.channel_capacity)
 
     def execute(self, *args, **kwargs):
-        ctx = _ExecutionContext(args, kwargs)
         with self._lock:
+            if not self._pipeline_checked:
+                self._pipeline_checked = True
+                try:
+                    self._pipeline = self._try_build_pipeline()
+                except Exception:
+                    self._pipeline = None
+            if self._pipeline is not None:
+                if len(args) != 1 or kwargs:
+                    # the stage actors are now dedicated to their channel
+                    # loops — an RPC fallback would queue behind them
+                    # forever, so refuse loudly instead
+                    raise TypeError(
+                        "a compiled channel pipeline takes exactly one "
+                        "positional input")
+                return self._pipeline.execute(args[0])
+            ctx = _ExecutionContext(args, kwargs)
             ctx.actors = self._persistent_actors
             out = _resolve(self._root, ctx)
         if isinstance(out, list):
@@ -203,6 +355,9 @@ class CompiledDAG:
 
     def teardown(self) -> None:
         with self._lock:
+            if self._pipeline is not None:
+                self._pipeline.teardown()
+                self._pipeline = None
             for actor in self._persistent_actors.values():
                 try:
                     ray_tpu.kill(actor)

@@ -1,0 +1,145 @@
+"""Mutable-object channels + compiled-DAG channel pipeline (reference:
+python/ray/experimental/channel.py tests + accelerated-DAG shapes)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental.channel import Channel, ChannelClosed
+
+
+def test_channel_local_roundtrip():
+    ch = Channel(capacity=1 << 16)
+    r = ch.reader(0)
+    try:
+        ch.write({"x": 1})
+        assert r.read(timeout=5) == {"x": 1}
+        ch.write([1, 2, 3])
+        assert r.read(timeout=5) == [1, 2, 3]
+        # single-slot back-pressure: second write blocks until consumed
+        ch.write("a")
+        with pytest.raises(TimeoutError):
+            ch.write("b", timeout=0.2)
+        assert r.read(timeout=5) == "a"
+        ch.write("b", timeout=5)
+        assert r.read(timeout=5) == "b"
+        ch.close()
+        with pytest.raises(ChannelClosed):
+            r.read(timeout=5)
+    finally:
+        r.close()
+        ch.destroy()
+
+
+def test_channel_capacity_enforced():
+    ch = Channel(capacity=64)
+    try:
+        with pytest.raises(ValueError):
+            ch.write(b"x" * 4096)
+    finally:
+        ch.destroy()
+
+
+def test_channel_cross_thread_throughput():
+    ch = Channel(capacity=1 << 12)
+    r = ch.reader(0)
+    n = 2000
+    got = []
+
+    def consume():
+        for _ in range(n):
+            got.append(r.read(timeout=30))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    t0 = time.perf_counter()
+    for i in range(n):
+        ch.write(i, timeout=30)
+    t.join(timeout=30)
+    dt = time.perf_counter() - t0
+    assert got == list(range(n))
+    # zero-RPC hand-off should be far faster than the task path
+    assert n / dt > 2000, f"{n / dt:.0f} handoffs/s"
+    r.close()
+    ch.destroy()
+
+
+def test_compiled_dag_channel_pipeline(ray_start_regular):
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Plus:
+        def __init__(self, k):
+            self.k = k
+        def add(self, x):
+            return x + self.k
+
+    with InputNode() as inp:
+        dag = Plus.bind(100).add.bind(Plus.bind(10).add.bind(inp))
+
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._pipeline is None  # built lazily on first execute
+        assert ray_tpu.get(compiled.execute(1)) == 111
+        assert compiled._pipeline is not None, "channel path not taken"
+        # pipelined: submit several before reading any
+        refs = [compiled.execute(i) for i in range(5)]
+        assert [ray_tpu.get(r) for r in refs] == [110 + i for i in range(5)]
+        # throughput sanity: channel path beats per-call RPC comfortably
+        t0 = time.perf_counter()
+        m = 200
+        for i in range(m):
+            ray_tpu.get(compiled.execute(i))
+        rate = m / (time.perf_counter() - t0)
+        assert rate > 300, f"{rate:.0f} pipeline execs/s"
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_stage_error_propagates(ray_start_regular):
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Div:
+        def div(self, x):
+            return 10 // x
+
+    with InputNode() as inp:
+        dag = Div.bind().div.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        assert ray_tpu.get(compiled.execute(5)) == 2
+        with pytest.raises(ZeroDivisionError):
+            ray_tpu.get(compiled.execute(0))
+        # the stage survives the error and keeps serving
+        assert ray_tpu.get(compiled.execute(2)) == 5
+        # lists of pipeline refs work through ray_tpu.get
+        refs = [compiled.execute(1), compiled.execute(10)]
+        assert ray_tpu.get(refs) == [10, 1]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_same_actor_falls_back(ray_start_regular):
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Two:
+        def f(self, x):
+            return x + 1
+        def g(self, x):
+            return x * 2
+
+    with InputNode() as inp:
+        a = Two.bind()
+        dag = a.f.bind(a.g.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        # two stages on ONE serial actor would deadlock a channel
+        # pipeline; the compiler must fall back to the RPC path
+        assert ray_tpu.get(compiled.execute(3)) == 7
+        assert compiled._pipeline is None
+    finally:
+        compiled.teardown()
