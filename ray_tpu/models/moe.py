@@ -1,0 +1,80 @@
+"""Mixture-of-experts MLP with capacity-based top-1 (Switch) routing.
+
+TPU-first dispatch: token->expert movement is expressed as einsums over a
+dispatch one-hot ``[tokens, experts, capacity]`` (the flaxformer/Switch
+formulation). With expert weights sharded on the ``ep`` mesh axis and
+tokens on ``dp``/``fsdp``, XLA lowers the two boundary einsums to
+all-to-alls over ICI — no hand-written NCCL alltoall like torch MoE
+stacks (reference has no in-tree MoE; SURVEY.md §2.5 commits the ``ep``
+axis here).
+
+Static shapes throughout (capacity fixes the per-expert token count, the
+overflow is dropped and carried by the residual), so the whole layer
+jits into the one GSPMD program like everything else.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_mlp(c, lp, h):
+    """h: [batch, seq, d_model] (compute dtype). Returns (out, aux_loss).
+
+    lp carries ``moe_wg [D,E]``, ``moe_wi [E,D,F]``, ``moe_wo [E,F,D]``.
+    aux_loss is the Switch load-balancing term (encourages uniform
+    routing; weight it into the training loss).
+    """
+    dt = c.dtype
+    B, S, D = h.shape
+    E = c.n_experts
+    N = B * S
+    capacity = max(1, int(c.capacity_factor * N / E))
+    x = h.reshape(N, D)
+
+    logits = jnp.dot(x, lp["moe_wg"].astype(dt)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)              # [N, E]
+    gate = jnp.max(probs, axis=-1)                       # top-1 weight
+    expert = jnp.argmax(probs, axis=-1)                  # [N]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+
+    # position of each token within its expert's buffer; tokens past
+    # capacity are dropped (their residual passes through unchanged)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0      # [N, E]
+    keep = ((pos >= 0.0) & (pos < capacity)).astype(jnp.float32)
+    slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    # [N, E, capacity] dispatch one-hot
+    dispatch = jax.nn.one_hot(slot, capacity, dtype=jnp.float32) \
+        * (onehot * keep)[..., None]
+    combine = dispatch * gate[:, None, None]
+
+    # boundary einsums: tokens-sharded <-> expert-sharded (all-to-all)
+    xin = jnp.einsum("nec,nd->ecd", dispatch.astype(dt), x)
+    hmid = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin,
+                                  lp["moe_wi"].astype(dt)))
+    xout = jnp.einsum("ecf,efd->ecd", hmid, lp["moe_wo"].astype(dt))
+    y = jnp.einsum("nec,ecd->nd", combine.astype(dt), xout)
+
+    # Switch aux loss: E * sum_e mean(frac routed to e) * mean(prob e)
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return y.reshape(B, S, D), aux
+
+
+def moe_param_shapes(c):
+    """(name -> shape) for one layer's MoE parameters."""
+    return {
+        "moe_wg": (c.d_model, c.n_experts),
+        "moe_wi": (c.n_experts, c.d_model, c.d_ff),
+        "moe_wo": (c.n_experts, c.d_ff, c.d_model),
+    }
+
+
+def moe_logical_axes():
+    return {
+        "moe_wg": ("layers", "embed", None),
+        "moe_wi": ("layers", "expert", "embed", "mlp"),
+        "moe_wo": ("layers", "expert", "mlp", "embed"),
+    }

@@ -22,6 +22,10 @@ MODEL_CONFIGS: Dict[str, TransformerConfig] = {
         vocab_size=50400, d_model=4096, n_layers=28, n_heads=16,
         head_dim=256, d_ff=16384, max_seq_len=2048, rotary_dim=64,
         block_style="gptj"),
+    "moe-tiny": TransformerConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, head_dim=16,
+        d_ff=128, max_seq_len=128, rotary_dim=8, block_style="gptj",
+        n_experts=4, dtype=jnp.float32, remat=False),
     "gptj-tiny": TransformerConfig(
         vocab_size=512, d_model=64, n_layers=2, n_heads=4, head_dim=16,
         d_ff=256, max_seq_len=128, rotary_dim=8, block_style="gptj",

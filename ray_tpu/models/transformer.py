@@ -61,6 +61,11 @@ class TransformerConfig:
     attn_impl: str = "auto"                  # ops.multihead_attention impl
     attn_block_q: int = 512
     attn_block_k: int = 512
+    # MoE (0 = dense): every layer's MLP becomes n_experts experts with
+    # Switch top-1 routing, weights sharded on the ep mesh axis
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def kv_heads(self) -> int:
@@ -72,7 +77,11 @@ class TransformerConfig:
         e, v, h = self.d_model, self.vocab_size, self.n_heads * self.head_dim
         kvh = self.kv_heads * self.head_dim
         per_layer = e * h + 2 * e * kvh + h * e          # q, k, v, o
-        if self.block_style == "llama":
+        if self.n_experts:
+            per_layer += e * self.n_experts \
+                + self.n_experts * 2 * e * self.d_ff     # router + experts
+            per_layer += 2 * e                           # norms
+        elif self.block_style == "llama":
             per_layer += 3 * e * self.d_ff + 2 * e       # swiglu + 2 rmsnorm
         else:
             per_layer += 2 * e * self.d_ff + self.d_ff + e  # fc biases
@@ -82,11 +91,21 @@ class TransformerConfig:
         total += e * v + (v if self.block_style == "gptj" else 0)  # lm head
         return total
 
+    @property
+    def num_active_params(self) -> int:
+        """Params touched per token: with Switch top-1 routing only ONE
+        expert's MLP runs per token — FLOPs must not count the rest."""
+        if not self.n_experts:
+            return self.num_params
+        inactive = self.n_layers * (self.n_experts - 1) \
+            * 2 * self.d_model * self.d_ff
+        return self.num_params - inactive
+
     def flops_per_token(self, seq_len: Optional[int] = None) -> float:
-        """Approximate train FLOPs/token (6·N params + attention term)."""
+        """Approximate train FLOPs/token (6·N active params + attention)."""
         s = seq_len or self.max_seq_len
         attn = 12 * self.n_layers * self.n_heads * self.head_dim * s
-        return 6.0 * self.num_params + attn
+        return 6.0 * self.num_active_params + attn
 
 
 # ------------------------------------------------------------------ init
@@ -111,7 +130,29 @@ def init_params(config: TransformerConfig, key) -> Dict:
         "wv": stack(keys[2], (c.d_model, kvh)),
         "wo": stack(keys[3], (h, c.d_model), out_scale),
     }
-    if c.block_style == "llama":
+    if c.n_experts:
+        from ray_tpu.models.moe import moe_param_shapes
+        mk = jax.random.split(keys[6], 3)
+        layers.update({
+            name: stack(mk[i], shape,
+                        out_scale if name == "moe_wo" else 0.02)
+            for i, (name, shape) in
+            enumerate(sorted(moe_param_shapes(c).items()))})
+        if c.block_style == "llama":
+            layers.update({
+                "attn_norm": jnp.ones((L, c.d_model), jnp.float32),
+                "mlp_norm": jnp.ones((L, c.d_model), jnp.float32)})
+            final = {"scale": jnp.ones((c.d_model,), jnp.float32)}
+            head = {"w": _dense_init(keys[8], (c.d_model, c.vocab_size))}
+        else:
+            layers.update({
+                "ln_scale": jnp.ones((L, c.d_model), jnp.float32),
+                "ln_bias": jnp.zeros((L, c.d_model), jnp.float32)})
+            final = {"scale": jnp.ones((c.d_model,), jnp.float32),
+                     "bias": jnp.zeros((c.d_model,), jnp.float32)}
+            head = {"w": _dense_init(keys[8], (c.d_model, c.vocab_size)),
+                    "b": jnp.zeros((c.vocab_size,), jnp.float32)}
+    elif c.block_style == "llama":
         layers.update({
             "w_gate": stack(keys[4], (c.d_model, c.d_ff)),
             "w_up": stack(keys[5], (c.d_model, c.d_ff)),
@@ -152,7 +193,20 @@ def logical_axes(config: TransformerConfig) -> Dict:
         "wv": ("layers", "embed", "kv"),
         "wo": ("layers", "heads", "embed"),
     }
-    if c.block_style == "llama":
+    if c.n_experts:
+        from ray_tpu.models.moe import moe_logical_axes
+        layers = {**common, **moe_logical_axes()}
+        if c.block_style == "llama":
+            layers.update({"attn_norm": ("layers", "embed"),
+                           "mlp_norm": ("layers", "embed")})
+            final = {"scale": ("embed",)}
+            head = {"w": ("embed", "vocab")}
+        else:
+            layers.update({"ln_scale": ("layers", "embed"),
+                           "ln_bias": ("layers", "embed")})
+            final = {"scale": ("embed",), "bias": ("embed",)}
+            head = {"w": ("embed", "vocab"), "b": ("vocab",)}
+    elif c.block_style == "llama":
         layers = {**common,
                   "w_gate": ("layers", "embed", "mlp"),
                   "w_up": ("layers", "embed", "mlp"),
@@ -222,15 +276,28 @@ def _attn_sublayer(c, h, lp, sin, cos, layout, mesh, rules):
                       lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
 
 
-def _gptj_block(c, x, lp, sin, cos, mesh, rules):
-    h = layer_norm(x, lp["ln_scale"], lp["ln_bias"])
+def _mlp_sublayer(c, h, lp):
+    """Dense or MoE MLP on normed input h; returns (out, moe_aux)."""
     dt = c.dtype
-    att = _attn_sublayer(c, h, lp, sin, cos, "gptj", mesh, rules)
+    if c.n_experts:
+        from ray_tpu.models.moe import moe_mlp
+        return moe_mlp(c, lp, h.astype(dt))
+    if c.block_style == "llama":
+        gate = jax.nn.silu(jnp.dot(h, lp["w_gate"].astype(dt)))
+        up = jnp.dot(h, lp["w_up"].astype(dt))
+        return jnp.dot(gate * up, lp["w_down"].astype(dt)), 0.0
     mlp = jnp.dot(h.astype(dt), lp["fc_in"].astype(dt)) \
         + lp["fc_in_b"].astype(dt)
     mlp = jax.nn.gelu(mlp)
-    mlp = jnp.dot(mlp, lp["fc_out"].astype(dt)) + lp["fc_out_b"].astype(dt)
-    return x + (att + mlp).astype(x.dtype)
+    return jnp.dot(mlp, lp["fc_out"].astype(dt)) \
+        + lp["fc_out_b"].astype(dt), 0.0
+
+
+def _gptj_block(c, x, lp, sin, cos, mesh, rules):
+    h = layer_norm(x, lp["ln_scale"], lp["ln_bias"])
+    att = _attn_sublayer(c, h, lp, sin, cos, "gptj", mesh, rules)
+    mlp, aux = _mlp_sublayer(c, h, lp)
+    return x + (att + mlp).astype(x.dtype), aux
 
 
 def _llama_block(c, x, lp, sin, cos, mesh, rules):
@@ -239,18 +306,18 @@ def _llama_block(c, x, lp, sin, cos, mesh, rules):
     att = _attn_sublayer(c, h, lp, sin, cos, "neox", mesh, rules)
     x = x + att.astype(x.dtype)
     h2 = rms_norm(x, lp["mlp_norm"]).astype(dt)
-    gate = jax.nn.silu(jnp.dot(h2, lp["w_gate"].astype(dt)))
-    up = jnp.dot(h2, lp["w_up"].astype(dt))
-    mlp = jnp.dot(gate * up, lp["w_down"].astype(dt))
-    return x + mlp.astype(x.dtype)
+    mlp, aux = _mlp_sublayer(c, h2, lp)
+    return x + mlp.astype(x.dtype), aux
 
 
 def apply(config: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
-          mesh=None, rules=None) -> jnp.ndarray:
+          mesh=None, rules=None, return_moe_aux: bool = False):
     """Forward pass: (batch, seq) int32 -> (batch, seq, vocab) logits.
 
-    ``mesh``/``rules`` enable in-graph sharding constraints and ring
-    attention; both optional (single-device path needs neither).
+    Always returns logits; with ``return_moe_aux=True`` returns
+    ``(logits, moe_aux_loss)`` (0.0 for dense configs). ``mesh``/``rules``
+    enable in-graph sharding constraints and ring attention; both
+    optional (single-device path needs neither).
     """
     c = config
     x = jnp.take(params["embed"], input_ids, axis=0).astype(c.dtype)
@@ -266,13 +333,13 @@ def apply(config: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         body = jax.checkpoint(body)
 
     def scan_fn(carry, lp):
-        out = body(carry, lp)
+        out, aux = body(carry, lp)
         if mesh is not None and rules is not None:
             from ray_tpu.parallel.sharding import constrain
             out = constrain(out, mesh, rules, ("batch", "sequence", None))
-        return out, None
+        return out, aux
 
-    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    x, layer_aux = jax.lax.scan(scan_fn, x, params["layers"])
 
     fn = params["final_norm"]
     if c.block_style == "llama":
@@ -284,6 +351,8 @@ def apply(config: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         logits = jnp.dot(x.astype(c.dtype),
                          params["lm_head"]["w"].astype(c.dtype))
         logits = logits + params["lm_head"]["b"].astype(c.dtype)
+    if return_moe_aux:
+        return logits, jnp.sum(layer_aux) if c.n_experts else 0.0
     return logits
 
 
@@ -292,12 +361,17 @@ def lm_loss(config: TransformerConfig, params: Dict, batch: Dict,
     """Next-token LM loss. batch: {"input_ids": (b,s) int32,
     "loss_mask": optional (b,s)}. Returns (loss, aux)."""
     ids = batch["input_ids"]
-    logits = apply(config, params, ids, mesh=mesh, rules=rules)
+    logits, moe_aux = apply(config, params, ids, mesh=mesh, rules=rules,
+                            return_moe_aux=True)
     labels = ids[:, 1:]
     mask = batch.get("loss_mask")
     mask = mask[:, 1:] if mask is not None else None
     loss, n = cross_entropy_loss(logits[:, :-1], labels, mask=mask)
-    return loss, {"n_tokens": n}
+    aux = {"n_tokens": n}
+    if config.n_experts:
+        loss = loss + config.moe_aux_weight * moe_aux
+        aux["moe_aux"] = moe_aux
+    return loss, aux
 
 
 class Transformer:

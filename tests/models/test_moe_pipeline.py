@@ -1,0 +1,98 @@
+"""Expert parallelism (MoE dispatch) + pipeline parallelism — the two
+SURVEY §2.5 strategies that previously existed only as axis names."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.registry import get_config
+from ray_tpu.models.training import make_train_step
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_tpu.parallel.sharding import FSDP_RULES
+
+
+def test_moe_forward_routes_and_conserves(cpu_mesh_devices):
+    from ray_tpu.models.moe import moe_mlp
+    cfg = get_config("moe-tiny")
+    key = jax.random.PRNGKey(0)
+    lp = {
+        "moe_wg": 0.1 * jax.random.normal(key, (64, 4)),
+        "moe_wi": 0.1 * jax.random.normal(key, (4, 64, 128)),
+        "moe_wo": 0.1 * jax.random.normal(key, (4, 128, 64)),
+    }
+    h = jax.random.normal(jax.random.fold_in(key, 1), (2, 16, 64))
+    out, aux = moe_mlp(cfg, lp, h)
+    assert out.shape == h.shape
+    assert jnp.isfinite(out).all()
+    # uniform-ish routing at init: aux close to its minimum of 1.0
+    assert 0.9 < float(aux) < 2.5
+
+
+def test_moe_train_step_on_ep_mesh(cpu_mesh_devices):
+    cfg = get_config("moe-tiny")
+    mesh = build_mesh(MeshSpec(dp=2, ep=2, tp=2), cpu_mesh_devices)
+    bundle = make_train_step(cfg, mesh, rules=FSDP_RULES,
+                             learning_rate=1e-2)
+    state = bundle.init(seed=0)
+    # expert weights really shard over ep
+    wi = state["params"]["layers"]["moe_wi"]
+    ep_shards = {s.device.id for s in wi.addressable_shards}
+    assert len(ep_shards) == 8
+    spec = wi.sharding.spec
+    assert "ep" in str(spec), spec
+    ids = np.random.RandomState(0).randint(
+        1, 512, size=(4, 32)).astype(np.int32)
+    losses = []
+    for _ in range(3):
+        state, metrics = bundle.step(
+            state, {"input_ids": ids,
+                    "loss_mask": np.ones_like(ids, np.float32)})
+        assert np.isfinite(float(metrics["loss"]))
+        losses.append(float(metrics["loss"]))
+    assert losses[2] < losses[0]  # memorizing one batch must improve
+
+
+def test_pipeline_parallel_matches_sequential(cpu_mesh_devices):
+    from ray_tpu.ops.pipeline import pipeline_apply, stack_stage_params
+    mesh = build_mesh(MeshSpec(pp=4, dp=2), cpu_mesh_devices)
+    S = 4
+    key = jax.random.PRNGKey(0)
+    ws = [0.3 * jax.random.normal(jax.random.fold_in(key, i), (16, 16))
+          for i in range(S)]
+    params = stack_stage_params([{"w": w} for w in ws])
+
+    def stage(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    x = jax.random.normal(jax.random.fold_in(key, 9), (8, 16))
+    out = pipeline_apply(stage, params, x, mesh, n_microbatches=4)
+    ref = x
+    for w in ws:
+        ref = jnp.tanh(ref @ w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+    # gradients flow through the pipeline schedule (AD produces the
+    # backward pipeline automatically)
+    def loss_pipe(params):
+        return jnp.sum(pipeline_apply(stage, params, x, mesh, 4) ** 2)
+
+    def loss_ref(wlist):
+        h = x
+        for w in wlist:
+            h = jnp.tanh(h @ w)
+        return jnp.sum(h ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(params)["w"]
+    g_ref = jnp.stack(jax.grad(loss_ref)(ws))
+    np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_bad_microbatch_raises(cpu_mesh_devices):
+    from ray_tpu.ops.pipeline import pipeline_apply
+    mesh = build_mesh(MeshSpec(pp=4, dp=2), cpu_mesh_devices)
+    with pytest.raises(ValueError):
+        pipeline_apply(lambda p, h: h, {"w": jnp.zeros((4, 1))},
+                       jnp.zeros((7, 16)), mesh, n_microbatches=4)
