@@ -7,6 +7,7 @@ algorithm; PG the minimal baseline.
 
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import EnvRunner, compute_gae
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.pg import PG, PGConfig
@@ -16,6 +17,8 @@ from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "DQN",
+    "DQNConfig",
     "EnvRunner",
     "Learner",
     "LearnerGroup",
