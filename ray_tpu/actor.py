@@ -19,7 +19,7 @@ from ray_tpu.core.global_state import global_worker
 from ray_tpu.core.ids import ActorID, TaskID
 from ray_tpu.core.task_spec import FunctionDescriptor, TaskSpec
 from ray_tpu.remote_function import (
-    make_scheduling_strategy, resources_from_opts)
+    _prepare_env, make_scheduling_strategy, resources_from_opts)
 
 _ACTOR_DEFAULT_OPTS = dict(
     num_cpus=1.0, num_tpus=0.0, resources=None, max_restarts=0,
@@ -108,7 +108,7 @@ class ActorClass:
             namespace=opts.get("namespace") or "",
             is_async_actor=self._is_async,
             name=f"{self.__name__}.__init__",
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_prepare_env(w, opts.get("runtime_env")),
         )
         w.create_actor(spec)
         return ActorHandle(actor_id, self.__name__,

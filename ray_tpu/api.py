@@ -27,6 +27,7 @@ from ray_tpu.core.ids import ActorID, NodeID
 from ray_tpu.core.object_ref import ObjectRef
 
 _head = None  # _HeadProcess for the in-process controller+node
+_log_monitor = None
 
 
 class _HeadProcess:
@@ -122,6 +123,11 @@ def init(address: Optional[str] = None,
     runtime.namespace = namespace
     set_global_worker(runtime)
     reply = runtime.register()
+    global _log_monitor
+    if log_to_driver:
+        from ray_tpu.core.log_monitor import LogMonitor
+        _log_monitor = LogMonitor(session_dir)
+        _log_monitor.start()
     atexit.register(_atexit_shutdown)
     return {"session_dir": session_dir, "job_id": runtime.job_id.hex()}
 
@@ -134,7 +140,13 @@ def _atexit_shutdown():
 
 
 def shutdown() -> None:
-    global _head
+    global _head, _log_monitor
+    if _log_monitor is not None:
+        try:
+            _log_monitor.stop()
+        except Exception:
+            pass
+        _log_monitor = None
     w = try_global_worker()
     if w is not None:
         try:

@@ -272,10 +272,17 @@ class WorkerExecutor:
         retriable = True
         results = []
         values: Optional[list] = None
+        restore_env = None
         try:
             if tid_b in self._cancelled:
                 self._cancelled.pop(tid_b, None)
                 raise TaskCancelledError(spec.task_id)
+            if spec.runtime_env and not spec.is_actor_task \
+                    and not spec.is_actor_creation:
+                # normal tasks mount their env for THIS task only: pool
+                # workers are shared, so env/cwd/sys.path are restored
+                # after execution (reference: env-keyed worker pools)
+                restore_env = self._apply_runtime_env(spec.runtime_env)
             args, kwargs = self._resolve_args(
                 spec, m.get("inline_args") or {}, m.get("arg_errors") or {})
             if spec.is_actor_creation:
@@ -311,6 +318,11 @@ class WorkerExecutor:
         if on_main:
             self._current_tid = None
         self._cancelled.pop(tid_b, None)
+        if restore_env is not None:
+            try:
+                restore_env()
+            except Exception:
+                logger.exception("runtime_env restore failed")
         if error_blob is None:
             for i, value in enumerate(values):
                 oid = ObjectID.for_task_return(spec.task_id, i + 1)
@@ -430,16 +442,13 @@ class WorkerExecutor:
         os._exit(0)
 
     @staticmethod
-    def _apply_runtime_env(env: dict) -> None:
-        """Subset of the reference runtime_env (env_vars, working_dir);
-        pip/conda are not applicable in a hermetic TPU image."""
-        for k, v in (env.get("env_vars") or {}).items():
-            os.environ[k] = str(v)
-        wd = env.get("working_dir")
-        if wd and os.path.isdir(wd):
-            os.chdir(wd)
-            if wd not in sys.path:
-                sys.path.insert(0, wd)
+    def _apply_runtime_env(env: dict):
+        """env_vars + cached working_dir/py_modules mounts (reference:
+        the worker half of the runtime-env agent; pip/conda rejected at
+        submission — hermetic TPU image). Returns the restore callable
+        (used for normal tasks; actors keep their env for life)."""
+        from ray_tpu.core.runtime_env import apply_runtime_env
+        return apply_runtime_env(env)
 
 
 def _orphan_watchdog(parent_pid: int) -> None:

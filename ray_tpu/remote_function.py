@@ -18,6 +18,16 @@ from ray_tpu.core.global_state import global_worker
 from ray_tpu.core.ids import TaskID
 from ray_tpu.core.task_spec import FunctionDescriptor, SchedulingStrategy, TaskSpec
 
+
+def _prepare_env(w, env):
+    """Package working_dir/py_modules into the session cache before the
+    spec ships (reference: runtime-env agent URI creation)."""
+    if not env:
+        return env
+    from ray_tpu.core.runtime_env import prepare_runtime_env
+    return prepare_runtime_env(env, w.session_dir)
+
+
 _DEFAULT_OPTS = dict(
     num_cpus=1.0, num_tpus=0.0, resources=None, num_returns=1,
     max_retries=3, retry_exceptions=False, name=None,
@@ -116,7 +126,7 @@ class RemoteFunction:
             max_retries=opts["max_retries"],
             retry_exceptions=bool(opts["retry_exceptions"]),
             name=opts.get("name") or self.__name__,
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_prepare_env(w, opts.get("runtime_env")),
         )
         refs = w.submit_task(spec)
         return refs[0] if opts["num_returns"] == 1 else refs
