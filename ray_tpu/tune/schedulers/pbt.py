@@ -54,6 +54,7 @@ class PopulationBasedTraining(TrialScheduler):
                  hyperparam_mutations: Optional[Dict] = None,
                  quantile_fraction: float = 0.25,
                  resample_probability: float = 0.25,
+                 synch: bool = False,
                  seed: Optional[int] = None):
         super().__init__(metric, mode)
         self.time_attr = time_attr
@@ -61,9 +62,14 @@ class PopulationBasedTraining(TrialScheduler):
         self.mutations = hyperparam_mutations or {}
         self.quantile = quantile_fraction
         self.resample_prob = resample_probability
+        self.synch = synch
         self._rng = random.Random(seed)
         self._last_perturb: Dict[str, float] = {}
         self._latest: Dict[str, float] = {}  # trial_id -> score
+        # synch mode: trial_id -> score for trials waiting at the
+        # current perturbation boundary.
+        self._at_boundary: Dict[str, float] = {}
+        self._round = 1
         self.perturbation_count = 0
 
     def on_trial_result(self, controller, trial, result: Dict) -> str:
@@ -72,15 +78,31 @@ class PopulationBasedTraining(TrialScheduler):
         if t is None or score is None:
             return self.CONTINUE
         self._latest[trial.trial_id] = score
+        if self.synch:
+            return self._synch_step(controller, trial, t, score)
         last = self._last_perturb.get(trial.trial_id, 0.0)
         if t - last < self.interval:
             return self.CONTINUE
-        self._last_perturb[trial.trial_id] = t
 
-        live = {tid: s for tid, s in self._latest.items()
-                if controller.is_live(tid)}
-        if len(live) < 2:
+        # Exploit sources are any scored trial we can still clone from:
+        # live ones (checkpointed on demand) or terminated ones that left
+        # a checkpoint behind. Restricting to live trials deadlocks PBT
+        # when population members run serially (a fast trial can finish
+        # before a slow one produces its first score).
+        candidates = {}
+        for tid, s in self._latest.items():
+            other = controller.get_trial(tid)
+            if other is None:
+                continue
+            if controller.is_live(tid) or other.checkpoint is not None:
+                candidates[tid] = s
+        if len(candidates) < 2:
+            # Population not comparable yet — keep the perturbation slot
+            # so the next report retries instead of waiting a full
+            # interval.
             return self.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        live = candidates
         ordered = sorted(live, key=live.get)
         n_q = max(1, int(len(ordered) * self.quantile))
         bottom = set(ordered[:n_q])
@@ -97,3 +119,65 @@ class PopulationBasedTraining(TrialScheduler):
         controller.exploit_trial(trial, source, new_config)
         self.perturbation_count += 1
         return self.CONTINUE
+
+    # -- synchronous mode (reference pbt.py `synch=True`) --------------
+    # Trials PAUSE at each perturbation boundary (t >= round*interval)
+    # until the whole live population has arrived; the last arrival
+    # runs the exploit/explore round, everyone resumes together. This
+    # makes PBT deterministic under any trial interleaving.
+    def _synch_step(self, controller, trial, t: float,
+                    score: float) -> str:
+        if t < self._round * self.interval:
+            return self.CONTINUE
+        self._at_boundary[trial.trial_id] = score
+        if self._outstanding(controller):
+            return self.PAUSE
+        self._run_round(controller)
+        # The caller resumes via the controller's CONTINUE path; the
+        # paused cohort was resumed inside _run_round.
+        return self.CONTINUE
+
+    def _outstanding(self, controller) -> bool:
+        """Any live trial that has not reached the boundary yet?"""
+        for other in controller.trials:
+            if other.trial_id in self._at_boundary:
+                continue
+            if controller.is_live(other.trial_id):
+                return True
+        return False
+
+    def _run_round(self, controller) -> None:
+        cohort = dict(self._at_boundary)
+        self._at_boundary.clear()
+        self._round += 1
+        if len(cohort) >= 2:
+            ordered = sorted(cohort, key=cohort.get)
+            n_q = max(1, int(len(ordered) * self.quantile))
+            bottom = [tid for tid in ordered[:n_q]
+                      if controller.is_live(tid)]
+            top = ordered[-n_q:]
+            for tid in bottom:
+                target = controller.get_trial(tid)
+                pool = [s for s in top if s != tid]
+                if target is None or not pool:
+                    continue
+                source = controller.get_trial(self._rng.choice(pool))
+                if source is None:
+                    continue
+                new_config = _explore(source.config, self.mutations,
+                                      self.resample_prob, self._rng)
+                controller.exploit_trial(target, source, new_config)
+                self.perturbation_count += 1
+        for tid in cohort:
+            other = controller.get_trial(tid)
+            if other is not None:
+                controller.unpause_trial(other)
+
+    def on_trial_complete(self, controller, trial, result: Dict) -> None:
+        if not self.synch:
+            return
+        # A finished trial can no longer block the boundary; if it was
+        # the straggler, run the round now so the paused cohort resumes.
+        self._at_boundary.pop(trial.trial_id, None)
+        if self._at_boundary and not self._outstanding(controller):
+            self._run_round(controller)

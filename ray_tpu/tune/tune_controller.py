@@ -21,7 +21,7 @@ from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train._internal.storage import StorageContext
 from ray_tpu.tune import _trial_context
 from ray_tpu.tune.experiment import (
-    ERROR, PENDING, RUNNING, TERMINATED, Trial)
+    ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial)
 from ray_tpu.tune.placement_groups import PlacementGroupFactory
 from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
 from ray_tpu.tune.search import BasicVariantGenerator, Searcher
@@ -112,7 +112,7 @@ class TuneController:
 
     def is_live(self, trial_id: str) -> bool:
         t = self.get_trial(trial_id)
-        return t is not None and t.status == RUNNING
+        return t is not None and t.status in (RUNNING, PAUSED)
 
     def _trial_limit(self) -> int:
         """Total trials to create: the searcher's own count if it knows
@@ -276,7 +276,8 @@ class TuneController:
     def _capacity(self) -> int:
         if self.max_concurrent <= 0:
             return 1 << 30
-        running = sum(1 for t in self.trials if t.status == RUNNING)
+        running = sum(
+            1 for t in self.trials if t.status in (RUNNING, PAUSED))
         return max(0, self.max_concurrent - running)
 
     def run(self) -> List[Trial]:
@@ -285,6 +286,15 @@ class TuneController:
         while True:
             self._fill()
             if not self._futures:
+                paused = [t for t in self.trials if t.status == PAUSED]
+                if paused and not any(
+                        t.status in (PENDING, RUNNING) for t in self.trials):
+                    # Every live trial is paused and nothing can wake
+                    # them — a scheduler bug would deadlock the loop, so
+                    # resume them instead.
+                    for t in paused:
+                        self.unpause_trial(t)
+                    continue
                 if any(t.status in (PENDING, RUNNING) for t in self.trials):
                     continue
                 break
@@ -332,8 +342,18 @@ class TuneController:
         decision = self.scheduler.on_trial_result(self, trial, result)
         if decision == TrialScheduler.STOP:
             self._stop_trial(trial, TERMINATED)
+        elif decision == TrialScheduler.PAUSE:
+            # Actor (and its resources) stay alive; the scheduler must
+            # later call unpause_trial to resume training.
+            trial.status = PAUSED
         else:
             self._submit_train(trial)
+
+    def unpause_trial(self, trial: Trial) -> None:
+        if trial.status != PAUSED:
+            return
+        trial.status = RUNNING
+        self._submit_train(trial)
 
     def _handle_failure(self, trial: Trial, error: BaseException) -> None:
         n = self._failures.get(trial.trial_id, 0)

@@ -153,10 +153,13 @@ def test_pbt_exploits(ray_session, tmp_path):
             self.config = new_config
             return True
 
+    # synch=True: trials rendezvous at each perturbation boundary, so
+    # the exploit is deterministic under any trial interleaving.
     pbt = PopulationBasedTraining(
         metric="score", mode="max", perturbation_interval=3,
         hyperparam_mutations={"rate": [0.1, 1.0]},
-        quantile_fraction=0.5, resample_probability=0.0, seed=0)
+        quantile_fraction=0.5, resample_probability=0.0, synch=True,
+        seed=0)
     tuner = Tuner(
         Walker,
         param_space={"rate": tune.grid_search([0.1, 1.0])},
