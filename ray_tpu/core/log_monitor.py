@@ -82,11 +82,19 @@ class LogMonitor:
                     chunk = f.read(1 << 20)
             except OSError:
                 continue
-            # consume whole lines only; a partial tail waits for more
+            # consume whole lines only; a partial tail waits for more —
+            # unless the window is full with no newline at all (a giant
+            # single line), which must be flushed as-is or the file's
+            # tail would stall at this offset forever
             cut = chunk.rfind(b"\n")
             if cut < 0:
-                continue
-            self._offsets[path] = off + cut + 1
-            text = chunk[:cut].decode(errors="replace")
+                if len(chunk) < (1 << 20):
+                    continue
+                consumed = len(chunk)
+                text = chunk.decode(errors="replace")
+            else:
+                consumed = cut + 1
+                text = chunk[:cut].decode(errors="replace")
+            self._offsets[path] = off + consumed
             for line in text.splitlines():
                 print(f"{prefix} {line}", file=self.out)

@@ -15,11 +15,16 @@ from __future__ import annotations
 
 import hashlib
 import os
+import re
 import shutil
 import sys
 from typing import Any, Dict, Optional
 
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+#: staging dirs end with ".tmp-<pid>-<hex8>" (see _package_dir); a
+#: substring test would misclassify cache entries whose SOURCE dir
+#: happened to contain ".tmp-" in its name
+_STAGING_RE = re.compile(r"\.tmp-\d+-[0-9a-f]{8}$")
 _MAX_PACKAGE_BYTES = 512 << 20
 
 #: options the reference supports that a hermetic TPU image must reject
@@ -59,6 +64,14 @@ def _cache_root(session_dir: str) -> str:
     return os.path.join(session_dir, "runtime_resources")
 
 
+def _touch(path: str) -> None:
+    """Refresh a cache entry's LRU stamp (gc_cache orders by mtime)."""
+    try:
+        os.utime(path, None)
+    except OSError:
+        pass
+
+
 def _package_dir(session_dir: str, src: str, wrap: bool = False) -> str:
     """Copy ``src`` into the content-addressed cache (no-op when the
     same content is already cached — reference: URI cache hits).
@@ -72,8 +85,18 @@ def _package_dir(session_dir: str, src: str, wrap: bool = False) -> str:
         raise ValueError(f"runtime_env path {src!r} is not a directory")
     digest = _hash_dir(src)
     name = os.path.basename(src.rstrip("/"))
-    dest = os.path.join(_cache_root(session_dir), f"{name}-{digest}")
-    if not os.path.isdir(dest):
+    # wrapped (py_modules) and unwrapped (working_dir) layouts of the
+    # same tree are distinct cache entries — keying on content alone
+    # would serve whichever layout was cached first to both consumers
+    layout = "mod" if wrap else "dir"
+    dest = os.path.join(
+        _cache_root(session_dir), f"{name}-{digest}-{layout}")
+    if os.path.isdir(dest):
+        # bump the entry's LRU stamp: copytree preserved the SOURCE
+        # tree's mtime, and gc_cache orders by mtime, so without an
+        # explicit touch a live entry can be evicted as "oldest"
+        _touch(dest)
+    else:
         # unique staging dir: concurrent preparers of the same env must
         # not rmtree/copytree over each other's half-written trees
         tmp = f"{dest}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
@@ -81,11 +104,22 @@ def _package_dir(session_dir: str, src: str, wrap: bool = False) -> str:
         shutil.copytree(
             src, target,
             ignore=shutil.ignore_patterns(*_EXCLUDE_DIRS, "*.pyc"))
+        # copystat gave the staging root the SOURCE's mtime — restamp it
+        # so a concurrent gc_cache can't mistake it for an orphan
+        _touch(tmp)
         try:
             os.replace(tmp, dest)
         except OSError:
-            # a concurrent preparer won the race with identical content
+            # either a concurrent preparer won the race with identical
+            # content (fine), or the staging tree was lost (not fine —
+            # returning a path that doesn't exist would make workers
+            # silently skip the mount)
             shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.isdir(dest):
+                raise RuntimeError(
+                    f"runtime_env packaging of {src!r} failed: staging "
+                    f"dir vanished before publish (cache: {dest})")
+        _touch(dest)
     return dest
 
 
@@ -142,10 +176,13 @@ def apply_runtime_env(env: Dict[str, Any]):
     for k, v in (env.get("env_vars") or {}).items():
         os.environ[k] = str(v)
     for mod_dir in env.get("py_modules") or []:
-        if os.path.isdir(mod_dir) and mod_dir not in sys.path:
-            sys.path.insert(0, mod_dir)
+        if os.path.isdir(mod_dir):
+            _touch(mod_dir)
+            if mod_dir not in sys.path:
+                sys.path.insert(0, mod_dir)
     wd = env.get("working_dir")
     if wd and os.path.isdir(wd):
+        _touch(wd)
         os.chdir(wd)
         if wd not in sys.path:
             sys.path.insert(0, wd)
@@ -169,15 +206,35 @@ def gc_cache(session_dir: str, keep: int = 16) -> int:
     """Drop least-recently-used cache entries beyond ``keep`` (reference:
     URI reference counting + cache GC; sessions are short-lived here so
     LRU-by-mtime is sufficient). Returns number of entries removed."""
+    import time
     root = _cache_root(session_dir)
+    now = time.time()
+    removed = 0
     try:
-        entries = [(os.path.getmtime(os.path.join(root, e)),
-                    os.path.join(root, e)) for e in os.listdir(root)]
+        entries = []
+        for e in os.listdir(root):
+            p = os.path.join(root, e)
+            try:
+                mtime = os.path.getmtime(p)
+            except OSError:
+                continue
+            if _STAGING_RE.search(e):
+                # staging dir: in use by a live preparer if fresh,
+                # orphaned by a crashed one if stale
+                if now - mtime >= 60.0:
+                    shutil.rmtree(p, ignore_errors=True)
+                    removed += 1
+                continue
+            entries.append((mtime, p))
     except FileNotFoundError:
         return 0
     entries.sort(reverse=True)
-    removed = 0
-    for _, path in entries[keep:]:
+    for mtime, path in entries[keep:]:
+        # grace window: entries are utime-stamped on every access (see
+        # _package_dir/apply_runtime_env), so anything touched recently
+        # may be in use by an in-flight task
+        if now - mtime < 60.0:
+            continue
         shutil.rmtree(path, ignore_errors=True)
         removed += 1
     return removed

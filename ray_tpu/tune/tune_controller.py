@@ -244,6 +244,17 @@ class TuneController:
         src_ckpt = self._save_trial_checkpoint(source)
         if src_ckpt is None:
             return
+        if target.actor is None:
+            # Paused target (synch PBT rounds run while the cohort is
+            # parked): stage config + checkpoint; _start_trial applies
+            # both when the trial resumes. The exploit checkpoint also
+            # becomes the trial's own latest checkpoint — otherwise a
+            # post-resume failure-retry would restore pre-exploit
+            # weights under the post-exploit config.
+            target.config = new_config
+            target.checkpoint = src_ckpt
+            target.restore_pending = src_ckpt
+            return
         try:
             ok = ray_tpu.get(target.actor.reset.remote(new_config))
         except (TaskError, ActorError, ActorDiedError):
@@ -276,8 +287,7 @@ class TuneController:
     def _capacity(self) -> int:
         if self.max_concurrent <= 0:
             return 1 << 30
-        running = sum(
-            1 for t in self.trials if t.status in (RUNNING, PAUSED))
+        running = sum(1 for t in self.trials if t.status == RUNNING)
         return max(0, self.max_concurrent - running)
 
     def run(self) -> List[Trial]:
@@ -286,16 +296,20 @@ class TuneController:
         while True:
             self._fill()
             if not self._futures:
-                paused = [t for t in self.trials if t.status == PAUSED]
-                if paused and not any(
-                        t.status in (PENDING, RUNNING) for t in self.trials):
-                    # Every live trial is paused and nothing can wake
-                    # them — a scheduler bug would deadlock the loop, so
-                    # resume them instead.
-                    for t in paused:
-                        self.unpause_trial(t)
-                    continue
                 if any(t.status in (PENDING, RUNNING) for t in self.trials):
+                    continue
+                paused = [t for t in self.trials if t.status == PAUSED]
+                if paused:
+                    # Nothing running and nothing pending: whatever
+                    # paused these trials (soft stop, a synch barrier
+                    # whose trigger died) will never fire again, so
+                    # resume them rather than deadlock or strand them.
+                    # Rescued trials run to completion — re-pausing in
+                    # the experiment tail would thrash actor setup and
+                    # teardown once per training step.
+                    for t in paused:
+                        t._rescued = True
+                        self.unpause_trial(t)
                     continue
                 break
             ready, _ = ray_tpu.wait(
@@ -340,20 +354,36 @@ class TuneController:
             self._stop_trial(trial, TERMINATED)
             return
         decision = self.scheduler.on_trial_result(self, trial, result)
+        if decision == TrialScheduler.PAUSE \
+                and getattr(trial, "_rescued", False):
+            decision = TrialScheduler.CONTINUE
         if decision == TrialScheduler.STOP:
             self._stop_trial(trial, TERMINATED)
         elif decision == TrialScheduler.PAUSE:
-            # Actor (and its resources) stay alive; the scheduler must
-            # later call unpause_trial to resume training.
-            trial.status = PAUSED
+            self._pause_trial(trial)
         else:
             self._submit_train(trial)
 
+    def _pause_trial(self, trial: Trial) -> None:
+        """Checkpoint and park the trial, releasing its actor, placement
+        group, and concurrency slot (a paused trial must not pin compute
+        — median-stopping's soft stop pauses precisely to free it).
+        Resume goes through the normal restore path."""
+        if self._save_trial_checkpoint(trial) is None:
+            # No checkpoint means resuming would silently restart from
+            # scratch; keep training instead of losing state.
+            self._submit_train(trial)
+            return
+        trial.restore_pending = trial.checkpoint
+        self._release_trial_resources(trial)
+        trial.status = PAUSED
+
     def unpause_trial(self, trial: Trial) -> None:
+        """Move a paused trial back to PENDING; _fill restarts it within
+        the concurrency budget and restores its pause checkpoint."""
         if trial.status != PAUSED:
             return
-        trial.status = RUNNING
-        self._submit_train(trial)
+        trial.status = PENDING
 
     def _handle_failure(self, trial: Trial, error: BaseException) -> None:
         n = self._failures.get(trial.trial_id, 0)

@@ -131,6 +131,45 @@ def test_median_stopping_rule_decisions():
     assert d == "STOP"
 
 
+def test_median_stopping_soft_pause_releases_resources(
+        ray_session, tmp_path):
+    """hard_stop=False PAUSEs the losing trial: its actor and slot are
+    released (not pinned), and the controller resumes it once the rest
+    of the experiment finishes, so fit() still terminates cleanly."""
+
+    class Ramp(tune.Trainable):
+        def setup(self, config):
+            self.value = 0.0
+
+        def step(self):
+            self.value += self.config["rate"]
+            return {"score": self.value}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "v.txt"), "w") as f:
+                f.write(str(self.value))
+            return d
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "v.txt")) as f:
+                self.value = float(f.read())
+
+    rule = MedianStoppingRule(metric="score", mode="max", grace_period=2,
+                              min_samples_required=1, hard_stop=False)
+    tuner = Tuner(
+        Ramp,
+        param_space={"rate": tune.grid_search([0.1, 10.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=rule,
+                               max_concurrent_trials=1),
+        run_config=RunConfig(name="soft", storage_path=str(tmp_path),
+                             stop={"training_iteration": 6}))
+    results = tuner.fit()
+    assert results.num_errors == 0
+    # both trials finished (paused one was resumed, restored from its
+    # pause checkpoint, and ran to the stop criterion)
+    assert all(r.metrics["training_iteration"] == 6 for r in results)
+
+
 def test_pbt_exploits(ray_session, tmp_path):
     class Walker(tune.Trainable):
         def setup(self, config):

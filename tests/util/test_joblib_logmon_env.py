@@ -80,6 +80,102 @@ def test_runtime_env_py_modules_and_cache(cluster, tmp_path):
         nope.remote()
 
 
+def test_log_monitor_flushes_giant_line(tmp_path):
+    """A single line >= the 1 MiB read window must not stall the tail
+    (regression: rfind(newline) == -1 left the offset unchanged forever)."""
+    from ray_tpu.core.log_monitor import LogMonitor
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    buf = io.StringIO()
+    mon = LogMonitor(str(tmp_path), out=buf, poll_s=0.05)
+    mon.start()
+    p = logs / "worker-deadbeef.out"
+    with open(p, "wb") as f:
+        f.write(b"x" * (1 << 20))       # giant line, no newline
+        f.write(b"\nAFTER-THE-FLOOD\n")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if "AFTER-THE-FLOOD" in buf.getvalue():
+            break
+        time.sleep(0.1)
+    mon.stop()
+    out = buf.getvalue()
+    assert "AFTER-THE-FLOOD" in out
+    assert "xxxx" in out  # the giant line's content was streamed too
+
+
+def test_runtime_env_layout_cache_no_collision(cluster, tmp_path):
+    """The same source tree used as working_dir and as py_modules needs
+    two cache entries: the layouts differ (py_modules wraps the tree one
+    level deep so `import <name>` works)."""
+    from ray_tpu.core.runtime_env import prepare_runtime_env
+    lib = tmp_path / "samelib"
+    lib.mkdir()
+    (lib / "__init__.py").write_text("TOKEN = 'both-layouts'\n")
+    (lib / "data.txt").write_text("payload\n")
+    sd = cluster["session_dir"]
+    as_wd = prepare_runtime_env({"working_dir": str(lib)}, sd)
+    as_mod = prepare_runtime_env({"py_modules": [str(lib)]}, sd)
+    wd_path = as_wd["working_dir"]
+    mod_path = as_mod["py_modules"][0]
+    assert wd_path != mod_path
+    # unwrapped layout: files at top level (cwd semantics)
+    assert os.path.isfile(os.path.join(wd_path, "data.txt"))
+    # wrapped layout: importable package one level down
+    assert os.path.isfile(
+        os.path.join(mod_path, "samelib", "__init__.py"))
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(lib)]})
+    def imp():
+        import samelib
+        return samelib.TOKEN
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(lib)})
+    def cwd_file():
+        with open("data.txt") as f:
+            return f.read().strip()
+
+    assert ray_tpu.get(imp.remote(), timeout=60) == "both-layouts"
+    assert ray_tpu.get(cwd_file.remote(), timeout=60) == "payload"
+
+
+def test_runtime_env_gc_spares_fresh_entries(cluster, tmp_path):
+    """gc_cache must never evict an entry that was just created/used:
+    eviction goes by our own access stamp, not the source tree's mtime."""
+    import shutil as _shutil
+
+    from ray_tpu.core.runtime_env import (
+        _package_dir, gc_cache)
+    sd = cluster["session_dir"]
+    old = tmp_path / "oldlib"
+    old.mkdir()
+    (old / "__init__.py").write_text("V = 1\n")
+    # make the SOURCE tree look ancient; copytree preserves this mtime
+    os.utime(old, (1, 1))
+    dest = _package_dir(sd, str(old))
+    # overflow the cache with distinct entries
+    for i in range(20):
+        d = tmp_path / f"lib{i}"
+        d.mkdir()
+        (d / "__init__.py").write_text(f"V = {i}\n")
+        _package_dir(sd, str(d))
+    # a crashed preparer's stale staging dir is collected; a fresh one
+    # (concurrent preparer mid-copy) is spared
+    root = os.path.join(sd, "runtime_resources")
+    stale_tmp = os.path.join(root, "dead-0000.tmp-999-aa")
+    fresh_tmp = os.path.join(root, "live-0000.tmp-999-bb")
+    os.makedirs(stale_tmp)
+    os.makedirs(fresh_tmp)
+    os.utime(stale_tmp, (1, 1))
+    gc_cache(sd, keep=4)
+    # the just-created ancient-source entry survived (fresh access stamp)
+    assert os.path.isdir(dest)
+    assert not os.path.isdir(stale_tmp)
+    assert os.path.isdir(fresh_tmp)
+    _shutil.rmtree(dest, ignore_errors=True)
+    _shutil.rmtree(fresh_tmp, ignore_errors=True)
+
+
 def test_joblib_backend(cluster):
     import joblib
 
