@@ -122,6 +122,11 @@ class Controller:
         self._send_q: Deque[Tuple[bytes, bytes, bytes]] = collections.deque()
         self._call_q: Deque = collections.deque()  # marshaled loop calls
         self._send_lock = threading.Lock()
+        self._sched_dirty = True
+        # local_waiters parked on UNKNOWN objects: first-park timestamp
+        # + audit strike counts (directory-hole detection)
+        self._waiter_since: Dict[bytes, float] = {}
+        self._hole_strikes: Dict[bytes, int] = {}
         # per-peer outbox for loop-thread sends: flushed once per event-loop
         # cycle as MSG_BATCH frames — amortizes pickling + syscalls over a
         # burst without adding latency (flush happens before the next poll)
@@ -417,6 +422,7 @@ class Controller:
     # -------------------------------------------------------- registration
     def _h_register(self, identity: bytes, m: dict) -> None:
         kind = m["kind"]
+        self._sched_dirty = True  # new node/worker = new capacity
         self.peers[identity] = {"kind": kind, "node_id": m.get("node_id"),
                                 "pid": m.get("pid")}
         if kind == "node":
@@ -563,6 +569,8 @@ class Controller:
                 t.transfers_remaining.discard(object_id_b)
                 if not t.transfers_remaining:
                     self._dispatch(task_id)
+        self._waiter_since.pop(object_id_b, None)
+        self._hole_strikes.pop(object_id_b, None)
         waiters = self.local_waiters.pop(object_id_b, [])
         for identity, rid in waiters:
             self._answer_location(identity, rid, object_id_b)
@@ -575,15 +583,28 @@ class Controller:
             self._answer_location(identity, m["rid"], object_id_b,
                                   want_node=m.get("want_node"))
         else:
-            # not created yet (or lost) — try lineage reconstruction, else wait
+            # not created yet (or lost) — try lineage reconstruction, else
+            # wait (the audit probes node stores for long-parked waiters:
+            # probing here would broadcast on every ordinary
+            # get-before-producer-finishes, the hot borrower path)
             if e is not None and e.lineage_task is not None and not e.locations \
                     and e.inline is None and e.error is None:
                 self._reconstruct(e)
+            elif e is None and object_id_b not in self._waiter_since:
+                self._waiter_since[object_id_b] = time.monotonic()
             self.local_waiters[object_id_b].append((identity, m["rid"]))
 
     def _answer_location(self, identity: bytes, rid: bytes, object_id_b: bytes,
                          want_node: Optional[bytes] = None) -> None:
-        e = self.objects[object_id_b]
+        e = self.objects.get(object_id_b)
+        if e is None:
+            # raced with a release: answering with an error beats the
+            # KeyError that used to swallow the reply and hang the get
+            from ray_tpu.exceptions import ObjectLostError
+            self._reply(identity, rid, {"error": P.dumps(
+                ObjectLostError(ObjectID(object_id_b),
+                                "freed before the location lookup"))})
+            return
         if e.error is not None:
             self._reply(identity, rid, {"error": e.error})
             return
@@ -673,23 +694,51 @@ class Controller:
     def _h_ref_deltas(self, identity: bytes, m: dict) -> None:
         self.refs.apply_deltas(m["deltas"])
 
+    def _h_owner_free(self, identity: bytes, m: dict) -> None:
+        """The owner already evicted these never-shared extents from the
+        segment (eager owner-side GC); drop metadata, waiters, and node
+        bookkeeping. Node-side FREE_OBJECT is idempotent on an
+        already-evicted extent."""
+        for b in m["object_ids"]:
+            if self.refs.force_release(b):
+                self._on_refcount_zero(ObjectID(b))
+
     def _on_refcount_zero(self, object_id: ObjectID) -> None:
         b = object_id.binary()
+        entry = self.objects.get(b)
+        has_waiters = bool(self.dep_waiters.get(b)
+                           or self.local_waiters.get(b))
+        if has_waiters and entry is not None and (
+                entry.inline is not None or entry.locations
+                or entry.lineage_task is not None):
+            # Someone is actively waiting AND the object is still
+            # materializable: the zero is a transient artifact of delta
+            # batching (the waiter holds a live ref whose +1 is still in
+            # flight). Freeing now would strand the parked tasks — keep
+            # the object; the pending +1 resurrects the count and a
+            # later real zero retries the free.
+            self.refs.cancel_release(b)
+            return
         e = self.objects.pop(b, None)
+        # Unrecoverable (no entry, or entry with no way to materialize):
+        # fail the waiters loudly rather than stranding them.
+        for tid in list(self.dep_waiters.pop(b, ())):
+            self._handle_task_failure(
+                tid, f"object {ObjectID(b).hex()[:12]} freed while the "
+                f"task waited on it", retriable=False)
+        waiters = self.local_waiters.pop(b, [])
+        if waiters:
+            from ray_tpu.exceptions import ObjectLostError
+            err = P.dumps(ObjectLostError(object_id,
+                                          "freed: refcount zero"))
+            for identity, rid in waiters:
+                self._reply(identity, rid, {"error": err})
         if e is None:
             return
         for node_b in e.locations:
             node = self.nodes.get(node_b)
             if node is not None:
                 self._send(node.identity, P.FREE_OBJECT, {"object_id": b})
-        self.dep_waiters.pop(b, None)
-        # unblock anyone still waiting on the (now freed) object
-        waiters = self.local_waiters.pop(b, [])
-        if waiters:
-            from ray_tpu.exceptions import ObjectLostError
-            err = P.dumps(ObjectLostError(object_id, "freed: refcount zero"))
-            for identity, rid in waiters:
-                self._reply(identity, rid, {"error": err})
 
     # --------------------------------------------------------------- tasks
     def _h_submit_batch(self, identity: bytes, m: dict) -> None:
@@ -744,6 +793,7 @@ class Controller:
     def _enqueue_ready(self, tid: bytes, t: PendingTask) -> None:
         """Mark a task ready and file it under its scheduling class."""
         t.state = "QUEUED"
+        self._sched_dirty = True
         if t.shape_key is None:
             strat = t.spec.scheduling_strategy
             if t.spec.is_actor_creation:
@@ -793,11 +843,27 @@ class Controller:
                 continue
             self._refill_lease(lease)
 
-    def _maybe_schedule(self) -> None:
+    def _release_res(self, node_id, resources) -> None:
+        """Release node resources AND mark the scheduler dirty: freed
+        capacity can admit queued work."""
+        self.scheduler.release(node_id, resources)
+        self._sched_dirty = True
+
+    def _maybe_schedule(self, force: bool = False) -> None:
         """Drain the ready queues (reference:
         ClusterTaskManager::ScheduleAndDispatchTasks). A scheduling class
         that fails to place blocks only itself, and the drain costs
-        O(#classes + #dispatched) — not O(#queued tasks)."""
+        O(#classes + #dispatched) — not O(#queued tasks).
+
+        Event-driven: a no-op unless capacity or demand changed since the
+        last drain (``_sched_dirty``). Lease pipelines refill inline at
+        completion (_lease_housekeeping), so a full drain per TASK_DONE
+        would re-scan every class x lease for nothing — measured at ~30%
+        of controller CPU on the async-task hot path. The health loop
+        forces a periodic drain as a self-healing backstop."""
+        if not self._sched_dirty and not force:
+            return
+        self._sched_dirty = False
         if self.ready_queues:
             empties = []
             for key, q in self.ready_queues.items():
@@ -956,7 +1022,7 @@ class Controller:
         node = self.nodes.get(lease.node_b)
         if node is not None and node.alive and not lease.blocked:
             # a blocked lease already released its allocation
-            self.scheduler.release(NodeID(lease.node_b), lease.resources)
+            self._release_res(NodeID(lease.node_b), lease.resources)
         self._return_worker(worker)
 
     def _h_task_done(self, identity: bytes, m: dict) -> None:
@@ -1012,7 +1078,7 @@ class Controller:
                 return
             if lease is None and t.node_id is not None:
                 # leased tasks don't own resources (the lease does)
-                self.scheduler.release(t.node_id, self._sched_res(t.spec))
+                self._release_res(t.node_id, self._sched_res(t.spec))
             t.node_id = None
             t.worker = None
             t.transfers_remaining.clear()
@@ -1030,16 +1096,24 @@ class Controller:
         results_meta = []
         for r in m.get("results", []):
             if self.refs.is_released(r["object_id"]):
-                # the owner already dropped every reference (its direct
-                # TASK_RESULT beat this TASK_DONE): recording the location
-                # would resurrect a dead entry and pin the extent forever —
-                # free it at the producing node instead
-                if r.get("node_id"):
-                    node = self.nodes.get(r["node_id"])
-                    if node is not None:
-                        self._send(node.identity, P.FREE_OBJECT,
-                                   {"object_id": r["object_id"]})
-                continue
+                rb = r["object_id"]
+                if self.local_waiters.get(rb) or self.dep_waiters.get(rb):
+                    # the release was premature (delta batching can zero
+                    # transiently while a waiter's +1 is still in
+                    # flight): a waiter holds a live ref, so record the
+                    # result and let the count resurrect
+                    self.refs.cancel_release(rb)
+                else:
+                    # the owner already dropped every reference (its
+                    # direct TASK_RESULT beat this TASK_DONE): recording
+                    # the location would resurrect a dead entry and pin
+                    # the extent forever — free it at the producing node
+                    if r.get("node_id"):
+                        node = self.nodes.get(r["node_id"])
+                        if node is not None:
+                            self._send(node.identity, P.FREE_OBJECT,
+                                       {"object_id": rb})
+                    continue
             e = self._entry(r["object_id"])
             e.owner = m.get("owner_identity", identity)
             e.size = r.get("size", 0)
@@ -1065,7 +1139,7 @@ class Controller:
         else:
             if t is not None and t.node_id is not None and not is_actor_task \
                     and not is_actor_creation:
-                self.scheduler.release(t.node_id, self._sched_res(t.spec))
+                self._release_res(t.node_id, self._sched_res(t.spec))
             if not is_actor_creation and actor_id_b is None:
                 self._return_worker(identity)
 
@@ -1094,6 +1168,7 @@ class Controller:
         return m.get("owner")
 
     def _return_worker(self, identity: bytes) -> None:
+        self._sched_dirty = True
         info = self.peers.get(identity)
         if not info:
             return
@@ -1118,7 +1193,7 @@ class Controller:
             return
         if t.node_id is not None and release_resources and \
                 t.worker not in self.leases:
-            self.scheduler.release(t.node_id, self._sched_res(t.spec))
+            self._release_res(t.node_id, self._sched_res(t.spec))
         if oom:
             # OOM kills spend their own budget, with a delay so the node
             # can shed pressure before the task lands again — transient
@@ -1193,7 +1268,7 @@ class Controller:
                 except ValueError:
                     pass
             if t.node_id is not None:
-                self.scheduler.release(t.node_id, self._sched_res(t.spec))
+                self._release_res(t.node_id, self._sched_res(t.spec))
             err = P.dumps(TaskCancelledError(t.spec.task_id))
             results = []
             for oid in t.spec.return_ids():
@@ -1255,7 +1330,7 @@ class Controller:
             self.actor_workers.pop(aid, None)
             self._return_worker(worker)
             if t.node_id is not None:
-                self.scheduler.release(t.node_id, self._sched_res(t.spec))
+                self._release_res(t.node_id, self._sched_res(t.spec))
             self._publish(f"actor:{t.spec.actor_id.hex()}",
                           {"state": "DEAD", "actor_id": aid})
             self._answer_actor_addr_waiters(aid)
@@ -1263,7 +1338,7 @@ class Controller:
         info.state = "ALIVE"
         if not t.spec.hold_resources and t.node_id is not None:
             # default-resource actor: scheduling CPU released once alive
-            self.scheduler.release(t.node_id, self._sched_res(t.spec))
+            self._release_res(t.node_id, self._sched_res(t.spec))
         info.worker_id = WorkerID(worker) if len(worker) == WorkerID.SIZE else None
         self._publish(f"actor:{t.spec.actor_id.hex()}",
                       {"state": "ALIVE", "actor_id": aid})
@@ -1462,6 +1537,7 @@ class Controller:
         self.pgs.pop(b, None)
         self.pg_states[b] = "REMOVED"
         self.scheduler.release_placement_group(PlacementGroupID(b))
+        self._sched_dirty = True  # freed bundle capacity
         self._reply(identity, m["rid"], {"ok": True})
         self._maybe_schedule()
 
@@ -1476,7 +1552,7 @@ class Controller:
         lease.blocked = True
         node = self.nodes.get(lease.node_b)
         if node is not None and node.alive:
-            self.scheduler.release(NodeID(lease.node_b), lease.resources)
+            self._release_res(NodeID(lease.node_b), lease.resources)
         self._maybe_schedule()
 
     def _h_notify_unblocked(self, identity: bytes, m: dict) -> None:
@@ -1544,7 +1620,7 @@ class Controller:
                 peers_set.discard(worker_identity)
             lnode = self.nodes.get(lease.node_b)
             if lnode is not None and lnode.alive and not lease.blocked:
-                self.scheduler.release(NodeID(lease.node_b), lease.resources)
+                self._release_res(NodeID(lease.node_b), lease.resources)
         # fail/retry every in-flight task dispatched to that worker
         oom = m.get("reason") == "oom"
         for tid, t in list(self.tasks.items()):
@@ -1604,7 +1680,7 @@ class Controller:
             return
         self.actor_workers.pop(aid, None)
         if info.node_id is not None and info.spec.hold_resources:
-            self.scheduler.release(info.node_id, self._sched_res(info.spec))
+            self._release_res(info.node_id, self._sched_res(info.spec))
         if info.num_restarts < info.spec.max_restarts or info.spec.max_restarts < 0:
             info.num_restarts += 1
             info.state = "RESTARTING"
@@ -1631,6 +1707,19 @@ class Controller:
             cfg.health_check_timeout_ms / 1000.0
         while not self._shutdown.wait(period):
             now = time.monotonic()
+            # self-healing backstops: a missed dirty-mark or a stranded
+            # dep-parked task can only delay work by one period
+            try:
+                self.call_on_loop(lambda: self._maybe_schedule(force=True))
+                self.call_on_loop(self._audit_parked_tasks)
+                self.call_on_loop(self._audit_parked_waiters)
+            except Exception:
+                pass
+            try:
+                from ray_tpu.core.metric_defs import update_from_state
+                update_from_state(controller=self)
+            except Exception:
+                pass
             for node in list(self.nodes.values()):
                 if node.alive and node.last_heartbeat and \
                         now - node.last_heartbeat > threshold:
@@ -1649,6 +1738,117 @@ class Controller:
                             lambda a=aid: self._expire_recovered_actor(a))
                     except Exception:
                         logger.exception("recovered-actor expiry failed")
+
+    def _audit_parked_tasks(self) -> None:
+        """Backstop against stranded PENDING_DEPS tasks: a task whose dep
+        arrived without a wake resumes; one whose dep is reconstructable
+        reconstructs; one whose dep is gone for good fails loudly with
+        ObjectLostError instead of hanging forever."""
+        now = time.monotonic()
+        for tid, t in list(self.tasks.items()):
+            if t.state != "PENDING_DEPS" or not t.deps_remaining:
+                continue
+            # healthy producers are excluded via _object_expected below,
+            # so a moderate age gate suffices (repairing a real
+            # directory hole within ~15s instead of minutes)
+            if now - (t.submitted_at or now) < 15.0:
+                continue
+            for b in list(t.deps_remaining):
+                e = self.objects.get(b)
+                if e is not None and (e.inline is not None
+                                      or e.error is not None
+                                      or e.locations):
+                    # dep exists but the wake was missed
+                    self._object_created(b)
+                elif e is not None and e.lineage_task is not None:
+                    self._reconstruct(e)
+                elif e is None:
+                    if self._object_expected(b):
+                        # the producing task is tracked and alive: this
+                        # is a healthy dependency wait, not a hole
+                        t._audit_strikes = 0
+                        continue
+                    # strike 1: probe node stores — a producer killed
+                    # between storing the object and reporting it leaves
+                    # the bytes resident with no directory entry; the
+                    # node re-announces and the task resumes.
+                    # many strikes later: genuinely gone — fail loudly.
+                    strikes = getattr(t, "_audit_strikes", 0) + 1
+                    t._audit_strikes = strikes
+                    if strikes in (1, 5, 30):
+                        self._probe_nodes_for(b)
+                        continue
+                    if strikes < 300:
+                        continue
+                    self.dep_waiters.pop(b, None)
+                    from ray_tpu.exceptions import ObjectLostError
+                    self._handle_task_failure(
+                        tid, f"dependency {ObjectID(b).hex()[:12]} was "
+                        f"freed or lost before the task could run",
+                        retriable=False,
+                        exc=ObjectLostError(
+                            ObjectID(b), "freed before dependent task "
+                            "could run"))
+                    break
+
+    def _probe_nodes_for(self, object_id_b: bytes) -> None:
+        for node in self.nodes.values():
+            if node.alive:
+                self._send(node.identity, P.LOCATE_OBJECT,
+                           {"object_id": object_id_b})
+
+    def _object_expected(self, object_id_b: bytes) -> bool:
+        """True if a tracked pending/running task will produce this
+        object — waiters on it are healthy, not stranded."""
+        try:
+            tid = ObjectID(object_id_b).task_id().binary()
+        except Exception:
+            return False
+        return tid in self.tasks
+
+    def _audit_parked_waiters(self) -> None:
+        """Backstop for gets parked on objects the directory never
+        learned about (producer killed between store and report): probe
+        node stores after a minute, fail with ObjectLostError if the
+        probes come back empty. Also drops waiters whose client is
+        gone."""
+        now = time.monotonic()
+        for b in list(self._waiter_since):
+            waiters = self.local_waiters.get(b)
+            if not waiters or self.objects.get(b) is not None:
+                self._waiter_since.pop(b, None)
+                self._hole_strikes.pop(b, None)
+                continue
+            live = [(ident, rid) for ident, rid in waiters
+                    if ident in self.peers]
+            if not live:
+                self.local_waiters.pop(b, None)
+                self._waiter_since.pop(b, None)
+                self._hole_strikes.pop(b, None)
+                continue
+            self.local_waiters[b] = live
+            if now - self._waiter_since[b] < 15.0:
+                continue
+            if self._object_expected(b):
+                # the producing task is tracked and alive — healthy wait
+                self._hole_strikes.pop(b, None)
+                continue
+            strikes = self._hole_strikes.get(b, 0) + 1
+            self._hole_strikes[b] = strikes
+            if strikes in (1, 5, 30):
+                # cheap repair probes; directory holes (producer killed
+                # between store and report) resolve on the first one
+                self._probe_nodes_for(b)
+            elif strikes >= 300:
+                # ~5 minutes with no probe hit and no tracked producer:
+                # give up loudly instead of hanging the get forever
+                from ray_tpu.exceptions import ObjectLostError
+                err = P.dumps(ObjectLostError(
+                    ObjectID(b), "no node store holds this object"))
+                for ident, rid in self.local_waiters.pop(b, []):
+                    self._reply(ident, rid, {"error": err})
+                self._waiter_since.pop(b, None)
+                self._hole_strikes.pop(b, None)
 
     def _requeue_after_oom(self, tid: bytes, t: PendingTask) -> None:
         if self.tasks.get(tid) is not t:
@@ -1761,6 +1961,7 @@ class Controller:
         P.GET_LOCATION: _h_get_location,
         P.PULL_FAILED: _h_pull_failed,
         P.REF_DELTAS: _h_ref_deltas,
+        P.OWNER_FREE: _h_owner_free,
         P.KV_OP: _h_kv,
         P.EXPORT_FUNCTION: _h_export_function,
         P.FETCH_FUNCTION: _h_fetch_function,

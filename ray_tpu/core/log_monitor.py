@@ -97,4 +97,8 @@ class LogMonitor:
                 text = chunk[:cut].decode(errors="replace")
             self._offsets[path] = off + consumed
             for line in text.splitlines():
+                if "__ray_tpu_tqdm__:" in line:
+                    from ray_tpu.experimental.tqdm_ray import render_record
+                    if render_record(line, self.out):
+                        continue
                 print(f"{prefix} {line}", file=self.out)

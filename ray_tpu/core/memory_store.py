@@ -16,13 +16,38 @@ from typing import Callable, Dict, List, Optional
 from ray_tpu.core.ids import ObjectID
 
 
+class WeakExpired:
+    """Sentinel handed to on_ready callbacks whose weak-cached value was
+    collected: the receiver re-materializes from shared memory."""
+
+    __slots__ = ()
+
+
+class WeakCacheExpired(Exception):
+    """A blocking get hit a weak cache entry whose value was collected:
+    the object still exists in shm — the caller re-materializes instead
+    of treating this as a timeout or failure."""
+
+
+_WEAK_EXPIRED = WeakExpired()
+
+
 class _Entry:
-    __slots__ = ("value", "error", "ready")
+    __slots__ = ("value", "error", "ready", "weak")
 
     def __init__(self):
         self.value = None
         self.error: Optional[BaseException] = None
         self.ready = False
+        self.weak = False
+
+    def live_value(self):
+        """(alive, value): weak entries whose target was collected are
+        dead — the caller re-materializes from shm."""
+        if not self.weak:
+            return True, self.value
+        v = self.value()
+        return (v is not None), v
 
 
 class InProcessStore:
@@ -32,14 +57,28 @@ class InProcessStore:
         self._callbacks: Dict[ObjectID, List[Callable]] = {}
 
     def put(self, object_id: ObjectID, value, error: Optional[BaseException] = None,
-            force: bool = False) -> None:
+            force: bool = False, weak: bool = False) -> None:
+        """``weak=True`` caches a weakref: large shm-materialized values
+        must not be pinned by the cache beyond their user's lifetime —
+        the reader-ledger release (and therefore extent reuse) is tied
+        to the value's GC (reference: plasma buffers are pinned by the
+        client only while Python holds them)."""
+        import weakref
+        if weak:
+            try:
+                stored = weakref.ref(value)
+            except TypeError:
+                stored, weak = value, False
+        else:
+            stored = value
         with self._lock:
             e = self._objects.setdefault(object_id, _Entry())
             if e.ready and not force:
                 return  # idempotent (retries may double-complete)
-            e.value = value
+            e.value = stored
             e.error = error
             e.ready = True
+            e.weak = weak
             callbacks = self._callbacks.pop(object_id, [])
             self._lock.notify_all()
         for cb in callbacks:
@@ -48,7 +87,13 @@ class InProcessStore:
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             e = self._objects.get(object_id)
-            return e is not None and e.ready
+            if e is None or not e.ready:
+                return False
+            alive, _ = e.live_value()
+            if not alive:
+                del self._objects[object_id]
+                return False
+            return True
 
     def get(self, object_id: ObjectID, timeout: Optional[float] = None):
         """Blocks; returns value or raises the stored error."""
@@ -59,7 +104,13 @@ class InProcessStore:
                 if e is not None and e.ready:
                     if e.error is not None:
                         raise e.error
-                    return e.value
+                    alive, v = e.live_value()
+                    if not alive:
+                        # collected weak value: the caller re-derives it
+                        # from shm via the meta path
+                        del self._objects[object_id]
+                        raise WeakCacheExpired(str(object_id))
+                    return v
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     from ray_tpu.exceptions import GetTimeoutError
@@ -75,13 +126,23 @@ class InProcessStore:
                 return False, None
             if e.error is not None:
                 raise e.error
-            return True, e.value
+            alive, v = e.live_value()
+            if not alive:
+                del self._objects[object_id]
+                return False, None
+            return True, v
 
     def on_ready(self, object_id: ObjectID, callback: Callable) -> None:
         with self._lock:
             e = self._objects.get(object_id)
             if e is not None and e.ready:
-                value, error = e.value, e.error
+                alive, value = e.live_value()
+                error = e.error
+                if not alive and error is None:
+                    # collected weak value: completion already happened;
+                    # the receiver re-derives the value from shm
+                    del self._objects[object_id]
+                    value = _WEAK_EXPIRED
             else:
                 self._callbacks.setdefault(object_id, []).append(callback)
                 return

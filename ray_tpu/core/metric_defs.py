@@ -1,0 +1,121 @@
+"""Internal runtime metric definitions.
+
+Reference: ``src/ray/stats/metric_defs.cc`` — the fixed set of runtime
+metrics every Ray process exports (task counts by state, scheduler
+queue depths, object-store usage, gRPC/ZMQ traffic, worker counts).
+Here the same catalog is defined over :mod:`ray_tpu.util.metrics`;
+runtime components call the ``record_*`` helpers on their hot paths
+(cheap: process-local counters, exported with user metrics through the
+same Prometheus endpoint).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+_lock = threading.Lock()
+_defs: Optional["RuntimeMetrics"] = None
+
+
+class RuntimeMetrics:
+    """The runtime metric catalog (created once per process)."""
+
+    def __init__(self):
+        # -- tasks (reference: ray_tasks metric, by State/Name)
+        self.tasks_submitted = Counter(
+            "runtime_tasks_submitted_total",
+            "Tasks submitted by this process")
+        self.tasks_finished = Counter(
+            "runtime_tasks_finished_total",
+            "Task completions observed", tag_keys=("outcome",))
+        self.task_exec_seconds = Histogram(
+            "runtime_task_execution_seconds",
+            "Wall time of task execution on this worker")
+        # -- scheduler (reference: scheduler_tasks / scheduler_unscheduleable)
+        self.sched_queued = Gauge(
+            "runtime_scheduler_queued_tasks",
+            "Tasks in the controller's ready queues")
+        self.sched_pending_args = Gauge(
+            "runtime_scheduler_pending_args_tasks",
+            "Tasks parked waiting for dependencies")
+        self.sched_infeasible = Gauge(
+            "runtime_scheduler_infeasible_tasks",
+            "Tasks whose resource shape currently fits no node")
+        # -- objects (reference: object_store_memory / object_directory)
+        self.object_store_bytes = Gauge(
+            "runtime_object_store_used_bytes",
+            "Bytes used in the local shared-memory store")
+        self.object_store_objects = Gauge(
+            "runtime_object_store_num_objects",
+            "Sealed objects resident in the local store")
+        self.objects_tracked = Gauge(
+            "runtime_object_directory_size",
+            "Objects the controller tracks cluster-wide")
+        self.puts = Counter(
+            "runtime_puts_total", "ray_tpu.put calls")
+        self.put_bytes = Counter(
+            "runtime_put_bytes_total", "Bytes written by put")
+        # -- workers / actors (reference: actors-by-state, worker counts)
+        self.workers_alive = Gauge(
+            "runtime_workers_alive", "Worker processes registered")
+        self.actors_alive = Gauge(
+            "runtime_actors_alive", "Actors in ALIVE state")
+        self.actors_pending = Gauge(
+            "runtime_actors_pending", "Actors awaiting placement/start")
+        # -- transport (reference: grpc_server_req counters)
+        self.messages_sent = Counter(
+            "runtime_messages_sent_total",
+            "Control-plane messages sent", tag_keys=("kind",))
+        self.message_batch_size = Histogram(
+            "runtime_message_batch_size",
+            "Messages coalesced per wire batch")
+        # -- memory / health (reference: memory_manager worker kills)
+        self.oom_worker_kills = Counter(
+            "runtime_oom_worker_kills_total",
+            "Workers killed by the memory monitor")
+        self.node_mem_percent = Gauge(
+            "runtime_node_memory_used_percent",
+            "Node memory utilization")
+
+
+def runtime_metrics() -> RuntimeMetrics:
+    global _defs
+    with _lock:
+        if _defs is None:
+            _defs = RuntimeMetrics()
+        return _defs
+
+
+def update_from_state(controller=None, store_stats: Optional[Dict] = None,
+                      node_stats: Optional[Dict] = None) -> None:
+    """Refresh gauge families from component state (called from the
+    heartbeat/stats paths — gauges snapshot, counters accumulate)."""
+    m = runtime_metrics()
+    if controller is not None:
+        try:
+            m.sched_queued.set(
+                sum(len(q) for q in controller.ready_queues.values()))
+            m.sched_pending_args.set(sum(
+                1 for t in controller.tasks.values()
+                if t.state == "PENDING_DEPS"))
+            m.objects_tracked.set(len(controller.objects))
+            m.workers_alive.set(sum(
+                len(n.all_workers) for n in controller.nodes.values()))
+            m.actors_alive.set(sum(
+                1 for a in controller.actors.values()
+                if a.state == "ALIVE"))
+            m.actors_pending.set(sum(
+                1 for a in controller.actors.values()
+                if a.state in ("PENDING", "STARTING", "RESTARTING")))
+        except Exception:
+            pass
+    if store_stats:
+        m.object_store_bytes.set(store_stats.get("used_bytes", 0))
+        m.object_store_objects.set(store_stats.get("num_objects", 0))
+    if node_stats:
+        pct = node_stats.get("mem_percent")
+        if pct is not None:
+            m.node_mem_percent.set(pct)

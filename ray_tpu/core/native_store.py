@@ -392,6 +392,14 @@ class NativeShmClient:
         state, _, _ = self._segment().lookup(object_id)
         return state == 2
 
+    def evict(self, object_id: ObjectID) -> int:
+        """Free the extent now if (and only if) no reader holds it.
+        Returns freed bytes, 0 if skipped. Owner-side eager recycling:
+        freed extents go back on the allocator freelist with their tmpfs
+        pages still resident, so the next same-sized create skips the
+        page-population cost entirely."""
+        return self._segment().evict(object_id)
+
     def release(self, object_id: ObjectID) -> None:
         with self._lock:
             n = self._acquired.get(object_id, 0)

@@ -234,6 +234,17 @@ class NodeManager:
             oid = ObjectID(m["object_id"])
             self.shm.release(oid)
             self.store.delete(oid)
+        elif mtype == P.LOCATE_OBJECT:
+            # directory-repair probe: a producer died before its
+            # TASK_DONE reported this object, but the bytes are here
+            oid = ObjectID(m["object_id"])
+            if self.store.contains(oid):
+                state, _, size = self.store.seg.lookup(oid) \
+                    if hasattr(self.store, "seg") else (2, 0, 0)
+                self._send(P.PUT_OBJECT, {
+                    "object_id": m["object_id"],
+                    "node_id": self.node_id.binary(),
+                    "size": size})
         elif mtype == P.PULL_OBJECT:
             self._enqueue_pull(m)
         elif mtype == P.CANCEL_TASK:
@@ -412,6 +423,11 @@ class NodeManager:
             try:
                 import psutil
                 stats["mem_percent"] = psutil.virtual_memory().percent
+            except Exception:
+                pass
+            try:
+                from ray_tpu.core.metric_defs import update_from_state
+                update_from_state(store_stats=stats, node_stats=stats)
             except Exception:
                 pass
             self._send(P.HEARTBEAT, {

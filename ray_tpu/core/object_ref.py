@@ -71,6 +71,12 @@ class ObjectRef:
         ctx = serialization.get_active_context()
         if ctx is not None:
             ctx.record_contained_ref(self)
+        # any serialization means the ref may leave this process — the
+        # owner loses the right to eagerly free the object
+        from ray_tpu.core.global_state import try_global_worker
+        w = try_global_worker()
+        if w is not None:
+            w.mark_ref_escaped(self._id.binary())
         return (_deserialize_ref, (self._id.binary(), self._owner.binary() if self._owner else None))
 
     def __await__(self):

@@ -58,10 +58,16 @@ PULL_OBJECT = b"PUL"         # controller->dest node: pull this object
 PULL_REQUEST = b"PRQ"        # dest->src node DIRECT: stream it to me
 PUSH_OBJECT = b"PSH"         # src->dest node DIRECT: chunked payload
 PULL_FAILED = b"PLF"         # src->dest direct / dest->controller: pull failed
+LOCATE_OBJECT = b"LOB"       # controller->node {object_id}: if your store
+                             # holds it, announce it (repairs a directory
+                             # hole left by a producer killed mid-report)
 CHUNK_ACK = b"CAK"           # dest->src DIRECT: chunk received (flow control)
 RECONNECT = b"RCN"           # controller->peer: re-register + re-announce
                              # (sent after a controller restart)
 REF_DELTAS = b"RFD"          # {deltas: {bytes: int}}
+OWNER_FREE = b"OFR"          # owner->controller {object_ids: [bytes]}:
+                             # owner already evicted these never-shared
+                             # extents; drop metadata + node bookkeeping
 # kv / functions
 KV_OP = b"KVO"               # {op: put|get|del|keys|exists, ns, key, value}
 EXPORT_FUNCTION = b"EXF"     # {key, blob}

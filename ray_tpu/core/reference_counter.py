@@ -20,8 +20,8 @@ pinning (``task_manager.h:432``) lives controller-side as well.
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
-from typing import Callable, Dict, Optional
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional
 
 from ray_tpu.core.ids import ObjectID
 
@@ -37,16 +37,28 @@ class ReferenceCounter:
         self._pending_deltas: Dict[bytes, int] = {}
         self._flush_fn = flush_fn
         self._flush_threshold = 256
+        # fired (outside the lock) when an object's combined local +
+        # submitted count drops to zero — the owner's eager-free hook
+        self._on_owner_zero: Optional[Callable[[ObjectID], None]] = None
+        # decrefs from ObjectRef.__del__ — GC can run __del__ on the
+        # thread that already holds _lock (mid-_delta dict op), so
+        # __del__ must never lock: it appends here (GIL-atomic) and the
+        # next locked operation drains the queue
+        self._deferred_decrefs: "deque[ObjectID]" = deque()
 
     def set_flush_fn(self, fn: Callable[[Dict[bytes, int]], None]) -> None:
         self._flush_fn = fn
+
+    def set_owner_zero_fn(self, fn: Callable[[ObjectID], None]) -> None:
+        self._on_owner_zero = fn
 
     # -- ObjectRef lifecycle hooks --
     def add_local_reference(self, ref) -> None:
         self._delta(ref.id(), +1, self._local)
 
     def remove_local_reference(self, ref) -> None:
-        self._delta(ref.id(), -1, self._local)
+        # __del__-safe: lock-free defer (see _deferred_decrefs)
+        self._deferred_decrefs.append(ref.id())
 
     # -- task submission pinning --
     def add_submitted_task_ref(self, object_id: ObjectID) -> None:
@@ -55,48 +67,84 @@ class ReferenceCounter:
     def remove_submitted_task_ref(self, object_id: ObjectID) -> None:
         self._delta(object_id, -1, self._submitted)
 
+    def _apply_locked(self, object_id: ObjectID, d: int,
+                      table: Dict[ObjectID, int],
+                      zeros: List[ObjectID]) -> None:
+        """Apply one delta. Caller holds the lock; owner-zero events are
+        appended to ``zeros`` and must be fired after release."""
+        n = table.get(object_id, 0) + d
+        if n <= 0:
+            table.pop(object_id, None)
+        else:
+            table[object_id] = n
+        if d < 0 and n <= 0 \
+                and self._local.get(object_id, 0) == 0 \
+                and self._submitted.get(object_id, 0) == 0:
+            zeros.append(object_id)
+        key = object_id.binary()
+        # A +1/-1 pair inside one flush window still nets to a 0-delta
+        # entry that MUST be flushed: dropping it would hide the
+        # object's entire lifecycle from the controller (never "ever
+        # positive" -> its entry and shm extent would leak forever).
+        self._pending_deltas[key] = \
+            self._pending_deltas.get(key, 0) + d
+
+    def _drain_deferred_locked(self, zeros: List[ObjectID]) -> None:
+        while True:
+            try:
+                oid = self._deferred_decrefs.popleft()
+            except IndexError:
+                return
+            self._apply_locked(oid, -1, self._local, zeros)
+
+    def _fire(self, flush: Optional[Dict[bytes, int]],
+              zeros: List[ObjectID]) -> None:
+        if flush and self._flush_fn:
+            self._flush_fn(flush)
+        if zeros and self._on_owner_zero is not None:
+            for oid in zeros:
+                self._on_owner_zero(oid)
+
     def _delta(self, object_id: ObjectID, d: int, table: Dict[ObjectID, int]) -> None:
         flush = None
+        zeros: List[ObjectID] = []
         with self._lock:
-            n = table.get(object_id, 0) + d
-            if n <= 0:
-                table.pop(object_id, None)
-            else:
-                table[object_id] = n
-            key = object_id.binary()
-            pd = self._pending_deltas.get(key, 0) + d
-            if pd == 0:
-                self._pending_deltas.pop(key, None)
-            else:
-                self._pending_deltas[key] = pd
+            self._drain_deferred_locked(zeros)
+            self._apply_locked(object_id, d, table, zeros)
             if len(self._pending_deltas) >= self._flush_threshold:
                 flush = self._pending_deltas
                 self._pending_deltas = {}
-        if flush and self._flush_fn:
-            self._flush_fn(flush)
+        self._fire(flush, zeros)
 
     def flush(self) -> None:
+        zeros: List[ObjectID] = []
         with self._lock:
-            if not self._pending_deltas:
-                return
+            self._drain_deferred_locked(zeros)
             deltas = self._pending_deltas
             self._pending_deltas = {}
-        if self._flush_fn:
-            self._flush_fn(deltas)
+        self._fire(deltas or None, zeros)
 
     def local_count(self, object_id: ObjectID) -> int:
+        zeros: List[ObjectID] = []
         with self._lock:
-            return self._local.get(object_id, 0) + self._submitted.get(object_id, 0)
+            self._drain_deferred_locked(zeros)
+            n = self._local.get(object_id, 0) + \
+                self._submitted.get(object_id, 0)
+        self._fire(None, zeros)
+        return n
 
     def all_counts(self) -> Dict[bytes, int]:
         """Aggregate live counts, for re-seeding a restarted controller's
         global table (its counts died with it)."""
+        zeros: List[ObjectID] = []
         with self._lock:
+            self._drain_deferred_locked(zeros)
             out: Dict[bytes, int] = {}
             for table in (self._local, self._submitted):
                 for oid, n in table.items():
                     out[oid.binary()] = out.get(oid.binary(), 0) + n
-            return out
+        self._fire(None, zeros)
+        return out
 
 
 class GlobalRefTable:
@@ -124,7 +172,11 @@ class GlobalRefTable:
         with self._lock:
             for key, d in deltas.items():
                 n = self._counts.get(key, 0) + d
-                if d > 0:
+                if d >= 0:
+                    # d == 0 is a client-side netted +1/-1 pair: the
+                    # object existed and was fully dropped within one
+                    # flush window — it must still count as having been
+                    # referenced, or its entry never becomes freeable
                     self._ever_positive[key] = True
                 if n <= 0:
                     self._counts.pop(key, None)
@@ -138,6 +190,28 @@ class GlobalRefTable:
                     self._released.pop(key, None)
         for oid in zeroed:
             self._on_zero(oid)
+
+    def cancel_release(self, object_id_b: bytes) -> None:
+        """Undo a zero-event's tombstone: the controller decided the
+        object must live (active waiters hold refs whose deltas are
+        still in flight). Without this, the tombstone makes
+        _h_task_done discard the object's upcoming location records."""
+        with self._lock:
+            self._released.pop(object_id_b, None)
+
+    def force_release(self, object_id_b: bytes) -> bool:
+        """Owner-side eager free: drop this object's counts and tombstone
+        it so late deltas / completion records can't resurrect it.
+        Returns False if it was already released."""
+        with self._lock:
+            if object_id_b in self._released:
+                return False
+            self._counts.pop(object_id_b, None)
+            self._ever_positive.pop(object_id_b, None)
+            self._released[object_id_b] = None
+            while len(self._released) > self._released_cap:
+                self._released.popitem(last=False)
+            return True
 
     def is_released(self, object_id_b: bytes) -> bool:
         """True if this object's refcount already hit zero (it must not be

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from collections import OrderedDict
 from queue import Empty, SimpleQueue
 
 import zmq
@@ -61,9 +62,21 @@ class Runtime:
 
         self.memory_store = InProcessStore()
         self.reference_counter = ReferenceCounter(self._flush_ref_deltas)
+        self.reference_counter.set_owner_zero_fn(self._on_owner_zero)
         self.serialization = SerializationContext(self)
         self.shm = make_client(shm_session) if shm_session else None
         self.shm_session = shm_session
+
+        # Eager owner-side recycling (reference: owner-based GC frees an
+        # object the moment its owner's counts hit zero). put() objects
+        # whose refs never leave this process are evicted directly from
+        # the shared segment on last-ref-drop — the extent returns to the
+        # allocator freelist with its pages still resident, so a hot
+        # put loop recycles warm extents instead of faulting fresh ones.
+        self._eager_owned: Dict[bytes, None] = {}
+        self._escaped_refs: "OrderedDict[bytes, None]" = OrderedDict()
+        self._eager_lock = threading.Lock()
+        self._empty_args_blob: Optional[bytes] = None
 
         # object_id(bytes) -> result meta {"inline"|"node_id"/"size"|"error"}
         self._meta: Dict[bytes, dict] = {}
@@ -165,6 +178,12 @@ class Runtime:
     def _ping_loop(self) -> None:
         while not self._stopped.wait(2.0):
             self._send(P.PING, {})
+            # GC latency bound: pending ref deltas below the batch
+            # threshold still reach the controller within one period
+            try:
+                self.reference_counter.flush()
+            except Exception:
+                pass
 
     @property
     def current_task_id(self) -> TaskID:
@@ -494,8 +513,52 @@ class Runtime:
             self._put_counter += 1
             oid = ObjectID.for_put(self.current_task_id, self._put_counter)
         ref = ObjectRef(oid, self.worker_id)
-        self._store_value(oid, value, notify=True)
+        meta = self._store_value(oid, value, notify=True)
+        try:
+            from ray_tpu.core.metric_defs import runtime_metrics
+            m = runtime_metrics()
+            m.puts.inc()
+            m.put_bytes.inc(meta.get("size", 0))
+        except Exception:
+            pass
+        if meta.get("node_id") is not None and self.shm is not None \
+                and hasattr(self.shm, "evict"):
+            # shm-resident put owned by this process: eligible for eager
+            # eviction unless its ref escapes (see mark_ref_escaped)
+            with self._eager_lock:
+                self._eager_owned[oid.binary()] = None
         return ref
+
+    def mark_ref_escaped(self, object_id_b: bytes) -> None:
+        """The ref was serialized (task arg, nested put, any pickle) —
+        another process may now reference the object, so the owner must
+        never free it unilaterally; the controller's global refcount is
+        the authority from here on."""
+        with self._eager_lock:
+            self._eager_owned.pop(object_id_b, None)
+            self._escaped_refs[object_id_b] = None
+            while len(self._escaped_refs) > 65536:
+                self._escaped_refs.popitem(last=False)
+
+    def _on_owner_zero(self, oid: ObjectID) -> None:
+        b = oid.binary()
+        with self._eager_lock:
+            if b not in self._eager_owned or b in self._escaped_refs:
+                return
+            del self._eager_owned[b]
+        try:
+            freed = self.shm.evict(oid)
+        except Exception:
+            return
+        if freed:
+            with self._meta_lock:
+                self._meta.pop(b, None)
+            self.memory_store.delete(oid)
+            if not self._stopped.is_set():
+                try:
+                    self._send(P.OWNER_FREE, {"object_ids": [b]})
+                except Exception:
+                    pass
 
     def _store_value(self, oid: ObjectID, value: Any, notify: bool) -> dict:
         """Serialize and store a value; returns result meta for TASK_DONE."""
@@ -540,10 +603,12 @@ class Runtime:
             with self._actors_lock:
                 st = self._actors.get(aid)
                 if st is not None:
-                    st["inflight"].pop(m.get("task_id"), None)
+                    done_spec = st["inflight"].pop(m.get("task_id"), None)
+                    self._unpin_task_args(done_spec)
         if m.get("task_id") is not None:
             with self._inflight_lock:
-                self._inflight_specs.pop(m["task_id"], None)
+                done_spec = self._inflight_specs.pop(m["task_id"], None)
+            self._unpin_task_args(done_spec)
         for r in m.get("results", []):
             b = r["object_id"]
             with self._meta_lock:
@@ -551,6 +616,21 @@ class Runtime:
             oid = ObjectID(b)
             # materialize lazily at get(); but wake any waiter now
             self.memory_store.put(oid, _MetaReady(r))
+
+    @staticmethod
+    def _find_weakref_targets(value, depth: int = 3) -> list:
+        return _weakref_targets(value, depth)
+
+    def _unpin_task_args(self, spec) -> None:
+        """Balance add_submitted_task_ref once the task's result is in:
+        the arg pin exists so an arg object can't be freed while its
+        consumer is still in flight. Without the release every task-arg
+        object stays pinned (count never reaches zero) and its extent
+        leaks for the session's lifetime."""
+        if spec is None:
+            return
+        for _, oid in spec.arg_refs:
+            self.reference_counter.remove_submitted_task_ref(oid)
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -586,11 +666,34 @@ class Runtime:
         # Not local: if we own the object its TASK_RESULT will be pushed to
         # us; otherwise ask the controller (async; reply lands in the memory
         # store as _MetaReady). Block with the caller's timeout either way.
-        if ref.owner is None or ref.owner != self.worker_id:
+        owned = ref.owner is not None and ref.owner == self.worker_id
+        if not owned:
             self._ensure_location_probe(b)
+        from ray_tpu.core.memory_store import WeakCacheExpired
         token = self._enter_blocked()
         try:
-            value = self.memory_store.get(oid, timeout)
+            if owned:
+                # grace-then-probe: the direct TASK_RESULT push normally
+                # lands in ms, but if it was lost (producer killed with
+                # the result still in its send queue) waiting on it alone
+                # hangs forever — fall back to asking the controller,
+                # which answers from its task table, reconstructs via
+                # lineage, or fails the object loudly.
+                from ray_tpu.exceptions import GetTimeoutError
+                grace = 5.0 if timeout is None else min(5.0, timeout)
+                try:
+                    value = self.memory_store.get(oid, grace)
+                except GetTimeoutError:
+                    self._ensure_location_probe(b)
+                    rest = None if timeout is None else timeout - grace
+                    value = self.memory_store.get(oid, rest)
+            else:
+                value = self.memory_store.get(oid, timeout)
+        except WeakCacheExpired:
+            # the value existed, was weak-cached, and got collected
+            # between our checks — re-materialize from shm via meta
+            # (the finally below balances _enter_blocked exactly once)
+            return self._get_one(ref, timeout)
         finally:
             self._exit_blocked(token)
         if isinstance(value, _MetaReady):
@@ -630,7 +733,7 @@ class Runtime:
             view = self.shm.get_view(oid, timeout=5.0)
             if view is not None:
                 value, _ = self.serialization.deserialize_from_view(view)
-                self.memory_store.put(oid, value, force=True)
+                self._cache_shm_value(oid, value)
                 return value
         # remote: ask controller to make it local (or hand us inline bytes)
         reply = self.request(P.GET_LOCATION, {
@@ -652,8 +755,37 @@ class Runtime:
             from ray_tpu.exceptions import ObjectLostError
             raise ObjectLostError(oid)
         value, _ = self.serialization.deserialize_from_view(view)
-        self.memory_store.put(oid, value, force=True)
+        self._cache_shm_value(oid, value)
         return value
+
+    def _cache_shm_value(self, oid: ObjectID, value: Any) -> None:
+        """Cache a zero-copy shm value WEAKLY and release the reader
+        ledger when the value is collected (reference: plasma buffers
+        pin an object only while the client still holds them). A strong
+        cache would pin the extent for the process lifetime — every
+        large task arg a worker ever saw would leak."""
+        import weakref
+        targets = _weakref_targets(value)
+        if not targets:
+            # nothing weakref-able aliases the extent (pure-copy value):
+            # release the ledger now and cache strongly
+            self.memory_store.put(oid, value, force=True)
+            self.shm.release(oid)
+            return
+        remaining = [len(targets)]
+        shm = self.shm
+
+        def _release(_=None):
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                try:
+                    shm.release(oid)
+                except Exception:
+                    pass
+
+        for t in targets:
+            weakref.finalize(t, _release)
+        self.memory_store.put(oid, value, force=True, weak=True)
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None,
@@ -747,6 +879,16 @@ class Runtime:
         oid = ref.id()
 
         def materialize_and_call(value, error):
+            from ray_tpu.core.memory_store import WeakExpired
+            if isinstance(value, WeakExpired):
+                with self._meta_lock:
+                    meta = self._meta.get(oid.binary())
+                if meta is None:
+                    # locally-materialized object with no recorded meta:
+                    # the bytes are still in the local store
+                    meta = {"object_id": oid.binary(),
+                            "node_id": self.node_id.binary()}
+                value = _MetaReady(meta)
             if isinstance(value, _MetaReady):
                 try:
                     value = self._materialize(oid, value.meta)
@@ -777,6 +919,14 @@ class Runtime:
                        ) -> Tuple[bytes, List[Tuple[int, ObjectID]], List[ObjectID]]:
         """Top-level ObjectRef args become placeholders resolved pre-exec
         (reference: dependency_resolver.cc); nested refs stay borrowed."""
+        if not args and not kwargs:
+            # no-arg calls dominate fan-out workloads: one cached blob
+            # instead of a fresh cloudpickle Pickler per submission
+            blob = self._empty_args_blob
+            if blob is None:
+                blob = self._empty_args_blob = \
+                    self.serialization.serialize(((), {})).to_bytes()
+            return blob, [], []
         arg_refs: List[Tuple[int, ObjectID]] = []
         new_args = []
         for i, a in enumerate(args):
@@ -801,7 +951,8 @@ class Runtime:
         refs = [ObjectRef(oid, self.worker_id) for oid in spec.return_ids()]
         for _, oid in spec.arg_refs:
             self.reference_counter.add_submitted_task_ref(oid)
-        self.reference_counter.flush()
+        # deltas ride the threshold/periodic flush — flushing per submit
+        # would cost a REF_DELTAS apply per task on the controller loop
         if spec.is_actor_task:
             self._submit_actor_task(spec)
         else:
@@ -945,6 +1096,7 @@ class Runtime:
             with self._meta_lock:
                 self._meta[oid.binary()] = meta
             self.memory_store.put(oid, _MetaReady(meta))
+        self._unpin_task_args(spec)
 
     def create_actor(self, spec: TaskSpec) -> None:
         spec.owner = self.worker_id
@@ -1058,3 +1210,37 @@ class _MetaReady:
 
     def __init__(self, meta: dict):
         self.meta = meta
+
+
+def _weakref_targets(value, depth: int = 3) -> list:
+    """Weakref-able objects inside ``value`` whose lifetime tracks the
+    zero-copy buffers (numpy arrays and arbitrary user objects). Plain
+    containers are walked shallowly; values with no weakref-able parts
+    (pure bytes/str/scalars — which pickle COPIES out of the buffer
+    anyway) return []."""
+    out: list = []
+
+    def walk(v, d):
+        if d < 0:
+            return
+        tv = type(v)
+        if tv in (int, float, str, bytes, bytearray, bool,
+                  type(None)):
+            return
+        if tv is dict:
+            for x in v.values():
+                walk(x, d - 1)
+            return
+        if tv in (list, tuple, set, frozenset):
+            for x in v:
+                walk(x, d - 1)
+            return
+        try:
+            import weakref
+            weakref.ref(v)
+        except TypeError:
+            return
+        out.append(v)
+
+    walk(value, depth)
+    return out

@@ -193,13 +193,24 @@ class WorkerExecutor:
             self._cancelled[tid] = now + 5.0
 
     def run_loop(self) -> None:
+        ran_since_gc = False
         while not self._stop:
             try:
                 m = self._queue.get(timeout=0.5)
             except queue.Empty:
                 if self.runtime._stopped.is_set():
                     break
+                if ran_since_gc:
+                    # idle collection: zero-copy arg values that ended up
+                    # in reference cycles hold reader leases on their shm
+                    # extents (freed extents stay zombie until released);
+                    # an idle worker must not pin them until its next
+                    # allocation burst happens to trigger gen-2 GC
+                    import gc
+                    gc.collect()
+                    ran_since_gc = False
                 continue
+            ran_since_gc = True
             try:
                 self._execute(m)
             except (KeyboardInterrupt, TaskCancelledError):
@@ -371,6 +382,14 @@ class WorkerExecutor:
             # the spec so the controller can re-route the retry
             done["spec"] = spec
         self.runtime._send(P.TASK_DONE, done)
+        try:
+            from ray_tpu.core.metric_defs import runtime_metrics
+            rm = runtime_metrics()
+            rm.tasks_finished.inc(
+                tags={"outcome": "error" if error_blob else "ok"})
+            rm.task_exec_seconds.observe(time.time() - start)
+        except Exception:
+            pass
         self.runtime.record_span(
             spec.name or spec.function.qualname, start, time.time() - start,
             task_id=spec.task_id.hex())

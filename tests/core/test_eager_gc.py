@@ -1,0 +1,96 @@
+"""Owner-side eager object recycling (reference: owner-based GC —
+``src/ray/core_worker/reference_count.h`` frees an object the moment the
+owner's counts hit zero; here the owner additionally evicts the shm
+extent directly so a hot put loop recycles warm pages)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.global_state import global_worker
+
+
+SIZE = 8 << 20  # comfortably above the inline threshold
+
+
+def _store_used():
+    w = global_worker()
+    if w.shm is None or not hasattr(w.shm, "_segment"):
+        pytest.skip("native store not attached")
+    used, _, _ = w.shm._segment().stats()
+    return used
+
+
+def test_eager_put_recycling(ray_start_shared):
+    """Dropping the last ref to a never-shared put frees its extent
+    immediately — no controller roundtrip, no store growth in a loop."""
+    data = np.ones(SIZE, dtype=np.uint8)
+    ref = ray_tpu.put(data)
+    base = _store_used()
+    del ref
+    # decrefs from __del__ are deferred (GC-safety); any refcount
+    # operation drains them — flush() is the explicit drain
+    global_worker().reference_counter.flush()
+    assert _store_used() <= base - SIZE
+    # a put loop reuses the same extent instead of growing the heap
+    levels = []
+    for _ in range(6):
+        r = ray_tpu.put(data)
+        levels.append(_store_used())
+        del r
+    assert max(levels) - min(levels) <= SIZE  # no monotonic growth
+
+
+def test_eager_free_skipped_for_escaped_refs(ray_start_shared):
+    """A ref that was serialized (task arg / nested put / raw pickle) may
+    be held by another process: the owner must NOT free it eagerly."""
+    data = np.full(SIZE, 7, dtype=np.uint8)
+
+    @ray_tpu.remote
+    def reader(x):
+        return int(x[0])
+
+    ref = ray_tpu.put(data)
+    out = reader.remote(ref)
+    assert ray_tpu.get(out, timeout=60) == 7
+    before = _store_used()
+    del ref
+    # escaped: extent still resident right after the local drop (normal
+    # controller-driven GC reclaims it later)
+    assert _store_used() >= before - 0  # no crash; still accounted
+    # and the cluster still works
+    assert ray_tpu.get(ray_tpu.put(123)) == 123
+
+
+def test_eager_free_after_task_use(ray_start_shared):
+    """Passing a put ref through a task then dropping everything must
+    not break later gets of unrelated objects or leak forever."""
+    data = np.arange(SIZE, dtype=np.uint8)
+
+    @ray_tpu.remote
+    def total(x):
+        return int(x[:100].sum())
+
+    ref = ray_tpu.put(data)
+    expect = int(data[:100].sum())
+    for _ in range(3):
+        assert ray_tpu.get(total.remote(ref), timeout=60) == expect
+    del ref
+    time.sleep(0.1)
+    v = ray_tpu.put(np.zeros(SIZE, dtype=np.uint8))
+    assert ray_tpu.get(v)[0] == 0
+
+
+def test_put_get_roundtrip_under_recycling(ray_start_shared):
+    """Values must never be corrupted by extent reuse: interleave puts,
+    gets, and drops of same-sized objects."""
+    refs = {}
+    for i in range(8):
+        refs[i] = ray_tpu.put(np.full(SIZE // 8, i, dtype=np.uint8))
+        if i >= 2:
+            del refs[i - 2]  # free behind the writer
+    for i in (6, 7):
+        v = ray_tpu.get(refs[i])
+        assert v[0] == i and v[-1] == i
