@@ -78,6 +78,14 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> "ActorHandle":
         opts = self._opts
+        from ray_tpu.remote_function import _client_route
+        client = _client_route()
+        if client is not None:
+            if getattr(self, "_client_cls", None) is None:
+                self._client_cls = client._wrap(
+                    self._cls,
+                    {k: v for k, v in opts.items() if v is not None})
+            return self._client_cls.remote(*args, **kwargs)
         # default-resource actors release their scheduling CPU once alive
         hold = any(opts.get(k) not in (None, _ACTOR_DEFAULT_OPTS.get(k))
                    for k in ("num_cpus", "num_tpus", "resources", "memory"))

@@ -28,6 +28,12 @@ from ray_tpu.core.object_ref import ObjectRef
 
 _head = None  # _HeadProcess for the in-process controller+node
 _log_monitor = None
+_client = None  # ClientWorker when connected via ray:// (client mode)
+
+
+def _client_or_none():
+    return _client if _client is not None and _client.is_connected() \
+        else None
 
 
 class _HeadProcess:
@@ -79,12 +85,29 @@ def init(address: Optional[str] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          _num_initial_workers: Optional[int] = None,
          _session_dir: Optional[str] = None) -> Dict[str, Any]:
-    """Start a cluster in-process (or connect to one via ``address``)."""
-    global _head
+    """Start a cluster in-process (or connect to one via ``address``).
+
+    ``address="ray://host:port"`` enters client mode (reference: Ray
+    Client, ``python/ray/util/client/worker.py:81``): no local runtime is
+    started; the public API proxies to a remote cluster's client server.
+    """
+    global _head, _client
     if address is None:
         # `ray-tpu submit` / external drivers point here via env var
         # (reference analog: RAY_ADDRESS).
         address = os.environ.get("RAY_TPU_ADDRESS") or None
+    if address and address.startswith("ray://"):
+        if _client is not None and _client.is_connected():
+            if ignore_reinit_error:
+                return {}
+            raise RuntimeError("ray_tpu.init() called twice "
+                               "(use ignore_reinit_error=True)")
+        from ray_tpu.util.client import connect as _client_connect
+        _client = _client_connect(address)
+        atexit.register(_atexit_shutdown)
+        return {"client": True, "address": address,
+                **{k: v for k, v in _client.server_info.items()
+                   if k != "ok"}}
     if try_global_worker() is not None:
         if ignore_reinit_error:
             return {}
@@ -140,7 +163,13 @@ def _atexit_shutdown():
 
 
 def shutdown() -> None:
-    global _head, _log_monitor
+    global _head, _log_monitor, _client
+    if _client is not None:
+        try:
+            _client.disconnect()
+        except Exception:
+            pass
+        _client = None
     if _log_monitor is not None:
         try:
             _log_monitor.stop()
@@ -160,12 +189,15 @@ def shutdown() -> None:
 
 
 def is_initialized() -> bool:
-    return try_global_worker() is not None
+    return try_global_worker() is not None or _client_or_none() is not None
 
 
 def remote(*args, **options):
     """``@remote`` decorator for functions and classes (reference:
     ``worker.py:3137``)."""
+    c = _client_or_none()
+    if c is not None:
+        return c.remote(*args, **options)
     from ray_tpu.actor import ActorClass
     from ray_tpu.remote_function import RemoteFunction
 
@@ -190,10 +222,16 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
     if isinstance(refs, (list, tuple)) and any(
             hasattr(r, "__dag_local_value__") for r in refs):
         return [get(r, timeout=timeout) for r in refs]
+    c = _client_or_none()
+    if c is not None:
+        return c.get(refs, timeout=timeout)
     return global_worker().get(refs, timeout=timeout)
 
 
 def put(value: Any) -> ObjectRef:
+    c = _client_or_none()
+    if c is not None:
+        return c.put(value)
     return global_worker().put(value)
 
 
@@ -201,19 +239,32 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None, fetch_local: bool = True):
     if isinstance(refs, ObjectRef):
         raise TypeError("wait() expects a list of ObjectRefs")
+    c = _client_or_none()
+    if c is not None:
+        return c.wait(refs, num_returns=num_returns, timeout=timeout,
+                      fetch_local=fetch_local)
     return global_worker().wait(refs, num_returns=num_returns,
                                 timeout=timeout, fetch_local=fetch_local)
 
 
 def kill(actor, *, no_restart: bool = True) -> None:
+    c = _client_or_none()
+    if c is not None:
+        return c.kill(actor, no_restart=no_restart)
     global_worker().kill_actor(actor._id, no_restart=no_restart)
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    c = _client_or_none()
+    if c is not None:
+        return c.cancel(ref, force=force)
     global_worker().cancel(ref, force=force)
 
 
 def get_actor(name: str, namespace: str = ""):
+    c = _client_or_none()
+    if c is not None:
+        return c.get_actor(name, namespace=namespace)
     from ray_tpu.actor import ActorHandle
     from ray_tpu.core import protocol as P
     w = global_worker()
@@ -223,14 +274,23 @@ def get_actor(name: str, namespace: str = ""):
 
 
 def nodes() -> List[dict]:
+    c = _client_or_none()
+    if c is not None:
+        return c.nodes()
     return global_worker().state_query("nodes")
 
 
 def cluster_resources() -> Dict[str, float]:
+    c = _client_or_none()
+    if c is not None:
+        return c.cluster_resources()
     return global_worker().state_query("cluster_resources")
 
 
 def available_resources() -> Dict[str, float]:
+    c = _client_or_none()
+    if c is not None:
+        return c.available_resources()
     return global_worker().state_query("available_resources")
 
 

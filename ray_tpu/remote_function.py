@@ -16,6 +16,16 @@ import cloudpickle
 
 from ray_tpu.core.global_state import global_worker
 from ray_tpu.core.ids import TaskID
+
+
+def _client_route():
+    """The installed ray:// ClientWorker iff client mode is active AND
+    no local runtime exists (a local runtime always wins)."""
+    from ray_tpu.core.global_state import try_global_worker
+    if try_global_worker() is not None:
+        return None
+    from ray_tpu import api
+    return api._client_or_none()
 from ray_tpu.core.task_spec import FunctionDescriptor, SchedulingStrategy, TaskSpec
 
 
@@ -111,6 +121,15 @@ class RemoteFunction:
         return self._remote(args, kwargs, self._opts)
 
     def _remote(self, args, kwargs, opts):
+        client = _client_route()
+        if client is not None:
+            # decorated before ray_tpu.init("ray://..."): route through
+            # the client at call time (reference: client-mode hooks)
+            if getattr(self, "_client_fn", None) is None:
+                self._client_fn = client._wrap(
+                    self._function,
+                    {k: v for k, v in opts.items() if v is not None})
+            return self._client_fn.remote(*args, **kwargs)
         w = global_worker()
         descriptor = self._ensure_exported(w)
         args_blob, arg_refs, _ = w.serialize_args(args, kwargs)

@@ -1,0 +1,307 @@
+"""Client server: hosts a proxy driver for remote clients.
+
+Reference: ``python/ray/util/client/server/server.py`` (RayletServicer —
+per-client object/actor leases, function cache, disconnect GC). The
+server runs inside a process that is already a cluster driver (head
+node, or ``ray-tpu client-server``); each connected client gets its own
+reference table so a disconnect releases exactly its leases.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict
+
+import zmq
+
+from ray_tpu.util.client import common as C
+
+logger = logging.getLogger(__name__)
+
+
+class _ClientSession:
+    def __init__(self):
+        self.refs: Dict[bytes, Any] = {}      # ref_id -> ObjectRef
+        self.actors: Dict[bytes, Any] = {}    # actor_ref_id -> handle
+        self.functions: Dict[bytes, Any] = {} # fn_id -> RemoteFunction
+        self.classes: Dict[bytes, Any] = {}   # cls_id -> ActorClass
+        self.last_seen = time.monotonic()
+
+
+class ClientServer:
+    """Serves the client protocol on a TCP ROUTER socket."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = C.DEFAULT_PORT,
+                 idle_disconnect_s: float = 120.0):
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            raise RuntimeError(
+                "ClientServer must run inside an initialized driver "
+                "(call ray_tpu.init() first)")
+        self._ray = ray_tpu
+        self.host = host
+        self.port = port
+        self.idle_disconnect_s = idle_disconnect_s
+        self._sessions: Dict[bytes, _ClientSession] = {}
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        self._sock.bind(f"tcp://{host}:{port}")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="client-server", daemon=True)
+        self._ref_seq = 0
+
+    def start(self) -> "ClientServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=3)
+        try:
+            self._sock.close(0)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._sock, zmq.POLLIN)
+        last_reap = time.monotonic()
+        while not self._stop.is_set():
+            if not dict(poller.poll(timeout=250)):
+                if time.monotonic() - last_reap > 10.0:
+                    self._reap_idle()
+                    last_reap = time.monotonic()
+                continue
+            frames = self._sock.recv_multipart()
+            identity, payload = frames[0], frames[-1]
+            try:
+                req = C.loads(payload)
+            except Exception as e:  # noqa: BLE001
+                self._reply(identity, {"ok": False, "error": C.dumps(e)})
+                continue
+            try:
+                out = self._dispatch(identity, req)
+            except BaseException as e:  # noqa: BLE001
+                logger.debug("client op %s failed", req.get("op"),
+                             exc_info=True)
+                out = {"ok": False, "error": C.dumps(e)}
+            out["rid"] = req.get("rid")
+            self._reply(identity, out)
+
+    def _reply(self, identity: bytes, out: dict) -> None:
+        try:
+            self._sock.send_multipart([identity, C.dumps(out)])
+        except Exception:
+            pass
+
+    def _session(self, identity: bytes) -> _ClientSession:
+        s = self._sessions.get(identity)
+        if s is None:
+            s = self._sessions[identity] = _ClientSession()
+        s.last_seen = time.monotonic()
+        return s
+
+    def _reap_idle(self) -> None:
+        now = time.monotonic()
+        for identity in list(self._sessions):
+            s = self._sessions[identity]
+            if now - s.last_seen > self.idle_disconnect_s:
+                logger.info("client %s idle; releasing %d refs",
+                            identity.hex()[:8], len(s.refs))
+                self._drop_session(identity)
+
+    def _drop_session(self, identity: bytes) -> None:
+        s = self._sessions.pop(identity, None)
+        if s is None:
+            return
+        s.refs.clear()
+        for h in s.actors.values():
+            # only detached/named actors survive their creating client
+            try:
+                if not getattr(h, "_detached", False):
+                    self._ray.kill(h)
+            except Exception:
+                pass
+        s.actors.clear()
+
+    def _mint(self) -> bytes:
+        self._ref_seq += 1
+        return os.urandom(12) + self._ref_seq.to_bytes(4, "little")
+
+    # -------------------------------------------------------- marshaling
+    def _resolve_markers(self, session: _ClientSession, obj):
+        """Replace _RefMarker instances (from pickled ClientObjectRefs)
+        with the server-held ObjectRefs, recursively through the common
+        containers (same depth the reference's marker pass covers)."""
+        if isinstance(obj, C._RefMarker):
+            ref = session.refs.get(obj.ref_id)
+            if ref is None:
+                raise KeyError(
+                    f"client ref {obj.ref_id.hex()[:12]} is not leased "
+                    f"to this connection")
+            return ref
+        if isinstance(obj, (list, tuple)):
+            vals = [self._resolve_markers(session, v) for v in obj]
+            return type(obj)(vals) if not isinstance(obj, tuple) \
+                else tuple(vals)
+        if isinstance(obj, dict):
+            return {k: self._resolve_markers(session, v)
+                    for k, v in obj.items()}
+        return obj
+
+    def _lease_ref(self, session: _ClientSession, ref) -> bytes:
+        rid = self._mint()
+        session.refs[rid] = ref
+        return rid
+
+    # --------------------------------------------------------- dispatch
+    def _dispatch(self, identity: bytes, req: dict) -> dict:
+        op = req["op"]
+        session = self._session(identity)
+        for rid in req.get("release") or ():
+            session.refs.pop(rid, None)
+        handler = getattr(self, f"_op_{op}", None)
+        if handler is None:
+            raise ValueError(f"unknown client op {op!r}")
+        return handler(session, req)
+
+    def _op_connect(self, session, req) -> dict:
+        info = {
+            "ok": True,
+            "num_clients": len(self._sessions),
+            "resources": self._ray.cluster_resources(),
+        }
+        return info
+
+    def _op_disconnect(self, session, req) -> dict:
+        # release happens via identity lookup in _drop_session
+        for identity, s in list(self._sessions.items()):
+            if s is session:
+                self._drop_session(identity)
+        return {"ok": True}
+
+    def _op_put(self, session, req) -> dict:
+        value = self._resolve_markers(session, C.loads(req["value"]))
+        ref = self._ray.put(value)
+        return {"ok": True, "ref_id": self._lease_ref(session, ref)}
+
+    def _op_get(self, session, req) -> dict:
+        refs = [session.refs[rid] for rid in req["ref_ids"]]
+        vals = self._ray.get(refs, timeout=req.get("timeout"))
+        return {"ok": True, "values": C.dumps(vals)}
+
+    def _op_wait(self, session, req) -> dict:
+        by_id = {session.refs[rid]: rid for rid in req["ref_ids"]}
+        ready, pending = self._ray.wait(
+            list(by_id.keys()), num_returns=req.get("num_returns", 1),
+            timeout=req.get("timeout"))
+        return {"ok": True,
+                "ready": [by_id[r] for r in ready],
+                "pending": [by_id[r] for r in pending]}
+
+    def _op_release(self, session, req) -> dict:
+        for rid in req["ref_ids"]:
+            session.refs.pop(rid, None)
+        return {"ok": True}
+
+    def _op_release_actor(self, session, req) -> dict:
+        session.actors.pop(req["actor_id"], None)
+        return {"ok": True}
+
+    def _op_register_fn(self, session, req) -> dict:
+        fn = C.loads(req["func"])
+        opts = req.get("options") or {}
+        fn_id = self._mint()
+        session.functions[fn_id] = self._ray.remote(**opts)(fn) \
+            if opts else self._ray.remote(fn)
+        return {"ok": True, "fn_id": fn_id}
+
+    def _op_call_fn(self, session, req) -> dict:
+        rf = session.functions[req["fn_id"]]
+        if req.get("options"):
+            rf = rf.options(**req["options"])
+        args, kwargs = self._resolve_markers(
+            session, C.loads(req["args"]))
+        refs = rf.remote(*args, **kwargs)
+        many = isinstance(refs, list)
+        out = [self._lease_ref(session, r)
+               for r in (refs if many else [refs])]
+        return {"ok": True, "ref_ids": out, "many": many}
+
+    def _op_register_class(self, session, req) -> dict:
+        cls = C.loads(req["cls"])
+        opts = req.get("options") or {}
+        cls_id = self._mint()
+        session.classes[cls_id] = self._ray.remote(**opts)(cls) \
+            if opts else self._ray.remote(cls)
+        methods = [n for n in dir(cls)
+                   if not n.startswith("_") and callable(getattr(cls, n))]
+        return {"ok": True, "cls_id": cls_id, "methods": methods}
+
+    def _op_create_actor(self, session, req) -> dict:
+        ac = session.classes[req["cls_id"]]
+        opts = req.get("options") or {}
+        if opts:
+            ac = ac.options(**opts)
+        args, kwargs = self._resolve_markers(
+            session, C.loads(req["args"]))
+        handle = ac.remote(*args, **kwargs)
+        if opts.get("lifetime") == "detached" or opts.get("name"):
+            handle._detached = True
+        aid = self._mint()
+        session.actors[aid] = handle
+        return {"ok": True, "actor_id": aid}
+
+    def _op_call_method(self, session, req) -> dict:
+        handle = session.actors[req["actor_id"]]
+        method = getattr(handle, req["method"])
+        if req.get("options"):
+            method = method.options(**req["options"])
+        args, kwargs = self._resolve_markers(
+            session, C.loads(req["args"]))
+        refs = method.remote(*args, **kwargs)
+        many = isinstance(refs, list)
+        out = [self._lease_ref(session, r)
+               for r in (refs if many else [refs])]
+        return {"ok": True, "ref_ids": out, "many": many}
+
+    def _op_get_actor(self, session, req) -> dict:
+        handle = self._ray.get_actor(
+            req["name"], namespace=req.get("namespace", ""))
+        handle._detached = True   # named: outlives this client
+        methods = [n for n in dir(handle)
+                   if not n.startswith("_")]
+        aid = self._mint()
+        session.actors[aid] = handle
+        # handle exposes methods dynamically; ask the actor class
+        return {"ok": True, "actor_id": aid,
+                "methods": getattr(handle, "_method_names", methods)}
+
+    def _op_kill_actor(self, session, req) -> dict:
+        handle = session.actors.get(req["actor_id"])
+        if handle is not None:
+            self._ray.kill(handle, no_restart=req.get("no_restart", True))
+        return {"ok": True}
+
+    def _op_cancel(self, session, req) -> dict:
+        ref = session.refs.get(req["ref_id"])
+        if ref is not None:
+            self._ray.cancel(ref, force=req.get("force", False))
+        return {"ok": True}
+
+    def _op_cluster_info(self, session, req) -> dict:
+        kind = req.get("kind", "resources")
+        if kind == "resources":
+            data = self._ray.cluster_resources()
+        elif kind == "available":
+            data = self._ray.available_resources()
+        elif kind == "nodes":
+            data = self._ray.nodes()
+        else:
+            raise ValueError(f"unknown cluster_info kind {kind!r}")
+        return {"ok": True, "data": C.dumps(data)}

@@ -1,0 +1,268 @@
+"""Client-side of the client protocol.
+
+Reference: ``python/ray/util/client/worker.py:81`` (``Worker`` — the
+gRPC stub behind ``ray.init("ray://...")``) and ``api.py`` (the ClientAPI
+that the public functions delegate to in client mode). Here
+:class:`ClientWorker` is installed by ``ray_tpu.init("ray://host:port")``;
+``ray_tpu.remote/get/put/...`` route to it while connected.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import zmq
+
+from ray_tpu.util.client import common as C
+from ray_tpu.util.client.common import (
+    ClientActorHandle, ClientObjectRef)
+
+
+class ClientRemoteFunction:
+    """Client counterpart of RemoteFunction: lazily registered with the
+    server on first use (ships the cloudpickled function once)."""
+
+    def __init__(self, worker: "ClientWorker", func, options: dict):
+        self._worker = worker
+        self._func = func
+        self._options = dict(options)
+        self._fn_id: Optional[bytes] = None
+        self.__name__ = getattr(func, "__name__", "anonymous")
+
+    def options(self, **opts):
+        merged = dict(self._options)
+        merged.update(opts)
+        out = ClientRemoteFunction(self._worker, self._func, merged)
+        out._fn_id = self._fn_id  # per-call opts ride the CALL message
+        out._call_opts = opts
+        return out
+
+    def remote(self, *args, **kwargs):
+        if self._fn_id is None:
+            self._fn_id = self._worker._register_fn(
+                self._func, self._options)
+        return self._worker._call_fn(
+            self._fn_id, args, kwargs, getattr(self, "_call_opts", None))
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            "remote function cannot be called directly; use .remote()")
+
+
+class ClientActorClass:
+    def __init__(self, worker: "ClientWorker", cls, options: dict):
+        self._worker = worker
+        self._cls = cls
+        self._options = dict(options)
+        self._cls_id: Optional[bytes] = None
+        self._methods: List[str] = []
+
+    def options(self, **opts):
+        merged = dict(self._options)
+        merged.update(opts)
+        out = ClientActorClass(self._worker, self._cls, merged)
+        out._cls_id = self._cls_id
+        out._methods = self._methods
+        out._create_opts = opts
+        return out
+
+    def remote(self, *args, **kwargs):
+        if self._cls_id is None:
+            self._cls_id, self._methods = self._worker._register_class(
+                self._cls, self._options)
+        opts = getattr(self, "_create_opts", None)
+        return self._worker._create_actor(
+            self._cls_id, args, kwargs, opts, self._methods)
+
+
+class ClientWorker:
+    """Connection to a ClientServer; implements the public API surface."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        # address: "ray://host:port"
+        hostport = address[len("ray://"):] if address.startswith("ray://") \
+            else address
+        if ":" not in hostport:
+            hostport = f"{hostport}:{C.DEFAULT_PORT}"
+        self.address = hostport
+        self.timeout = timeout
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.DEALER)
+        self._sock.connect(f"tcp://{hostport}")
+        self._lock = threading.Lock()   # one in-flight request at a time
+        self._rid = 0
+        self._closed = False
+        self._pending_release: List[bytes] = []
+        info = self._request({"op": "connect"})
+        self.server_info = info
+
+    # -------------------------------------------------------------- rpc
+    def _request(self, req: dict, timeout: Optional[float] = None) -> dict:
+        if self._closed:
+            raise ConnectionError("client connection is closed")
+        timeout = self.timeout if timeout is None else timeout
+        with self._lock:
+            self._rid += 1
+            req["rid"] = self._rid
+            rel, self._pending_release = self._pending_release, []
+            if rel:
+                # piggyback deferred ref releases (no extra roundtrip)
+                req["release"] = rel
+            self._sock.send(C.dumps(req))
+            deadline = None if timeout is None else timeout * 1000
+            while True:
+                if not self._sock.poll(deadline if deadline else 60000):
+                    raise TimeoutError(
+                        f"client request {req['op']} timed out "
+                        f"({timeout}s) against {self.address}")
+                out = C.loads(self._sock.recv())
+                if out.get("rid") == self._rid:
+                    break
+        if not out.get("ok"):
+            err = out.get("error")
+            raise C.loads(err) if err is not None else \
+                ConnectionError("client request failed")
+        return out
+
+    def _release(self, ref_id: bytes) -> None:
+        # called from __del__ — defer to the next request, flush if many
+        if self._closed:
+            return
+        self._pending_release.append(ref_id)
+        if len(self._pending_release) >= 64:
+            try:
+                self._request({"op": "release", "ref_ids": []})
+            except Exception:
+                pass
+
+    def _release_actor(self, actor_id: bytes) -> None:
+        if self._closed:
+            return
+        try:
+            self._request({"op": "release_actor", "actor_id": actor_id})
+        except Exception:
+            pass
+
+    # -------------------------------------------------------------- api
+    def put(self, value: Any) -> ClientObjectRef:
+        out = self._request({"op": "put", "value": C.dumps(value)})
+        return ClientObjectRef(out["ref_id"], self)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        if single:
+            refs = [refs]
+        for r in refs:
+            if not isinstance(r, ClientObjectRef):
+                raise TypeError(f"expected ClientObjectRef, got {type(r)}")
+        out = self._request(
+            {"op": "get", "ref_ids": [r.binary() for r in refs],
+             "timeout": timeout},
+            timeout=None if timeout is None else timeout + 10)
+        vals = C.loads(out["values"])
+        return vals[0] if single else vals
+
+    def wait(self, refs: Sequence[ClientObjectRef], *, num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True
+             ) -> Tuple[List[ClientObjectRef], List[ClientObjectRef]]:
+        by_id = {r.binary(): r for r in refs}
+        out = self._request(
+            {"op": "wait", "ref_ids": list(by_id.keys()),
+             "num_returns": num_returns, "timeout": timeout},
+            timeout=None if timeout is None else timeout + 10)
+        return ([by_id[b] for b in out["ready"]],
+                [by_id[b] for b in out["pending"]])
+
+    def remote(self, *args, **options):
+        if len(args) == 1 and callable(args[0]) and not options:
+            return self._wrap(args[0], {})
+        def deco(obj):
+            return self._wrap(obj, options)
+        return deco
+
+    def _wrap(self, obj, options: dict):
+        if isinstance(obj, type):
+            return ClientActorClass(self, obj, options)
+        return ClientRemoteFunction(self, obj, options)
+
+    def kill(self, actor: ClientActorHandle, *, no_restart: bool = True):
+        self._request({"op": "kill_actor", "actor_id": actor._id,
+                       "no_restart": no_restart})
+
+    def cancel(self, ref: ClientObjectRef, *, force: bool = False):
+        self._request({"op": "cancel", "ref_id": ref.binary(),
+                       "force": force})
+
+    def get_actor(self, name: str, namespace: str = "") -> ClientActorHandle:
+        out = self._request({"op": "get_actor", "name": name,
+                             "namespace": namespace})
+        return ClientActorHandle(out["actor_id"], self, out["methods"])
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return C.loads(self._request(
+            {"op": "cluster_info", "kind": "resources"})["data"])
+
+    def available_resources(self) -> Dict[str, float]:
+        return C.loads(self._request(
+            {"op": "cluster_info", "kind": "available"})["data"])
+
+    def nodes(self) -> List[dict]:
+        return C.loads(self._request(
+            {"op": "cluster_info", "kind": "nodes"})["data"])
+
+    # ---------------------------------------------------- fn/actor plumbing
+    def _register_fn(self, func, options: dict) -> bytes:
+        return self._request({"op": "register_fn", "func": C.dumps(func),
+                              "options": options})["fn_id"]
+
+    def _call_fn(self, fn_id: bytes, args, kwargs, options):
+        out = self._request({
+            "op": "call_fn", "fn_id": fn_id,
+            "args": C.dumps((args, kwargs)), "options": options})
+        refs = [ClientObjectRef(b, self) for b in out["ref_ids"]]
+        return refs if out["many"] else refs[0]
+
+    def _register_class(self, cls, options: dict):
+        out = self._request({"op": "register_class", "cls": C.dumps(cls),
+                             "options": options})
+        return out["cls_id"], out["methods"]
+
+    def _create_actor(self, cls_id: bytes, args, kwargs, options, methods):
+        out = self._request({
+            "op": "create_actor", "cls_id": cls_id,
+            "args": C.dumps((args, kwargs)), "options": options})
+        return ClientActorHandle(out["actor_id"], self, methods)
+
+    def _call_method(self, actor_id: bytes, method: str, args, kwargs,
+                     options):
+        out = self._request({
+            "op": "call_method", "actor_id": actor_id, "method": method,
+            "args": C.dumps((args, kwargs)), "options": options or None})
+        refs = [ClientObjectRef(b, self) for b in out["ref_ids"]]
+        return refs if out["many"] else refs[0]
+
+    def disconnect(self) -> None:
+        if self._closed:
+            return
+        try:
+            self._request({"op": "disconnect"}, timeout=5)
+        except Exception:
+            pass
+        self._closed = True
+        try:
+            self._sock.close(0)
+        except Exception:
+            pass
+
+    # duck-type used by api.shutdown
+    def shutdown(self) -> None:
+        self.disconnect()
+
+    def is_connected(self) -> bool:
+        return not self._closed
+
+
+def connect(address: str, timeout: float = 30.0) -> ClientWorker:
+    """Connect to a ClientServer; returns the installed ClientWorker."""
+    return ClientWorker(address, timeout=timeout)
