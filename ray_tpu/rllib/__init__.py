@@ -7,9 +7,18 @@ algorithm; PG the minimal baseline.
 
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.connectors import (
+    ClipObs, Connector, ConnectorPipeline, FlattenObs, FrameStack,
+    NormalizeObs)
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import EnvRunner, compute_gae
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
+    MultiAgentPPOConfig)
+from ray_tpu.rllib.offline import (
+    BC, BCConfig, MARWIL, MARWILConfig, JsonReader, JsonWriter)
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
@@ -17,11 +26,29 @@ from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
+    "ClipObs",
+    "Connector",
+    "ConnectorPipeline",
     "DQN",
     "DQNConfig",
     "EnvRunner",
+    "FlattenObs",
+    "FrameStack",
+    "IMPALA",
+    "IMPALAConfig",
+    "JsonReader",
+    "JsonWriter",
     "Learner",
     "LearnerGroup",
+    "MARWIL",
+    "MARWILConfig",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "NormalizeObs",
     "PG",
     "PGConfig",
     "PPO",

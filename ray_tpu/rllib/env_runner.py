@@ -39,10 +39,14 @@ class EnvRunner:
     def __init__(self, env_creator: Callable[[], Any],
                  module_spec: RLModuleSpec, num_envs: int = 1,
                  gamma: float = 0.99, lambda_: float = 0.95,
-                 seed: int = 0, worker_index: int = 0):
+                 seed: int = 0, worker_index: int = 0,
+                 obs_connectors: Optional[list] = None):
         import jax
+        from ray_tpu.rllib.connectors import ConnectorPipeline
         self._envs = [env_creator() for _ in range(num_envs)]
         self._module = module_spec.build()
+        self._connectors = ConnectorPipeline(obs_connectors) \
+            if obs_connectors else None
         self._params = None
         self._gamma = gamma
         self._lambda = lambda_
@@ -50,6 +54,7 @@ class EnvRunner:
         self._obs = np.stack([
             self._reset(e, seed * 7919 + worker_index * 131 + i)
             for i, e in enumerate(self._envs)])
+        self._cur_obs: Optional[np.ndarray] = None
         self._ep_returns = [0.0] * num_envs
         self._completed: list = []
 
@@ -64,12 +69,25 @@ class EnvRunner:
     def get_weights(self):
         return self._params
 
-    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
-        """Collect num_steps per env; returns the flattened batch."""
+    def _transformed_obs(self) -> np.ndarray:
+        """Connector-transformed view of the CURRENT raw obs, applied
+        exactly once per distinct observation (stateful connectors —
+        FrameStack, NormalizeObs — must see each obs once; re-applying
+        for shape probes or bootstraps would corrupt their state)."""
+        if self._cur_obs is None:
+            self._cur_obs = self._connectors(self._obs) \
+                if self._connectors else self._obs.astype(np.float32)
+        return self._cur_obs
+
+    def _rollout(self, num_steps: int):
+        """Shared stepping loop for both sampling modes. Returns
+        time-major buffers [T, B, ...] plus the bootstrap values of the
+        final state."""
         import jax
         assert self._params is not None, "set_weights first"
         n_envs = len(self._envs)
-        obs_buf = np.zeros((num_steps, n_envs) + self._obs.shape[1:],
+        cur0 = self._transformed_obs()
+        obs_buf = np.zeros((num_steps, n_envs) + cur0.shape[1:],
                            np.float32)
         act_buf = np.zeros((num_steps, n_envs), np.int64)
         logp_buf = np.zeros((num_steps, n_envs), np.float32)
@@ -78,10 +96,11 @@ class EnvRunner:
         done_buf = np.zeros((num_steps, n_envs), np.float32)
 
         for t in range(num_steps):
+            cur = self._transformed_obs()
             self._key, sub = jax.random.split(self._key)
             actions, logps, values = self._module.forward_exploration(
-                self._params, self._obs, sub)
-            obs_buf[t] = self._obs
+                self._params, cur, sub)
+            obs_buf[t] = cur
             act_buf[t] = actions
             logp_buf[t] = logps
             val_buf[t] = values
@@ -100,12 +119,22 @@ class EnvRunner:
                     self._ep_returns[i] = 0.0
                     obs = self._reset(env)
                 self._obs[i] = obs
+            self._cur_obs = None  # raw obs changed
 
-        # bootstrap values for the unfinished tails
+        # bootstrap values of the final state (the transform is cached,
+        # so the next rollout's t=0 reuses it — still one application)
         self._key, sub = jax.random.split(self._key)
         _, _, last_values = self._module.forward_exploration(
-            self._params, self._obs, sub)
+            self._params, self._transformed_obs(), sub)
+        return (obs_buf, act_buf, logp_buf, val_buf, rew_buf, done_buf,
+                np.asarray(last_values, np.float32))
 
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps per env; returns the flattened batch with
+        GAE advantages."""
+        (obs_buf, act_buf, logp_buf, val_buf, rew_buf, done_buf,
+         last_values) = self._rollout(num_steps)
+        n_envs = len(self._envs)
         adv = np.zeros_like(rew_buf)
         ret = np.zeros_like(rew_buf)
         for i in range(n_envs):
@@ -121,6 +150,22 @@ class EnvRunner:
             "logp": flat(logp_buf),
             "value_targets": flat(ret),
             "advantages": flat(adv),
+        }
+
+    def sample_segments(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Time-major rollout segments for off-policy correction
+        (IMPALA/V-trace needs the [T, B] structure + behavior log-probs
+        + the bootstrap value of the final state; GAE is NOT computed —
+        the learner's V-trace recursion replaces it)."""
+        (obs_buf, act_buf, logp_buf, _val, rew_buf, done_buf,
+         last_values) = self._rollout(num_steps)
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "behavior_logp": logp_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "bootstrap_value": last_values,
         }
 
     def episode_returns(self, clear: bool = True) -> list:

@@ -152,6 +152,17 @@ class LearnerGroup:
                      for a in self._remote])
         return results[0][1]
 
+    def update_ordered(self, batch: Dict[str, np.ndarray]
+                       ) -> Dict[str, float]:
+        """One full-batch update with NO shuffling — sequence-structured
+        losses (V-trace's [T, B] reshape) need samples in order. Remote
+        multi-learner sharding would split the time axis, so ordered
+        updates always run on one learner."""
+        if self._local is not None:
+            return self._local.update_from_batch(batch)
+        return ray_tpu.get(
+            self._remote[0].update_from_batch.remote(batch))
+
     def get_weights(self):
         if self._local is not None:
             return self._local.get_weights()
