@@ -113,6 +113,8 @@ class RunConfig:
     stop: Optional[Dict[str, Any]] = None
     verbose: int = 1
     log_to_file: bool = False
+    #: tune.Callback instances (loggers, experiment trackers)
+    callbacks: Optional[list] = None
 
     def __post_init__(self):
         if self.storage_path is None:

@@ -1,0 +1,5 @@
+"""Experiment-tracking integrations (reference:
+``python/ray/air/integrations/`` — wandb/mlflow/comet/keras Tune
+callback adapters). Each adapter import-gates on its tracking library;
+the hermetic TPU image does not bake them, so construction raises a
+clear error telling the operator to add the package to the image."""

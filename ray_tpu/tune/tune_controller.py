@@ -78,7 +78,8 @@ class TuneController:
                  stop: Optional[Dict[str, float]] = None,
                  max_failures: int = 0,
                  checkpoint_frequency: int = 0,
-                 checkpoint_at_end: bool = True):
+                 checkpoint_at_end: bool = True,
+                 callbacks: Optional[list] = None):
         self.trainable_cls = _as_trainable_cls(trainable)
         self.param_space = param_space or {}
         self.searcher = searcher or BasicVariantGenerator()
@@ -92,6 +93,10 @@ class TuneController:
         self.max_failures = max_failures
         self.checkpoint_frequency = checkpoint_frequency
         self.checkpoint_at_end = checkpoint_at_end
+        from ray_tpu.tune.callback import CallbackList
+        self.callbacks = CallbackList(callbacks)
+        self.callbacks.fire("setup", stop=stop, num_samples=num_samples)
+        self._cb_iteration = 0
 
         self.searcher.set_search_properties(
             metric, self.mode, self.param_space, num_samples=num_samples)
@@ -171,9 +176,16 @@ class TuneController:
     def _start_trial(self, trial: Trial) -> None:
         factory = self._resource_request(trial.config)
         pg = factory() if factory is not None else None
+        trial.local_dir = self._trial_storage(trial).trial_dir
+        first_start = trial.actor is None and trial.status == PENDING \
+            and not getattr(trial, "_started_once", False)
         trial.actor = self._create_actor(trial, trial.config, pg)
         trial._pg = pg
         trial.status = RUNNING
+        if first_start:
+            trial._started_once = True
+            self.callbacks.fire("on_trial_start", self._cb_iteration,
+                                self.trials, trial)
         if trial.restore_pending is not None:
             trial.actor.restore.remote(trial.restore_pending)
             trial.restore_pending = None
@@ -201,6 +213,8 @@ class TuneController:
             return trial.checkpoint
         if ckpt is not None:
             trial.checkpoint = ckpt
+            self.callbacks.fire("on_checkpoint", self._cb_iteration,
+                                self.trials, trial, ckpt)
         return trial.checkpoint
 
     def _release_trial_resources(self, trial: Trial) -> None:
@@ -235,6 +249,9 @@ class TuneController:
             trial.trial_id, result=trial.last_result,
             error=status == ERROR)
         self.scheduler.on_trial_complete(self, trial, trial.last_result)
+        self.callbacks.fire(
+            "on_trial_error" if status == ERROR else "on_trial_complete",
+            self._cb_iteration, self.trials, trial)
         self._snapshot()
 
     # -- PBT hook -----------------------------------------------------
@@ -325,6 +342,7 @@ class TuneController:
                 continue
             self._handle_result(trial, result)
         self._snapshot()
+        self.callbacks.fire("on_experiment_end", self.trials)
         return self.trials
 
     def _fill(self) -> None:
@@ -346,6 +364,9 @@ class TuneController:
         trial.last_result = result
         trial.results.append(result)
         trial.iteration = result.get(TRAINING_ITERATION, trial.iteration + 1)
+        self._cb_iteration += 1
+        self.callbacks.fire("on_trial_result", self._cb_iteration,
+                            self.trials, trial, result)
         self.searcher.on_trial_result(trial.trial_id, result)
         if self.checkpoint_frequency and \
                 trial.iteration % self.checkpoint_frequency == 0:

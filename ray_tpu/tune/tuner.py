@@ -76,7 +76,8 @@ class Tuner:
             checkpoint_frequency=cc.checkpoint_frequency,
             checkpoint_at_end=(cc.checkpoint_at_end
                                if cc.checkpoint_at_end is not None
-                               else True))
+                               else True),
+            callbacks=self.run_config.callbacks)
 
     def fit(self) -> ResultGrid:
         if self._controller is None:

@@ -1,0 +1,79 @@
+"""Distributed tracing: spans around tasks/actors.
+
+Reference: ``python/ray/util/tracing/tracing_helper.py`` — Ray wraps
+task submission/execution in OpenTelemetry spans when the user enables
+tracing with an exporter. Here the same layering: if ``opentelemetry``
+is importable, spans go to its tracer provider; otherwise spans fall
+back to the runtime's built-in timeline (``ray-tpu timeline`` renders
+them in the Chrome trace), so tracing works out of the box with zero
+extra dependencies."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional
+
+_enabled = False
+_lock = threading.Lock()
+
+
+def enable_tracing() -> None:
+    """Turn on span recording (reference: ``ray.init(_tracing_startup_
+    hook=...)``)."""
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def _otel_tracer():
+    """A real OpenTelemetry tracer, or None. The default/proxy/no-op
+    provider doesn't count: with no user-configured exporter the spans
+    would vanish — the timeline fallback is strictly more useful."""
+    try:
+        from opentelemetry import trace
+    except ImportError:
+        return None
+    provider = trace.get_tracer_provider()
+    kind = type(provider).__name__
+    if "NoOp" in kind or "Proxy" in kind or "Default" in kind:
+        return None
+    return trace.get_tracer("ray_tpu")
+
+
+@contextlib.contextmanager
+def span(name: str, attributes: Optional[Dict[str, Any]] = None
+         ) -> Iterator[None]:
+    """Record one span. OpenTelemetry when available; else the span
+    lands in the runtime timeline as a complete event."""
+    if not _enabled:
+        yield
+        return
+    tracer = _otel_tracer()
+    if tracer is not None:
+        with tracer.start_as_current_span(name) as s:
+            for k, v in (attributes or {}).items():
+                s.set_attribute(k, v)
+            yield
+        return
+    start = time.time()
+    try:
+        yield
+    finally:
+        dur = time.time() - start
+        from ray_tpu.core.global_state import try_global_worker
+        w = try_global_worker()
+        if w is not None:
+            try:
+                w.record_span(name, start, dur, **(attributes or {}))
+            except Exception:
+                pass

@@ -1,0 +1,96 @@
+"""Tune callback API + built-in loggers + gated integrations
+(reference: tune/callback.py, tune/logger/, air/integrations)."""
+
+import csv
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.callback import (
+    Callback, CSVLoggerCallback, JsonLoggerCallback)
+
+
+@pytest.fixture
+def ray_session():
+    info = ray_tpu.init(num_cpus=4, _num_initial_workers=2,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+class _Recorder(Callback):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, **info):
+        self.events.append("setup")
+
+    def on_trial_start(self, iteration, trials, trial, **info):
+        self.events.append(("start", trial.trial_id))
+
+    def on_trial_result(self, iteration, trials, trial, result, **info):
+        self.events.append(("result", trial.trial_id,
+                            result["score"]))
+
+    def on_trial_complete(self, iteration, trials, trial, **info):
+        self.events.append(("complete", trial.trial_id))
+
+    def on_experiment_end(self, trials, **info):
+        self.events.append("end")
+
+
+def test_callback_hooks_and_loggers(ray_session, tmp_path):
+    def _trainable(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1)})
+
+    rec = _Recorder()
+    tuner = Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            name="cb", storage_path=str(tmp_path),
+            callbacks=[rec, JsonLoggerCallback(), CSVLoggerCallback()]))
+    results = tuner.fit()
+    assert results.num_errors == 0
+
+    # hook ordering per trial: setup ... start < results < complete < end
+    assert rec.events[0] == "setup"
+    assert rec.events[-1] == "end"
+    starts = [e for e in rec.events if e[0] == "start"]
+    completes = [e for e in rec.events if e[0] == "complete"]
+    result_evts = [e for e in rec.events if e[0] == "result"]
+    assert len(starts) == 2 and len(completes) == 2
+    assert len(result_evts) == 6  # 2 trials x 3 reports
+
+    # logger artifacts exist and parse
+    trial_dirs = [d for d in (tmp_path / "cb").iterdir() if d.is_dir()
+                  and (d / "result.json").exists()]
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        lines = [json.loads(x) for x in
+                 (d / "result.json").read_text().splitlines()]
+        assert len(lines) == 3
+        assert "score" in lines[0]
+        with open(d / "progress.csv") as f:
+            rows = list(csv.DictReader(f))
+        assert len(rows) == 3
+        assert float(rows[-1]["score"]) in (3.0, 6.0)
+
+
+def test_integrations_are_gated():
+    with pytest.raises(ImportError, match="wandb"):
+        from ray_tpu.air.integrations.wandb import WandbLoggerCallback
+        WandbLoggerCallback(project="x")
+    with pytest.raises(ImportError, match="mlflow"):
+        from ray_tpu.air.integrations.mlflow import MLflowLoggerCallback
+        MLflowLoggerCallback()
+    with pytest.raises(ImportError, match="comet"):
+        from ray_tpu.air.integrations.comet import CometLoggerCallback
+        CometLoggerCallback()

@@ -1,0 +1,95 @@
+"""util.iter parallel iterators, experimental internal_kv / tqdm_ray,
+dask shim gating (reference: ray/util/iter.py, experimental/)."""
+
+import io
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import iter as rt_iter
+
+
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=4, _num_initial_workers=2,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_parallel_iterator_basics(cluster):
+    it = rt_iter.from_range(20, num_shards=3)
+    out = sorted(it.gather_sync())
+    assert out == list(range(20))
+    it.stop()
+
+
+def test_parallel_iterator_transforms(cluster):
+    it = rt_iter.from_items(list(range(12)), num_shards=2)
+    it = it.for_each(lambda x: x * 10).filter(lambda x: x >= 20)
+    out = sorted(it.gather_sync())
+    assert out == [x * 10 for x in range(2, 12)]
+    it.stop()
+
+    it2 = rt_iter.from_items([1, 2, 3, 4], num_shards=2).batch(2)
+    batches = list(it2.gather_sync())
+    assert sorted(sum(batches, [])) == [1, 2, 3, 4]
+    assert all(len(b) <= 2 for b in batches)
+    it2.stop()
+
+
+def test_parallel_iterator_union_async(cluster):
+    a = rt_iter.from_range(5, num_shards=1)
+    b = rt_iter.from_range(5, num_shards=1).for_each(lambda x: x + 100)
+    u = a.union(b)
+    assert u.num_shards() == 2
+    out = sorted(u.gather_async())
+    assert out == list(range(5)) + list(range(100, 105))
+    u.stop()
+
+
+def test_internal_kv(cluster):
+    from ray_tpu.experimental import internal_kv as kv
+    assert kv._kv_initialized()
+    assert kv._internal_kv_put(b"k1", b"v1") is False  # didn't exist
+    assert kv._internal_kv_get(b"k1") == b"v1"
+    assert kv._internal_kv_exists(b"k1")
+    assert kv._internal_kv_put(b"k1", b"v2") is True   # existed
+    assert kv._internal_kv_get(b"k1") == b"v2"
+    assert b"k1" in kv._internal_kv_list(b"k")
+    assert kv._internal_kv_del(b"k1")
+    assert not kv._internal_kv_exists(b"k1")
+
+
+def test_tqdm_ray_records_render():
+    from ray_tpu.experimental import tqdm_ray
+    buf = io.StringIO()
+    emitted = []
+
+    import builtins
+    real_print = builtins.print
+
+    def capture(*args, **kw):
+        if args and isinstance(args[0], str) \
+                and args[0].startswith(tqdm_ray.MAGIC):
+            emitted.append(args[0])
+        else:
+            real_print(*args, **kw)
+
+    builtins.print = capture
+    try:
+        for _ in tqdm_ray.tqdm(range(10), desc="work", total=10):
+            pass
+    finally:
+        builtins.print = real_print
+    assert emitted
+    # driver-side renderer consumes the record
+    assert tqdm_ray.render_record(emitted[-1], out=buf)
+    assert "work" in buf.getvalue()
+    assert not tqdm_ray.render_record("plain line", out=buf)
+
+
+def test_dask_shim_is_gated():
+    from ray_tpu.util.dask import enable_dask_on_ray
+    with pytest.raises(ImportError, match="dask"):
+        enable_dask_on_ray()

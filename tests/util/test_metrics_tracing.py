@@ -1,0 +1,92 @@
+"""Runtime metric defs, tracing spans, profiling sampler (reference:
+src/ray/stats/metric_defs.cc, ray/util/tracing, dashboard reporter
+profile_manager)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=2, _num_initial_workers=1,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_runtime_metrics_exported(cluster):
+    import numpy as np
+    from ray_tpu.core.metric_defs import runtime_metrics
+    from ray_tpu.util.metrics import export_prometheus
+
+    runtime_metrics()  # instantiate the catalog in the driver
+    ray_tpu.put(np.zeros(1 << 20, np.uint8))
+
+    @ray_tpu.remote
+    def f():
+        return 1
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    time.sleep(1.5)  # let a health tick refresh the gauges
+
+    text = export_prometheus()
+    assert "runtime_puts_total" in text
+    assert "runtime_put_bytes_total" in text
+    assert "runtime_object_directory_size" in text
+    # the put counter actually moved
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("runtime_puts_total")][-1]
+    assert float(line.rsplit(" ", 1)[1]) >= 1
+
+
+def test_tracing_spans_land_in_timeline(cluster, tmp_path):
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    try:
+        with tracing.span("my-traced-block", {"phase": "test"}):
+            time.sleep(0.01)
+    finally:
+        tracing.disable_tracing()
+    out = str(tmp_path / "trace.json")
+    ray_tpu.timeline(out)
+    import json
+    events = json.load(open(out))
+    names = {e.get("name") for e in events}
+    assert "my-traced-block" in names
+
+
+def test_stack_sampler_profiles_hot_function():
+    from ray_tpu.util.profiling import StackSampler
+
+    stop = [False]
+
+    def hot_loop():
+        while not stop[0]:
+            sum(i * i for i in range(200))
+
+    import threading
+    t = threading.Thread(target=hot_loop, daemon=True)
+    t.start()
+    s = StackSampler(interval_s=0.002).start()
+    time.sleep(0.6)
+    s.stop()
+    stop[0] = True
+    t.join(timeout=2)
+    assert s.num_samples > 20
+    collapsed = s.collapsed()
+    assert "hot_loop" in collapsed
+    top = dict(s.top(20))
+    assert any("hot_loop" in k or "genexpr" in k for k in top)
+
+
+def test_external_profilers_are_gated():
+    from ray_tpu.util import profiling
+    if not profiling.pyspy_available():
+        with pytest.raises(RuntimeError, match="py-spy"):
+            profiling.cpu_profile(1, 0.1)
+    if not profiling.memray_available():
+        with pytest.raises(RuntimeError, match="memray"):
+            profiling.memory_profile(1, 0.1)
