@@ -35,6 +35,9 @@ _tried = False
 
 
 def _build() -> bool:
+    # Sanitizer-instrumented builds live in tests/core/test_store_sanitize.py
+    # (a standalone stress binary over the same TU) — the loader builds
+    # the production library only.
     # pid-unique temp output: concurrent builders (several node
     # managers starting at once) must not clobber each other mid-write.
     tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"

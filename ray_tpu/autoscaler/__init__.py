@@ -6,9 +6,11 @@ Reference: ``python/ray/autoscaler/`` (v1 StandardAutoscaler + providers).
 from ray_tpu.autoscaler.autoscaler import (
     AutoscalerMonitor, NodeTypeConfig, StandardAutoscaler)
 from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+from ray_tpu.autoscaler.v2 import AutoscalerV2
 
 __all__ = [
     "AutoscalerMonitor",
+    "AutoscalerV2",
     "FakeNodeProvider",
     "NodeProvider",
     "NodeTypeConfig",

@@ -39,6 +39,44 @@ def _fits(node_resources: Dict[str, float],
                for k, v in demand.items() if v > 0)
 
 
+def collect_demand_snapshot(controller) -> dict:
+    """Controller-loop-thread: pending demand + per-node busyness.
+    Shared by the v1 StandardAutoscaler and the v2 reconciler."""
+    c = controller
+    demand: List[Dict[str, float]] = []
+    for key, q in c.ready_queues.items():
+        for tid in q:
+            t = c.tasks.get(tid)
+            if t is not None and t.state == "QUEUED":
+                demand.append(c._sched_res(t.spec))
+    for _, spec in c.pending_pgs:
+        demand.extend(b.resources for b in spec.bundles)
+    busy_nodes = set()
+    for lease in c.leases.values():
+        busy_nodes.add(lease.node_b)
+    for info in c.actors.values():
+        if info.state != "DEAD" and info.node_id is not None:
+            busy_nodes.add(info.node_id.binary())
+    alive = {nb for nb, n in c.nodes.items() if n.alive}
+    return {"demand": demand, "busy_nodes": busy_nodes,
+            "alive_nodes": alive}
+
+
+def drain_node_if_idle(controller, node_b: bytes) -> bool:
+    """Controller-loop-thread: mark draining unless work holds the
+    node. Returns True when the node is safe to terminate."""
+    from ray_tpu.core.ids import NodeID
+    c = controller
+    busy = any(l.node_b == node_b for l in c.leases.values()) or any(
+        info.state != "DEAD" and info.node_id is not None
+        and info.node_id.binary() == node_b
+        for info in c.actors.values())
+    if busy:
+        return False
+    c.scheduler.set_draining(NodeID(node_b), True)
+    return True
+
+
 class StandardAutoscaler:
     def __init__(self, controller, provider: NodeProvider,
                  node_types: List[NodeTypeConfig],
@@ -62,25 +100,7 @@ class StandardAutoscaler:
                 "pending_demand": len(snap["demand"])}
 
     def _snapshot(self) -> dict:
-        """Controller-loop-thread: pending demand + per-node busyness."""
-        c = self.controller
-        demand: List[Dict[str, float]] = []
-        for key, q in c.ready_queues.items():
-            for tid in q:
-                t = c.tasks.get(tid)
-                if t is not None and t.state == "QUEUED":
-                    demand.append(c._sched_res(t.spec))
-        for _, spec in c.pending_pgs:
-            demand.extend(b.resources for b in spec.bundles)
-        busy_nodes = set()
-        for lease in c.leases.values():
-            busy_nodes.add(lease.node_b)
-        for info in c.actors.values():
-            if info.state != "DEAD" and info.node_id is not None:
-                busy_nodes.add(info.node_id.binary())
-        alive = {nb for nb, n in c.nodes.items() if n.alive}
-        return {"demand": demand, "busy_nodes": busy_nodes,
-                "alive_nodes": alive}
+        return collect_demand_snapshot(self.controller)
 
     def _provider_nodes_by_type(self) -> Dict[str, List[str]]:
         out: Dict[str, List[str]] = {name: [] for name in self.node_types}
@@ -179,18 +199,7 @@ class StandardAutoscaler:
         return terminated
 
     def _drain_if_idle(self, node_b: bytes) -> bool:
-        """Controller-loop-thread: mark draining unless work holds the
-        node. Returns True when the node is safe to terminate."""
-        from ray_tpu.core.ids import NodeID
-        c = self.controller
-        busy = any(l.node_b == node_b for l in c.leases.values()) or any(
-            info.state != "DEAD" and info.node_id is not None
-            and info.node_id.binary() == node_b
-            for info in c.actors.values())
-        if busy:
-            return False
-        c.scheduler.set_draining(NodeID(node_b), True)
-        return True
+        return drain_node_if_idle(self.controller, node_b)
 
 
 class AutoscalerMonitor:

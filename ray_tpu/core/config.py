@@ -78,6 +78,9 @@ class Config:
     #: executes serially from its local queue, so the lease holds ONE
     #: resource allocation regardless of depth.
     dispatch_pipeline_depth: int = 8
+    #: workers to warm per node when a driver connects (reference:
+    #: prestart_worker_first_driver); 0 disables
+    prestart_workers: int = 2
     #: Max workers a node will start per CPU if unspecified.
     workers_per_cpu: int = 1
 

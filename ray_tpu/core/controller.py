@@ -127,6 +127,8 @@ class Controller:
         # + audit strike counts (directory-hole detection)
         self._waiter_since: Dict[bytes, float] = {}
         self._hole_strikes: Dict[bytes, int] = {}
+        # worker -> last runtime-env key (env-affinity dispatch)
+        self._worker_env: Dict[bytes, str] = {}
         # per-peer outbox for loop-thread sends: flushed once per event-loop
         # cycle as MSG_BATCH frames — amortizes pickling + syscalls over a
         # burst without adding latency (flush happens before the next poll)
@@ -499,6 +501,7 @@ class Controller:
                 "session_dir": self.session_dir,
                 "config": self.config.to_json(),
             })
+            self._prestart_workers()
             self._maybe_schedule()
             return
         self._send(identity, P.REGISTER_REPLY, {"ok": True,
@@ -936,8 +939,42 @@ class Controller:
             t.state = "QUEUED_WORKER"
             self._waiting_for_worker(node, tid)
             return
-        worker = node.idle_workers.popleft()
+        worker = self._pick_idle_worker(node, t.spec)
         self._dispatch_to_worker(tid, node, worker)
+
+    def _prestart_workers(self) -> None:
+        """Warm the pool when a driver connects (reference:
+        prestart_worker_first_driver / worker_pool.cc PrestartWorkers):
+        the driver's first task burst then lands on live workers instead
+        of paying process-spawn latency serially."""
+        target = self.config.prestart_workers
+        if target <= 0:
+            return
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            cap = max(1, int(node.resources.total.get("CPU", 1)))
+            want = min(target, cap)
+            have = len(node.all_workers) + node.starting_workers
+            for _ in range(max(0, want - have)):
+                node.starting_workers += 1
+                self._send(node.identity, P.TASK_ASSIGN,
+                           {"start_worker": True})
+
+    def _pick_idle_worker(self, node: NodeInfo, spec) -> bytes:
+        """Prefer an idle worker whose last-applied runtime env matches
+        the task's (reference: runtime-env-keyed worker pools,
+        worker_pool.cc — avoids re-mounting working_dir/py_modules and
+        env-var churn on shared workers). Falls back to FIFO."""
+        env = getattr(spec, "runtime_env", None)
+        key = repr(sorted(env.items())) if env else ""
+        for i, w in enumerate(node.idle_workers):
+            if self._worker_env.get(w, "") == key:
+                del node.idle_workers[i]
+                return w
+        w = node.idle_workers.popleft()
+        self._worker_env[w] = key
+        return w
 
     def _waiting_for_worker(self, node: NodeInfo, tid: bytes) -> None:
         node.stats.setdefault("wait_worker", collections.deque()).append(tid)
@@ -947,7 +984,8 @@ class Controller:
         while waiting and node.idle_workers:
             tid = waiting.popleft()
             if tid in self.tasks:
-                worker = node.idle_workers.popleft()
+                worker = self._pick_idle_worker(
+                    node, self.tasks[tid].spec)
                 self._dispatch_to_worker(tid, node, worker)
 
     def _dispatch_to_worker(self, tid: bytes, node: NodeInfo, worker: bytes) -> None:
@@ -1027,6 +1065,18 @@ class Controller:
 
     def _h_task_done(self, identity: bytes, m: dict) -> None:
         tid = m["task_id"]
+        if m.get("owner_report"):
+            # the OWNER reports a task that will never execute (dead
+            # actor): record the error objects and wake their waiters —
+            # no lease/worker bookkeeping (identity is not an executor)
+            self.tasks.pop(tid, None)
+            for r in m.get("results", []):
+                e = self._entry(r["object_id"])
+                e.owner = identity
+                e.error = m.get("error")
+            for r in m.get("results", []):
+                self._object_created(r["object_id"])
+            return
         t = self.tasks.pop(tid, None)
         lease = self.leases.get(identity)
         if lease is not None:
@@ -1599,6 +1649,7 @@ class Controller:
         node = self.nodes.get(m.get("node_id") or b"")
         if node is not None and worker_identity in node.all_workers:
             del node.all_workers[worker_identity]
+            self._worker_env.pop(worker_identity, None)
             try:
                 node.idle_workers.remove(worker_identity)
             except ValueError:

@@ -1089,14 +1089,35 @@ class Runtime:
                 self._fail_actor_task_local(s, err)
 
     def _fail_actor_task_local(self, spec: TaskSpec, err) -> None:
-        """The owner fails its own futures — no server round-trip."""
+        """The owner fails its own futures — and tells the controller,
+        so tasks parked on these result objects fail fast with the
+        actor's error instead of waiting on an object that will never
+        exist (error propagation through the object graph)."""
         blob = P.dumps(err)
+        results = []
         for oid in spec.return_ids():
             meta = {"object_id": oid.binary(), "error": blob}
             with self._meta_lock:
                 self._meta[oid.binary()] = meta
             self.memory_store.put(oid, _MetaReady(meta))
+            results.append({"object_id": oid.binary()})
         self._unpin_task_args(spec)
+        try:
+            self._send(P.TASK_DONE, {
+                "task_id": spec.task_id.binary(),
+                "results": results,
+                "error": blob,
+                "retriable": False,
+                "owner": self.worker_id.binary(),
+                "owner_notified": True,
+                "is_actor_task": True,
+                # sender is the OWNER, not the executing worker: the
+                # controller must only record the error objects, never
+                # run worker/lease bookkeeping against this identity
+                "owner_report": True,
+            })
+        except Exception:
+            pass
 
     def create_actor(self, spec: TaskSpec) -> None:
         spec.owner = self.worker_id

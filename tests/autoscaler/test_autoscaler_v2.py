@@ -1,0 +1,115 @@
+"""Autoscaler v2: instance lifecycle + reconciler with the fake
+provider (reference: python/ray/autoscaler/v2 instance_manager tests +
+the same fake-multinode shape as v1's test)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import FakeNodeProvider, NodeTypeConfig
+from ray_tpu.autoscaler.v2 import (
+    ALLOCATED, QUEUED, RAY_RUNNING, REQUESTED, TERMINATED, AutoscalerV2,
+    Instance, InstanceStorage, ResourceDemandScheduler)
+
+
+@pytest.fixture
+def head():
+    info = ray_tpu.init(num_cpus=1, _num_initial_workers=1,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _controller():
+    import ray_tpu.api as api
+    return api._head.controller
+
+
+def test_instance_storage_transitions():
+    st = InstanceStorage()
+    inst = st.add("cpu-worker")
+    assert inst.status == QUEUED
+    assert st.transition(inst.instance_id, REQUESTED,
+                         provider_node_id="fake-1")
+    assert st.transition(inst.instance_id, ALLOCATED)
+    assert st.transition(inst.instance_id, RAY_RUNNING)
+    # invalid jump is refused and recorded nowhere
+    assert not st.transition(inst.instance_id, REQUESTED)
+    assert st.get(inst.instance_id).status == RAY_RUNNING
+    assert st.get(inst.instance_id).history == [
+        QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING]
+    assert st.list(RAY_RUNNING)
+
+
+def test_demand_scheduler_launch_decisions():
+    types = {"small": NodeTypeConfig("small", {"CPU": 2},
+                                    min_workers=0, max_workers=2),
+             "big": NodeTypeConfig("big", {"CPU": 8},
+                                   min_workers=0, max_workers=1)}
+    sched = ResourceDemandScheduler(types)
+    # 3 two-cpu demands: two fit small nodes (cap 2), the third needs
+    # more small than allowed -> big
+    out = sched.schedule([{"CPU": 2}] * 5, [], [])
+    assert out["launch"].get("small", 0) == 2
+    assert out["launch"].get("big", 0) == 1
+    # in-flight instances absorb demand
+    inflight = [Instance("i1", "small", status=REQUESTED)]
+    out = sched.schedule([{"CPU": 2}], inflight, [])
+    assert not out["launch"]
+    # min_workers floor with no demand
+    types["small"].min_workers = 1
+    out = sched.schedule([], [], [])
+    assert out["launch"] == {"small": 1}
+    types["small"].min_workers = 0
+    # bin-packing: ten 1-CPU demands fill nodes, not one node per task
+    big_only = {"big": NodeTypeConfig("big", {"CPU": 8},
+                                     min_workers=0, max_workers=20)}
+    out = ResourceDemandScheduler(big_only).schedule(
+        [{"CPU": 1}] * 10, [], [])
+    assert out["launch"] == {"big": 2}
+
+
+def test_v2_reconciles_up_and_down(head):
+    provider = FakeNodeProvider(head["session_dir"])
+    scaler = AutoscalerV2(
+        _controller(), provider,
+        [NodeTypeConfig("cpu-worker", {"CPU": 2, "accel": 1},
+                        min_workers=0, max_workers=3)],
+        idle_timeout_s=3.0)
+    try:
+        assert scaler.update()["launched"] == []
+
+        @ray_tpu.remote(resources={"accel": 1})
+        def on_accel():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        refs = [on_accel.remote() for _ in range(2)]
+        time.sleep(0.5)
+        result = scaler.update()
+        assert len(result["launched"]) >= 1
+        # instance walks QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            scaler.update()
+            if scaler.storage.list(RAY_RUNNING):
+                break
+            time.sleep(1)
+        assert scaler.storage.list(RAY_RUNNING)
+        nodes = ray_tpu.get(refs, timeout=120)
+        head_node = ray_tpu.get_runtime_context().get_node_id()
+        assert all(n != head_node for n in nodes)
+
+        # drain-then-terminate once idle
+        deadline = time.time() + 90
+        done = False
+        while time.time() < deadline and not done:
+            out = scaler.update()
+            done = bool(out["terminated"])
+            time.sleep(1)
+        assert done, scaler.storage.list()
+        inst = scaler.storage.list(TERMINATED)
+        assert inst and inst[0].history[-1] == TERMINATED
+    finally:
+        for pid in provider.non_terminated_nodes():
+            provider.terminate_node(pid)
