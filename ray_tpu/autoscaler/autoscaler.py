@@ -54,6 +54,10 @@ def collect_demand_snapshot(controller) -> dict:
     busy_nodes = set()
     for lease in c.leases.values():
         busy_nodes.add(lease.node_b)
+    # direct-transport worker leases create no controller lease but
+    # their workers execute driver-pushed tasks — the node is busy
+    for nb in getattr(c, "_lease_node", {}).values():
+        busy_nodes.add(nb)
     for info in c.actors.values():
         if info.state != "DEAD" and info.node_id is not None:
             busy_nodes.add(info.node_id.binary())
@@ -67,10 +71,13 @@ def drain_node_if_idle(controller, node_b: bytes) -> bool:
     node. Returns True when the node is safe to terminate."""
     from ray_tpu.core.ids import NodeID
     c = controller
-    busy = any(l.node_b == node_b for l in c.leases.values()) or any(
-        info.state != "DEAD" and info.node_id is not None
-        and info.node_id.binary() == node_b
-        for info in c.actors.values())
+    busy = any(l.node_b == node_b for l in c.leases.values()) \
+        or any(nb == node_b
+               for nb in getattr(c, "_lease_node", {}).values()) \
+        or any(
+            info.state != "DEAD" and info.node_id is not None
+            and info.node_id.binary() == node_b
+            for info in c.actors.values())
     if busy:
         return False
     c.scheduler.set_draining(NodeID(node_b), True)

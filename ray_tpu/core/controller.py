@@ -129,6 +129,16 @@ class Controller:
         self._hole_strikes: Dict[bytes, int] = {}
         # worker -> last runtime-env key (env-affinity dispatch)
         self._worker_env: Dict[bytes, str] = {}
+        # worker identity -> owning driver identity: workers leased to a
+        # driver for DIRECT task submission (reference: worker leases,
+        # direct_task_transport.h — tasks bypass the controller wholly;
+        # TASK_DONE only records results)
+        self.driver_leases: Dict[bytes, bytes] = {}
+        self._lease_node: Dict[bytes, bytes] = {}  # leased worker -> node
+        self._pending_leases: List[tuple] = []  # [(driver, count_still_wanted)]
+        self._lease_blocked: set = set()  # driver-leased workers in ray.get
+        # reclaimed-while-blocked workers parked until NOTIFY_UNBLOCKED
+        self._blocked_orphans: set = set()
         # per-peer outbox for loop-thread sends: flushed once per event-loop
         # cycle as MSG_BATCH frames — amortizes pickling + syscalls over a
         # burst without adding latency (flush happens before the next poll)
@@ -476,6 +486,7 @@ class Controller:
                     # TASK_DONE (transient resource over-admission until
                     # then self-corrects)
                     node.idle_workers.append(identity)
+                    self._grant_parked_leases()
                     self._drain_waiting_tasks(node)
             if m.get("actor_id") is not None:
                 self._restore_actor_binding(m["actor_id"], identity,
@@ -697,6 +708,107 @@ class Controller:
     def _h_ref_deltas(self, identity: bytes, m: dict) -> None:
         self.refs.apply_deltas(m["deltas"])
 
+    def _h_lease_workers(self, identity: bytes, m: dict) -> None:
+        """Grant idle workers to a driver for direct task submission.
+        Each grant holds the worker's CPU until released/reclaimed.
+        Under load there are no idle workers at request time, so the
+        remainder is PARKED and granted as workers free up (pushed via
+        LEASE_GRANT — the reference's lease requests queue in the
+        raylet the same way)."""
+        want = int(m.get("count", 1))
+        granted = self._grant_leases(identity, want)
+        self._reply(identity, m["rid"], {"workers": granted})
+        remaining = want - len(granted)
+        if remaining > 0:
+            # one parked entry per driver (latest wins)
+            self._pending_leases = [
+                (d, n) for d, n in self._pending_leases if d != identity]
+            self._pending_leases.append((identity, remaining))
+
+    def _grant_leases(self, identity: bytes, want: int) -> List[bytes]:
+        granted: List[bytes] = []
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            # never grant below the controller queue's own needs
+            if node.stats.get("wait_worker"):
+                continue
+            while want > 0 and node.idle_workers:
+                if not self.scheduler.try_acquire(
+                        node.node_id, {"CPU": 1.0}):
+                    break
+                w = node.idle_workers.popleft()
+                self.driver_leases[w] = identity
+                self._lease_node[w] = node.node_id.binary()
+                granted.append(w)
+                want -= 1
+            if want <= 0:
+                break
+        return granted
+
+    def _grant_parked_leases(self) -> None:
+        if not self._pending_leases:
+            return
+        if self.ready_queues:
+            # queued controller-path tasks outrank parked lease
+            # requests — granting here would re-take the CPU a
+            # starvation reclaim just freed (revoke/grant thrash)
+            return
+        still: List[tuple] = []
+        for driver, n in self._pending_leases:
+            got = self._grant_leases(driver, n)
+            if got:
+                self._send(driver, P.LEASE_GRANT, {"workers": got})
+            if len(got) < n:
+                still.append((driver, n - len(got)))
+        self._pending_leases = still
+
+    def _h_release_leases(self, identity: bytes, m: dict) -> None:
+        for w in m.get("workers", ()):
+            self._reclaim_driver_lease(w)
+
+    def _reclaim_driver_lease(self, worker: bytes) -> None:
+        if self.driver_leases.pop(worker, None) is None:
+            return
+        node_b = self._lease_node.pop(worker, None)
+        was_blocked = worker in self._lease_blocked
+        self._lease_blocked.discard(worker)
+        node = self.nodes.get(node_b) if node_b else None
+        if node is not None and node.alive:
+            if was_blocked:
+                # serial thread is sitting in ray.get: idle-pooling it
+                # now would bounce every dispatch (handback spin). Park
+                # it; NOTIFY_UNBLOCKED returns it to the pool.
+                self._blocked_orphans.add(worker)
+                return
+            self._release_res(NodeID(node_b), {"CPU": 1.0})
+            if worker in node.all_workers:
+                self._return_worker(worker)
+
+    def _reclaim_driver_leases_of(self, driver: bytes) -> None:
+        for w in [w for w, d in self.driver_leases.items() if d == driver]:
+            self._reclaim_driver_lease(w)
+        self._pending_leases = [
+            (d, n) for d, n in self._pending_leases if d != driver]
+
+    def _audit_driver_leases(self) -> None:
+        """Reclaim leases (and parked lease requests) whose driver has
+        gone silent — a crashed driver must not pin worker CPUs forever.
+        Drivers ping every 2s; 30s of silence is decisive."""
+        if not self.driver_leases and not self._pending_leases:
+            return
+        now = time.monotonic()
+        drivers = set(self.driver_leases.values()) | {
+            d for d, _ in self._pending_leases}
+        for d in drivers:
+            info = self.peers.get(d)
+            last = (info or {}).get("last_seen")
+            if info is None or (last is not None and now - last > 30.0):
+                logger.warning(
+                    "reclaiming worker leases of silent driver %s",
+                    d.hex()[:8] if isinstance(d, bytes) else d)
+                self._reclaim_driver_leases_of(d)
+
     def _h_owner_free(self, identity: bytes, m: dict) -> None:
         """The owner already evicted these never-shared extents from the
         segment (eager owner-side GC); drop metadata, waiters, and node
@@ -759,6 +871,19 @@ class Controller:
         if spec.is_actor_task:
             self._submit_actor_task(identity, spec)
             return
+        # owner-side dependency seeding (see TaskSpec.arg_metas): fill
+        # directory holes for args the owner already knows
+        for b, am in (spec.arg_metas or {}).items():
+            e = self.objects.get(b)
+            if e is None or (e.inline is None and e.error is None
+                             and not e.locations):
+                e = self._entry(b)
+                if am.get("inline") is not None:
+                    e.inline = am["inline"]
+                if am.get("node_id"):
+                    e.locations.add(am["node_id"])
+                e.size = e.size or am.get("size", 0)
+                self._object_created(b)
         t = PendingTask(spec=spec, retries_left=spec.max_retries,
                         submitted_at=time.monotonic())
         tid = spec.task_id.binary()
@@ -880,6 +1005,23 @@ class Controller:
                     node_id = self.scheduler.pick_node(
                         self._sched_res(t.spec), t.spec.scheduling_strategy)
                     if node_id is None:
+                        # driver-held worker leases can starve the queue
+                        # (their CPU is reserved): reclaim one per drain.
+                        # BLOCKED leases are exempt — their CPU is
+                        # already released, and returning a worker whose
+                        # serial thread sits in ray.get to the idle pool
+                        # wedges the cluster in a dispatch/bounce loop.
+                        w = next((w for w in self.driver_leases
+                                  if w not in self._lease_blocked), None)
+                        if w is not None:
+                            driver = self.driver_leases.get(w)
+                            self._reclaim_driver_lease(w)
+                            if driver is not None:
+                                # worker is alive: its queued direct
+                                # tasks still complete — no resubmit
+                                self._send(driver, P.LEASE_REVOKED,
+                                           {"worker": w, "dead": False})
+                            self._sched_dirty = True
                         break  # class infeasible right now; try next class
                     q.popleft()
                     self._assign_node(tid, t, node_id)
@@ -1065,6 +1207,40 @@ class Controller:
 
     def _h_task_done(self, identity: bytes, m: dict) -> None:
         tid = m["task_id"]
+        if m.get("driver_leased") and not m.get("is_actor_task"):
+            # direct driver-leased execution (flag set at dispatch, so
+            # this holds even after the lease was reclaimed): the
+            # controller never saw the task — record results and
+            # observability only; resources are held by the grant
+            self.task_table[tid] = {
+                "task_id": TaskID(tid).hex(), "type": "NORMAL_TASK",
+                "state": "FAILED" if m.get("error") else "FINISHED",
+                "finished_at": time.time(), "leased": True}
+            if m.get("error") is not None and m.get("retriable") \
+                    and m.get("spec") is not None:
+                spec: TaskSpec = m["spec"]
+                if spec.max_retries != 0:
+                    if spec.max_retries > 0:
+                        spec.max_retries -= 1
+                    # re-route the retry through the normal scheduler
+                    self._h_submit_task(m.get("owner") or identity,
+                                        {"spec": spec})
+                    return
+            for r in m.get("results", []):
+                if self.refs.is_released(r["object_id"]):
+                    continue
+                e = self._entry(r["object_id"])
+                e.owner = m.get("owner", identity)
+                e.size = r.get("size", 0)
+                if r.get("inline") is not None:
+                    e.inline = r["inline"]
+                if r.get("node_id"):
+                    e.locations.add(r["node_id"])
+                if m.get("error") is not None:
+                    e.error = m["error"]
+            for r in m.get("results", []):
+                self._object_created(r["object_id"])
+            return
         if m.get("owner_report"):
             # the OWNER reports a task that will never execute (dead
             # actor): record the error objects and wake their waiters —
@@ -1232,6 +1408,7 @@ class Controller:
                 self._dispatch_to_worker(tid, node, identity)
                 return
         node.idle_workers.append(identity)
+        self._grant_parked_leases()
 
     def _handle_task_failure(self, tid: bytes, reason: str,
                              retriable: bool = True,
@@ -1596,6 +1773,16 @@ class Controller:
         """A worker's serial thread blocked in ray.get inside a task:
         release the lease's cpu so dependent work can run (reference:
         NotifyDirectCallTaskBlocked → raylet releases cpu resources)."""
+        if identity in self.driver_leases:
+            # direct driver-leased worker blocked in ray.get: free its
+            # CPU so dependents can run (same contract as class leases)
+            if identity not in self._lease_blocked:
+                self._lease_blocked.add(identity)
+                nb = self._lease_node.get(identity)
+                if nb:
+                    self._release_res(NodeID(nb), {"CPU": 1.0})
+                self._maybe_schedule()
+            return
         lease = self.leases.get(identity)
         if lease is None or lease.blocked:
             return
@@ -1606,6 +1793,20 @@ class Controller:
         self._maybe_schedule()
 
     def _h_notify_unblocked(self, identity: bytes, m: dict) -> None:
+        if identity in self._blocked_orphans:
+            # lease was reclaimed while this worker sat in ray.get; it
+            # is now resumable — rejoin the pool (its CPU was already
+            # released at block time and stays released until a new
+            # dispatch acquires it)
+            self._blocked_orphans.discard(identity)
+            self._return_worker(identity)
+            return
+        if identity in self._lease_blocked:
+            self._lease_blocked.discard(identity)
+            nb = self._lease_node.get(identity)
+            if nb:
+                self.scheduler.force_acquire(NodeID(nb), {"CPU": 1.0})
+            return
         lease = self.leases.get(identity)
         if lease is None or not lease.blocked:
             return
@@ -1618,11 +1819,38 @@ class Controller:
 
     def _h_task_handback(self, identity: bytes, m: dict) -> None:
         """A blocking worker returned its unstarted pipeline tasks."""
+        if m.get("blocked"):
+            # the sender's serial thread is in ray.get RIGHT NOW: make
+            # sure its lease is marked so refill stops targeting it
+            # (idempotent; heals any missed NOTIFY_BLOCKED)
+            lease = self.leases.get(identity)
+            if lease is not None and not lease.blocked:
+                lease.blocked = True
+                node = self.nodes.get(lease.node_b)
+                if node is not None and node.alive:
+                    self._release_res(NodeID(lease.node_b),
+                                      lease.resources)
+            elif identity in self.driver_leases \
+                    and identity not in self._lease_blocked:
+                self._lease_blocked.add(identity)
+                nb = self._lease_node.get(identity)
+                if nb:
+                    self._release_res(NodeID(nb), {"CPU": 1.0})
         requeued = False
         for spec in m.get("specs", ()):
             tid = spec.task_id.binary()
             t = self.tasks.get(tid)
-            if t is None or t.worker != identity or t.state != "RUNNING":
+            if t is None:
+                if tid not in self.task_table:
+                    # direct dispatch bounced by a blocked worker (the
+                    # lease may already be reclaimed — adopt anyway; a
+                    # handed-back spec vanishing strands its owner)
+                    self._h_submit_task(
+                        spec.owner.binary() if spec.owner else identity,
+                        {"spec": spec})
+                    requeued = True
+                continue
+            if t.worker != identity or t.state != "RUNNING":
                 continue
             lease = self.leases.get(identity)
             if lease is not None:
@@ -1635,7 +1863,9 @@ class Controller:
             self._maybe_schedule()
 
     def _h_ping(self, identity: bytes, m: dict) -> None:
-        pass  # the unknown-peer check in _dispatch_msg does the work
+        info = self.peers.get(identity)
+        if info is not None:
+            info["last_seen"] = time.monotonic()
 
     def _h_heartbeat(self, identity: bytes, m: dict) -> None:
         node = self.nodes.get(m["node_id"])
@@ -1650,6 +1880,16 @@ class Controller:
         if node is not None and worker_identity in node.all_workers:
             del node.all_workers[worker_identity]
             self._worker_env.pop(worker_identity, None)
+            driver = self.driver_leases.pop(worker_identity, None)
+            self._blocked_orphans.discard(worker_identity)
+            if driver is not None:
+                nb = self._lease_node.pop(worker_identity, None)
+                if nb and worker_identity not in self._lease_blocked:
+                    self._release_res(NodeID(nb), {"CPU": 1.0})
+                self._lease_blocked.discard(worker_identity)
+                # the lease owner must resubmit in-flight direct tasks
+                self._send(driver, P.LEASE_REVOKED,
+                           {"worker": worker_identity, "dead": True})
             try:
                 node.idle_workers.remove(worker_identity)
             except ValueError:
@@ -1764,6 +2004,7 @@ class Controller:
                 self.call_on_loop(lambda: self._maybe_schedule(force=True))
                 self.call_on_loop(self._audit_parked_tasks)
                 self.call_on_loop(self._audit_parked_waiters)
+                self.call_on_loop(self._audit_driver_leases)
             except Exception:
                 pass
             try:
@@ -2013,6 +2254,8 @@ class Controller:
         P.PULL_FAILED: _h_pull_failed,
         P.REF_DELTAS: _h_ref_deltas,
         P.OWNER_FREE: _h_owner_free,
+        P.LEASE_WORKERS: _h_lease_workers,
+        P.RELEASE_LEASES: _h_release_leases,
         P.KV_OP: _h_kv,
         P.EXPORT_FUNCTION: _h_export_function,
         P.FETCH_FUNCTION: _h_fetch_function,

@@ -65,6 +65,15 @@ CHUNK_ACK = b"CAK"           # dest->src DIRECT: chunk received (flow control)
 RECONNECT = b"RCN"           # controller->peer: re-register + re-announce
                              # (sent after a controller restart)
 REF_DELTAS = b"RFD"          # {deltas: {bytes: int}}
+# direct normal-task transport (reference: worker leases,
+# direct_task_transport.h — the owner leases workers and pushes tasks
+# peer-to-peer; the controller only grants/reclaims leases)
+LEASE_WORKERS = b"LSW"       # driver->controller {count, rid} -> {workers}
+RELEASE_LEASES = b"RLW"      # driver->controller {workers: [identity]}
+LEASE_REVOKED = b"LRV"       # controller->driver {worker}: leased worker
+                             # died — resubmit its in-flight tasks
+LEASE_GRANT = b"LGR"         # controller->driver {workers}: deferred
+                             # grant for a parked LEASE_WORKERS request
 OWNER_FREE = b"OFR"          # owner->controller {object_ids: [bytes]}:
                              # owner already evicted these never-shared
                              # extents; drop metadata + node bookkeeping

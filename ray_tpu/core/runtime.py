@@ -15,8 +15,9 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
+import collections
 from collections import OrderedDict
 from queue import Empty, SimpleQueue
 
@@ -77,6 +78,22 @@ class Runtime:
         self._escaped_refs: "OrderedDict[bytes, None]" = OrderedDict()
         self._eager_lock = threading.Lock()
         self._empty_args_blob: Optional[bytes] = None
+
+        # Direct normal-task transport (reference: worker leases,
+        # direct_task_transport.h): the driver leases workers from the
+        # controller and pushes dependency-free default-shape tasks to
+        # them peer-to-peer; only TASK_DONE accounting reaches the
+        # controller. State guarded by _lease_lock.
+        self._lease_lock = threading.Lock()
+        self._lease_pool: List[bytes] = []
+        self._lease_inflight: Dict[bytes, int] = {}
+        self._lease_state = "none"      # none | pending | ready
+        self._lease_backoff_until = 0.0
+        self._direct_tids: Dict[bytes, bytes] = {}  # tid -> worker
+        # saturated-lease overflow queues HERE and drains on completions
+        # (falling back to the controller would starve its queue behind
+        # lease-held CPUs and trigger reclaim thrash)
+        self._direct_backlog: Deque[TaskSpec] = collections.deque()
 
         # object_id(bytes) -> result meta {"inline"|"node_id"/"size"|"error"}
         self._meta: Dict[bytes, dict] = {}
@@ -405,6 +422,10 @@ class Runtime:
                 self.pg_cond.notify_all()
         elif mtype == P.RECONNECT:
             self._on_reconnect(m.get("gen"))
+        elif mtype == P.LEASE_REVOKED:
+            self._on_lease_revoked(m["worker"], m.get("dead", True))
+        elif mtype == P.LEASE_GRANT:
+            self._on_lease_grant(m.get("workers") or [])
         elif mtype == P.SHUTDOWN:
             self._stopped.set()
 
@@ -452,6 +473,15 @@ class Runtime:
             return
         self._reconnect_gen = gen
         logger.info("%s: controller restarted; re-announcing", self.kind)
+        # worker leases died with the controller's grant table; the
+        # inflight resubmit below covers direct tasks too
+        with self._lease_lock:
+            self._lease_pool.clear()
+            self._lease_inflight.clear()
+            self._direct_tids.clear()
+            self._direct_backlog.clear()  # inflight resubmit covers them
+            self._lease_state = "none"
+            self._lease_backoff_until = time.monotonic() + 2.0
         self._send(P.REGISTER, self._register_msg())
         for channel in list(self.pubsub_handlers):
             if channel != "*":
@@ -473,6 +503,7 @@ class Runtime:
             self._resolve_actor(aid)
 
     def shutdown(self) -> None:
+        self._release_all_leases()
         self.reference_counter.flush()
         self.flush_timeline()
         self._stopped.set()
@@ -609,6 +640,7 @@ class Runtime:
             with self._inflight_lock:
                 done_spec = self._inflight_specs.pop(m["task_id"], None)
             self._unpin_task_args(done_spec)
+            self._on_direct_task_result(m["task_id"])
         for r in m.get("results", []):
             b = r["object_id"]
             with self._meta_lock:
@@ -961,9 +993,178 @@ class Runtime:
             # owning core worker holds the spec, not the GCS)
             with self._inflight_lock:
                 self._inflight_specs[spec.task_id.binary()] = spec
-            self._send(P.SUBMIT_TASK, {"spec": spec})
+            if not self._try_direct_submit(spec):
+                if spec.arg_refs:
+                    # owner-side dependency seeding: attach what we know
+                    # about arg objects so the controller can resolve
+                    # deps it never learned of (a producer killed with
+                    # its TASK_DONE unflushed leaves a directory hole;
+                    # our direct TASK_RESULT still recorded the meta)
+                    metas = {}
+                    with self._meta_lock:
+                        for _, oid in spec.arg_refs:
+                            am = self._meta.get(oid.binary())
+                            if am and am.get("error") is None and (
+                                    am.get("node_id") is not None
+                                    or (am.get("inline") is not None
+                                        and len(am["inline"]) <= 1 << 16)):
+                                metas[oid.binary()] = am
+                    if metas:
+                        spec.arg_metas = metas
+                self._send(P.SUBMIT_TASK, {"spec": spec})
         self._record_event(spec, "submitted")
         return refs
+
+    # ---------------------------------------------- direct normal tasks
+    def _try_direct_submit(self, spec: TaskSpec) -> bool:
+        """Push a dependency-free default-shape task straight to a
+        leased worker. Returns False when the controller path should
+        handle it (deps, placement constraints, custom resources, no
+        lease capacity)."""
+        if self.kind != "driver" or spec.arg_refs \
+                or spec.is_actor_creation \
+                or spec.scheduling_strategy.kind != "DEFAULT":
+            return False
+        res = spec.resources
+        if res and (set(res) - {"CPU"} or res.get("CPU", 1.0) > 1.0):
+            return False
+        with self._lease_lock:
+            if self._lease_state == "none":
+                if time.monotonic() >= self._lease_backoff_until:
+                    self._lease_state = "pending"
+                    self._request_leases()
+                return False
+            if self._lease_state != "ready" or not self._lease_pool:
+                return False
+            w = self._pick_leased_worker_locked()
+            if w is None:
+                # saturated: commit to the direct path anyway — queue
+                # locally and drain on completions (bounded backlog so a
+                # monster burst still spills to the controller)
+                if len(self._direct_backlog) < 4096:
+                    self._direct_backlog.append(spec)
+                    return True
+                return False
+            self._direct_tids[spec.task_id.binary()] = w
+        self._send_direct(w, P.TASK_DISPATCH,
+                          {"spec": spec, "driver_leased": True})
+        return True
+
+    def _pick_leased_worker_locked(self) -> Optional[bytes]:
+        depth = self.config.dispatch_pipeline_depth
+        best, best_n = None, depth
+        for w in self._lease_pool:
+            n = self._lease_inflight.get(w, 0)
+            if n < best_n:
+                best, best_n = w, n
+        if best is not None:
+            self._lease_inflight[best] = best_n + 1
+        return best
+
+    def _request_leases(self, count: int = 4) -> None:
+        def on_reply(reply):
+            workers = (reply or {}).get("workers") or []
+            with self._lease_lock:
+                if workers:
+                    self._lease_pool.extend(workers)
+                    self._lease_state = "ready"
+                else:
+                    # nothing grantable right now; retry later
+                    self._lease_state = "none"
+                    self._lease_backoff_until = time.monotonic() + 2.0
+
+        rid = self.replies.new_request(callback=on_reply)
+        self._send(P.LEASE_WORKERS, {"count": count, "rid": rid})
+
+    def _on_lease_grant(self, workers: List[bytes]) -> None:
+        """Deferred grant arrived (parked request): extend the pool and
+        drain backlog onto the new capacity."""
+        sends = []
+        with self._lease_lock:
+            self._lease_pool.extend(workers)
+            if self._lease_pool:
+                self._lease_state = "ready"
+            while self._direct_backlog:
+                w = self._pick_leased_worker_locked()
+                if w is None:
+                    break
+                spec = self._direct_backlog.popleft()
+                self._direct_tids[spec.task_id.binary()] = w
+                sends.append((w, spec))
+        for w, spec in sends:
+            self._send_direct(w, P.TASK_DISPATCH,
+                              {"spec": spec, "driver_leased": True})
+
+    def _on_direct_task_result(self, tid_b: bytes) -> None:
+        send = None
+        with self._lease_lock:
+            w = self._direct_tids.pop(tid_b, None)
+            if w is not None and w in self._lease_inflight:
+                n = self._lease_inflight[w] - 1
+                if n <= 0:
+                    self._lease_inflight.pop(w, None)
+                else:
+                    self._lease_inflight[w] = n
+            if self._direct_backlog and self._lease_pool:
+                nxt = self._pick_leased_worker_locked()
+                if nxt is not None:
+                    spec = self._direct_backlog.popleft()
+                    self._direct_tids[spec.task_id.binary()] = nxt
+                    send = (nxt, spec)
+        if send is not None:
+            self._send_direct(send[0], P.TASK_DISPATCH,
+                              {"spec": send[1], "driver_leased": True})
+
+    def _on_lease_revoked(self, worker: bytes,
+                          dead: bool = True) -> None:
+        """The controller took a leased worker back. If the worker DIED,
+        resubmit its in-flight specs via the controller path (anything
+        still tracked here never reported a result). If it was merely
+        reclaimed (queue starvation), its queued direct tasks still
+        complete — just stop sending it new ones."""
+        resubmit: List[TaskSpec] = []
+        with self._lease_lock:
+            try:
+                self._lease_pool.remove(worker)
+            except ValueError:
+                pass
+            if dead:
+                self._lease_inflight.pop(worker, None)
+                lost = [tid for tid, w in self._direct_tids.items()
+                        if w == worker]
+                for tid in lost:
+                    del self._direct_tids[tid]
+            else:
+                lost = []
+            if not self._lease_pool:
+                self._lease_state = "none"
+                self._lease_backoff_until = time.monotonic() + 1.0
+                # no leases left: the local backlog would never drain
+                while self._direct_backlog:
+                    resubmit.append(self._direct_backlog.popleft())
+        with self._inflight_lock:
+            for tid in lost:
+                spec = self._inflight_specs.get(tid)
+                if spec is not None:
+                    resubmit.append(spec)
+        for spec in resubmit:
+            self._send(P.SUBMIT_TASK, {"spec": spec})
+
+    def _release_all_leases(self) -> None:
+        with self._lease_lock:
+            pool, self._lease_pool = self._lease_pool, []
+            self._lease_state = "none"
+            self._lease_inflight.clear()
+            self._direct_tids.clear()
+            backlog = list(self._direct_backlog)
+            self._direct_backlog.clear()
+        for spec in backlog:
+            self._send(P.SUBMIT_TASK, {"spec": spec})
+        if pool:
+            try:
+                self._send(P.RELEASE_LEASES, {"workers": pool})
+            except Exception:
+                pass
 
     # ------------------------------------------------- direct actor calls
     def _submit_actor_task(self, spec: TaskSpec) -> None:
@@ -1149,6 +1350,29 @@ class Runtime:
             return
         if worker is not None:
             self._send_direct(worker, P.CANCEL_QUEUED,
+                              {"task_id": tid_b, "force": force})
+            return
+        # driver-leased direct task: cancel at its worker (the
+        # controller never saw it); backlogged → unqueue + fail locally
+        with self._lease_lock:
+            direct_worker = self._direct_tids.get(tid_b)
+            backlogged = None
+            if direct_worker is None:
+                for i, s in enumerate(self._direct_backlog):
+                    if s.task_id.binary() == tid_b:
+                        backlogged = s
+                        del self._direct_backlog[i]
+                        break
+        if backlogged is not None:
+            from ray_tpu.exceptions import TaskCancelledError
+            with self._inflight_lock:
+                # never resubmit a cancelled task on RECONNECT
+                self._inflight_specs.pop(tid_b, None)
+            self._fail_actor_task_local(
+                backlogged, TaskCancelledError(backlogged.task_id))
+            return
+        if direct_worker is not None:
+            self._send_direct(direct_worker, P.CANCEL_QUEUED,
                               {"task_id": tid_b, "force": force})
             return
         self._send(P.CANCEL_TASK, {"task_id": tid_b, "force": force})

@@ -199,6 +199,13 @@ class ClusterResourceScheduler:
             if n is not None:
                 n.release(demand)
 
+    def try_acquire(self, node_id: NodeID,
+                    demand: Dict[str, float]) -> bool:
+        """Acquire resources on a SPECIFIC node (worker-lease grants)."""
+        with self._lock:
+            n = self.nodes.get(node_id)
+            return n is not None and n.alive and n.acquire(demand)
+
     def force_acquire(self, node_id: NodeID, demand: Dict[str, float]) -> None:
         """Unconditional acquisition for a resuming blocked worker: may
         drive availability transiently negative (visible backpressure that

@@ -62,6 +62,11 @@ class TaskSpec:
     args_blob: bytes = b""
     arg_refs: List[Tuple[int, ObjectID]] = field(default_factory=list)
     num_returns: int = 1
+    #: owner-known metadata for arg objects (inline blob / location),
+    #: attached at submission so the controller can satisfy dependencies
+    #: it never heard about (producer died with TASK_DONE unflushed; the
+    #: owner still got its direct TASK_RESULT)
+    arg_metas: Optional[Dict[bytes, dict]] = None
     resources: Dict[str, float] = field(default_factory=dict)
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     max_retries: int = 3

@@ -143,7 +143,11 @@ class WorkerExecutor:
             # makes bounce-vs-drain atomic against on_block)
             with self._block_lock:
                 if self._block_depth > 0:
-                    self.runtime._send(P.TASK_HANDBACK, {"specs": [spec]})
+                    # blocked hint: heals the controller's lease state if
+                    # its NOTIFY_BLOCKED bookkeeping missed this worker
+                    # (otherwise refill ping-pongs dispatches here forever)
+                    self.runtime._send(P.TASK_HANDBACK,
+                                       {"specs": [spec], "blocked": True})
                     return
                 self._queue.put(m)
             return
@@ -377,6 +381,13 @@ class WorkerExecutor:
             # on every actor call would tax the hot path
             "is_actor_task": spec.is_actor_task,
         }
+        if m.get("driver_leased"):
+            # direct driver-leased dispatch: tell the controller to skip
+            # worker/lease bookkeeping; retriable errors ship the spec so
+            # the controller can re-route through the normal scheduler
+            done["driver_leased"] = True
+            if may_retry:
+                done["spec"] = spec
         if may_retry and spec.is_actor_task:
             # direct actor calls have no controller-side PendingTask; ship
             # the spec so the controller can re-route the retry

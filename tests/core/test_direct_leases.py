@@ -1,0 +1,120 @@
+"""Direct normal-task transport via worker leases (reference:
+direct_task_transport.h — the owner leases workers and pushes tasks
+peer-to-peer; the controller grants/reclaims leases and only records
+results)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.global_state import global_worker
+
+
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=4, _num_initial_workers=2,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _controller():
+    import ray_tpu.api as api
+    return api._head.controller
+
+
+def test_direct_path_engages_and_results_flow(cluster):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    # warm (grants leases lazily)
+    assert ray_tpu.get(sq.remote(3), timeout=60) == 9
+    w = global_worker()
+    deadline = time.time() + 30
+    while time.time() < deadline and w._lease_state != "ready":
+        ray_tpu.get(sq.remote(1), timeout=60)
+        time.sleep(0.2)
+    assert w._lease_state == "ready" and w._lease_pool
+
+    out = ray_tpu.get([sq.remote(i) for i in range(200)], timeout=120)
+    assert out == [i * i for i in range(200)]
+    # the tasks really went direct (controller saw only TASK_DONE rows)
+    ctl = _controller()
+    leased_rows = [r for r in ctl.task_table.values()
+                   if r.get("leased")]
+    assert leased_rows, "no task took the direct lease path"
+
+
+def test_direct_errors_propagate(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("direct-kaboom")
+
+    w = global_worker()
+
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    ray_tpu.get(ok.remote(), timeout=60)
+    deadline = time.time() + 30
+    while time.time() < deadline and w._lease_state != "ready":
+        ray_tpu.get(ok.remote(), timeout=60)
+        time.sleep(0.2)
+    with pytest.raises(ray_tpu.TaskError, match="direct-kaboom"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_backlog_drains_beyond_pipeline_depth(cluster):
+    """Far more tasks than lease slots: the local backlog must drain
+    completely on completions."""
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ray_tpu.get(inc.remote(0), timeout=60)
+    out = ray_tpu.get([inc.remote(i) for i in range(600)], timeout=180)
+    assert out == [i + 1 for i in range(600)]
+    w = global_worker()
+    assert not w._direct_backlog
+    assert not w._direct_tids
+
+
+def test_leased_worker_death_resubmits(cluster):
+    """Killing a leased worker mid-task must not lose the task: the
+    controller revokes the lease and the owner resubmits."""
+    import os
+
+    @ray_tpu.remote(max_retries=2)
+    def slow_pid():
+        time.sleep(2.0)
+        return os.getpid()
+
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    ray_tpu.get(ok.remote(), timeout=60)
+    w = global_worker()
+    deadline = time.time() + 30
+    while time.time() < deadline and not w._lease_pool:
+        ray_tpu.get(ok.remote(), timeout=60)
+        time.sleep(0.2)
+    ref = slow_pid.remote()
+    time.sleep(0.5)
+    # kill whichever worker holds it (if it went direct)
+    with w._lease_lock:
+        victim = w._direct_tids.get(ref.id().task_id().binary())
+    if victim is None:
+        pytest.skip("task did not take the direct path this run")
+    ctl = _controller()
+    node = next(iter(ctl.nodes.values()))
+    info = node.all_workers.get(victim) or {}
+    pid = info.get("pid")
+    assert pid, "victim worker pid unknown"
+    os.kill(pid, 9)
+    # the retry lands somewhere else and completes
+    out = ray_tpu.get(ref, timeout=120)
+    assert out != pid
