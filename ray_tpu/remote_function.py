@@ -133,6 +133,17 @@ class RemoteFunction:
         w = global_worker()
         descriptor = self._ensure_exported(w)
         args_blob, arg_refs, _ = w.serialize_args(args, kwargs)
+        # resources/strategy are pure functions of opts — compute once
+        # per opts object, not per call (fan-out submit hot path). The
+        # resources dict is copied into each spec (specs outlive the
+        # call in _inflight_specs; a shared mutable dict would be a
+        # corruption hazard); the strategy instance is shared and
+        # treated as a read-only descriptor downstream.
+        cache = getattr(self, "_opts_cache", None)
+        if cache is None or cache[0] is not opts:
+            cache = (opts, resources_from_opts(opts),
+                     make_scheduling_strategy(opts))
+            self._opts_cache = cache
         spec = TaskSpec(
             task_id=w.next_task_id(),
             job_id=w.job_id,
@@ -140,8 +151,8 @@ class RemoteFunction:
             args_blob=args_blob,
             arg_refs=[(i, oid) for i, oid in arg_refs],
             num_returns=opts["num_returns"],
-            resources=resources_from_opts(opts),
-            scheduling_strategy=make_scheduling_strategy(opts),
+            resources=dict(cache[1]),
+            scheduling_strategy=cache[2],
             max_retries=opts["max_retries"],
             retry_exceptions=bool(opts["retry_exceptions"]),
             name=opts.get("name") or self.__name__,
