@@ -27,6 +27,7 @@ from ray_tpu.data.datasource import (
     read_parquet,
     read_text,
     read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [
@@ -52,4 +53,5 @@ __all__ = [
     "read_parquet",
     "read_text",
     "read_tfrecords",
+    "read_webdataset",
 ]

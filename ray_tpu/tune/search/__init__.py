@@ -2,10 +2,12 @@ from ray_tpu.tune.search.sample import (
     choice, grid_search, lograndint, loguniform, qloguniform, qrandint,
     quniform, randint, randn, sample_from, uniform)
 from ray_tpu.tune.search.searcher import (
-    BasicVariantGenerator, ConcurrencyLimiter, OptunaSearch, Searcher)
+    BasicVariantGenerator, BayesOptSearch, ConcurrencyLimiter,
+    HyperOptSearch, OptunaSearch, Searcher)
 
 __all__ = [
-    "BasicVariantGenerator", "ConcurrencyLimiter", "OptunaSearch",
+    "BasicVariantGenerator", "BayesOptSearch", "ConcurrencyLimiter",
+    "HyperOptSearch", "OptunaSearch",
     "Searcher", "choice", "grid_search", "lograndint", "loguniform",
     "qloguniform", "qrandint", "quniform", "randint", "randn",
     "sample_from", "uniform",

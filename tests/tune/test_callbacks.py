@@ -84,6 +84,14 @@ def test_callback_hooks_and_loggers(ray_session, tmp_path):
         assert float(rows[-1]["score"]) in (3.0, 6.0)
 
 
+def test_search_wrappers_are_gated():
+    from ray_tpu.tune.search import BayesOptSearch, HyperOptSearch
+    with pytest.raises(ImportError, match="hyperopt"):
+        HyperOptSearch(metric="m", mode="max")
+    with pytest.raises(ImportError, match="bayesian-optimization"):
+        BayesOptSearch(metric="m", mode="max")
+
+
 def test_integrations_are_gated():
     with pytest.raises(ImportError, match="wandb"):
         from ray_tpu.air.integrations.wandb import WandbLoggerCallback
