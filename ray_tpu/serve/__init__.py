@@ -9,6 +9,7 @@ from ray_tpu.serve.api import (
     delete,
     get_app_handle,
     get_deployment_handle,
+    grpc_proxy_address,
     proxy_address,
     run,
     shutdown,
@@ -35,6 +36,7 @@ __all__ = [
     "get_app_handle",
     "get_deployment_handle",
     "get_multiplexed_model_id",
+    "grpc_proxy_address",
     "proxy_address",
     "run",
     "shutdown",

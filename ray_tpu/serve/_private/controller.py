@@ -109,6 +109,10 @@ class ServeController:
         with self._lock:
             return self._apps.get(app_name)
 
+    def list_applications(self) -> List[str]:
+        with self._lock:
+            return sorted(self._apps)
+
     def list_deployments(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [{

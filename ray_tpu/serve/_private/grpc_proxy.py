@@ -1,0 +1,146 @@
+"""gRPC ingress proxy.
+
+Reference: ``python/ray/serve/_private/proxy.py`` runs an HTTP *and* a
+gRPC proxy per node; the gRPC side (``grpc_util.py``, serve's
+``RayServeAPIService``) routes by application name carried in the
+request. Here a generic-handler service avoids protoc codegen: one
+``Predict`` method takes a JSON payload, routes through the same
+DeploymentHandle machinery as HTTP, and returns the JSON result;
+``Healthz``/``ListApplications`` mirror the reference's service API.
+
+The wire format is JSON (like the HTTP ingress), NOT pickle: ingress
+ports sit on a network trust boundary, and unpickling peer-controlled
+bytes would be remote code execution.
+
+Wire contract (UTF-8 JSON bytes):
+  /ray_tpu.serve.ServeAPIService/Predict
+      request  = {"app": str, "args": [...], "kwargs": {...}}
+      response = {"result": ...} or {"error": str}
+  /ray_tpu.serve.ServeAPIService/Healthz          -> "OK"
+  /ray_tpu.serve.ServeAPIService/ListApplications -> [names]
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Any
+
+SERVICE = "ray_tpu.serve.ServeAPIService"
+
+
+class GrpcProxy:
+    """Actor hosting the gRPC server (one per cluster, like the HTTP
+    proxy actor)."""
+
+    def __init__(self, controller, host: str = "127.0.0.1",
+                 port: int = 9000):
+        import grpc
+
+        import ray_tpu
+        self._controller = controller
+        self._ray = ray_tpu
+        # app -> (ingress deployment name, handle); re-validated against
+        # the controller on every call so redeploys take effect
+        self._handles: dict = {}
+
+        proxy = self
+
+        def predict(request: bytes, context) -> bytes:
+            try:
+                req = json.loads(request.decode() or "{}")
+                out = proxy._dispatch(req.get("app", "default"),
+                                      tuple(req.get("args", ())),
+                                      req.get("kwargs", {}))
+                return json.dumps({"result": out}, default=str).encode()
+            except BaseException as e:  # noqa: BLE001
+                return json.dumps({"error": repr(e)}).encode()
+
+        def healthz(request: bytes, context) -> bytes:
+            return json.dumps("OK").encode()
+
+        def list_apps(request: bytes, context) -> bytes:
+            apps = self._ray.get(
+                self._controller.list_applications.remote())
+            return json.dumps(list(apps)).encode()
+
+        ident = lambda b: b  # noqa: E731 — bytes in, bytes out
+        handlers = {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                predict, request_deserializer=ident,
+                response_serializer=ident),
+            "Healthz": grpc.unary_unary_rpc_method_handler(
+                healthz, request_deserializer=ident,
+                response_serializer=ident),
+            "ListApplications": grpc.unary_unary_rpc_method_handler(
+                list_apps, request_deserializer=ident,
+                response_serializer=ident),
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        if bound == 0 and port != 0:
+            raise OSError(
+                f"gRPC proxy could not bind {host}:{port} "
+                f"(port already in use?)")
+        self._port = bound
+        self._host = host
+        self._server.start()
+
+    def _dispatch(self, app: str, args: tuple, kwargs: dict) -> Any:
+        ingress = self._ray.get(
+            self._controller.get_app_ingress.remote(app))
+        if ingress is None:
+            raise RuntimeError(f"No application named {app!r}")
+        cached = self._handles.get(app)
+        if cached is None or cached[0] != ingress:
+            from ray_tpu.serve.handle import DeploymentHandle
+            cached = (ingress,
+                      DeploymentHandle(ingress, self._controller, app))
+            self._handles[app] = cached
+        return cached[1].remote(*args, **kwargs).result(timeout_s=60)
+
+    def address(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def stop(self) -> None:
+        self._server.stop(grace=None)
+
+
+def _unary(address: str, method: str, payload: bytes,
+           timeout_s: float) -> bytes:
+    import grpc
+    channel = grpc.insecure_channel(address)
+    try:
+        fn = channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        return fn(payload, timeout=timeout_s)
+    finally:
+        channel.close()
+
+
+def grpc_call(address: str, app: str, *args, timeout_s: float = 60.0,
+              **kwargs) -> Any:
+    """Client helper (reference: serve's gRPC client examples)."""
+    out = json.loads(_unary(
+        address, "Predict",
+        json.dumps({"app": app, "args": list(args),
+                    "kwargs": kwargs}).encode(),
+        timeout_s))
+    if "error" in out:
+        raise RuntimeError(f"serve gRPC call failed: {out['error']}")
+    return out["result"]
+
+
+def grpc_healthz(address: str, timeout_s: float = 10.0) -> str:
+    return json.loads(_unary(address, "Healthz", b"", timeout_s))
+
+
+def grpc_list_applications(address: str,
+                           timeout_s: float = 10.0) -> list:
+    return json.loads(_unary(address, "ListApplications", b"",
+                             timeout_s))

@@ -18,6 +18,7 @@ from ray_tpu.serve._private.controller import (
     CONTROLLER_NAME, ServeController)
 
 _proxy_actor = None
+_grpc_proxy_actor = None
 
 
 def _get_or_create_controller():
@@ -72,18 +73,37 @@ def run(target: Application, *, name: str = "default",
     return handle
 
 
-def start(http_options: Optional[Dict[str, Any]] = None, **kwargs) -> None:
-    """Start the HTTP proxy (reference ``serve.start``)."""
-    global _proxy_actor
+def start(http_options: Optional[Dict[str, Any]] = None,
+          grpc_options: Optional[Dict[str, Any]] = None,
+          **kwargs) -> None:
+    """Start the ingress proxies (reference ``serve.start``). HTTP
+    starts when ``http_options`` is given or when neither option is
+    given (legacy default); gRPC starts only when ``grpc_options`` is
+    given — a gRPC-only start must not grab the default HTTP port."""
+    global _proxy_actor, _grpc_proxy_actor
+    want_http = http_options is not None or grpc_options is None
     http_options = http_options or {}
     controller = _get_or_create_controller()
-    if _proxy_actor is None:
+    if want_http and _proxy_actor is None:
         from ray_tpu.serve._private.proxy import HTTPProxy
         cls = ray_tpu.remote(num_cpus=0.5,
                              max_concurrency=16)(HTTPProxy)
         _proxy_actor = cls.remote(
             controller, http_options.get("host", "127.0.0.1"),
             http_options.get("port", 8000))
+    if grpc_options is not None and _grpc_proxy_actor is None:
+        from ray_tpu.serve._private.grpc_proxy import GrpcProxy
+        gcls = ray_tpu.remote(num_cpus=0.25,
+                              max_concurrency=16)(GrpcProxy)
+        _grpc_proxy_actor = gcls.remote(
+            controller, grpc_options.get("host", "127.0.0.1"),
+            grpc_options.get("port", 9000))
+
+
+def grpc_proxy_address() -> Optional[str]:
+    if _grpc_proxy_actor is None:
+        return None
+    return ray_tpu.get(_grpc_proxy_actor.address.remote())
 
 
 def proxy_address() -> Optional[str]:
@@ -125,7 +145,7 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _proxy_actor
+    global _proxy_actor, _grpc_proxy_actor
     controller = _controller_or_none()
     if controller is not None:
         try:
@@ -143,3 +163,10 @@ def shutdown() -> None:
         except Exception:
             pass
         _proxy_actor = None
+    if _grpc_proxy_actor is not None:
+        try:
+            ray_tpu.get(_grpc_proxy_actor.stop.remote(), timeout=10)
+            ray_tpu.kill(_grpc_proxy_actor)
+        except Exception:
+            pass
+        _grpc_proxy_actor = None

@@ -152,6 +152,30 @@ def test_http_proxy(serve_session):
     assert out == {"got": {"x": 1}}
 
 
+def test_grpc_proxy(serve_session):
+    """gRPC ingress (reference: serve's RayServeAPIService gRPC proxy
+    alongside HTTP)."""
+    pytest.importorskip("grpc")
+    from ray_tpu.serve._private.grpc_proxy import grpc_call, grpc_healthz
+
+    @serve.deployment
+    class Scale:
+        def __call__(self, x, factor=10):
+            return x * factor
+
+    serve.run(Scale.bind(), name="scaler")
+    serve.start(grpc_options={"port": 0})
+    addr = serve.grpc_proxy_address()
+    assert addr is not None
+    assert grpc_healthz(addr) == "OK"
+    assert grpc_call(addr, "scaler", 4) == 40
+    assert grpc_call(addr, "scaler", 3, factor=7) == 21
+    from ray_tpu.serve._private.grpc_proxy import grpc_list_applications
+    assert "scaler" in grpc_list_applications(addr)
+    with pytest.raises(RuntimeError, match="No application"):
+        grpc_call(addr, "nope", 1)
+
+
 def test_status_and_delete(serve_session):
     @serve.deployment(num_replicas=2)
     class Thing:
