@@ -114,14 +114,16 @@ def test_cancel_queued_on_worker(ray_start_shared):
     def quick():
         return "quick"
 
-    running = slow.remote()
+    # saturate every worker's serial thread (direct leases spread tasks
+    # across the pool, so ONE slow task no longer blocks the victim)
+    running = [slow.remote() for _ in range(8)]
     queued = [quick.remote() for _ in range(4)]
     victim = quick.remote()
     time.sleep(0.3)  # let dispatch settle
     ray_tpu.cancel(victim)
-    # the running task and its queued neighbours still complete
-    assert ray_tpu.get(running, timeout=30) == "done"
-    assert ray_tpu.get(queued, timeout=30) == ["quick"] * 4
+    # the running tasks and the queued neighbours still complete
+    assert ray_tpu.get(running, timeout=60) == ["done"] * 8
+    assert ray_tpu.get(queued, timeout=60) == ["quick"] * 4
     with pytest.raises((ray_tpu.TaskCancelledError, ray_tpu.TaskError)):
         ray_tpu.get(victim, timeout=30)
 
