@@ -767,6 +767,47 @@ class Controller:
         for w in m.get("workers", ()):
             self._reclaim_driver_lease(w)
 
+    def _reclaimable_lease_for(self, demand, strategy) -> Optional[bytes]:
+        """A driver-held lease whose reclaim could actually unblock
+        ``demand``: its node must satisfy the demand once the lease's
+        reserved {"CPU": 1.0} is returned. Returns None when no reclaim
+        can help — demand needing resources no lease holds, demand
+        requiring CPU the lease doesn't cover, PLACEMENT_GROUP tasks
+        (their blocking condition is the bundle reservation, not node
+        CPU), or node-pinned tasks whose pin excludes the lease's node."""
+        if not demand.get("CPU"):
+            # reclaiming frees only CPU; CPU-free demand can't benefit
+            # (PG tasks reach here too: _sched_res gives them {})
+            return None
+        # group reclaimable leases by node: a multi-CPU demand may need
+        # several reclaims (one per drain) on the same node to place, so
+        # the test is "would freeing ALL this node's leases satisfy it"
+        by_node: Dict[bytes, List[bytes]] = {}
+        for w in self.driver_leases:
+            if w in self._lease_blocked:
+                continue
+            node_b = self._lease_node.get(w)
+            if node_b is not None:
+                by_node.setdefault(node_b, []).append(w)
+        for node_b, leases in by_node.items():
+            node = self.scheduler.get_node(NodeID(node_b))
+            if node is None or not node.alive or node.draining:
+                continue
+            if strategy.kind == "NODE_AFFINITY" and \
+                    strategy.node_id is not None and \
+                    strategy.node_id.binary() != node_b and \
+                    not strategy.soft:
+                continue
+            if strategy.kind == "NODE_LABEL" and any(
+                    node.labels.get(k) not in allowed
+                    for k, allowed in strategy.hard_labels.items()):
+                continue
+            if all(node.available.get(k, 0.0)
+                   + (float(len(leases)) if k == "CPU" else 0.0) + 1e-9
+                   >= v for k, v in demand.items()):
+                return leases[0]
+        return None
+
     def _reclaim_driver_lease(self, worker: bytes) -> None:
         if self.driver_leases.pop(worker, None) is None:
             return
@@ -1006,13 +1047,19 @@ class Controller:
                         self._sched_res(t.spec), t.spec.scheduling_strategy)
                     if node_id is None:
                         # driver-held worker leases can starve the queue
-                        # (their CPU is reserved): reclaim one per drain.
+                        # (their CPU is reserved): reclaim one per drain —
+                        # but only a lease whose freed CPU would make THIS
+                        # demand placeable on its node. Demand infeasible
+                        # for other reasons (e.g. a custom resource no
+                        # node provides) must not dismantle the
+                        # direct-transport lease pool one drain at a time.
                         # BLOCKED leases are exempt — their CPU is
                         # already released, and returning a worker whose
                         # serial thread sits in ray.get to the idle pool
                         # wedges the cluster in a dispatch/bounce loop.
-                        w = next((w for w in self.driver_leases
-                                  if w not in self._lease_blocked), None)
+                        w = self._reclaimable_lease_for(
+                            self._sched_res(t.spec),
+                            t.spec.scheduling_strategy)
                         if w is not None:
                             driver = self.driver_leases.get(w)
                             self._reclaim_driver_lease(w)

@@ -16,6 +16,12 @@ import cloudpickle
 
 DEFAULT_PORT = 10001
 
+#: Max time one server-side get/wait handler may block before replying
+#: "pending"; the client re-polls in the same slice. Shared here because
+#: the two sides must stay in lockstep: the client's per-RPC deadline
+#: must comfortably exceed this server-side clamp.
+BLOCK_SLICE_S = 2.0
+
 
 def dumps(obj: Any) -> bytes:
     return cloudpickle.dumps(obj, protocol=5)

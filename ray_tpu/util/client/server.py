@@ -11,8 +11,10 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict
 
 import zmq
@@ -20,6 +22,12 @@ import zmq
 from ray_tpu.util.client import common as C
 
 logger = logging.getLogger(__name__)
+
+#: Hard cap on how long a single get/wait handler may block, regardless
+#: of the client-requested timeout. Clients re-poll (worker.py loops in
+#: the same slice), so one never-ready object can't pin a handler slot
+#: for unbounded time. Defined in common.py: both sides must agree.
+_BLOCK_SLICE_S = C.BLOCK_SLICE_S
 
 
 class _ClientSession:
@@ -29,13 +37,22 @@ class _ClientSession:
         self.functions: Dict[bytes, Any] = {} # fn_id -> RemoteFunction
         self.classes: Dict[bytes, Any] = {}   # cls_id -> ActorClass
         self.last_seen = time.monotonic()
+        #: ops currently executing on the handler pool — the idle reaper
+        #: must never drop a session mid-operation (handlers run
+        #: concurrently with the loop thread since the pool landed)
+        self.inflight = 0
 
 
 class ClientServer:
     """Serves the client protocol on a TCP ROUTER socket."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = C.DEFAULT_PORT,
-                 idle_disconnect_s: float = 120.0):
+    def __init__(self, host: str = "127.0.0.1", port: int = C.DEFAULT_PORT,
+                 idle_disconnect_s: float = 120.0, num_handlers: int = 8):
+        # Default bind is loopback: the protocol deserializes pickled
+        # payloads (arbitrary code execution by design, same trust model
+        # as the reference's ray://). Exposing it beyond the machine is
+        # an explicit operator opt-in (host="0.0.0.0") for trusted
+        # networks only.
         import ray_tpu
         if not ray_tpu.is_initialized():
             raise RuntimeError(
@@ -46,13 +63,26 @@ class ClientServer:
         self.port = port
         self.idle_disconnect_s = idle_disconnect_s
         self._sessions: Dict[bytes, _ClientSession] = {}
+        self._sessions_lock = threading.Lock()
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.ROUTER)
         self._sock.bind(f"tcp://{host}:{port}")
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="client-server", daemon=True)
+        # Ops run on a pool so one slow client (big arg deserialization,
+        # a get that has to pull a large object) can't stall every other
+        # connection. Replies funnel back to the loop thread via a queue
+        # + inproc wake socket: the ROUTER socket stays single-threaded.
+        self._pool = ThreadPoolExecutor(
+            max_workers=num_handlers, thread_name_prefix="client-op")
+        self._reply_q: "queue.Queue[tuple]" = queue.Queue()
+        self._wake_addr = f"inproc://client-server-wake-{id(self):x}"
+        self._wake_pull = self._ctx.socket(zmq.PULL)
+        self._wake_pull.bind(self._wake_addr)
+        self._tls = threading.local()
         self._ref_seq = 0
+        self._ref_seq_lock = threading.Lock()
 
     def start(self) -> "ClientServer":
         self._thread.start()
@@ -61,62 +91,116 @@ class ClientServer:
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=3)
-        try:
-            self._sock.close(0)
-        except Exception:
-            pass
+        self._pool.shutdown(wait=False)
+        for s in (self._sock, self._wake_pull):
+            try:
+                s.close(0)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------- loop
     def _loop(self) -> None:
         poller = zmq.Poller()
         poller.register(self._sock, zmq.POLLIN)
+        poller.register(self._wake_pull, zmq.POLLIN)
         last_reap = time.monotonic()
         while not self._stop.is_set():
-            if not dict(poller.poll(timeout=250)):
+            events = dict(poller.poll(timeout=250))
+            if self._wake_pull in events:
+                while self._wake_pull.poll(0):
+                    self._wake_pull.recv()
+            self._drain_replies()
+            if self._sock not in events:
+                # reap only on idle polls, and only after every received
+                # message has bumped its session's last_seen below — a
+                # request sitting in the recv or pool queue must never
+                # lose its session to the reaper
                 if time.monotonic() - last_reap > 10.0:
                     self._reap_idle()
                     last_reap = time.monotonic()
                 continue
-            frames = self._sock.recv_multipart()
-            identity, payload = frames[0], frames[-1]
+            while self._sock.poll(0):
+                frames = self._sock.recv_multipart()
+                identity, payload = frames[0], frames[-1]
+                try:
+                    req = C.loads(payload)
+                except Exception as e:  # noqa: BLE001
+                    self._reply(identity, {"ok": False,
+                                           "error": C.dumps(e)})
+                    continue
+                # touch the session on the loop thread BEFORE handing to
+                # the pool: protects it from the reaper while queued
+                self._session(identity)
+                self._pool.submit(self._handle, identity, req)
+
+    def _handle(self, identity: bytes, req: dict) -> None:
+        session = self._session(identity)
+        with self._sessions_lock:
+            session.inflight += 1
+        try:
+            out = self._dispatch(identity, req, session)
+        except BaseException as e:  # noqa: BLE001
+            logger.debug("client op %s failed", req.get("op"),
+                         exc_info=True)
+            out = {"ok": False, "error": C.dumps(e)}
+        finally:
+            with self._sessions_lock:
+                session.inflight -= 1
+                session.last_seen = time.monotonic()
+        out["rid"] = req.get("rid")
+        self._reply(identity, out)
+
+    def _drain_replies(self) -> None:
+        while True:
             try:
-                req = C.loads(payload)
-            except Exception as e:  # noqa: BLE001
-                self._reply(identity, {"ok": False, "error": C.dumps(e)})
-                continue
+                identity, blob = self._reply_q.get_nowait()
+            except queue.Empty:
+                return
             try:
-                out = self._dispatch(identity, req)
-            except BaseException as e:  # noqa: BLE001
-                logger.debug("client op %s failed", req.get("op"),
-                             exc_info=True)
-                out = {"ok": False, "error": C.dumps(e)}
-            out["rid"] = req.get("rid")
-            self._reply(identity, out)
+                self._sock.send_multipart([identity, blob])
+            except Exception:
+                pass
 
     def _reply(self, identity: bytes, out: dict) -> None:
+        self._reply_q.put((identity, C.dumps(out)))
+        if threading.current_thread() is self._thread:
+            self._drain_replies()
+        else:
+            self._wake()
+
+    def _wake(self) -> None:
+        push = getattr(self._tls, "push", None)
+        if push is None:
+            push = self._ctx.socket(zmq.PUSH)
+            push.connect(self._wake_addr)
+            self._tls.push = push
         try:
-            self._sock.send_multipart([identity, C.dumps(out)])
+            push.send(b"", zmq.DONTWAIT)
         except Exception:
             pass
 
     def _session(self, identity: bytes) -> _ClientSession:
-        s = self._sessions.get(identity)
-        if s is None:
-            s = self._sessions[identity] = _ClientSession()
-        s.last_seen = time.monotonic()
-        return s
+        with self._sessions_lock:
+            s = self._sessions.get(identity)
+            if s is None:
+                s = self._sessions[identity] = _ClientSession()
+            s.last_seen = time.monotonic()
+            return s
 
     def _reap_idle(self) -> None:
         now = time.monotonic()
-        for identity in list(self._sessions):
-            s = self._sessions[identity]
-            if now - s.last_seen > self.idle_disconnect_s:
-                logger.info("client %s idle; releasing %d refs",
-                            identity.hex()[:8], len(s.refs))
-                self._drop_session(identity)
+        with self._sessions_lock:
+            idle = [i for i, s in self._sessions.items()
+                    if s.inflight == 0
+                    and now - s.last_seen > self.idle_disconnect_s]
+        for identity in idle:
+            logger.info("client %s idle; releasing refs",
+                        identity.hex()[:8])
+            self._drop_session(identity)
 
     def _drop_session(self, identity: bytes) -> None:
-        s = self._sessions.pop(identity, None)
+        with self._sessions_lock:
+            s = self._sessions.pop(identity, None)
         if s is None:
             return
         s.refs.clear()
@@ -130,8 +214,10 @@ class ClientServer:
         s.actors.clear()
 
     def _mint(self) -> bytes:
-        self._ref_seq += 1
-        return os.urandom(12) + self._ref_seq.to_bytes(4, "little")
+        with self._ref_seq_lock:
+            self._ref_seq += 1
+            seq = self._ref_seq
+        return os.urandom(12) + seq.to_bytes(4, "little")
 
     # -------------------------------------------------------- marshaling
     def _resolve_markers(self, session: _ClientSession, obj):
@@ -160,29 +246,34 @@ class ClientServer:
         return rid
 
     # --------------------------------------------------------- dispatch
-    def _dispatch(self, identity: bytes, req: dict) -> dict:
+    def _dispatch(self, identity: bytes, req: dict,
+                  session: _ClientSession) -> dict:
         op = req["op"]
-        session = self._session(identity)
         for rid in req.get("release") or ():
             session.refs.pop(rid, None)
+        for aid in req.get("release_actors") or ():
+            session.actors.pop(aid, None)
         handler = getattr(self, f"_op_{op}", None)
         if handler is None:
             raise ValueError(f"unknown client op {op!r}")
         return handler(session, req)
 
     def _op_connect(self, session, req) -> dict:
+        with self._sessions_lock:
+            n = len(self._sessions)
         info = {
             "ok": True,
-            "num_clients": len(self._sessions),
+            "num_clients": n,
             "resources": self._ray.cluster_resources(),
         }
         return info
 
     def _op_disconnect(self, session, req) -> dict:
         # release happens via identity lookup in _drop_session
-        for identity, s in list(self._sessions.items()):
-            if s is session:
-                self._drop_session(identity)
+        with self._sessions_lock:
+            idents = [i for i, s in self._sessions.items() if s is session]
+        for identity in idents:
+            self._drop_session(identity)
         return {"ok": True}
 
     def _op_put(self, session, req) -> dict:
@@ -190,16 +281,29 @@ class ClientServer:
         ref = self._ray.put(value)
         return {"ok": True, "ref_id": self._lease_ref(session, ref)}
 
+    @staticmethod
+    def _clamp(timeout) -> float:
+        # never let a client-supplied timeout (or None) hold a handler
+        # slot longer than one slice; the client loops (worker.py get/wait)
+        return _BLOCK_SLICE_S if timeout is None \
+            else max(0.0, min(float(timeout), _BLOCK_SLICE_S))
+
     def _op_get(self, session, req) -> dict:
         refs = [session.refs[rid] for rid in req["ref_ids"]]
-        vals = self._ray.get(refs, timeout=req.get("timeout"))
+        uniq = list(dict.fromkeys(refs))
+        ready, _ = self._ray.wait(
+            uniq, num_returns=len(uniq),
+            timeout=self._clamp(req.get("timeout")))
+        if len(ready) < len(uniq):
+            return {"ok": True, "pending": True}
+        vals = self._ray.get(refs)
         return {"ok": True, "values": C.dumps(vals)}
 
     def _op_wait(self, session, req) -> dict:
         by_id = {session.refs[rid]: rid for rid in req["ref_ids"]}
         ready, pending = self._ray.wait(
             list(by_id.keys()), num_returns=req.get("num_returns", 1),
-            timeout=req.get("timeout"))
+            timeout=self._clamp(req.get("timeout")))
         return {"ok": True,
                 "ready": [by_id[r] for r in ready],
                 "pending": [by_id[r] for r in pending]}

@@ -9,14 +9,21 @@ that the public functions delegate to in client mode). Here
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import zmq
 
+from ray_tpu.exceptions import GetTimeoutError
 from ray_tpu.util.client import common as C
 from ray_tpu.util.client.common import (
     ClientActorHandle, ClientObjectRef)
+
+_BLOCK_SLICE_S = C.BLOCK_SLICE_S
+
+_UNSET = object()
 
 
 class ClientRemoteFunction:
@@ -93,29 +100,53 @@ class ClientWorker:
         self._lock = threading.Lock()   # one in-flight request at a time
         self._rid = 0
         self._closed = False
+        # Deferred releases: __del__ may run on any thread, including one
+        # already inside _request holding self._lock — so a release NEVER
+        # does network I/O itself; it only appends here, and the list is
+        # flushed as a piggyback on the next normal request (same pattern
+        # as core.reference_counter._deferred_decrefs).
+        self._release_lock = threading.Lock()
         self._pending_release: List[bytes] = []
+        self._pending_release_actors: List[bytes] = []
         info = self._request({"op": "connect"})
         self.server_info = info
 
     # -------------------------------------------------------------- rpc
-    def _request(self, req: dict, timeout: Optional[float] = None) -> dict:
+    def _request(self, req: dict, timeout: Any = _UNSET) -> dict:
+        """One round-trip. ``timeout`` is the per-RPC reply deadline
+        (default: the connection timeout); ``None`` waits forever.
+        Blocking ops (get/wait) never need a long RPC deadline — the
+        server clamps them to _BLOCK_SLICE_S and the caller re-polls."""
         if self._closed:
             raise ConnectionError("client connection is closed")
-        timeout = self.timeout if timeout is None else timeout
+        timeout = self.timeout if timeout is _UNSET else timeout
         with self._lock:
             self._rid += 1
             req["rid"] = self._rid
-            rel, self._pending_release = self._pending_release, []
+            with self._release_lock:
+                rel, self._pending_release = self._pending_release, []
+                rel_a, self._pending_release_actors = \
+                    self._pending_release_actors, []
             if rel:
                 # piggyback deferred ref releases (no extra roundtrip)
                 req["release"] = rel
+            if rel_a:
+                req["release_actors"] = rel_a
             self._sock.send(C.dumps(req))
-            deadline = None if timeout is None else timeout * 1000
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
             while True:
-                if not self._sock.poll(deadline if deadline else 60000):
-                    raise TimeoutError(
-                        f"client request {req['op']} timed out "
-                        f"({timeout}s) against {self.address}")
+                if deadline is None:
+                    wait_ms = 60000
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"client request {req['op']} timed out "
+                            f"({timeout}s) against {self.address}")
+                    wait_ms = max(1, int(remaining * 1000))
+                if not self._sock.poll(wait_ms):
+                    continue
                 out = C.loads(self._sock.recv())
                 if out.get("rid") == self._rid:
                     break
@@ -126,23 +157,19 @@ class ClientWorker:
         return out
 
     def _release(self, ref_id: bytes) -> None:
-        # called from __del__ — defer to the next request, flush if many
+        # called from __del__ on an arbitrary thread: append only —
+        # any network I/O here can deadlock on self._lock (see ctor).
         if self._closed:
             return
-        self._pending_release.append(ref_id)
-        if len(self._pending_release) >= 64:
-            try:
-                self._request({"op": "release", "ref_ids": []})
-            except Exception:
-                pass
+        with self._release_lock:
+            self._pending_release.append(ref_id)
 
     def _release_actor(self, actor_id: bytes) -> None:
+        # also reached from ClientActorHandle.__del__: defer identically.
         if self._closed:
             return
-        try:
-            self._request({"op": "release_actor", "actor_id": actor_id})
-        except Exception:
-            pass
+        with self._release_lock:
+            self._pending_release_actors.append(actor_id)
 
     # -------------------------------------------------------------- api
     def put(self, value: Any) -> ClientObjectRef:
@@ -150,29 +177,61 @@ class ClientWorker:
         return ClientObjectRef(out["ref_id"], self)
 
     def get(self, refs, timeout: Optional[float] = None):
+        """Blocks until the objects are ready (timeout=None means
+        forever, matching the driver-side contract) by re-polling the
+        server in _BLOCK_SLICE_S slices — no RPC ever outlives a slice,
+        so a long-running task cannot trip the connection timeout."""
         single = isinstance(refs, ClientObjectRef)
         if single:
             refs = [refs]
         for r in refs:
             if not isinstance(r, ClientObjectRef):
                 raise TypeError(f"expected ClientObjectRef, got {type(r)}")
-        out = self._request(
-            {"op": "get", "ref_ids": [r.binary() for r in refs],
-             "timeout": timeout},
-            timeout=None if timeout is None else timeout + 10)
-        vals = C.loads(out["values"])
-        return vals[0] if single else vals
+        ids = [r.binary() for r in refs]
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            sl = _BLOCK_SLICE_S if deadline is None else \
+                max(0.0, min(_BLOCK_SLICE_S,
+                             deadline - time.monotonic()))
+            # RPC deadline: the reply for a ready object includes its
+            # serialized value, which can take arbitrarily long to build
+            # and transfer for huge objects — so a user-unbounded get
+            # gets an unbounded RPC too (contract: get(timeout=None)
+            # blocks forever), while a bounded get allows the user's
+            # whole remaining budget plus a transfer margin.
+            rpc_t = None if timeout is None else \
+                max(deadline - time.monotonic(), sl) + \
+                max(self.timeout, _BLOCK_SLICE_S * 2)
+            out = self._request({"op": "get", "ref_ids": ids,
+                                 "timeout": sl}, timeout=rpc_t)
+            if not out.get("pending"):
+                vals = C.loads(out["values"])
+                return vals[0] if single else vals
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(
+                    f"ray.get timed out after {timeout}s waiting for "
+                    f"{len(ids)} object(s)")
 
     def wait(self, refs: Sequence[ClientObjectRef], *, num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True
              ) -> Tuple[List[ClientObjectRef], List[ClientObjectRef]]:
         by_id = {r.binary(): r for r in refs}
-        out = self._request(
-            {"op": "wait", "ref_ids": list(by_id.keys()),
-             "num_returns": num_returns, "timeout": timeout},
-            timeout=None if timeout is None else timeout + 10)
-        return ([by_id[b] for b in out["ready"]],
-                [by_id[b] for b in out["pending"]])
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            sl = _BLOCK_SLICE_S if deadline is None else \
+                max(0.0, min(_BLOCK_SLICE_S,
+                             deadline - time.monotonic()))
+            out = self._request(
+                {"op": "wait", "ref_ids": list(by_id.keys()),
+                 "num_returns": num_returns, "timeout": sl},
+                timeout=sl + max(self.timeout, _BLOCK_SLICE_S * 2))
+            if len(out["ready"]) >= num_returns or (
+                    deadline is not None
+                    and time.monotonic() >= deadline):
+                return ([by_id[b] for b in out["ready"]],
+                        [by_id[b] for b in out["pending"]])
 
     def remote(self, *args, **options):
         if len(args) == 1 and callable(args[0]) and not options:
@@ -263,6 +322,12 @@ class ClientWorker:
         return not self._closed
 
 
-def connect(address: str, timeout: float = 30.0) -> ClientWorker:
-    """Connect to a ClientServer; returns the installed ClientWorker."""
+def connect(address: str, timeout: Optional[float] = None) -> ClientWorker:
+    """Connect to a ClientServer; returns the installed ClientWorker.
+
+    ``timeout`` is the per-RPC reply deadline (not a cap on how long
+    get/wait may block — those re-poll in slices). Defaults to the
+    RAY_TPU_CLIENT_TIMEOUT env var, else 30s."""
+    if timeout is None:
+        timeout = float(os.environ.get("RAY_TPU_CLIENT_TIMEOUT", "30"))
     return ClientWorker(address, timeout=timeout)

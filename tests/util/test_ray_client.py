@@ -111,6 +111,48 @@ def test_ray_client_end_to_end(client_server):
     assert "CLIENT-OK" in proc.stdout
 
 
+def test_client_get_outlives_connection_timeout(client_server,
+                                                ray_start_shared):
+    """A task running longer than the client's connection timeout must
+    still be gettable with timeout=None (the client re-polls in bounded
+    slices; no single RPC spans the task's runtime). Regression for the
+    30s-cap bug: get(timeout=None) used to inherit the connect timeout."""
+    script = textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {REPO!r})
+        import ray_tpu
+        ray_tpu.init({client_server!r})
+        @ray_tpu.remote
+        def slow():
+            time.sleep(6.0)
+            return "done"
+        ref = slow.remote()
+        # also exercise wait() blocking past one slice
+        ready, pending = ray_tpu.wait([ref], num_returns=1, timeout=None)
+        assert len(ready) == 1, (ready, pending)
+        assert ray_tpu.get(ref) == "done"
+        # and a get() with a too-short timeout raises GetTimeoutError
+        from ray_tpu.exceptions import GetTimeoutError
+        ref2 = slow.remote()
+        t0 = time.monotonic()
+        try:
+            ray_tpu.get(ref2, timeout=1.0)
+            raise AssertionError("expected GetTimeoutError")
+        except GetTimeoutError:
+            pass
+        assert time.monotonic() - t0 < 5.0
+        ray_tpu.shutdown()
+        print("SLOW-OK")
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=240,
+        env={**os.environ, "RAY_TPU_JAX_PLATFORM": "cpu",
+             "RAY_TPU_CLIENT_TIMEOUT": "4"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "SLOW-OK" in proc.stdout
+
+
 def test_client_disconnect_releases_leases(client_server, ray_start_shared):
     script = textwrap.dedent(f"""
         import sys
