@@ -69,18 +69,28 @@ def collect_demand_snapshot(controller) -> dict:
 def drain_node_if_idle(controller, node_b: bytes) -> bool:
     """Controller-loop-thread: mark draining unless work holds the
     node. Returns True when the node is safe to terminate."""
+    return drain_nodes_if_idle(controller, [node_b])
+
+
+def drain_nodes_if_idle(controller, node_bs: List[bytes]) -> bool:
+    """Controller-loop-thread, slice-granular: drain ALL the given nodes
+    atomically, or none — a TPU pod slice terminates as a unit, so one
+    busy host VM vetoes the whole slice's termination (reference:
+    DrainNode precedes termination; the gang extension is ours)."""
     from ray_tpu.core.ids import NodeID
     c = controller
-    busy = any(l.node_b == node_b for l in c.leases.values()) \
-        or any(nb == node_b
+    targets = set(node_bs)
+    busy = any(l.node_b in targets for l in c.leases.values()) \
+        or any(nb in targets
                for nb in getattr(c, "_lease_node", {}).values()) \
         or any(
             info.state != "DEAD" and info.node_id is not None
-            and info.node_id.binary() == node_b
+            and info.node_id.binary() in targets
             for info in c.actors.values())
     if busy:
         return False
-    c.scheduler.set_draining(NodeID(node_b), True)
+    for node_b in targets:
+        c.scheduler.set_draining(NodeID(node_b), True)
     return True
 
 
@@ -140,7 +150,8 @@ class StandardAutoscaler:
         planned_room: List[Dict[str, float]] = [
             dict(self.provider.node_resources(nid))
             for nids in by_type.values() for nid in nids
-            if self.provider.internal_id(nid) not in snap["alive_nodes"]]
+            if not any(i in snap["alive_nodes"]
+                       for i in self.provider.internal_ids(nid))]
         for d in demand:
             placed = False
             for room in planned_room:
@@ -178,9 +189,16 @@ class StandardAutoscaler:
         for t in self.node_types.values():
             nodes = by_type.get(t.name, [])
             for nid in nodes:
-                internal = self.provider.internal_id(nid)
-                joined = internal in snap["alive_nodes"]
-                busy = internal in snap["busy_nodes"]
+                internals = self.provider.internal_ids(nid)
+                # a multi-host slice has joined when EVERY expected host
+                # VM is alive (partially-joined slices are still
+                # starting); one busy host makes the whole slice busy —
+                # slices terminate as a unit
+                joined = len(internals) >= \
+                    self.provider.expected_internal_count(nid) and \
+                    bool(internals) and all(
+                        i in snap["alive_nodes"] for i in internals)
+                busy = any(i in snap["busy_nodes"] for i in internals)
                 if busy or not joined:
                     # not-yet-joined nodes are starting up, not idle
                     self._idle_since.pop(nid, None)
@@ -191,12 +209,14 @@ class StandardAutoscaler:
                 if len(nodes) - len([x for x in terminated
                                      if x in nodes]) <= t.min_workers:
                     continue
-                # drain atomically on the controller loop: mark the node
-                # unschedulable iff still idle there (reference: DrainNode
-                # precedes termination) — closes the race where a lease
-                # lands between our snapshot and the SIGTERM
+                # drain atomically on the controller loop: mark every
+                # host unschedulable iff all are still idle (reference:
+                # DrainNode precedes termination) — closes the race
+                # where a lease lands between our snapshot and the
+                # SIGTERM, and keeps slice termination all-or-nothing
                 if not self.controller.call_on_loop(
-                        lambda b=internal: self._drain_if_idle(b)):
+                        lambda ids=internals:
+                        drain_nodes_if_idle(self.controller, ids)):
                     self._idle_since.pop(nid, None)
                     continue
                 logger.info("autoscaler: terminating idle node %s", nid)
@@ -204,9 +224,6 @@ class StandardAutoscaler:
                 self._idle_since.pop(nid, None)
                 terminated.append(nid)
         return terminated
-
-    def _drain_if_idle(self, node_b: bytes) -> bool:
-        return drain_node_if_idle(self.controller, node_b)
 
 
 class AutoscalerMonitor:

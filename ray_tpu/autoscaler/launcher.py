@@ -1,0 +1,289 @@
+"""Cluster launcher: ``ray-tpu up / down / attach`` from a YAML config.
+
+Reference: ``python/ray/autoscaler/_private/commands.py`` (``ray up`` —
+validate config, create or update head node, bootstrap it over SSH,
+start the autoscaler there) with the schema contract of
+``python/ray/autoscaler/ray-schema.json``. TPU-native differences: the
+provisioning unit is a TPU pod slice (see gce.py), the head is itself a
+TPU VM (or an existing address), and bootstrap commands run on every
+host VM of a slice via the command runner (reference:
+``gcp/tpu_command_runner.py`` fans one runner out per networkEndpoint).
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+from typing import Any, Callable, Dict, List, Optional
+
+import yaml
+
+from ray_tpu.autoscaler.autoscaler import NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------- schema
+class ConfigError(ValueError):
+    """Invalid cluster YAML, with the offending path in the message."""
+
+
+_PROVIDER_REQUIRED = {"gce_tpu": ("project", "zone")}
+
+
+def validate_cluster_config(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """Validate + normalize a cluster config dict (reference:
+    ray-schema.json, scoped to the fields this launcher consumes).
+    Returns the config with defaults filled in."""
+    if not isinstance(cfg, dict):
+        raise ConfigError("cluster config must be a mapping")
+
+    def need(d: dict, key: str, typ, path: str):
+        if key not in d:
+            raise ConfigError(f"missing required field '{path}{key}'")
+        if not isinstance(d[key], typ):
+            raise ConfigError(
+                f"'{path}{key}' must be {typ.__name__}, "
+                f"got {type(d[key]).__name__}")
+        return d[key]
+
+    need(cfg, "cluster_name", str, "")
+    provider = need(cfg, "provider", dict, "")
+    ptype = need(provider, "type", str, "provider.")
+    for field in _PROVIDER_REQUIRED.get(ptype, ()):
+        need(provider, field, str, "provider.")
+    types = need(cfg, "available_node_types", dict, "")
+    if not types:
+        raise ConfigError("'available_node_types' must not be empty")
+    for name, t in types.items():
+        if not isinstance(t, dict):
+            raise ConfigError(
+                f"'available_node_types.{name}' must be a mapping")
+        path = f"available_node_types.{name}."
+        res = need(t, "resources", dict, path)
+        for k, v in res.items():
+            if not isinstance(v, (int, float)) or v < 0:
+                raise ConfigError(
+                    f"'{path}resources.{k}' must be a non-negative "
+                    f"number")
+        t.setdefault("min_workers", 0)
+        t.setdefault("max_workers", cfg.get("max_workers", 8))
+        for bound in ("min_workers", "max_workers"):
+            if not isinstance(t[bound], int) or t[bound] < 0:
+                raise ConfigError(
+                    f"'{path}{bound}' must be a non-negative integer")
+        if t["min_workers"] > t["max_workers"]:
+            raise ConfigError(
+                f"'{path}min_workers' ({t['min_workers']}) exceeds "
+                f"max_workers ({t['max_workers']})")
+        t.setdefault("node_config", {})
+        if not isinstance(t["node_config"], dict):
+            raise ConfigError(f"'{path}node_config' must be a mapping")
+    head_type = need(cfg, "head_node_type", str, "")
+    if head_type not in types:
+        raise ConfigError(
+            f"'head_node_type' {head_type!r} is not one of "
+            f"available_node_types {sorted(types)}")
+    cfg.setdefault("max_workers", 8)
+    cfg.setdefault("setup_commands", [])
+    cfg.setdefault("head_start_commands", [])
+    cfg.setdefault("worker_start_commands", [])
+    for key in ("setup_commands", "head_start_commands",
+                "worker_start_commands"):
+        if not isinstance(cfg[key], list) or \
+                not all(isinstance(x, str) for x in cfg[key]):
+            raise ConfigError(f"'{key}' must be a list of strings")
+    auth = cfg.setdefault("auth", {})
+    if not isinstance(auth, dict):
+        raise ConfigError("'auth' must be a mapping")
+    auth.setdefault("ssh_user", "ray")
+    return cfg
+
+
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    return validate_cluster_config(cfg)
+
+
+# -------------------------------------------------------- command runner
+class CommandRunner:
+    """Runs bootstrap commands on a cluster host (reference:
+    command_runner.py CommandRunnerInterface)."""
+
+    def run(self, cmd: str, timeout: float = 600.0) -> str:
+        raise NotImplementedError
+
+
+class SSHCommandRunner(CommandRunner):
+    def __init__(self, ip: str, user: str,
+                 ssh_key: Optional[str] = None):
+        self.ip = ip
+        self.user = user
+        self.ssh_key = ssh_key
+
+    def run(self, cmd: str, timeout: float = 600.0) -> str:
+        ssh = ["ssh", "-o", "StrictHostKeyChecking=no",
+               "-o", "ConnectTimeout=20"]
+        if self.ssh_key:
+            ssh += ["-i", self.ssh_key]
+        ssh += [f"{self.user}@{self.ip}", cmd]
+        logger.info("[%s] %s", self.ip, cmd)
+        proc = subprocess.run(ssh, capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"command failed on {self.ip} (rc={proc.returncode}): "
+                f"{cmd}\n{proc.stderr[-2000:]}")
+        return proc.stdout
+
+
+# --------------------------------------------------------------- launcher
+def _make_provider(cfg: Dict[str, Any],
+                   api=None) -> NodeProvider:
+    provider_cfg = dict(cfg["provider"])
+    ptype = provider_cfg["type"]
+    if ptype == "gce_tpu":
+        from ray_tpu.autoscaler.gce import (
+            GCETPUNodeProvider, state_resolver)
+        provider_cfg["cluster_name"] = cfg["cluster_name"]
+        provider_cfg["node_configs"] = {
+            name: t.get("node_config", {})
+            for name, t in cfg["available_node_types"].items()}
+        provider_cfg["resources"] = {
+            name: t["resources"]
+            for name, t in cfg["available_node_types"].items()}
+        return GCETPUNodeProvider(provider_cfg, api=api,
+                                  resolve_internal=state_resolver())
+    if ptype == "fake":
+        from ray_tpu.autoscaler.node_provider import FakeNodeProvider
+        return FakeNodeProvider(provider_cfg.get("session_dir", "/tmp"),
+                                provider_cfg)
+    raise ConfigError(f"unknown provider type {ptype!r}")
+
+
+def node_type_configs(cfg: Dict[str, Any]) -> List[NodeTypeConfig]:
+    """Worker node types for the autoscaler: every type but the head."""
+    return [
+        NodeTypeConfig(name, t["resources"],
+                       min_workers=t["min_workers"],
+                       max_workers=t["max_workers"])
+        for name, t in cfg["available_node_types"].items()
+        if name != cfg["head_node_type"]]
+
+
+class ClusterLauncher:
+    """up/down/attach against a validated config. ``runner_factory``
+    (ip, user -> CommandRunner) is injectable so tests record commands
+    instead of opening SSH connections."""
+
+    def __init__(self, cfg: Dict[str, Any],
+                 provider: Optional[NodeProvider] = None,
+                 api=None,
+                 runner_factory: Optional[
+                     Callable[[str, str], CommandRunner]] = None):
+        self.cfg = cfg
+        self.provider = provider or _make_provider(cfg, api=api)
+        self.runner_factory = runner_factory or (
+            lambda ip, user: SSHCommandRunner(
+                ip, user, cfg["auth"].get("ssh_private_key")))
+
+    # -------------------------------------------------------------- up
+    def up(self) -> Dict[str, Any]:
+        """Create (or reuse) the head slice, bootstrap every host VM of
+        it, start the head daemon + autoscaler (reference:
+        commands.get_or_create_head_node)."""
+        head_type = self.cfg["head_node_type"]
+        head = self._existing_head()
+        created = False
+        if head is None:
+            head = self.provider.create_node(
+                head_type,
+                self.cfg["available_node_types"][head_type]["resources"])
+            created = True
+        if hasattr(self.provider, "wait_until_ready"):
+            self.provider.wait_until_ready(head)
+        endpoints = self._endpoints(head)
+        head_ip = endpoints[0] if endpoints else None
+        user = self.cfg["auth"]["ssh_user"]
+        cmds = list(self.cfg["setup_commands"])
+        start = [c.format(head_ip=head_ip or "127.0.0.1",
+                          cluster_name=self.cfg["cluster_name"])
+                 for c in self.cfg["head_start_commands"]]
+        # worker hosts of a multi-host head slice join as workers
+        for i, ip in enumerate(endpoints):
+            runner = self.runner_factory(ip, user)
+            for cmd in cmds + (start if i == 0 else [
+                    c.format(head_ip=head_ip, cluster_name=self
+                             .cfg["cluster_name"])
+                    for c in self.cfg["worker_start_commands"]]):
+                runner.run(cmd)
+        logger.info("cluster %s is up (head=%s ip=%s)",
+                    self.cfg["cluster_name"], head, head_ip)
+        return {"head_node": head, "head_ip": head_ip,
+                "created": created}
+
+    def _existing_head(self) -> Optional[str]:
+        head_type = self.cfg["head_node_type"]
+        for nid in self.provider.non_terminated_nodes():
+            try:
+                if self.provider.node_type(nid) == head_type:
+                    return nid
+            except KeyError:
+                continue
+        return None
+
+    def _endpoints(self, node_id: str) -> List[str]:
+        if hasattr(self.provider, "host_endpoints"):
+            eps = self.provider.host_endpoints(node_id)
+            out = []
+            for e in eps:
+                access = e.get("accessConfig") or {}
+                out.append(access.get("externalIp") or e.get("ipAddress"))
+            return [ip for ip in out if ip]
+        return []
+
+    # ------------------------------------------------------------ down
+    def down(self, keep_head: bool = False) -> List[str]:
+        """Terminate every provider node of this cluster (reference:
+        commands.teardown_cluster; workers first, head last so state
+        queries keep working during the drain)."""
+        head_type = self.cfg["head_node_type"]
+        nodes = self.provider.non_terminated_nodes()
+        workers = [n for n in nodes
+                   if self._type_of(n) != head_type]
+        heads = [n for n in nodes if self._type_of(n) == head_type]
+        gone = []
+        for nid in workers + ([] if keep_head else heads):
+            self.provider.terminate_node(nid)
+            gone.append(nid)
+        logger.info("cluster %s: terminated %d node(s)",
+                    self.cfg["cluster_name"], len(gone))
+        return gone
+
+    def _type_of(self, nid: str) -> Optional[str]:
+        try:
+            return self.provider.node_type(nid)
+        except KeyError:
+            return None
+
+    # ---------------------------------------------------------- attach
+    def attach_command(self) -> List[str]:
+        """The ssh invocation for an interactive shell on the head."""
+        head = self._existing_head()
+        if head is None:
+            raise RuntimeError(
+                f"cluster {self.cfg['cluster_name']} has no head node; "
+                f"run `ray-tpu up` first")
+        if hasattr(self.provider, "wait_until_ready"):
+            self.provider.wait_until_ready(head, timeout_s=60)
+        ips = self._endpoints(head)
+        if not ips:
+            raise RuntimeError(f"head node {head} has no endpoints yet")
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+        key = self.cfg["auth"].get("ssh_private_key")
+        if key:
+            cmd += ["-i", key]
+        cmd.append(f"{self.cfg['auth']['ssh_user']}@{ips[0]}")
+        return cmd

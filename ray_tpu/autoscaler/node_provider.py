@@ -48,6 +48,19 @@ class NodeProvider:
         controller-side utilization."""
         raise NotImplementedError
 
+    def internal_ids(self, node_id: str) -> List[bytes]:
+        """ALL controller NodeIDs belonging to this provider node — a
+        multi-host TPU slice maps one provider node to one NodeID per
+        host VM. Default: the single-id contract."""
+        one = self.internal_id(node_id)
+        return [one] if one is not None else []
+
+    def expected_internal_count(self, node_id: str) -> int:
+        """How many cluster nodes this provider node contributes when
+        fully joined (host VMs of a slice). The autoscaler treats the
+        node as still starting until that many have registered."""
+        return 1
+
 
 class FakeNodeProvider(NodeProvider):
     """Launches REAL node-manager processes on this host (reference:

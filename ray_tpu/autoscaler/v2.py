@@ -208,7 +208,7 @@ class AutoscalerV2:
     # -------------------------------------------------------- reconcile
     def update(self) -> Dict[str, Any]:
         from ray_tpu.autoscaler.autoscaler import (
-            collect_demand_snapshot, drain_node_if_idle)
+            collect_demand_snapshot, drain_nodes_if_idle)
         snap = self.controller.call_on_loop(
             lambda: collect_demand_snapshot(self.controller))
         provider_nodes = set(self.provider.non_terminated_nodes())
@@ -226,10 +226,14 @@ class AutoscalerV2:
             if inst.provider_node_id in provider_nodes:
                 self.storage.transition(inst.instance_id, ALLOCATED)
         for inst in self.storage.list(ALLOCATED):
-            internal = self.provider.internal_id(inst.provider_node_id)
-            if internal and internal in snap["alive_nodes"]:
+            # slice-granular join: every expected host VM must be alive
+            # (a multi-host TPU slice is RAY_RUNNING only when whole)
+            ids = self.provider.internal_ids(inst.provider_node_id)
+            if ids and len(ids) >= self.provider.expected_internal_count(
+                    inst.provider_node_id) and all(
+                    i in snap["alive_nodes"] for i in ids):
                 self.storage.transition(inst.instance_id, RAY_RUNNING,
-                                        ray_node_id=internal)
+                                        ray_node_id=ids[0])
         for inst in self.storage.list(REQUESTED, ALLOCATED, RAY_RUNNING):
             if inst.provider_node_id is not None and \
                     inst.provider_node_id not in provider_nodes:
@@ -248,10 +252,10 @@ class AutoscalerV2:
         now = time.monotonic()
         idle = []
         for inst in self.storage.list(RAY_RUNNING):
-            internal = inst.ray_node_id
             pid = inst.provider_node_id
-            if internal and internal in snap["alive_nodes"] \
-                    and internal not in snap["busy_nodes"] \
+            ids = self.provider.internal_ids(pid)
+            if ids and all(i in snap["alive_nodes"] for i in ids) \
+                    and not any(i in snap["busy_nodes"] for i in ids) \
                     and not snap["demand"]:
                 since = self._idle_since.setdefault(pid, now)
                 if now - since >= self.idle_timeout_s:
@@ -284,12 +288,14 @@ class AutoscalerV2:
             inst = self.storage.get(iid)
             if inst is None or inst.status != RAY_RUNNING:
                 continue
-            # drain atomically on the controller loop (DrainNode before
-            # termination — same race-closure as v1)
-            if inst.ray_node_id is not None and not \
-                    self.controller.call_on_loop(
-                        lambda b=inst.ray_node_id:
-                        drain_node_if_idle(self.controller, b)):
+            # drain ALL host VMs of the slice atomically on the
+            # controller loop (DrainNode before termination — same
+            # race-closure as v1; one busy host vetoes the slice)
+            all_ids = self.provider.internal_ids(inst.provider_node_id) \
+                or ([inst.ray_node_id] if inst.ray_node_id else [])
+            if all_ids and not self.controller.call_on_loop(
+                    lambda ids=all_ids:
+                    drain_nodes_if_idle(self.controller, ids)):
                 self._idle_since.pop(inst.provider_node_id, None)
                 continue
             if self.storage.transition(iid, RAY_STOPPING):

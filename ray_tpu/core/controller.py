@@ -2227,6 +2227,7 @@ class Controller:
                 "node_id": n.node_id.hex(), "alive": n.alive,
                 "resources_total": n.resources.total,
                 "resources_available": n.resources.available,
+                "labels": dict(n.resources.labels),
                 "num_workers": len(n.all_workers), "stats": dict(n.stats, wait_worker=None),
             } for n in self.nodes.values()]
         elif what == "tasks":

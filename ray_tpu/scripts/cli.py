@@ -71,6 +71,19 @@ def cmd_start(args) -> None:
             cmd += ["--num-cpus", str(args.num_cpus)]
         if args.num_tpus is not None:
             cmd += ["--num-tpus", str(args.num_tpus)]
+        if getattr(args, "labels", None):
+            # "k=v,k2=v2" — the cluster launcher stamps
+            # ray-tpu-node-id=<slice> here so the autoscaler can join
+            # provider slices to registered nodes
+            labels = {}
+            for kv in args.labels.split(","):
+                if "=" not in kv:
+                    raise SystemExit(
+                        f"--labels: {kv!r} is not k=v (values must "
+                        f"not contain commas)")
+                k, v = kv.split("=", 1)
+                labels[k] = v
+            cmd += ["--labels", json.dumps(labels)]
         log = open("/tmp/ray_tpu/node.log", "ab")
         proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
                                 start_new_session=True)
@@ -198,6 +211,47 @@ def cmd_job(args) -> None:
         print(client.stop_job(args.submission_id))
 
 
+def cmd_up(args) -> None:
+    """Create/bootstrap a cluster from YAML (reference: `ray up`,
+    commands.py:create_or_update_cluster)."""
+    from ray_tpu.autoscaler.launcher import (
+        ClusterLauncher, load_cluster_config)
+    cfg = load_cluster_config(args.config)
+    if not args.yes:
+        ans = input(f"Launch cluster {cfg['cluster_name']!r} "
+                    f"({cfg['provider']['type']})? [y/N] ")
+        if ans.strip().lower() not in ("y", "yes"):
+            print("aborted")
+            return
+    out = ClusterLauncher(cfg).up()
+    print(json.dumps(out))
+
+
+def cmd_down(args) -> None:
+    from ray_tpu.autoscaler.launcher import (
+        ClusterLauncher, load_cluster_config)
+    cfg = load_cluster_config(args.config)
+    if not args.yes:
+        ans = input(f"Tear down cluster {cfg['cluster_name']!r}? [y/N] ")
+        if ans.strip().lower() not in ("y", "yes"):
+            print("aborted")
+            return
+    gone = ClusterLauncher(cfg).down(keep_head=args.keep_head)
+    print(json.dumps({"terminated": gone}))
+
+
+def cmd_attach(args) -> None:
+    import subprocess as sp_mod
+    from ray_tpu.autoscaler.launcher import (
+        ClusterLauncher, load_cluster_config)
+    cfg = load_cluster_config(args.config)
+    cmd = ClusterLauncher(cfg).attach_command()
+    if args.dry_run:
+        print(" ".join(cmd))
+        return
+    sp_mod.run(cmd)
+
+
 def main() -> None:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -208,6 +262,8 @@ def main() -> None:
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--num-tpus", type=float, default=None)
     sp.add_argument("--resources", default=None)
+    sp.add_argument("--labels", default=None,
+                    help="k=v,k2=v2 node labels (worker mode)")
     sp.add_argument("--initial-workers", type=int, default=2)
     sp.set_defaults(fn=cmd_start)
 
@@ -241,6 +297,23 @@ def main() -> None:
     sp.add_argument("script_args", nargs="*")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("up", help="launch a cluster from YAML config")
+    sp.add_argument("config")
+    sp.add_argument("-y", "--yes", action="store_true")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a YAML-config cluster")
+    sp.add_argument("config")
+    sp.add_argument("-y", "--yes", action="store_true")
+    sp.add_argument("--keep-head", action="store_true")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("attach", help="ssh to the cluster head")
+    sp.add_argument("config")
+    sp.add_argument("--dry-run", action="store_true",
+                    help="print the ssh command instead of running it")
+    sp.set_defaults(fn=cmd_attach)
 
     sp = sub.add_parser("microbenchmark", help="core perf suite")
     sp.set_defaults(fn=cmd_microbenchmark)

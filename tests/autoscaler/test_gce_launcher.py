@@ -1,0 +1,450 @@
+"""GCE TPU provider + cluster launcher against a mocked TPU REST API
+(reference behavior: python/ray/autoscaler/_private/gcp/node.py GCPTPU,
+commands.py `ray up`/`ray down`). No network: the injectable transport
+is the test double."""
+
+import re
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import (
+    NodeTypeConfig, StandardAutoscaler)
+from ray_tpu.autoscaler.gce import (
+    GCETPUNodeProvider, LABEL_CLUSTER, LABEL_NODE_ID, LABEL_NODE_TYPE,
+    TPUApiClient, TPUApiError)
+from ray_tpu.autoscaler.launcher import (
+    ClusterLauncher, CommandRunner, ConfigError, node_type_configs,
+    validate_cluster_config)
+
+
+class MockTPUApi:
+    """Simulates tpu.googleapis.com/v2: nodes create/list/get/delete +
+    long-running operations. Slices become READY after `ready_delay`
+    list/get observations (0 = immediately)."""
+
+    def __init__(self, num_hosts_by_type=None, ready_delay=0):
+        self.nodes = {}          # name -> node dict
+        self.ops = {}            # op name -> op dict
+        self.calls = []          # (method, url) log
+        self.create_bodies = []  # bodies given to nodes.create
+        self.num_hosts_by_type = num_hosts_by_type or {}
+        self.ready_delay = ready_delay
+        self._op_seq = 0
+
+    def __call__(self, method, url, body):
+        self.calls.append((method, url))
+        path = url.split("googleapis.com/v2/")[-1]
+        m = re.match(r"(projects/[^/]+/locations/[^/]+)/nodes\?nodeId=(.+)",
+                     path)
+        if method == "POST" and m:
+            parent, node_id = m.group(1), m.group(2)
+            name = f"{parent}/nodes/{node_id}"
+            accel = body.get("acceleratorType", "v5litepod-16")
+            hosts = self.num_hosts_by_type.get(accel, 1)
+            self.nodes[name] = {
+                "name": name, "state": "CREATING",
+                "acceleratorType": accel,
+                "labels": dict(body.get("labels", {})),
+                "metadata": dict(body.get("metadata", {})),
+                "networkEndpoints": [
+                    {"ipAddress": f"10.0.0.{i+1}",
+                     "accessConfig": {"externalIp": f"34.1.0.{i+1}"}}
+                    for i in range(hosts)],
+                "_age": 0,
+            }
+            self.create_bodies.append(dict(body))
+            self._op_seq += 1
+            op_name = f"{parent}/operations/op-{self._op_seq}"
+            op = {"name": op_name, "done": True, "response": {}}
+            self.ops[op_name] = op
+            return op
+        if method == "GET" and path.endswith("/nodes"):
+            out = []
+            for n in self.nodes.values():
+                self._age(n)
+                out.append(dict(n))
+            return {"nodes": out}
+        if method == "GET" and "/operations/" in path:
+            return dict(self.ops[path])
+        if method == "GET" and "/nodes/" in path:
+            n = self.nodes.get(path)
+            if n is None:
+                raise TPUApiError(f"404 {path}", status=404)
+            self._age(n)
+            return dict(n)
+        if method == "DELETE" and "/nodes/" in path:
+            if path not in self.nodes:
+                raise TPUApiError(f"404 {path}", status=404)
+            del self.nodes[path]
+            self._op_seq += 1
+            op = {"name": f"op-{self._op_seq}", "done": True,
+                  "response": {}}
+            return op
+        raise AssertionError(f"unexpected request {method} {url}")
+
+    def _age(self, n):
+        if n["state"] == "CREATING":
+            n["_age"] += 1
+            if n["_age"] > self.ready_delay:
+                n["state"] = "READY"
+
+
+def make_provider(mock=None, cluster="testclus", resolve=None,
+                  num_hosts_by_type=None):
+    mock = mock or MockTPUApi(num_hosts_by_type=num_hosts_by_type)
+    api = TPUApiClient("proj", "us-central2-b", request_fn=mock)
+    cfg = {
+        "project": "proj", "zone": "us-central2-b",
+        "cluster_name": cluster,
+        "list_cache_ttl_s": 0.0,
+        "head_address": "10.0.0.1:6380",
+        "startup_script": "ray-tpu start --address={head} "
+                          "--labels ray-tpu-node-id={node_id}",
+        "node_configs": {
+            "v5e_16": {"acceleratorType": "v5litepod-16",
+                       "runtimeVersion": "tpu-ubuntu2204-base"},
+            "v5e_64": {"acceleratorType": "v5litepod-64",
+                       "runtimeVersion": "tpu-ubuntu2204-base"},
+            "head": {"acceleratorType": "v5litepod-1",
+                     "runtimeVersion": "tpu-ubuntu2204-base"},
+        },
+        "resources": {
+            "v5e_16": {"TPU": 16.0, "TPU-v5litepod-16-head": 1.0},
+            "v5e_64": {"TPU": 64.0, "TPU-v5litepod-64-head": 1.0},
+            "head": {"CPU": 8.0},
+        },
+    }
+    return GCETPUNodeProvider(cfg, api=api,
+                              resolve_internal=resolve), mock
+
+
+# ------------------------------------------------------------- provider
+def test_create_node_issues_one_slice_create():
+    provider, mock = make_provider()
+    nid = provider.create_node("v5e_64", {"TPU": 64})
+    assert len(mock.create_bodies) == 1
+    body = mock.create_bodies[0]
+    assert body["acceleratorType"] == "v5litepod-64"
+    assert body["labels"][LABEL_CLUSTER] == "testclus"
+    assert body["labels"][LABEL_NODE_TYPE] == "v5e_64"
+    assert body["labels"][LABEL_NODE_ID] == nid
+    assert body["networkConfig"]["enableExternalIps"] is True
+    # startup script templated with head address + this node's id
+    assert "10.0.0.1:6380" in body["metadata"]["startup-script"]
+    assert nid in body["metadata"]["startup-script"]
+    # visible in inventory immediately (pending create)
+    assert nid in provider.non_terminated_nodes()
+    assert provider.node_type(nid) == "v5e_64"
+    assert provider.node_resources(nid)["TPU-v5litepod-64-head"] == 1.0
+
+
+def test_list_filters_foreign_and_terminated_slices():
+    provider, mock = make_provider()
+    nid = provider.create_node("v5e_16", {})
+    # a slice from another cluster and a dead slice are both invisible
+    mock.nodes["projects/proj/locations/us-central2-b/nodes/other"] = {
+        "name": "projects/proj/locations/us-central2-b/nodes/other",
+        "state": "READY",
+        "labels": {LABEL_CLUSTER: "someone-else", LABEL_NODE_ID: "x"},
+        "networkEndpoints": [], "_age": 99}
+    mock.nodes["projects/proj/locations/us-central2-b/nodes/dead"] = {
+        "name": "projects/proj/locations/us-central2-b/nodes/dead",
+        "state": "TERMINATED",
+        "labels": {LABEL_CLUSTER: "testclus", LABEL_NODE_ID: "y"},
+        "networkEndpoints": [], "_age": 99}
+    assert provider.non_terminated_nodes() == [nid]
+
+
+def test_terminate_deletes_slice_and_tolerates_404():
+    provider, mock = make_provider()
+    nid = provider.create_node("v5e_16", {})
+    provider.terminate_node(nid)
+    assert not mock.nodes
+    assert nid not in provider.non_terminated_nodes()
+    # double-terminate is a no-op (reference retries around 404s)
+    provider.terminate_node(nid)
+
+
+def test_wait_until_ready_polls_to_ready():
+    mock = MockTPUApi(ready_delay=2)
+    provider, _ = make_provider(mock=mock)
+    nid = provider.create_node("v5e_16", {})
+    node = provider.wait_until_ready(nid, timeout_s=30)
+    assert node["state"] == "READY"
+    eps = provider.host_endpoints(nid)
+    assert eps and eps[0]["accessConfig"]["externalIp"] == "34.1.0.1"
+
+
+# ----------------------------------------------- gang autoscaling (mock)
+class StubController:
+    """Just enough controller for StandardAutoscaler: snapshot comes from
+    the test, drain runs inline."""
+
+    def __init__(self):
+        self.leases = {}
+        self._lease_node = {}
+        self.actors = {}
+        self.drained = []
+        outer = self
+
+        class Sched:
+            def set_draining(self, node_id, flag):
+                outer.drained.append((node_id.binary(), flag))
+        self.scheduler = Sched()
+        self.snap = {"demand": [], "busy_nodes": set(),
+                     "alive_nodes": set()}
+
+    def call_on_loop(self, fn):
+        return fn()
+
+
+def make_autoscaler(provider, controller, idle_timeout_s=0.0):
+    types = [
+        NodeTypeConfig("v5e_64",
+                       {"TPU": 64.0, "TPU-v5litepod-64-head": 1.0},
+                       min_workers=0, max_workers=4),
+        NodeTypeConfig("v5e_16",
+                       {"TPU": 16.0, "TPU-v5litepod-16-head": 1.0},
+                       min_workers=0, max_workers=4),
+    ]
+    a = StandardAutoscaler(controller, provider, types,
+                           idle_timeout_s=idle_timeout_s)
+    a._snapshot = lambda: controller.snap
+    return a
+
+
+def test_gang_demand_provisions_exactly_one_slice():
+    """A pending TPU-v5e-64-head gang demand creates ONE 16-host slice,
+    not 16 loose nodes — the slice is the provisioning atom."""
+    host_ids = {}
+    provider, mock = make_provider(
+        num_hosts_by_type={"v5litepod-64": 16, "v5litepod-16": 4},
+        resolve=lambda nid: host_ids.get(nid, []))
+    ctl = StubController()
+    ctl.snap["demand"] = [{"TPU-v5litepod-64-head": 1.0, "TPU": 64.0}]
+    asc = make_autoscaler(provider, ctl)
+
+    out = asc.update()
+    assert len(out["launched"]) == 1
+    assert len(mock.create_bodies) == 1
+    assert mock.create_bodies[0]["acceleratorType"] == "v5litepod-64"
+    nid = out["launched"][0]
+
+    # while the slice boots (hosts not yet joined), the same demand must
+    # NOT trigger a second launch: pending capacity absorbs it
+    out2 = asc.update()
+    assert out2["launched"] == []
+    assert len(mock.create_bodies) == 1
+
+    # 16 host VMs join the cluster -> slice is "joined"; demand gone
+    ids = [bytes([i]) * 28 for i in range(16)]
+    host_ids[nid] = ids
+    ctl.snap["demand"] = []
+    ctl.snap["alive_nodes"] = set(ids)
+    ctl.snap["busy_nodes"] = set(ids[:1])   # one busy host
+    out3 = asc.update()
+    # one busy host vetoes termination of the whole slice
+    assert out3["terminated"] == []
+    assert nid in provider.non_terminated_nodes()
+
+    # fully idle -> drain all 16 hosts atomically, then delete the slice
+    ctl.snap["busy_nodes"] = set()
+    out4 = asc.update()
+    assert out4["terminated"] == [nid]
+    assert not mock.nodes
+    drained = {b for b, flag in ctl.drained if flag}
+    assert drained == set(ids)
+
+
+def test_partial_join_is_not_idle():
+    """A slice with only some hosts registered is still starting: it
+    must be neither terminated nor double-launched."""
+    host_ids = {}
+    provider, mock = make_provider(
+        num_hosts_by_type={"v5litepod-64": 16},
+        resolve=lambda nid: host_ids.get(nid, []))
+    ctl = StubController()
+    ctl.snap["demand"] = [{"TPU-v5litepod-64-head": 1.0}]
+    asc = make_autoscaler(provider, ctl)
+    (nid,) = asc.update()["launched"]
+
+    ids = [bytes([i]) * 28 for i in range(16)]
+    host_ids[nid] = ids[:7]                   # 7 of 16 joined
+    ctl.snap["demand"] = []
+    ctl.snap["alive_nodes"] = set(ids[:7])
+    out = asc.update()
+    assert out["terminated"] == [] and out["launched"] == []
+    assert nid in provider.non_terminated_nodes()
+
+
+# --------------------------------------------------------------- schema
+def good_config():
+    return {
+        "cluster_name": "c1",
+        "provider": {"type": "gce_tpu", "project": "p",
+                     "zone": "us-central2-b"},
+        "head_node_type": "head",
+        "available_node_types": {
+            "head": {"resources": {"CPU": 8},
+                     "node_config": {"acceleratorType": "v5litepod-1",
+                                     "runtimeVersion": "tpu-vm"},
+                     "max_workers": 0},
+            "v5e_64": {"resources": {"TPU": 64,
+                                     "TPU-v5litepod-64-head": 1},
+                       "node_config": {"acceleratorType": "v5litepod-64",
+                                       "runtimeVersion": "tpu-vm"},
+                       "min_workers": 0, "max_workers": 4},
+        },
+        "setup_commands": ["pip list"],
+        "head_start_commands": ["ray-tpu start --head"],
+        "worker_start_commands": ["ray-tpu start --address={head_ip}:6380"],
+    }
+
+
+@pytest.mark.parametrize("mutate,msg", [
+    (lambda c: c.pop("cluster_name"), "cluster_name"),
+    (lambda c: c.pop("provider"), "provider"),
+    (lambda c: c["provider"].pop("project"), "provider.project"),
+    (lambda c: c.update(head_node_type="nope"), "head_node_type"),
+    (lambda c: c["available_node_types"]["v5e_64"].update(
+        min_workers=9), "min_workers"),
+    (lambda c: c["available_node_types"]["v5e_64"]["resources"].update(
+        TPU=-1), "resources.TPU"),
+    (lambda c: c.update(setup_commands="oops"), "setup_commands"),
+])
+def test_schema_rejects(mutate, msg):
+    cfg = good_config()
+    mutate(cfg)
+    with pytest.raises(ConfigError, match=re.escape(msg)):
+        validate_cluster_config(cfg)
+
+
+def test_schema_fills_defaults_and_node_types():
+    cfg = validate_cluster_config(good_config())
+    assert cfg["available_node_types"]["v5e_64"]["max_workers"] == 4
+    assert cfg["auth"]["ssh_user"] == "ray"
+    types = node_type_configs(cfg)
+    assert [t.name for t in types] == ["v5e_64"]   # head excluded
+    assert types[0].resources["TPU-v5litepod-64-head"] == 1
+
+
+# ------------------------------------------------------------- launcher
+class RecordingRunner(CommandRunner):
+    def __init__(self, log, ip, user):
+        self.log = log
+        self.ip = ip
+        self.user = user
+
+    def run(self, cmd, timeout=600.0):
+        self.log.append((self.ip, cmd))
+        return ""
+
+
+def launcher_pair(mock=None):
+    cfg = validate_cluster_config(good_config())
+    mock = mock or MockTPUApi(num_hosts_by_type={"v5litepod-1": 1,
+                                                 "v5litepod-64": 16})
+    provider, _ = make_provider(mock=mock, cluster="c1")
+    log = []
+    launcher = ClusterLauncher(
+        cfg, provider=provider,
+        runner_factory=lambda ip, user: RecordingRunner(log, ip, user))
+    return launcher, mock, log
+
+
+def test_up_creates_head_bootstraps_and_is_idempotent():
+    launcher, mock, log = launcher_pair()
+    out = launcher.up()
+    assert out["created"] is True
+    assert out["head_ip"] == "34.1.0.1"
+    # head slice exists with the head node type label
+    assert len(mock.nodes) == 1
+    (node,) = mock.nodes.values()
+    assert node["labels"][LABEL_NODE_TYPE] == "head"
+    # setup + head start ran on the head VM, in order
+    cmds = [c for ip, c in log if ip == "34.1.0.1"]
+    assert cmds == ["pip list", "ray-tpu start --head"]
+
+    # second up reuses the head (no new slice)
+    log.clear()
+    out2 = launcher.up()
+    assert out2["created"] is False
+    assert len(mock.nodes) == 1
+    assert [c for _, c in log] == ["pip list", "ray-tpu start --head"]
+
+
+def test_down_terminates_workers_then_head():
+    launcher, mock, _ = launcher_pair()
+    launcher.up()
+    launcher.provider.create_node("v5e_64", {})
+    assert len(mock.nodes) == 2
+    gone = launcher.down()
+    assert len(gone) == 2
+    assert mock.nodes == {}
+    # worker slice deleted before the head
+    deletes = [u for m, u in mock.calls if m == "DELETE"]
+    assert "v5e_64" in deletes[0] and "head" in deletes[1]
+
+
+def test_attach_command_targets_head_ip():
+    launcher, _, _ = launcher_pair()
+    launcher.up()
+    cmd = launcher.attach_command()
+    assert cmd[0] == "ssh" and cmd[-1] == "ray@34.1.0.1"
+
+
+def test_attach_without_head_raises():
+    launcher, _, _ = launcher_pair()
+    with pytest.raises(RuntimeError, match="no head"):
+        launcher.attach_command()
+
+
+# ------------------------------------------------------ CLI round trip
+def test_cli_up_attach_down_round_trip(monkeypatch, tmp_path, capsys):
+    """`ray-tpu up/attach/down <yaml>` end to end with the TPU API and
+    SSH both mocked — the full operator path."""
+    import json
+    import sys as _sys
+
+    import yaml as _yaml
+
+    from ray_tpu.autoscaler import gce, launcher as L
+    from ray_tpu.scripts import cli
+
+    mock = MockTPUApi(num_hosts_by_type={"v5litepod-1": 1})
+    orig_init = gce.TPUApiClient.__init__
+
+    def patched_init(self, project, zone, request_fn=None, token_fn=None):
+        orig_init(self, project, zone, request_fn=mock,
+                  token_fn=lambda: "test-token")
+
+    monkeypatch.setattr(gce.TPUApiClient, "__init__", patched_init)
+    log = []
+    monkeypatch.setattr(
+        L, "SSHCommandRunner",
+        lambda ip, user, key=None: RecordingRunner(log, ip, user))
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text(_yaml.safe_dump(good_config()))
+
+    monkeypatch.setattr(_sys, "argv",
+                        ["ray-tpu", "up", str(cfg_path), "-y"])
+    cli.main()
+    up_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert up_out["created"] is True
+    assert up_out["head_ip"] == "34.1.0.1"
+    assert len(mock.nodes) == 1
+    assert ("34.1.0.1", "ray-tpu start --head") in log
+
+    monkeypatch.setattr(
+        _sys, "argv",
+        ["ray-tpu", "attach", str(cfg_path), "--dry-run"])
+    cli.main()
+    assert "ray@34.1.0.1" in capsys.readouterr().out
+
+    monkeypatch.setattr(_sys, "argv",
+                        ["ray-tpu", "down", str(cfg_path), "-y"])
+    cli.main()
+    down_out = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(down_out["terminated"]) == 1
+    assert mock.nodes == {}
