@@ -94,6 +94,18 @@ class Runtime:
         # (falling back to the controller would starve its queue behind
         # lease-held CPUs and trigger reclaim thrash)
         self._direct_backlog: Deque[TaskSpec] = collections.deque()
+        #: memory bound on locally-queued direct tasks — NOT a
+        #: throughput valve (the controller path is slower per task).
+        #: Both a count cap and a byte cap: specs carry the full inline
+        #: args blob, so count alone bounds nothing when tasks pass
+        #: megabyte args by value.
+        self._direct_backlog_cap = int(os.environ.get(
+            "RAY_TPU_DIRECT_BACKLOG_CAP", "200000"))
+        self._direct_backlog_bytes_cap = int(os.environ.get(
+            "RAY_TPU_DIRECT_BACKLOG_BYTES_CAP", str(1 << 31)))  # 2 GiB
+        self._direct_backlog_bytes = 0
+        #: a LEASE_WORKERS request is outstanding (initial or top-up)
+        self._lease_req_inflight = False
 
         # object_id(bytes) -> result meta {"inline"|"node_id"/"size"|"error"}
         self._meta: Dict[bytes, dict] = {}
@@ -235,6 +247,25 @@ class Runtime:
             return
         self._out_q.put((target, mtype, payload))
 
+    def _send_many(self, msgs: List[Tuple[Optional[bytes], bytes, Any]]
+                   ) -> None:
+        """Enqueue several (target, mtype, payload) messages with ONE
+        queue handoff — each put can cost a flusher-thread wakeup.
+        Same-process targets still short-circuit."""
+        rest = []
+        me = self.worker_id.binary()
+        for target, mtype, payload in msgs:
+            if target == me:
+                try:
+                    self._on_message(mtype, payload)
+                except Exception:
+                    logger.exception("%s: error in local direct %s",
+                                     self.kind, mtype)
+            else:
+                rest.append((target, mtype, payload))
+        if rest:
+            self._out_q.put(rest)
+
     def _sock_send(self, mtype: bytes, blob: bytes) -> None:
         with self._send_lock:
             self.sock.send_multipart([mtype, blob])
@@ -295,13 +326,15 @@ class Runtime:
                 if it is None:
                     stop = True
                     break
-                target, mtype, payload = it
-                if target is None and mtype == P.SUBMIT_TASK:
-                    specs.append(payload["spec"])
-                    continue
-                if target is None:
-                    close_specs()
-                boxes.setdefault(target, []).append((mtype, payload))
+                # a list item is a multi-message put (_send_many)
+                for target, mtype, payload in (
+                        it if isinstance(it, list) else (it,)):
+                    if target is None and mtype == P.SUBMIT_TASK:
+                        specs.append(payload["spec"])
+                        continue
+                    if target is None:
+                        close_specs()
+                    boxes.setdefault(target, []).append((mtype, payload))
             close_specs()
             for target, msgs in boxes.items():
                 self._flush_box(target, msgs)
@@ -480,6 +513,7 @@ class Runtime:
             self._lease_inflight.clear()
             self._direct_tids.clear()
             self._direct_backlog.clear()  # inflight resubmit covers them
+            self._direct_backlog_bytes = 0
             self._lease_state = "none"
             self._lease_backoff_until = time.monotonic() + 2.0
         self._send(P.REGISTER, self._register_msg())
@@ -980,7 +1014,16 @@ class Runtime:
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         spec.owner = self.worker_id
-        refs = [ObjectRef(oid, self.worker_id) for oid in spec.return_ids()]
+        # register return refs against OUR counter directly — the
+        # ObjectRef ctor's context lookup (global-worker resolve per
+        # ref) is measurable on the fan-out hot path
+        rc = self.reference_counter
+        refs = []
+        for oid in spec.return_ids():
+            r = ObjectRef(oid, self.worker_id, _register=False)
+            rc.add_local_reference(r)
+            r._registered = True
+            refs.append(r)
         for _, oid in spec.arg_refs:
             self.reference_counter.add_submitted_task_ref(oid)
         # deltas ride the threshold/periodic flush — flushing per submit
@@ -1034,17 +1077,32 @@ class Runtime:
                     self._lease_state = "pending"
                     self._request_leases()
                 return False
+            if self._lease_state == "pending":
+                # grant in flight: commit the burst to the direct path
+                # now — spilling to the controller while every CPU is
+                # about to be lease-held just feeds the starvation
+                # reclaimer (revoke/grant thrash measured at ~2.4x the
+                # per-task cost of waiting for the grant)
+                return self._backlog_locked(spec)
             if self._lease_state != "ready" or not self._lease_pool:
                 return False
             w = self._pick_leased_worker_locked()
             if w is None:
-                # saturated: commit to the direct path anyway — queue
-                # locally and drain on completions (bounded backlog so a
-                # monster burst still spills to the controller)
-                if len(self._direct_backlog) < 4096:
-                    self._direct_backlog.append(spec)
-                    return True
-                return False
+                # saturated: queue locally and drain on completions.
+                # The caps bound driver memory, not throughput — the
+                # controller path dispatches to the same workers but
+                # costs ~3 extra controller-loop hops per task, so it
+                # only wins once the backlog is pathological. A growing
+                # backlog also re-requests leases sized to demand so a
+                # big cluster's idle workers are drawn into the pool
+                # (the controller parks what it can't grant yet).
+                took = self._backlog_locked(spec)
+                if took and not self._lease_req_inflight and \
+                        len(self._direct_backlog) > \
+                        len(self._lease_pool) * \
+                        self.config.dispatch_pipeline_depth:
+                    self._request_leases(self._lease_want_locked())
+                return took
             self._direct_tids[spec.task_id.binary()] = w
         self._send_direct(w, P.TASK_DISPATCH,
                           {"spec": spec, "driver_leased": True})
@@ -1061,17 +1119,76 @@ class Runtime:
             self._lease_inflight[best] = best_n + 1
         return best
 
+    def _backlog_locked(self, spec: TaskSpec) -> bool:
+        """Caller holds _lease_lock: queue a spec for the direct path if
+        the count/byte caps allow. Returns False to spill to the
+        controller instead."""
+        if len(self._direct_backlog) >= self._direct_backlog_cap or \
+                self._direct_backlog_bytes >= \
+                self._direct_backlog_bytes_cap:
+            return False
+        self._direct_backlog.append(spec)
+        self._direct_backlog_bytes += len(spec.args_blob) + 512
+        return True
+
+    def _pop_backlog_locked(self) -> TaskSpec:
+        spec = self._direct_backlog.popleft()
+        self._direct_backlog_bytes -= len(spec.args_blob) + 512
+        if not self._direct_backlog:
+            self._direct_backlog_bytes = 0
+        return spec
+
+    def _lease_want_locked(self) -> int:
+        """How many leases demand justifies: enough workers to cover the
+        backlog at the configured pipeline depth, within sane bounds."""
+        depth = max(1, self.config.dispatch_pipeline_depth)
+        want = (len(self._direct_backlog) + depth - 1) // depth
+        return max(4, min(1024, want))
+
+    def _drain_backlog_locked(self) -> List[Tuple[bytes, TaskSpec]]:
+        """Caller holds _lease_lock: assign backlogged specs to leased
+        workers up to the pipeline depth; returns the dispatches."""
+        sends = []
+        while self._direct_backlog and self._lease_pool:
+            w = self._pick_leased_worker_locked()
+            if w is None:
+                break
+            spec = self._pop_backlog_locked()
+            self._direct_tids[spec.task_id.binary()] = w
+            sends.append((w, spec))
+        return sends
+
     def _request_leases(self, count: int = 4) -> None:
+        self._lease_req_inflight = True
+
         def on_reply(reply):
             workers = (reply or {}).get("workers") or []
+            spill: List[TaskSpec] = []
+            sends: List[Tuple[bytes, TaskSpec]] = []
             with self._lease_lock:
+                self._lease_req_inflight = False
                 if workers:
                     self._lease_pool.extend(workers)
                     self._lease_state = "ready"
+                    # tasks backlogged while this request was in
+                    # flight: dispatch onto the fresh capacity NOW —
+                    # with no direct tasks inflight there are no
+                    # completions to drain them otherwise
+                    sends = self._drain_backlog_locked()
                 else:
-                    # nothing grantable right now; retry later
+                    # nothing grantable right now; retry later. Tasks
+                    # optimistically backlogged while the request was
+                    # in flight must not starve — route them through
+                    # the controller after all.
                     self._lease_state = "none"
                     self._lease_backoff_until = time.monotonic() + 2.0
+                    while self._direct_backlog:
+                        spill.append(self._pop_backlog_locked())
+            for w, spec in sends:
+                self._send_direct(w, P.TASK_DISPATCH,
+                                  {"spec": spec, "driver_leased": True})
+            for spec in spill:
+                self._send(P.SUBMIT_TASK, {"spec": spec})
 
         rid = self.replies.new_request(callback=on_reply)
         self._send(P.LEASE_WORKERS, {"count": count, "rid": rid})
@@ -1079,18 +1196,11 @@ class Runtime:
     def _on_lease_grant(self, workers: List[bytes]) -> None:
         """Deferred grant arrived (parked request): extend the pool and
         drain backlog onto the new capacity."""
-        sends = []
         with self._lease_lock:
             self._lease_pool.extend(workers)
             if self._lease_pool:
                 self._lease_state = "ready"
-            while self._direct_backlog:
-                w = self._pick_leased_worker_locked()
-                if w is None:
-                    break
-                spec = self._direct_backlog.popleft()
-                self._direct_tids[spec.task_id.binary()] = w
-                sends.append((w, spec))
+            sends = self._drain_backlog_locked()
         for w, spec in sends:
             self._send_direct(w, P.TASK_DISPATCH,
                               {"spec": spec, "driver_leased": True})
@@ -1108,7 +1218,7 @@ class Runtime:
             if self._direct_backlog and self._lease_pool:
                 nxt = self._pick_leased_worker_locked()
                 if nxt is not None:
-                    spec = self._direct_backlog.popleft()
+                    spec = self._pop_backlog_locked()
                     self._direct_tids[spec.task_id.binary()] = nxt
                     send = (nxt, spec)
         if send is not None:
@@ -1141,7 +1251,7 @@ class Runtime:
                 self._lease_backoff_until = time.monotonic() + 1.0
                 # no leases left: the local backlog would never drain
                 while self._direct_backlog:
-                    resubmit.append(self._direct_backlog.popleft())
+                    resubmit.append(self._pop_backlog_locked())
         with self._inflight_lock:
             for tid in lost:
                 spec = self._inflight_specs.get(tid)
@@ -1158,6 +1268,7 @@ class Runtime:
             self._direct_tids.clear()
             backlog = list(self._direct_backlog)
             self._direct_backlog.clear()
+            self._direct_backlog_bytes = 0
         for spec in backlog:
             self._send(P.SUBMIT_TASK, {"spec": spec})
         if pool:
@@ -1362,6 +1473,8 @@ class Runtime:
                     if s.task_id.binary() == tid_b:
                         backlogged = s
                         del self._direct_backlog[i]
+                        self._direct_backlog_bytes -= \
+                            len(s.args_blob) + 512
                         break
         if backlogged is not None:
             from ray_tpu.exceptions import TaskCancelledError

@@ -37,6 +37,14 @@ class FunctionDescriptor:
     def __repr__(self):
         return f"Fn({self.module}.{self.qualname})"
 
+    def __reduce__(self):
+        # positional wire form: dataclass pickling writes every field
+        # NAME per message; specs ride the per-task hot path, so the
+        # names are pure overhead (reference keeps specs in protobuf
+        # for the same reason)
+        return (FunctionDescriptor,
+                (self.module, self.qualname, self.function_hash))
+
 
 @dataclass
 class SchedulingStrategy:
@@ -50,6 +58,19 @@ class SchedulingStrategy:
     placement_group_capture_child_tasks: bool = False
     hard_labels: Dict[str, List[str]] = field(default_factory=dict)
     soft_labels: Dict[str, List[str]] = field(default_factory=dict)
+
+    def __reduce__(self):
+        # DEFAULT strategy (the overwhelmingly common case) pickles as a
+        # zero-arg call; everything else rides positionally
+        if self.kind == "DEFAULT" and self.node_id is None \
+                and not self.hard_labels and not self.soft_labels \
+                and self.placement_group_id is None:
+            return (SchedulingStrategy, ())
+        return (SchedulingStrategy, (
+            self.kind, self.node_id, self.soft, self.placement_group_id,
+            self.placement_group_bundle_index,
+            self.placement_group_capture_child_tasks,
+            self.hard_labels, self.soft_labels))
 
 
 @dataclass
@@ -99,6 +120,27 @@ class TaskSpec:
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i + 1)
                 for i in range(self.num_returns)]
+
+    def __reduce__(self):
+        # Positional wire form (same rationale as FunctionDescriptor):
+        # a spec crosses at least two process boundaries per task, and
+        # dataclass pickling ships all ~25 field names each time —
+        # ~3x the bytes and ~3x the CPU of this tuple.
+        return (_spec_from_wire, (
+            self.task_id, self.job_id, self.function, self.args_blob,
+            self.arg_refs, self.num_returns, self.arg_metas,
+            self.resources, self.scheduling_strategy, self.max_retries,
+            self.retry_exceptions, self.owner, self.name,
+            self.runtime_env, self.actor_id, self.sequence_number,
+            self.concurrency_group, self.is_actor_creation,
+            self.hold_resources, self.max_restarts,
+            self.max_task_retries, self.max_concurrency,
+            self.max_pending_calls, self.actor_name, self.namespace,
+            self.is_async_actor))
+
+
+def _spec_from_wire(*fields) -> "TaskSpec":
+    return TaskSpec(*fields)
 
 
 @dataclass

@@ -58,6 +58,10 @@ class WorkerExecutor:
         #: thread would corrupt unrelated serial state)
         self._current_tid: Optional[bytes] = None
         self._main_ident = threading.get_ident()
+        #: learned wire bytes of the canonical ((), {}) args blob —
+        #: lets _resolve_args skip deserializing no-arg fan-out calls
+        self._empty_args_blob: Optional[bytes] = None
+        self._rm = None  # cached runtime metrics handle
         self._block_depth = 0  # main thread blocked in ray.get inside task
         #: serializes the pump thread's dispatch-vs-blocked decision against
         #: on_block's queue drain (without it a dispatch passing the depth
@@ -267,8 +271,15 @@ class WorkerExecutor:
             dep_values.append(value)
         args, kwargs = (), {}
         if spec.args_blob:
+            # no-arg fan-out calls all ship the owner's one cached empty
+            # blob (runtime.serialize_args) — skip the parse entirely
+            blob = spec.args_blob
+            if blob == self._empty_args_blob:
+                return (), {}
             (args, kwargs), _ = self.runtime.serialization.deserialize_from_view(
-                memoryview(spec.args_blob))
+                memoryview(blob))
+            if not args and not kwargs and not spec.arg_refs:
+                self._empty_args_blob = blob
         args = tuple(dep_values[a.index] if isinstance(a, _ArgPlaceholder) else a
                      for a in args)
         kwargs = {k: dep_values[v.index] if isinstance(v, _ArgPlaceholder) else v
@@ -362,8 +373,11 @@ class WorkerExecutor:
         may_retry = (error_blob is not None and retriable
                      and spec.max_retries != 0)
         direct_ok = owner_b is not None and not may_retry
+        result_msg = None
         if direct_ok:
-            self.runtime._send_direct(owner_b, P.TASK_RESULT, {
+            # shallow-copy the metas: TASK_DONE carries the same list,
+            # and a same-process owner stores these dicts directly
+            result_msg = (owner_b, P.TASK_RESULT, {
                 "task_id": tid_b,
                 "results": [dict(r, error=error_blob) for r in results],
                 "error": error_blob,
@@ -392,13 +406,24 @@ class WorkerExecutor:
             # direct actor calls have no controller-side PendingTask; ship
             # the spec so the controller can re-route the retry
             done["spec"] = spec
-        self.runtime._send(P.TASK_DONE, done)
+        # one queue handoff for both messages: each _out_q put can wake
+        # the flusher thread (a futex round-trip per task adds up)
+        done_msg = (None, P.TASK_DONE, done)
+        if result_msg is not None:
+            self.runtime._send_many([result_msg, done_msg])
+        else:
+            self.runtime._send_many([done_msg])
         try:
-            from ray_tpu.core.metric_defs import runtime_metrics
-            rm = runtime_metrics()
-            rm.tasks_finished.inc(
-                tags={"outcome": "error" if error_blob else "ok"})
-            rm.task_exec_seconds.observe(time.time() - start)
+            rm = self._rm
+            if rm is None:
+                from ray_tpu.core.metric_defs import runtime_metrics
+                base = runtime_metrics()
+                rm = self._rm = (
+                    base.tasks_finished.bound({"outcome": "ok"}),
+                    base.tasks_finished.bound({"outcome": "error"}),
+                    base.task_exec_seconds.bound())
+            rm[1 if error_blob else 0].inc()
+            rm[2].observe(time.time() - start)
         except Exception:
             pass
         self.runtime.record_span(
@@ -515,6 +540,23 @@ def main() -> None:
     set_global_worker(runtime)
     runtime.register()
     executor = WorkerExecutor(runtime)
+    profile_out = os.environ.get("RAY_TPU_PROFILE_WORKER")
+    if profile_out:
+        # drop a cProfile of the execution loop at exit (debugging aid:
+        # per-task overhead hunting; reference: `ray stack`/py-spy fill
+        # this role). SIGTERM becomes a clean loop stop so the stats
+        # actually flush.
+        import cProfile
+        import signal as _sig
+        _sig.signal(_sig.SIGTERM,
+                    lambda *_: setattr(executor, "_stop", True))
+        pr = cProfile.Profile()
+        try:
+            pr.runcall(executor.run_loop)
+        finally:
+            pr.dump_stats(f"{profile_out}.{os.getpid()}")
+            runtime.shutdown()
+        return
     try:
         executor.run_loop()
     finally:

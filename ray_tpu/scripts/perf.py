@@ -18,10 +18,75 @@ import numpy as np
 BASELINES = {
     "tasks_sync_per_s": 1046.0,
     "tasks_async_per_s": 8159.0,
+    "multi_client_tasks_async_per_s": 26697.0,
     "actor_calls_sync_per_s": 2138.0,
     "actor_calls_async_per_s": 9183.0,
     "put_gib_per_s": 19.5,
+    "multi_client_put_gib_per_s": 33.6,
 }
+
+
+_MULTI_CLIENT_SRC = """
+import sys, time, os
+sys.path.insert(0, {repo!r})
+import ray_tpu
+ray_tpu.init(address={session!r}, log_to_driver=False)
+mode = {mode!r}
+if mode == "tasks":
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+    ray_tpu.get([nop.remote() for _ in range(100)])
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range({n})])
+    print("RESULT", {n} / (time.perf_counter() - t0))
+else:
+    import numpy as np
+    data = np.random.default_rng(0).integers(
+        0, 255, size=({mb} << 20,), dtype=np.uint8)
+    ray_tpu.put(data)
+    t0 = time.perf_counter()
+    for _ in range({iters}):
+        ray_tpu.put(data)
+    print("RESULT", ({mb} * {iters} / 1024.0) / (time.perf_counter() - t0))
+ray_tpu.shutdown()
+"""
+
+
+def _run_clients(ray_tpu, mode: str, num_clients: int, **fmt) -> float:
+    """Aggregate throughput of N driver processes attached to this
+    cluster (reference: multi_client_* phases of ray_perf.py run 4+
+    drivers against one cluster)."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from ray_tpu.core.global_state import global_worker
+    src = _MULTI_CLIENT_SRC.format(
+        repo=repo, session=global_worker().session_dir,
+        mode=mode, **fmt)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", src], stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "RAY_TPU_JAX_PLATFORM": "cpu"})
+        for _ in range(num_clients)]
+    total = 0.0
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        if p.returncode != 0:
+            raise RuntimeError(f"client failed rc={p.returncode}")
+        vals = [ln.split()[1] for ln in out.splitlines()
+                if ln.startswith("RESULT ")]
+        total += float(vals[-1])
+    return total
+
+
+def bench_multi_client_tasks(ray_tpu, clients=4, n=1500) -> float:
+    return _run_clients(ray_tpu, "tasks", clients, n=n, mb=0, iters=0)
+
+
+def bench_multi_client_put(ray_tpu, clients=4, mb=32, iters=6) -> float:
+    return _run_clients(ray_tpu, "put", clients, n=0, mb=mb, iters=iters)
 
 
 def bench_tasks_sync(ray_tpu, n=200) -> float:
@@ -120,10 +185,12 @@ def main() -> Dict[str, float]:
     for name, fn in (
             ("tasks_sync_per_s", bench_tasks_sync),
             ("tasks_async_per_s", bench_tasks_async),
+            ("multi_client_tasks_async_per_s", bench_multi_client_tasks),
             ("actor_calls_sync_per_s", bench_actor_sync),
             ("actor_calls_async_per_s", bench_actor_async),
             ("put_gib_per_s", bench_put),
             ("put_bytes_gib_per_s", bench_put_bytes),
+            ("multi_client_put_gib_per_s", bench_multi_client_put),
     ):
         results[name] = fn(ray_tpu)
         settle()

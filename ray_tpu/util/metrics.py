@@ -69,6 +69,12 @@ class Counter(Metric):
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
 
+    def bound(self, tags: Optional[Dict[str, str]] = None
+              ) -> "_BoundCounter":
+        """Pre-resolve the label key once; the returned handle's inc()
+        skips tag merging/sorting — for per-task hot paths."""
+        return _BoundCounter(self, _label_key(self._merged(tags)))
+
     def _samples(self) -> List[str]:
         out = [f"# TYPE {self._name} counter"]
         with self._lock:
@@ -111,6 +117,40 @@ class Histogram(Metric):
                 key, [0] * (len(self._bounds) + 1))
             counts[bisect.bisect_left(self._bounds, value)] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def bound(self, tags: Optional[Dict[str, str]] = None
+              ) -> "_BoundHistogram":
+        """Pre-resolved-label handle (see Counter.bound)."""
+        return _BoundHistogram(self, _label_key(self._merged(tags)))
+
+
+class _BoundCounter:
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, metric: Counter, key: Tuple):
+        self._m = metric
+        self._key = key
+
+    def inc(self, value: float = 1.0) -> None:
+        m = self._m
+        with m._lock:
+            m._values[self._key] = m._values.get(self._key, 0.0) + value
+
+
+class _BoundHistogram:
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, metric: "Histogram", key: Tuple):
+        self._m = metric
+        self._key = key
+
+    def observe(self, value: float) -> None:
+        m = self._m
+        with m._lock:
+            counts = m._counts.setdefault(
+                self._key, [0] * (len(m._bounds) + 1))
+            counts[bisect.bisect_left(m._bounds, value)] += 1
+            m._sums[self._key] = m._sums.get(self._key, 0.0) + value
 
     def _samples(self) -> List[str]:
         out = [f"# TYPE {self._name} histogram"]

@@ -114,3 +114,53 @@ def test_cluster_resources(ray_start_shared):
     assert res.get("CPU") == 4.0
     nodes = ray_tpu.nodes()
     assert len(nodes) == 1 and nodes[0]["alive"]
+
+
+def test_main_module_class_round_trip():
+    """Classes defined in the driver's __main__ must survive both
+    directions (arg and return). Regression for the C-pickle fast path:
+    plain pickle encodes __main__ globals BY REFERENCE without raising,
+    which a worker can't resolve — serialization must detect that and
+    fall back to cloudpickle's by-value treatment."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {repo!r})
+        from dataclasses import dataclass
+        import ray_tpu
+
+        @dataclass
+        class Point:
+            x: int
+            y: int
+
+        @ray_tpu.remote
+        def bump(p):
+            return Point(p.x + 1, p.y + 1)
+
+        ray_tpu.init(num_cpus=2, _num_initial_workers=1)
+        out = ray_tpu.get(bump.remote(Point(1, 2)), timeout=120)
+        assert (out.x, out.y) == (2, 3), out
+        # __main__ function object as an arg too
+        def double(v):
+            return v * 2
+
+        @ray_tpu.remote
+        def apply(fn, v):
+            return fn(v)
+
+        assert ray_tpu.get(apply.remote(double, 21), timeout=120) == 42
+        ray_tpu.shutdown()
+        print("MAIN-OK")
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=240,
+        env={**os.environ, "RAY_TPU_JAX_PLATFORM": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "MAIN-OK" in proc.stdout
