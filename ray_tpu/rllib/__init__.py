@@ -7,6 +7,7 @@ algorithm; PG the minimal baseline.
 
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.connectors import (
     ClipObs, Connector, ConnectorPipeline, FlattenObs, FrameStack,
     NormalizeObs)
@@ -24,6 +25,8 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
     "Algorithm",
     "AlgorithmConfig",
     "BC",

@@ -1,0 +1,113 @@
+"""APPO: asynchronous PPO — PPO's clipped surrogate on IMPALA's
+decoupled sampling with V-trace off-policy correction.
+
+Reference: ``rllib/algorithms/appo/appo.py`` (APPOConfig: vtrace=True,
+clip_param, use_kl_loss/kl_coeff/kl_target, target network updated
+every ``target_update_frequency``) and the loss in
+``appo/appo_learner.py`` + ``appo/torch/appo_torch_learner.py``
+(surrogate clip over V-trace pg advantages, value loss against vs
+targets, entropy bonus, KL regularizer toward the behaviour policy).
+TPU-native shape: the V-trace recursion and the clipped update fuse
+into one jitted XLA program (see impala.py); staleness between the
+learner policy and the sampling policy is the async part — weights
+broadcast every ``broadcast_interval`` iterations and the importance
+ratios correct the drift.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace_returns
+
+
+def appo_loss(fwd_out: Dict[str, jnp.ndarray],
+              batch: Dict[str, jnp.ndarray], *,
+              rollout_len: int = 40,
+              gamma: float = 0.99,
+              clip_param: float = 0.2,
+              vf_loss_coeff: float = 0.5,
+              entropy_coeff: float = 0.01,
+              kl_coeff: float = 0.0,
+              rho_clip: float = 1.0,
+              c_clip: float = 1.0):
+    T = rollout_len
+    logits = fwd_out["action_logits"]          # [T*B, A] time-major
+    values_flat = fwd_out["vf_preds"]          # [T*B]
+    B = logits.shape[0] // T
+
+    logp_all = jax.nn.log_softmax(logits)
+    logp_act = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+
+    tb = lambda x: x.reshape(T, B)  # noqa: E731
+    target_logp = tb(logp_act)
+    behavior_logp = tb(batch["behavior_logp"])
+    values = tb(values_flat)
+    rewards = tb(batch["rewards"])
+    dones = tb(batch["dones"])
+    bootstrap = batch["bootstrap_value"]       # [B]
+
+    vs, pg_adv = vtrace_returns(
+        target_logp, behavior_logp, rewards, values, bootstrap, dones,
+        gamma, rho_clip, c_clip)
+    adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+
+    # PPO clip on the off-policy ratio (reference: appo_learner computes
+    # logp_ratio against the BEHAVIOUR policy when vtrace is on)
+    ratio = jnp.exp(target_logp - behavior_logp)
+    surr1 = ratio * adv
+    surr2 = jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv
+    policy_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+
+    vf_loss = 0.5 * jnp.mean(jnp.square(vs - values))
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    # KL(behaviour ‖ target) estimator over sampled actions: restrains
+    # the update from straying far from the sampling policy
+    mean_kl = jnp.mean(behavior_logp - target_logp)
+
+    total = policy_loss + vf_loss_coeff * vf_loss \
+        - entropy_coeff * entropy + kl_coeff * mean_kl
+    metrics = {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "mean_kl": mean_kl,
+        "mean_rho": jnp.mean(ratio),
+    }
+    return total, metrics
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.clip_param: float = 0.2
+        self.use_kl_loss: bool = False
+        self.kl_coeff: float = 0.2
+        self.lr = 5e-4
+        #: APPO default broadcast is less frequent than IMPALA's — the
+        #: clip + vtrace tolerate staler batches (reference default
+        #: target_update_frequency=1 with async sampling)
+        self.broadcast_interval: int = 2
+
+
+class APPO(IMPALA):
+    config_cls = APPOConfig
+
+    def loss_fn(self):
+        return appo_loss
+
+    def loss_config(self) -> Dict[str, Any]:
+        c = self.config
+        return {
+            "rollout_len": c.rollout_len,
+            "gamma": c.gamma,
+            "clip_param": c.clip_param,
+            "vf_loss_coeff": c.vf_loss_coeff,
+            "entropy_coeff": c.entropy_coeff,
+            "kl_coeff": c.kl_coeff if c.use_kl_loss else 0.0,
+            "rho_clip": c.vtrace_rho_clip,
+            "c_clip": c.vtrace_c_clip,
+        }

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -87,6 +87,43 @@ def bench_multi_client_tasks(ray_tpu, clients=4, n=1500) -> float:
 
 def bench_multi_client_put(ray_tpu, clients=4, mb=32, iters=6) -> float:
     return _run_clients(ray_tpu, "put", clients, n=0, mb=mb, iters=iters)
+
+
+def bench_rllib_env_steps(ray_tpu, iters=3) -> Optional[float]:
+    """PPO sampling+training throughput in env-steps/s. Pipeline shape
+    follows the reference's Atari tuned example
+    (``rllib/tuned_examples/ppo/atari-ppo.yaml:1-35``: 10 workers x 5
+    envs, train_batch 5000) with the worker count scaled to this host's
+    CPUs and CartPole standing in for ALE (not in the image). The
+    reference publishes no steps/s number for it, so vs_baseline is
+    null — the JSON records the trend across rounds."""
+    try:
+        import gymnasium  # noqa: F401
+    except ImportError:
+        return None
+    from ray_tpu.rllib import PPOConfig
+    cpus = int(ray_tpu.cluster_resources().get("CPU", 0))
+    if cpus < 3:
+        # each runner is a 1-CPU actor; with <2 schedulable runners the
+        # pipeline shape is meaningless (and actors would never place)
+        return None
+    n_runners = min(10, cpus - 1)
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=n_runners,
+                           num_envs_per_env_runner=5)
+              .training(train_batch_size=5000, minibatch_size=500,
+                        num_epochs=1, lr=3e-4)
+              .debugging(seed=0))
+    algo = config.build()
+    try:
+        steps0 = algo.train()["num_env_steps_sampled_lifetime"]
+        t0 = time.perf_counter()   # first train() warmed jit + workers
+        for _ in range(iters):
+            steps = algo.train()["num_env_steps_sampled_lifetime"]
+        return (steps - steps0) / (time.perf_counter() - t0)
+    finally:
+        algo.cleanup()
 
 
 def bench_tasks_sync(ray_tpu, n=200) -> float:
@@ -191,8 +228,12 @@ def main() -> Dict[str, float]:
             ("put_gib_per_s", bench_put),
             ("put_bytes_gib_per_s", bench_put_bytes),
             ("multi_client_put_gib_per_s", bench_multi_client_put),
+            ("rllib_env_steps_per_s", bench_rllib_env_steps),
     ):
-        results[name] = fn(ray_tpu)
+        out = fn(ray_tpu)
+        if out is None:
+            continue
+        results[name] = out
         settle()
     for name, value in results.items():
         base = BASELINES.get(name)
