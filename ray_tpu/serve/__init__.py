@@ -11,11 +11,13 @@ from ray_tpu.serve.api import (
     get_deployment_handle,
     grpc_proxy_address,
     proxy_address,
+    proxy_addresses,
     run,
     shutdown,
     start,
     status,
 )
+from ray_tpu.serve.http import Request, Response, ingress
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import (
     Application, AutoscalingConfig, Deployment, deployment)
@@ -37,7 +39,11 @@ __all__ = [
     "get_deployment_handle",
     "get_multiplexed_model_id",
     "grpc_proxy_address",
+    "ingress",
     "proxy_address",
+    "proxy_addresses",
+    "Request",
+    "Response",
     "run",
     "shutdown",
     "start",

@@ -105,6 +105,29 @@ class ServeController:
         with self._lock:
             return dict(self._routes)
 
+    def get_routes_info(self) -> Dict[str, Dict[str, Any]]:
+        """Route table with per-deployment HTTP dispatch flags: the
+        proxy picks unary / generator-streaming / ASGI per route
+        (reference: proxy asks the controller for app configs)."""
+        import inspect
+        with self._lock:
+            out = {}
+            for prefix, name in self._routes.items():
+                info = self._deployments.get(name)
+                asgi = streaming = False
+                if info is not None:
+                    fc = info.deployment.func_or_class
+                    asgi = bool(getattr(fc, "__serve_asgi__", False))
+                    target = fc if not isinstance(fc, type) else \
+                        getattr(fc, "__call__", None)
+                    streaming = bool(
+                        target is not None and (
+                            inspect.isgeneratorfunction(target)
+                            or inspect.isasyncgenfunction(target)))
+                out[prefix] = {"name": name, "asgi": asgi,
+                               "streaming": streaming}
+            return out
+
     def get_app_ingress(self, app_name: str) -> Optional[str]:
         with self._lock:
             return self._apps.get(app_name)

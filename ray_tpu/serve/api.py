@@ -18,6 +18,7 @@ from ray_tpu.serve._private.controller import (
     CONTROLLER_NAME, ServeController)
 
 _proxy_actor = None
+_proxy_actors: Dict[str, Any] = {}   # node id hex -> proxy actor
 _grpc_proxy_actor = None
 
 
@@ -79,18 +80,42 @@ def start(http_options: Optional[Dict[str, Any]] = None,
     """Start the ingress proxies (reference ``serve.start``). HTTP
     starts when ``http_options`` is given or when neither option is
     given (legacy default); gRPC starts only when ``grpc_options`` is
-    given — a gRPC-only start must not grab the default HTTP port."""
+    given — a gRPC-only start must not grab the default HTTP port.
+
+    One HTTP proxy runs on EVERY alive node (reference: proxy-per-node
+    behind ProxyRouter) unless ``http_options={"location": "HeadOnly"}``.
+    On a real pod each node binds the same configured port; when several
+    nodes share one host (tests), secondary proxies take ephemeral
+    ports — ``proxy_addresses()`` lists them all."""
     global _proxy_actor, _grpc_proxy_actor
     want_http = http_options is not None or grpc_options is None
     http_options = http_options or {}
     controller = _get_or_create_controller()
     if want_http and _proxy_actor is None:
         from ray_tpu.serve._private.proxy import HTTPProxy
-        cls = ray_tpu.remote(num_cpus=0.5,
-                             max_concurrency=16)(HTTPProxy)
-        _proxy_actor = cls.remote(
-            controller, http_options.get("host", "127.0.0.1"),
-            http_options.get("port", 8000))
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        host = http_options.get("host", "127.0.0.1")
+        port = http_options.get("port", 8000)
+        location = http_options.get("location", "EveryNode")
+        nodes = [n for n in ray_tpu.nodes() if n.get("alive")]
+        local_hex = ray_tpu.get_runtime_context().get_node_id()
+        if location != "EveryNode":
+            nodes = [n for n in nodes if n["node_id"] == local_hex]
+        for n in nodes or [{"node_id": local_hex}]:
+            nid = n["node_id"]
+            # every node's proxy tries the SAME configured port (one
+            # proxy per host on a real pod); co-located nodes in
+            # single-host test clusters lose the bind race and fall
+            # back to an ephemeral port inside HTTPProxy
+            cls = ray_tpu.remote(
+                num_cpus=0, max_concurrency=16,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=nid, soft=True))(HTTPProxy)
+            actor = cls.remote(controller, host, port)
+            _proxy_actors[nid] = actor
+            if nid == local_hex or _proxy_actor is None:
+                _proxy_actor = actor
     if grpc_options is not None and _grpc_proxy_actor is None:
         from ray_tpu.serve._private.grpc_proxy import GrpcProxy
         gcls = ray_tpu.remote(num_cpus=0.25,
@@ -110,6 +135,12 @@ def proxy_address() -> Optional[str]:
     if _proxy_actor is None:
         return None
     return ray_tpu.get(_proxy_actor.address.remote())
+
+
+def proxy_addresses() -> Dict[str, str]:
+    """All per-node proxy addresses, keyed by node id hex."""
+    return {nid: ray_tpu.get(a.address.remote())
+            for nid, a in _proxy_actors.items()}
 
 
 def get_deployment_handle(deployment_name: str,
@@ -156,13 +187,15 @@ def shutdown() -> None:
             ray_tpu.kill(controller)
         except Exception:
             pass
-    if _proxy_actor is not None:
+    for actor in set(_proxy_actors.values()) | (
+            {_proxy_actor} if _proxy_actor is not None else set()):
         try:
-            ray_tpu.get(_proxy_actor.stop.remote(), timeout=10)
-            ray_tpu.kill(_proxy_actor)
+            ray_tpu.get(actor.stop.remote(), timeout=10)
+            ray_tpu.kill(actor)
         except Exception:
             pass
-        _proxy_actor = None
+    _proxy_actors.clear()
+    _proxy_actor = None
     if _grpc_proxy_actor is not None:
         try:
             ray_tpu.get(_grpc_proxy_actor.stop.remote(), timeout=10)

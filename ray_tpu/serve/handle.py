@@ -62,6 +62,11 @@ class DeploymentResponseGenerator:
         self._rkey = rkey
         self._buf: List[Any] = []
         self._done = False
+        #: items pulled per replica round-trip. 8 amortizes RPCs for
+        #: throughput consumers; latency-sensitive consumers (the HTTP
+        #: proxy streaming tokens) set 1 so a slow producer's first
+        #: item isn't held hostage to its eighth.
+        self.batch_size = 8
 
     def __iter__(self):
         return self
@@ -79,7 +84,8 @@ class DeploymentResponseGenerator:
                 raise StopIteration
             try:
                 items, done = ray_tpu.get(
-                    self._replica.next_chunks.remote(self._stream_id))
+                    self._replica.next_chunks.remote(
+                        self._stream_id, self.batch_size))
             except BaseException:
                 self._finish()
                 raise
