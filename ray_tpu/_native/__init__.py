@@ -117,6 +117,11 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint32)]
+        lib.ns_list.restype = ctypes.c_uint32
+        lib.ns_list.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32]
         lib.ns_base.restype = ctypes.c_void_p
         lib.ns_base.argtypes = [ctypes.c_void_p]
         lib.ns_total_size.restype = ctypes.c_uint64

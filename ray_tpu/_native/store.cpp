@@ -561,6 +561,28 @@ void ns_stats(void* handle, uint64_t* used, uint64_t* capacity,
   if (nobjects) *nobjects = h->hdr->nobjects;
 }
 
+// Enumerate sealed objects: fills out_ids (max_n * kIdLen bytes),
+// out_sizes and out_refcnts (max_n entries each); returns the count
+// written. Lets the node-manager authority see locally-created objects
+// it was never notified about (spill/eviction candidates) — plasma's
+// store-side object table walk.
+uint32_t ns_list(void* handle, uint8_t* out_ids, uint64_t* out_sizes,
+                 uint32_t* out_refcnts, uint32_t max_n) {
+  Handle* h = static_cast<Handle*>(handle);
+  Locker lock(h);
+  Header* hdr = h->hdr;
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < hdr->nslots && n < max_n; i++) {
+    Slot* s = &h->slots[i];
+    if (s->state != kSealed) continue;
+    memcpy(out_ids + static_cast<size_t>(n) * kIdLen, s->id, kIdLen);
+    out_sizes[n] = s->size;
+    out_refcnts[n] = s->refcnt;
+    n++;
+  }
+  return n;
+}
+
 // Base pointer of the mapping (for ctypes buffer construction).
 uint8_t* ns_base(void* handle) {
   return static_cast<Handle*>(handle)->base;

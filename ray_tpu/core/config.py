@@ -62,6 +62,13 @@ class Config:
 
     # --- health / heartbeats (reference: gcs_health_check_manager.h) ---
     health_check_period_ms: int = 1000
+    #: grace before a delta-driven refcount zero actually frees the
+    #: object: absorbs in-flight +1 deltas from other processes
+    #: (cross-process batches have no global ordering)
+    free_grace_s: float = 2.0
+    #: how long a create blocks behind spilling/eviction before
+    #: surfacing ObjectStoreFullError (plasma create-queue analog)
+    store_full_timeout_s: float = 30.0
     health_check_timeout_ms: int = 10000
     #: Missed-heartbeat budget before a node is declared dead.
     health_check_failure_threshold: int = 5

@@ -145,7 +145,16 @@ class Controller:
         self._outbox: Dict[bytes, List[Tuple[bytes, Any]]] = {}
 
         self.scheduler = ClusterResourceScheduler()
-        self.refs = GlobalRefTable(self._on_refcount_zero)
+        self.refs = GlobalRefTable(self._queue_refcount_zero)
+        #: delta-driven zero events park here for a grace window before
+        #: the actual free: cross-process delta batches can zero the
+        #: aggregate transiently while a direct-path consumer's pin
+        #: (+1) is still in flight — freeing immediately loses the only
+        #: copy of an object a queued task still needs. Owner-initiated
+        #: frees (_h_owner_free) stay immediate: the owner's count is
+        #: authoritative (reference: frees are owner-driven,
+        #: reference_count.h).
+        self._pending_frees: Dict[bytes, float] = {}
 
         self.peers: Dict[bytes, dict] = {}          # identity -> {kind, node_id}
         self.nodes: Dict[bytes, NodeInfo] = {}      # node_id binary -> NodeInfo
@@ -859,6 +868,23 @@ class Controller:
             if self.refs.force_release(b):
                 self._on_refcount_zero(ObjectID(b))
 
+    def _queue_refcount_zero(self, object_id: ObjectID) -> None:
+        self._pending_frees[object_id.binary()] = \
+            time.monotonic() + self.config.free_grace_s
+
+    def _drain_pending_frees(self) -> None:
+        """Health-loop: run frees whose grace expired and whose count
+        did not resurrect meanwhile (a positive delta clears the
+        tombstone, making is_released False)."""
+        if not self._pending_frees:
+            return
+        now = time.monotonic()
+        due = [b for b, t in self._pending_frees.items() if t <= now]
+        for b in due:
+            del self._pending_frees[b]
+            if self.refs.is_released(b):
+                self._on_refcount_zero(ObjectID(b))
+
     def _on_refcount_zero(self, object_id: ObjectID) -> None:
         b = object_id.binary()
         entry = self.objects.get(b)
@@ -1254,6 +1280,10 @@ class Controller:
 
     def _h_task_done(self, identity: bytes, m: dict) -> None:
         tid = m["task_id"]
+        # Duplicate executions happen (at-least-once resubmission racing
+        # a completion already in flight): lease/worker bookkeeping below
+        # must still run for WHICHEVER worker executed, but result
+        # recording is first-wins — see _record_result_entry.
         if m.get("driver_leased") and not m.get("is_actor_task"):
             # direct driver-leased execution (flag set at dispatch, so
             # this holds even after the lease was reclaimed): the
@@ -1274,7 +1304,11 @@ class Controller:
                                         {"spec": spec})
                     return
             for r in m.get("results", []):
-                if self.refs.is_released(r["object_id"]):
+                if self.refs.is_released(r["object_id"]) and \
+                        r["object_id"] not in self._pending_frees:
+                    # zero confirmed past the grace window: don't
+                    # resurrect. Grace-pending zeros still record — the
+                    # deferred free (or a resurrecting +1) decides.
                     continue
                 e = self._entry(r["object_id"])
                 e.owner = m.get("owner", identity)
@@ -1283,7 +1317,11 @@ class Controller:
                     e.inline = r["inline"]
                 if r.get("node_id"):
                     e.locations.add(r["node_id"])
-                if m.get("error") is not None:
+                if m.get("error") is not None and e.inline is None \
+                        and not e.locations:
+                    # first-wins: a duplicate execution (at-least-once
+                    # resubmit) failing on since-freed args must not
+                    # poison an object that already has data
                     e.error = m["error"]
             for r in m.get("results", []):
                 self._object_created(r["object_id"])
@@ -1376,6 +1414,11 @@ class Controller:
                     # flight): a waiter holds a live ref, so record the
                     # result and let the count resurrect
                     self.refs.cancel_release(rb)
+                elif rb in self._pending_frees:
+                    # zero still inside the free-grace window: record
+                    # the result normally (keeping the tombstone); the
+                    # deferred free — or a resurrecting +1 — decides
+                    pass
                 else:
                     # the owner already dropped every reference (its
                     # direct TASK_RESULT beat this TASK_DONE): recording
@@ -1394,7 +1437,9 @@ class Controller:
                 e.inline = r["inline"]
             if r.get("node_id"):
                 e.locations.add(r["node_id"])
-            if m.get("error") is not None:
+            if m.get("error") is not None and e.inline is None \
+                    and not e.locations:
+                # first-wins (duplicate executions; see above)
                 e.error = m["error"]
             if t is not None and not t.spec.is_actor_creation:
                 e.lineage_task = t.spec  # lineage for reconstruction
@@ -2052,6 +2097,7 @@ class Controller:
                 self.call_on_loop(self._audit_parked_tasks)
                 self.call_on_loop(self._audit_parked_waiters)
                 self.call_on_loop(self._audit_driver_leases)
+                self.call_on_loop(self._drain_pending_frees)
             except Exception:
                 pass
             try:

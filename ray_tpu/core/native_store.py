@@ -18,8 +18,17 @@ import time
 from collections import OrderedDict
 from typing import Dict, Optional
 
+import logging
+
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError
+
+logger = logging.getLogger(__name__)
+
+#: RAY_TPU_STORE_DEBUG=1 logs object lifecycle decisions with full ids
+#: (spill/restore/delete forensics; analog of plasma's debug-level
+#: object-lifecycle logging)
+STORE_DEBUG = os.environ.get("RAY_TPU_STORE_DEBUG") == "1"
 
 _SHM_ROOT = "/dev/shm"
 _FULL = 2 ** 64 - 1
@@ -118,6 +127,24 @@ class _Segment:
                           ctypes.byref(cap), ctypes.byref(n))
         return used.value, cap.value, n.value
 
+    def list_sealed(self, max_n: Optional[int] = None):
+        """[(ObjectID, size, refcnt)] of every sealed object in the
+        segment — the authoritative inventory for spill/eviction (the
+        index, not notifications, is the source of truth). Buffers are
+        sized from the live object count: this runs under the store
+        lock on every eviction sweep, so fixed 2.6MB allocations would
+        tax exactly the pressure episodes it serves."""
+        if max_n is None:
+            _, _, n_live = self.stats()
+            max_n = max(64, min(65536, int(n_live) + 64))
+        ids = (ctypes.c_uint8 * (28 * max_n))()
+        sizes = (ctypes.c_uint64 * max_n)()
+        refs = (ctypes.c_uint32 * max_n)()
+        n = self.lib.ns_list(self.handle, ids, sizes, refs, max_n)
+        raw = bytes(ids)
+        return [(ObjectID(raw[i * 28:(i + 1) * 28]),
+                 sizes[i], refs[i]) for i in range(n)]
+
     def close(self, unlink: bool = False) -> None:
         try:
             self.view.release()
@@ -145,11 +172,15 @@ class NativeShmStore:
         self.session_name = session_name
         self.capacity = capacity_bytes
         # Physical segment is over-provisioned (tmpfs pages materialize
-        # only when touched) so a create that transiently overshoots the
-        # nominal capacity succeeds and eviction catches up at seal time
-        # — plasma's "fallback allocation" semantics.
+        # only when touched, so unused headroom costs nothing) — a
+        # create that overshoots the nominal capacity succeeds while
+        # eviction/spilling works back toward the budget. This is
+        # plasma's "fallback allocation" escape valve: the in-flight
+        # working set (reader-leased extents of executing tasks) may
+        # legitimately exceed the budget, and refusing creates then
+        # deadlocks the pipeline that would have released those leases.
         self.seg = _Segment(self.lib, session_name,
-                            capacity=capacity_bytes * 2)
+                            capacity=capacity_bytes * 4)
         self.spill_dir = spill_dir
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
@@ -157,6 +188,11 @@ class NativeShmStore:
         self._sealed: "OrderedDict[ObjectID, int]" = OrderedDict()
         self._pinned: Dict[ObjectID, int] = {}
         self._spilled: Dict[ObjectID, str] = {}
+        #: freshly-restored objects are exempt from spilling briefly —
+        #: without the grace window, memory pressure can re-spill an
+        #: object between its restore RPC reply and the requester's
+        #: first read lease (restore/spill livelock)
+        self._restore_grace: Dict[ObjectID, float] = {}
         # Background prefault (bounded): once tmpfs pages exist, every
         # client mapping reaches memcpy-class put bandwidth; unfaulted
         # tails are handled per-create by _madvise_populate.
@@ -206,6 +242,8 @@ class NativeShmStore:
             self._delete_locked(object_id)
 
     def _delete_locked(self, object_id: ObjectID) -> None:
+        if STORE_DEBUG:
+            logger.info("DELETE %s", object_id.hex())
         self._sealed.pop(object_id, None)
         self.seg.delete(object_id)
         spath = self._spilled.pop(object_id, None)
@@ -215,68 +253,166 @@ class NativeShmStore:
             except FileNotFoundError:
                 pass
 
+    def _evict_candidates_locked(self):
+        """Spill/evict candidates: segment-indexed sealed objects (the
+        segment is the source of truth — workers create/seal without
+        notifying this authority) that are neither pinned, reader-held,
+        nor inside the restore-grace window. Notified objects (_sealed,
+        transfer receives) come first in their LRU order."""
+        now = time.monotonic()
+        for oid in [o for o, t in self._restore_grace.items()
+                    if t < now]:
+            del self._restore_grace[oid]
+        skip = self._restore_grace
+        listed = self.seg.list_sealed()
+        refcnt_of = {oid: rc for oid, _sz, rc in listed}
+        seen = set(self._sealed.keys())
+        # reader-held extents are unspillable (seg.evict would refuse
+        # AFTER the disk write): filter by refcount everywhere
+        out = [oid for oid in self._sealed.keys()
+               if oid not in self._pinned and oid not in skip
+               and refcnt_of.get(oid, 0) == 0]
+        out += [oid for oid, _sz, rc in listed
+                if rc == 0 and oid not in self._pinned
+                and oid not in skip and oid not in seen]
+        return out
+
+    def maybe_evict(self) -> None:
+        """Background spill/eviction toward the nominal budget (called
+        from the node heartbeat): keeps resident bytes near capacity so
+        foreground creates almost never stall on make_room."""
+        with self._lock:
+            self._maybe_evict_locked()
+
+    def make_room(self, bytes_needed: int) -> int:
+        """Spill/evict LRU unpinned sealed objects until at least
+        ``bytes_needed`` of segment DATA capacity is free (or nothing
+        more can move). The worker-side create retries after this — the
+        reference's create-request-queue semantics
+        (plasma/create_request_queue.h), server-authoritative."""
+        freed = 0
+        with self._lock:
+            # bounded per call: spilling is disk I/O under the store
+            # lock, and a concurrent restore RPC waiting on this lock
+            # must not starve past its caller's deadline — callers loop
+            # (runtime create retry), so partial progress is fine
+            moved = 0
+            for oid in self._evict_candidates_locked():
+                # free space measured against the DATA area (ns_stats
+                # capacity), not the mapped size (which counts header/
+                # slot-table overhead as if it were allocatable)
+                used, cap, _ = self.seg.stats()
+                if cap - used >= bytes_needed or moved >= 8:
+                    break
+                before = used
+                if self.spill_dir:
+                    self._spill_locked(oid)
+                elif self.seg.evict(oid) > 0:
+                    self._sealed.pop(oid, None)
+                moved += 1
+                after, _, _ = self.seg.stats()
+                freed += max(0, before - after)
+        return freed
+
     def _maybe_evict_locked(self) -> None:
         # Evict against the NOMINAL capacity; the physical segment has
         # headroom so in-flight creates don't fail while we catch up.
         used, _, _ = self.seg.stats()
         if used <= self.capacity:
             return
-        for oid in list(self._sealed.keys()):
+        moved = 0
+        for oid in self._evict_candidates_locked():
             used, _, _ = self.seg.stats()
-            if used <= self.capacity * 0.8:
+            if used <= self.capacity * 0.8 or moved >= 8:
+                # bounded sweep: the heartbeat calls again next tick;
+                # unbounded spilling would hold the lock through many
+                # seconds of disk writes and stall restore RPCs
                 break
-            if oid in self._pinned:
-                continue
             if self.spill_dir:
                 self._spill_locked(oid)
             elif self.seg.evict(oid) > 0:
                 self._sealed.pop(oid, None)
+            moved += 1
 
     def _spill_locked(self, object_id: ObjectID) -> None:
         state, off, size = self.seg.lookup(object_id)
         if state != 2:
             return
         dst = os.path.join(self.spill_dir, object_id.hex())
-        with open(dst, "wb") as f:
-            f.write(self.seg.view[off:off + size])
+        already = self._spilled.get(object_id)
+        if already is None:
+            # don't rewrite an existing backing copy: the object can be
+            # in BOTH places when a duplicate execution (at-least-once
+            # resubmit) re-created an already-spilled object's extent
+            with open(dst, "wb") as f:
+                f.write(self.seg.view[off:off + size])
         if self.seg.evict(object_id) == 0:
-            # A live reader holds the extent; leave it resident (its
-            # spilled copy is redundant but harmless).
-            try:
-                os.unlink(dst)
-            except FileNotFoundError:
-                pass
+            # A live reader holds the extent; leave it resident. Only
+            # remove the file WE just wrote — unlinking a pre-existing
+            # backing copy here would strand _spilled pointing at
+            # nothing (observed as ObjectLost under spill pressure).
+            if already is None:
+                try:
+                    os.unlink(dst)
+                except FileNotFoundError:
+                    pass
             return
         self._sealed.pop(object_id, None)
         self._spilled[object_id] = dst
+        if STORE_DEBUG:
+            logger.info("SPILL %s", object_id.hex())
 
     def maybe_restore(self, object_id: ObjectID) -> bool:
         with self._lock:
             spath = self._spilled.get(object_id)
             if spath is None:
                 state, _, _ = self.seg.lookup(object_id)
+                if state != 2 and STORE_DEBUG:
+                    logger.warning(
+                        "RESTOREMISS %s state=%s nspilled=%d",
+                        object_id.hex(), state, len(self._spilled))
                 return state == 2
-            size = os.stat(spath).st_size
+            if self.seg.lookup(object_id)[0] == 2:
+                # resident AND spilled (duplicate-execution re-create):
+                # the extent is current; keep the disk copy as backup
+                return True
+            try:
+                size = os.stat(spath).st_size
+            except FileNotFoundError:
+                # backing file vanished (historical unlink bug, manual
+                # cleanup): surface not-restorable instead of raising
+                self._spilled.pop(object_id, None)
+                return False
             off = self.seg.alloc(object_id, size)
             if off == _FULL:
-                # Make room: evict other unreferenced residents, then
-                # retry once (the Python store's restore never fails on
-                # capacity either).
-                for other in list(self._sealed.keys()):
-                    if other != object_id and other not in self._pinned \
-                            and self.seg.evict(other) > 0:
+                # Make room by SPILLING other unreferenced residents
+                # (never plain eviction here — an unspilled resident's
+                # only copy may live in this segment), then retry.
+                for other in self._evict_candidates_locked():
+                    if other == object_id:
+                        continue
+                    if self.spill_dir:
+                        self._spill_locked(other)
+                    elif self.seg.evict(other) > 0:
                         self._sealed.pop(other, None)
-                        off = self.seg.alloc(object_id, size)
-                        if off != _FULL:
-                            break
-            if off in (_FULL, _EXISTS):
-                return off == _EXISTS
+                    off = self.seg.alloc(object_id, size)
+                    if off != _FULL:
+                        break
+            if off == _EXISTS:
+                return True
+            if off == _FULL:
+                # the backing copy EXISTS but the segment can't admit it
+                # right now (remaining extents reader-held or in their
+                # restore grace): transient — callers must retry, not
+                # declare the object lost
+                return "retry"
             with open(spath, "rb") as f:
                 f.readinto(self.seg.view[off:off + size])
             self.seg.seal(object_id)
             os.unlink(spath)
             self._spilled.pop(object_id, None)
             self._sealed[object_id] = size
+            self._restore_grace[object_id] = time.monotonic() + 2.0
             return True
 
     def reap_dead_readers(self) -> int:

@@ -21,6 +21,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import zmq
@@ -101,6 +102,12 @@ class NodeManager:
         # huge object never sits fully buffered in zmq send queues
         self._outgoing: Dict[tuple, dict] = {}  # (requester, oid) -> state
         self._peer_last_used: Dict[bytes, float] = {}
+        #: pull retries parked by restore-capacity backoff timers;
+        #: drained by the message loop (appends are GIL-atomic)
+        self._pull_retries: "deque" = deque()
+        from queue import SimpleQueue
+        self._store_rpc_q: "SimpleQueue" = SimpleQueue()
+        self._store_rpc_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------ run
     def _register_with_controller(self) -> None:
@@ -165,6 +172,12 @@ class NodeManager:
                 events = dict(poller.poll(timeout=1000))
             except zmq.ZMQError:
                 break
+            while self._pull_retries:
+                requester, m = self._pull_retries.popleft()
+                try:
+                    self._start_stream(requester, m)
+                except Exception:
+                    logger.exception("pull retry failed")
             if self.sock in events:
                 while True:
                     try:
@@ -419,6 +432,14 @@ class NodeManager:
                     reap()
                 except Exception:
                     pass
+            # background spill/eviction toward the budget: local creates
+            # never notify this authority, so without a periodic sweep
+            # the segment drifts to its physical ceiling and every
+            # foreground create stalls behind a make_room RPC
+            try:
+                self.store.maybe_evict()
+            except Exception:
+                pass
             stats = self.store.stats()
             try:
                 import psutil
@@ -438,7 +459,19 @@ class NodeManager:
     # admits work against a byte budget); the controller only names the
     # source. Chunks ride the direct node-to-node channel.
     def _handle_direct(self, sender: bytes, mtype: bytes, m: dict) -> None:
-        if mtype == P.PULL_REQUEST:
+        if mtype == P.STORE_RPC:
+            # spill/restore move megabytes through disk: never on the
+            # message loop (it also carries heartbeats and transfers).
+            # One long-lived maintenance thread drains these — under
+            # store pressure every blocked worker polls frequently, and
+            # a thread per request would churn exactly then.
+            if self._store_rpc_thread is None:
+                self._store_rpc_thread = threading.Thread(
+                    target=self._store_rpc_loop, name="node-store-rpc",
+                    daemon=True)
+                self._store_rpc_thread.start()
+            self._store_rpc_q.put((sender, m))
+        elif mtype == P.PULL_REQUEST:
             self._start_stream(sender, m)
         elif mtype == P.PUSH_OBJECT:
             self._receive_push(sender, m)
@@ -448,6 +481,73 @@ class NodeManager:
             # the SOURCE says the object is gone there: stale location
             self._pull_failed(m["object_id"], m.get("src_node"),
                               stale_src=True)
+
+    def _requeue_pull_request(self, requester: bytes, m: dict) -> None:
+        # timer thread: park the retry; the message loop drains it on
+        # its next wakeup (loop thread owns all stream/peer state)
+        self._pull_retries.append((requester, m))
+
+    def _store_rpc_loop(self) -> None:
+        #: reply sockets cached per sender (this thread only)
+        reply_socks: Dict[bytes, zmq.Socket] = {}
+        while not self._stopped.is_set():
+            try:
+                sender, m = self._store_rpc_q.get(timeout=1.0)
+            except Exception:
+                continue
+            try:
+                self._store_rpc(sender, m, reply_socks)
+            except Exception:
+                logger.exception("store rpc failed")
+
+    def _store_rpc(self, sender: bytes, m: dict,
+                   reply_socks: Optional[Dict[bytes, "zmq.Socket"]]
+                   = None) -> None:
+        """Worker-requested store maintenance (reference: plasma's
+        create-request queue + spilled-object restore requests run in
+        the store owner, not the client)."""
+        op = m.get("op")
+        out: dict = {}
+        try:
+            if op == "make_room":
+                out["freed"] = self.store.make_room(
+                    int(m.get("bytes", 0)))
+            elif op == "restore":
+                oid = ObjectID(m["object_id"])
+                result = self.store.maybe_restore(oid)
+                out["ok"] = result is True
+                # capacity-full restores are transient (see
+                # NativeShmStore.maybe_restore): tell the caller to
+                # retry instead of giving up
+                out["retry"] = result == "retry"
+            else:
+                out["error"] = f"unknown store op {op!r}"
+        except Exception as e:  # noqa: BLE001
+            out["error"] = str(e)
+        # maintenance thread (not the message loop): _peer_socks is
+        # loop-thread-only, so reply over this thread's own cached
+        # DEALER per sender. Unique identity: reusing the node's fixed
+        # identity would collide with its persistent DEALER to the same
+        # worker ROUTER and the reply would be silently dropped.
+        sock = None if reply_socks is None else reply_socks.get(sender)
+        if sock is None:
+            sock = self.ctx.socket(zmq.DEALER)
+            sock.setsockopt(zmq.IDENTITY,
+                            self.identity[:8] + os.urandom(8))
+            sock.setsockopt(zmq.LINGER, 1000)
+            sock.connect(D.direct_addr(self.session_dir, sender))
+            if reply_socks is not None:
+                reply_socks[sender] = sock
+                while len(reply_socks) > 256:
+                    old, s_old = next(iter(reply_socks.items()))
+                    del reply_socks[old]
+                    s_old.close(0)
+        try:
+            sock.send_multipart([P.GENERIC_REPLY, P.dumps(
+                {"rid": m.get("rid"), "data": out})])
+        finally:
+            if reply_socks is None:
+                sock.close()
 
     def _enqueue_pull(self, m: dict) -> None:
         b = m["object_id"]
@@ -535,8 +635,22 @@ class NodeManager:
     def _start_stream(self, requester: bytes, m: dict) -> None:
         b = m["object_id"]
         oid = ObjectID(b)
-        self.store.maybe_restore(oid)
-        view = self.shm.get_view(oid, timeout=2.0)
+        restored = self.store.maybe_restore(oid)
+        view = self.shm.get_view(oid, timeout=2.0) \
+            if restored is True else None
+        if view is None and restored == "retry" and \
+                m.get("_restore_tries", 0) < 20:
+            # transient capacity pressure (segment full of reader-held
+            # extents): the on-disk copy EXISTS — reporting PULL_FAILED
+            # would make the controller drop the only holder. Re-try
+            # shortly instead (off-loop timer; the message loop must
+            # not sleep).
+            m = dict(m, _restore_tries=m.get("_restore_tries", 0) + 1)
+            t = threading.Timer(0.5, self._requeue_pull_request,
+                                args=(requester, m))
+            t.daemon = True
+            t.start()
+            return
         if view is None:
             logger.warning("pull for missing object %s", oid.hex()[:12])
             self._send_direct(requester, P.PULL_FAILED, {

@@ -58,6 +58,10 @@ PULL_OBJECT = b"PUL"         # controller->dest node: pull this object
 PULL_REQUEST = b"PRQ"        # dest->src node DIRECT: stream it to me
 PUSH_OBJECT = b"PSH"         # src->dest node DIRECT: chunked payload
 PULL_FAILED = b"PLF"         # src->dest direct / dest->controller: pull failed
+STORE_RPC = b"SRP"           # worker->node DIRECT {op, rid, ...}:
+                             # make_room {bytes} -> {freed} |
+                             # restore {object_id} -> {ok} — plasma's
+                             # create-queue + restore requests analog
 LOCATE_OBJECT = b"LOB"       # controller->node {object_id}: if your store
                              # holds it, announce it (repairs a directory
                              # hole left by a producer killed mid-report)

@@ -32,6 +32,7 @@ from ray_tpu.core.memory_store import InProcessStore
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.reference_counter import ReferenceCounter
 from ray_tpu.core.serialization import SerializationContext, SerializedObject
+from ray_tpu.exceptions import ObjectStoreFullError
 from ray_tpu.core.shm_store import make_client
 from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.exceptions import GetTimeoutError
@@ -643,7 +644,30 @@ class Runtime:
             # process memory would double the footprint of every big put
             # (local gets deserialize zero-copy from the sealed extent)
             try:
-                view = self.shm.create(oid, size)
+                view = None
+                deadline = time.monotonic() + \
+                    self.config.store_full_timeout_s
+                collected = False
+                while True:
+                    try:
+                        view = self.shm.create(oid, size)
+                        break
+                    except ObjectStoreFullError:
+                        # Queue behind eviction like plasma's create
+                        # request queue (create_request_queue.h): ask
+                        # the node authority to spill LRU objects, drop
+                        # our own GC-deferred zero-copy values ONCE
+                        # (their reader leases block spilling), and
+                        # wait for in-flight executions elsewhere to
+                        # release theirs.
+                        if not collected:
+                            collected = True
+                            import gc
+                            gc.collect()
+                        self._node_store_rpc("make_room", bytes=size)
+                        if time.monotonic() >= deadline:
+                            raise
+                        time.sleep(0.2)
                 serialized.write_to(view)
                 self.shm.seal(oid)
             except FileExistsError:
@@ -662,22 +686,73 @@ class Runtime:
         with self._meta_lock:
             self._meta[object_id_b] = meta
 
+    def _restore_local(self, oid: ObjectID) -> Optional[memoryview]:
+        """Restore a locally-spilled object and acquire a view,
+        retrying while the node reports transient capacity pressure
+        (segment full of reader-held extents). Returns None when the
+        object is genuinely absent from this node."""
+        deadline = time.monotonic() + self.config.store_full_timeout_s
+        while True:
+            try:
+                reply = self._node_store_rpc(
+                    "restore", object_id=oid.binary(), timeout=60.0)
+            except Exception:
+                return None
+            if reply.get("ok"):
+                view = self.shm.get_view(oid, timeout=5.0)
+                if view is not None:
+                    return view
+                # re-spilled between reply and our lease: loop
+            elif not reply.get("retry"):
+                return None
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.3)
+
+    def _node_store_rpc(self, op: str, timeout: float = 30.0,
+                        **params) -> dict:
+        """Blocking store-maintenance request to OUR node manager over
+        the direct channel (make_room / restore spilled objects)."""
+        rid = self.replies.new_request()
+        node_identity = b"N" + self.node_id.binary()[:27]
+        self._send_direct(node_identity, P.STORE_RPC,
+                          dict(params, op=op, rid=rid))
+        return self.replies.wait(rid, timeout) or {}
+
     def _on_task_result(self, m: dict) -> None:
         aid = m.get("actor_id")
+        known = False
         if aid is not None:
             with self._actors_lock:
                 st = self._actors.get(aid)
                 if st is not None:
                     done_spec = st["inflight"].pop(m.get("task_id"), None)
+                    known = known or done_spec is not None
                     self._unpin_task_args(done_spec)
         if m.get("task_id") is not None:
             with self._inflight_lock:
                 done_spec = self._inflight_specs.pop(m["task_id"], None)
+            known = known or done_spec is not None
             self._unpin_task_args(done_spec)
             self._on_direct_task_result(m["task_id"])
+        err = m.get("error")
         for r in m.get("results", []):
             b = r["object_id"]
+            failed = err is not None or r.get("error") is not None
             with self._meta_lock:
+                existing = self._meta.get(b)
+                if not known and failed and existing is not None \
+                        and existing.get("error") is None and (
+                            existing.get("inline") is not None
+                            or existing.get("node_id") is not None):
+                    # duplicate execution (at-least-once resubmit raced
+                    # a completion already in flight): first result
+                    # wins — a duplicate failing on since-freed args
+                    # must not poison good metas. Unknown-tid SUCCESS
+                    # results still record: lineage reconstruction
+                    # legitimately re-runs tasks whose spec we already
+                    # retired.
+                    continue
                 self._meta[b] = r
             oid = ObjectID(b)
             # materialize lazily at get(); but wake any waiter now
@@ -796,7 +871,17 @@ class Runtime:
         node_b = meta.get("node_id")
         if self.shm is not None and (node_b == self.node_id.binary()
                                      or self.shm.contains(oid)):
-            view = self.shm.get_view(oid, timeout=5.0)
+            # fast probe first: a locally-SPILLED object will never
+            # appear however long we poll — restore it instead of
+            # burning the full timeout (background eviction makes
+            # spilled-but-local routine)
+            view = self.shm.get_view(oid, timeout=0.05)
+            if view is None and node_b == self.node_id.binary():
+                # not in the segment but supposedly local: it may have
+                # been spilled to disk — ask the node to restore it
+                # (reference: AsyncRestoreSpilledObject before a local
+                # plasma get gives up)
+                view = self._restore_local(oid)
             if view is not None:
                 value, _ = self.serialization.deserialize_from_view(view)
                 self._cache_shm_value(oid, value)
@@ -817,6 +902,8 @@ class Runtime:
         if self.shm is None:
             raise RuntimeError("no shm store attached; cannot fetch object")
         view = self.shm.get_view(oid, timeout=self.config.rpc_timeout_s)
+        if view is None:
+            view = self._restore_local(oid)
         if view is None:
             from ray_tpu.exceptions import ObjectLostError
             raise ObjectLostError(oid)

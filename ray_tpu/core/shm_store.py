@@ -159,6 +159,29 @@ class ShmObjectStore:
                 g.write(chunk)
         os.unlink(src)
 
+    def maybe_evict(self) -> None:
+        """Background spill/eviction toward the budget (node heartbeat)."""
+        with self._lock:
+            self._maybe_evict_locked()
+
+    def make_room(self, bytes_needed: int) -> int:
+        """Spill/evict LRU unpinned objects until ``bytes_needed`` of
+        capacity is free (see NativeShmStore.make_room)."""
+        freed = 0
+        with self._lock:
+            for oid in list(self._sealed.keys()):
+                if self.capacity - self._used >= bytes_needed:
+                    break
+                if oid in self._pinned:
+                    continue
+                size = self._sealed.get(oid, 0)
+                if self.spill_dir:
+                    self._spill_locked(oid)
+                else:
+                    self._delete_locked(oid)
+                freed += size
+        return freed
+
     def maybe_restore(self, object_id: ObjectID) -> bool:
         """Restore a spilled object back into shm (reference:
         local_object_manager.h AsyncRestoreSpilledObject)."""

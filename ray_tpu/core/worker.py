@@ -522,6 +522,13 @@ def _orphan_watchdog(parent_pid: int) -> None:
 def main() -> None:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s: %(message)s")
+    dump_after = os.environ.get("RAY_TPU_WORKER_FAULTDUMP")
+    if dump_after:
+        # debugging aid: dump all thread stacks to the worker log every
+        # N seconds (hang diagnosis; reference: `ray stack`)
+        import faulthandler
+        faulthandler.dump_traceback_later(
+            float(dump_after), repeat=True)
     threading.Thread(target=_orphan_watchdog, args=(os.getppid(),),
                      daemon=True).start()
     # Honor an explicit platform override before any task imports jax.

@@ -21,6 +21,7 @@ from ray_tpu.data.datasource import (
     range,
     range_tensor,
     read_binary_files,
+    read_images,
     read_csv,
     read_json,
     read_numpy,
@@ -47,6 +48,7 @@ __all__ = [
     "range",
     "range_tensor",
     "read_binary_files",
+    "read_images",
     "read_csv",
     "read_json",
     "read_numpy",

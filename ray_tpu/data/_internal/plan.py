@@ -61,6 +61,20 @@ class AllToAllOp:
 
 
 @dataclass
+class ExchangeOp:
+    """Pipelined all-to-all (reference: planner/exchange/ operators fed
+    by the streaming executor): ``run`` receives the upstream ref
+    ITERATOR so map-side tasks launch as blocks materialize; only the
+    reduce phase barriers. ``count_hint`` is the statically-known
+    upstream block count (None after limit/union)."""
+    run: Callable[..., List[Any]]  # (ref_iter, count_hint) -> refs
+    name: str = "Exchange"
+    #: statically-known output block count (repartition(n)); None keeps
+    #: the upstream count (shuffle/sort)
+    out_count: Optional[int] = None
+
+
+@dataclass
 class LimitOp:
     n: int
     name: str = "Limit"
@@ -160,12 +174,14 @@ def execute_streaming(plan: ExecutionPlan,
         items_are_refs = True
 
     stages = _fuse(plan.ops)
-    stream = _run_stages(items, items_are_refs, stages, ctx)
+    stream = _run_stages(items, items_are_refs, stages, ctx,
+                         plan.source_len())
     yield from stream
 
 
 def _run_stages(items: Iterator[Any], items_are_refs: bool,
-                stages: List[Any], ctx: DataContext) -> Iterator[Any]:
+                stages: List[Any], ctx: DataContext,
+                count_hint: Optional[int] = None) -> Iterator[Any]:
     if not stages:
         # Source only: materialize reads into refs.
         if items_are_refs:
@@ -178,21 +194,33 @@ def _run_stages(items: Iterator[Any], items_are_refs: bool,
     stage, rest = stages[0], stages[1:]
     if isinstance(stage, list):  # fused one-to-one stage
         out = _run_fused_stage(items, items_are_refs, stage, ctx)
-        yield from _run_stages(out, True, rest, ctx)
+        yield from _run_stages(out, True, rest, ctx, count_hint)
+    elif isinstance(stage, ExchangeOp):
+        upstream = _run_stages(items, items_are_refs, [], ctx,
+                               count_hint)
+        out_refs = stage.run(upstream, count_hint)
+        yield from _run_stages(iter(out_refs), True, rest, ctx,
+                               len(out_refs))
     elif isinstance(stage, AllToAllOp):
-        refs = list(_run_stages(items, items_are_refs, [], ctx))
+        refs = list(_run_stages(items, items_are_refs, [], ctx,
+                                count_hint))
         out_refs = stage.fn(refs)
-        yield from _run_stages(iter(out_refs), True, rest, ctx)
+        yield from _run_stages(iter(out_refs), True, rest, ctx,
+                               len(out_refs))
     elif isinstance(stage, LimitOp):
         out = _run_limit(
-            _run_stages(items, items_are_refs, [], ctx), stage.n)
-        yield from _run_stages(out, True, rest, ctx)
+            _run_stages(items, items_are_refs, [], ctx, count_hint),
+            stage.n)
+        # limit truncates an unknown number of blocks: no hint below
+        yield from _run_stages(out, True, rest, ctx, None)
     elif isinstance(stage, UnionOp):
         def chained():
-            yield from _run_stages(items, items_are_refs, [], ctx)
+            yield from _run_stages(items, items_are_refs, [], ctx,
+                                   count_hint)
             for other in stage.others:
                 yield from execute_streaming(other, ctx)
-        yield from _run_stages(chained(), True, rest, ctx)
+        # other branches' output counts aren't statically derived here
+        yield from _run_stages(chained(), True, rest, ctx, None)
     else:
         raise TypeError(f"Unknown stage: {stage!r}")
 

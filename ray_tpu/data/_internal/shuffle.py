@@ -94,62 +94,8 @@ def _r(fn):
 
 def repartition(refs: List[Any], num_blocks: int) -> List[Any]:
     """Equal-row re-split (reference ``RepartitionTaskSpec``)."""
-    if num_blocks <= 0:
-        raise ValueError("num_blocks must be > 0")
     counts = ray_tpu.get([_r(_rows).remote(ref) for ref in refs])
-    total = sum(counts)
-    base, extra = divmod(total, num_blocks)
-    targets = [base + (1 if i < extra else 0) for i in range(num_blocks)]
-
-    # Plan which (ref, start, end) spans feed each output block.
-    out_spans: List[List[Tuple[int, Tuple[int, int]]]] = [
-        [] for _ in range(num_blocks)]
-    ref_i, offset = 0, 0
-    for out_i, need in enumerate(targets):
-        while need > 0 and ref_i < len(refs):
-            avail = counts[ref_i] - offset
-            take = min(avail, need)
-            if take > 0:
-                out_spans[out_i].append((ref_i, (offset, offset + take)))
-                offset += take
-                need -= take
-            if offset >= counts[ref_i]:
-                ref_i += 1
-                offset = 0
-
-    # Phase 1: slice each input once for all its consumers.
-    per_ref_spans: List[List[Tuple[int, int]]] = [[] for _ in refs]
-    span_pos = {}
-    for out_i, spans in enumerate(out_spans):
-        for ref_i, (s, e) in spans:
-            span_pos[(out_i, ref_i, s, e)] = len(per_ref_spans[ref_i])
-            per_ref_spans[ref_i].append((s, e))
-    sliced = []
-    for i, spans in enumerate(per_ref_spans):
-        if not spans:
-            sliced.append(None)
-        elif len(spans) == 1:
-            s, e = spans[0]
-            sliced.append([_r(_slice_one).remote(refs[i], s, e)])
-        else:
-            sliced.append(_r(_slice_spans).options(
-                num_returns=len(spans)).remote(refs[i], spans))
-
-    def span_ref(out_i, ref_i, s, e):
-        return sliced[ref_i][span_pos[(out_i, ref_i, s, e)]]
-
-    # Phase 2: concat spans per output block.
-    out = []
-    for out_i, spans in enumerate(out_spans):
-        part_refs = [span_ref(out_i, ref_i, s, e)
-                     for ref_i, (s, e) in spans]
-        if not part_refs:
-            out.append(_r(_concat).remote())
-        elif len(part_refs) == 1:
-            out.append(part_refs[0])
-        else:
-            out.append(_r(_concat).remote(*part_refs))
-    return out
+    return _repartition_planned(refs, counts, num_blocks)
 
 
 def repartition_to_counts(refs: List[Any],
@@ -182,12 +128,28 @@ def repartition_to_counts(refs: List[Any],
     return out
 
 
-def random_shuffle(refs: List[Any], seed: Optional[int] = None,
-                   num_blocks: Optional[int] = None) -> List[Any]:
-    """Two-phase row shuffle (reference ``ShuffleTaskSpec``)."""
-    n_out = num_blocks or max(1, len(refs))
+# ---------------------------------------------------- streaming exchange
+# Reference: python/ray/data/_internal/planner/exchange/ — the map phase
+# of an exchange runs per input block and the reference's streaming
+# executor feeds it blocks as upstream tasks finish. The functions below
+# take the upstream REF ITERATOR (not a materialized list): map-side
+# tasks launch the moment each block materializes, overlapping upstream
+# production; only the reduce phase is a true barrier (inherent to an
+# all-to-all). Peak driver state is one ref per partition slice — block
+# BYTES live in the object store and spill when the budget is exceeded.
+
+def streaming_random_shuffle(ref_iter, seed: Optional[int] = None,
+                             num_blocks: Optional[int] = None,
+                             count_hint: Optional[int] = None) -> List[Any]:
+    n_out = num_blocks or count_hint
+    if n_out is None:
+        # unknown upstream cardinality (e.g. after limit): drain first
+        refs = list(ref_iter)
+        n_out = max(1, len(refs))
+        ref_iter = iter(refs)
+    n_out = max(1, n_out)
     parts: List[List[Any]] = [[] for _ in range(n_out)]
-    for i, ref in enumerate(refs):
+    for i, ref in enumerate(ref_iter):
         s = None if seed is None else seed + i
         part_refs = _r(_partition_random).options(
             num_returns=n_out).remote(ref, n_out, s)
@@ -198,23 +160,30 @@ def random_shuffle(refs: List[Any], seed: Optional[int] = None,
     out = []
     for j, plist in enumerate(parts):
         s = None if seed is None else seed + 10_000 + j
+        if not plist:
+            out.append(_r(_concat).remote())
+            continue
         merged = _r(_concat).remote(*plist)
         out.append(_r(_shuffle_rows).remote(merged, s))
     return out
 
 
-def sort(refs: List[Any], key: str, descending: bool = False) -> List[Any]:
-    """Sample-based range-partition sort (reference ``SortTaskSpec``)."""
+def streaming_sort(ref_iter, key: str,
+                   descending: bool = False) -> List[Any]:
+    """Sample-as-they-arrive range sort: the sampling pass overlaps
+    upstream production; partitioning starts once bounds are known."""
+    refs: List[Any] = []
+    sample_refs: List[Any] = []
+    for ref in ref_iter:
+        refs.append(ref)
+        sample_refs.append(_r(_sample_keys).remote(ref, key, 16))
     if not refs:
         return refs
     n_out = len(refs)
-    samples = ray_tpu.get(
-        [_r(_sample_keys).remote(ref, key, 16) for ref in refs])
+    samples = ray_tpu.get(sample_refs)
     flat = sorted(x for s in samples for x in s)
     if not flat:
         return refs
-    # Bounds stay ASCENDING even for descending sorts (searchsorted
-    # requires it); _partition_by_bounds flips partition indices.
     bounds = [flat[int(len(flat) * (i + 1) / n_out)]
               for i in range(n_out - 1)
               if int(len(flat) * (i + 1) / n_out) < len(flat)]
@@ -229,3 +198,72 @@ def sort(refs: List[Any], key: str, descending: bool = False) -> List[Any]:
             parts[j].append(pr)
     return [_r(_concat_sorted).remote(key, descending, *plist)
             for plist in parts]
+
+
+def streaming_repartition(ref_iter, num_blocks: int) -> List[Any]:
+    """Row counting overlaps upstream production; the span plan and
+    slicing run once every count is known."""
+    refs: List[Any] = []
+    count_refs: List[Any] = []
+    for ref in ref_iter:
+        refs.append(ref)
+        count_refs.append(_r(_rows).remote(ref))
+    if not refs:
+        return refs
+    # reuse the span planner on the materialized (ref, count) lists
+    return _repartition_planned(refs, ray_tpu.get(count_refs),
+                                num_blocks)
+
+
+def _repartition_planned(refs: List[Any], counts: List[int],
+                         num_blocks: int) -> List[Any]:
+    if num_blocks <= 0:
+        raise ValueError("num_blocks must be > 0")
+    total = sum(counts)
+    base, extra = divmod(total, num_blocks)
+    targets = [base + (1 if i < extra else 0) for i in range(num_blocks)]
+    out_spans: List[List[Tuple[int, Tuple[int, int]]]] = [
+        [] for _ in range(num_blocks)]
+    ref_i, offset = 0, 0
+    for out_i, need in enumerate(targets):
+        while need > 0 and ref_i < len(refs):
+            avail = counts[ref_i] - offset
+            take = min(avail, need)
+            if take > 0:
+                out_spans[out_i].append((ref_i, (offset, offset + take)))
+                offset += take
+                need -= take
+            if offset >= counts[ref_i]:
+                ref_i += 1
+                offset = 0
+    per_ref_spans: List[List[Tuple[int, int]]] = [[] for _ in refs]
+    span_pos = {}
+    for out_i, spans in enumerate(out_spans):
+        for ref_i, (s, e) in spans:
+            span_pos[(out_i, ref_i, s, e)] = len(per_ref_spans[ref_i])
+            per_ref_spans[ref_i].append((s, e))
+    sliced = []
+    for i, spans in enumerate(per_ref_spans):
+        if not spans:
+            sliced.append(None)
+        elif len(spans) == 1:
+            s, e = spans[0]
+            sliced.append([_r(_slice_one).remote(refs[i], s, e)])
+        else:
+            sliced.append(_r(_slice_spans).options(
+                num_returns=len(spans)).remote(refs[i], spans))
+
+    def span_ref(out_i, ref_i, s, e):
+        return sliced[ref_i][span_pos[(out_i, ref_i, s, e)]]
+
+    out = []
+    for out_i, spans in enumerate(out_spans):
+        part_refs = [span_ref(out_i, ref_i, s, e)
+                     for ref_i, (s, e) in spans]
+        if not part_refs:
+            out.append(_r(_concat).remote())
+        elif len(part_refs) == 1:
+            out.append(part_refs[0])
+        else:
+            out.append(_r(_concat).remote(*part_refs))
+    return out

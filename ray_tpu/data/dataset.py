@@ -24,7 +24,8 @@ from ray_tpu.data.block import (
     Block, BlockAccessor, BlockMetadata, _to_table)
 from ray_tpu.data.context import DataContext
 from ray_tpu.data._internal.plan import (
-    AllToAllOp, ExecutionPlan, InputDataOp, LimitOp, OneToOneOp, ReadOp,
+    AllToAllOp, ExchangeOp, ExecutionPlan, InputDataOp, LimitOp,
+    OneToOneOp, ReadOp,
     UnionOp, execute_streaming)
 from ray_tpu.data._internal import shuffle as shuffle_mod
 
@@ -153,21 +154,28 @@ class Dataset:
             OneToOneOp(block_fn, name="Rename")))
 
     # --------------------------------------------------- all-to-all
+    # pipelined exchanges (reference: planner/exchange/ fed by the
+    # streaming executor): map-side tasks start as upstream blocks
+    # materialize instead of after a materialize-all barrier
     def repartition(self, num_blocks: int, **kwargs) -> "Dataset":
-        return Dataset(self._plan.with_op(AllToAllOp(
-            lambda refs: shuffle_mod.repartition(refs, num_blocks),
-            name=f"Repartition({num_blocks})")))
+        return Dataset(self._plan.with_op(ExchangeOp(
+            lambda it, hint: shuffle_mod.streaming_repartition(
+                it, num_blocks),
+            name=f"Repartition({num_blocks})", out_count=num_blocks)))
 
     def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None,
                        **kwargs) -> "Dataset":
-        return Dataset(self._plan.with_op(AllToAllOp(
-            lambda refs: shuffle_mod.random_shuffle(refs, seed=seed),
+        return Dataset(self._plan.with_op(ExchangeOp(
+            lambda it, hint: shuffle_mod.streaming_random_shuffle(
+                it, seed=seed, num_blocks=num_blocks, count_hint=hint),
             name="RandomShuffle")))
 
     def sort(self, key: str, descending: bool = False, **kwargs
              ) -> "Dataset":
-        return Dataset(self._plan.with_op(AllToAllOp(
-            lambda refs: shuffle_mod.sort(refs, key, descending),
+        return Dataset(self._plan.with_op(ExchangeOp(
+            lambda it, hint: shuffle_mod.streaming_sort(
+                it, key, descending),
             name=f"Sort({key})")))
 
     def limit(self, n: int) -> "Dataset":
@@ -262,7 +270,11 @@ class Dataset:
         return list(s.names) if s else []
 
     def num_blocks(self) -> int:
-        return self._plan.source_len()
+        n = self._plan.source_len()
+        for op in self._plan.ops:
+            if isinstance(op, ExchangeOp) and op.out_count is not None:
+                n = op.out_count
+        return n
 
     def size_bytes(self) -> int:
         return sum(b.nbytes for b in self.iter_blocks())

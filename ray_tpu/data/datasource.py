@@ -145,6 +145,92 @@ def _file_read_dataset(paths, suffixes, read_one: Callable[[str], Block],
     return _make_dataset([make_task(f) for f in files], name)
 
 
+def _pack_files_by_size(files: List[str],
+                        target_bytes: int,
+                        size_of: Optional[Callable[[str], int]] = None
+                        ) -> List[List[str]]:
+    """Block-size targeting (reference: FileBasedDatasource's
+    target-block-size file grouping): pack small files into one read
+    task until ~target_bytes so a directory of tiny files doesn't
+    become thousands of tiny blocks."""
+    size_of = size_of or (lambda p: os.path.getsize(p))
+    groups: List[List[str]] = []
+    cur: List[str] = []
+    cur_bytes = 0
+    for f in files:
+        s = max(1, size_of(f))
+        if cur and cur_bytes + s > target_bytes:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(f)
+        cur_bytes += s
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def _grouped_read_dataset(paths, suffixes,
+                          read_group: Callable[[List[str]], Block],
+                          name: str,
+                          target_bytes: Optional[int] = None,
+                          size_of=None) -> Dataset:
+    ctx = DataContext.get_current()
+    files = _resolve_paths(paths, suffixes)
+    groups = _pack_files_by_size(
+        files, target_bytes or ctx.target_max_block_size, size_of)
+
+    def make_task(group: List[str]) -> Callable[[], Block]:
+        return lambda: read_group(group)
+    return _make_dataset([make_task(g) for g in groups], name)
+
+
+_IMAGE_SUFFIXES = [".png", ".jpg", ".jpeg", ".gif", ".bmp", ".webp",
+                   ".tif", ".tiff"]
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: Optional[str] = None,
+                include_paths: bool = False, **kwargs) -> Dataset:
+    """Image files into an ``image`` tensor column (reference:
+    ``python/ray/data/datasource/image_datasource.py``). ``size=(h, w)``
+    resizes (and is required when source images vary in shape);
+    ``mode`` converts (e.g. "RGB", "L"). Files are packed into blocks
+    targeting the context block size based on DECODED bytes."""
+    from PIL import Image
+
+    def decoded_size(p: str) -> int:
+        if size is not None:
+            channels = 1 if mode == "L" else 3
+            return size[0] * size[1] * channels
+        # compressed-on-disk size underestimates decoded; ~10x is a
+        # serviceable planning figure for typical jpeg/png
+        return os.path.getsize(p) * 10
+
+    def read_group(group: List[str]) -> Block:
+        arrays, used_paths = [], []
+        for p in group:
+            img = Image.open(p)
+            if mode is not None:
+                img = img.convert(mode)
+            if size is not None:
+                img = img.resize((size[1], size[0]))
+            arrays.append(np.asarray(img))
+            used_paths.append(p)
+        shapes = {a.shape for a in arrays}
+        if len(shapes) > 1:
+            raise ValueError(
+                f"images have differing shapes {sorted(shapes)}; pass "
+                f"size=(h, w) to read_images to resize them")
+        cols: Dict[str, Any] = {"image": np.stack(arrays)}
+        table = _to_table(cols)
+        if include_paths:
+            table = table.append_column("path", pa.array(used_paths))
+        return table
+
+    return _grouped_read_dataset(paths, _IMAGE_SUFFIXES, read_group,
+                                 "ReadImages", size_of=decoded_size)
+
+
 def read_parquet(paths, **kwargs) -> Dataset:
     import pyarrow.parquet as pq
     return _file_read_dataset(
@@ -166,23 +252,28 @@ def read_json(paths, **kwargs) -> Dataset:
 
 
 def read_text(paths, **kwargs) -> Dataset:
-    def read_one(p: str) -> Block:
-        with open(p, "r", errors="replace") as f:
-            lines = [ln.rstrip("\n") for ln in f]
+    def read_group(group: List[str]) -> Block:
+        lines: List[str] = []
+        for p in group:
+            with open(p, "r", errors="replace") as f:
+                lines.extend(ln.rstrip("\n") for ln in f)
         return pa.table({"text": pa.array(lines)})
-    return _file_read_dataset(paths, [".txt"], read_one, "ReadText")
+    return _grouped_read_dataset(paths, [".txt"], read_group, "ReadText")
 
 
 def read_binary_files(paths, *, include_paths: bool = False,
                       **kwargs) -> Dataset:
-    def read_one(p: str) -> Block:
-        with open(p, "rb") as f:
-            data = f.read()
-        cols: Dict[str, Any] = {"bytes": pa.array([data])}
+    def read_group(group: List[str]) -> Block:
+        blobs, names = [], []
+        for p in group:
+            with open(p, "rb") as f:
+                blobs.append(f.read())
+            names.append(p)
+        cols: Dict[str, Any] = {"bytes": pa.array(blobs)}
         if include_paths:
-            cols["path"] = pa.array([p])
+            cols["path"] = pa.array(names)
         return pa.table(cols)
-    return _file_read_dataset(paths, [""], read_one, "ReadBinary")
+    return _grouped_read_dataset(paths, [""], read_group, "ReadBinary")
 
 
 def read_numpy(paths, **kwargs) -> Dataset:

@@ -6,10 +6,11 @@ Forward: a VMEM-blocked streaming-softmax kernel. Grid is
 MXU in bf16 with float32 accumulation (``preferred_element_type``); the
 log-sum-exp is emitted so the backward pass can recompute P exactly.
 
-Backward: a `lax.scan` over k blocks in float32 — XLA fuses it well and it
-keeps peak memory at O(seq * block) instead of O(seq^2). (A Pallas backward
-kernel is a later optimization; the forward dominates inference and the
-backward is compute-, not launch-, bound.)
+Backward: Pallas dq/dk/dv kernels (default) — dk/dv accumulate in VMEM
+across a q scan, dq across a k scan, both recomputing P from the saved
+log-sum-exp (Dao et al., Algorithm 4). The earlier `lax.scan` XLA
+formulation remains available (``backward="xla"``) as the numerical
+cross-check.
 
 Layout convention at this layer: (batch, num_heads, seq, head_dim).
 Use :func:`ray_tpu.ops.attention.multihead_attention` for the (B, S, H, D)
@@ -42,6 +43,7 @@ class _Cfg:
     block_q: int
     block_k: int
     interpret: bool
+    bwd: str = "pallas"   # "pallas" | "xla"
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -154,8 +156,177 @@ def _flash_fwd(cfg: _Cfg, q, k, v):
     return o, (q, k, v, o, lse)
 
 
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_s, dv_s, *, cfg: _Cfg, offset: int):
+    """Grid (b, h, k_blocks, q_blocks), q innermost: dk/dv accumulators
+    persist in VMEM across the q scan; P is recomputed from the saved
+    LSE (the flash-attention backward recipe, Dao et al. Alg. 4)."""
+    kb = pl.program_id(2)
+    ib = pl.program_id(3)
+    nq = pl.num_programs(3)
+    bq, bk = cfg.block_q, cfg.block_k
+
+    @pl.when(ib == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    run = (kb * bk <= ib * bq + (bq - 1) + offset) if cfg.causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]                                   # (bq, d)
+        k = k_ref[0, 0]                                   # (bk, d)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)             # (bq, d)
+        lse = lse_ref[0, 0]                               # (1, bq)
+        delta = delta_ref[0, 0]                           # (1, bq)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * cfg.sm_scale
+        if cfg.causal:
+            rows = ib * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            cols = kb * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
+        p = jnp.exp(s - lse[0][:, None])                  # (bq, bk)
+        # dV += P^T dO
+        dv_s[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+        # dS = P * (dO V^T - delta) * scale;  dK += dS^T Q
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, bk)
+        ds = p * (dp - delta[0][:, None]) * cfg.sm_scale
+        dk_s[...] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+
+    @pl.when(ib == nq - 1)
+    def _final():
+        dk_ref[0, 0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_s, *, cfg: _Cfg, offset: int):
+    """Grid (b, h, q_blocks, k_blocks), k innermost: dq accumulates in
+    VMEM across the k scan."""
+    ib = pl.program_id(2)
+    kb = pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq, bk = cfg.block_q, cfg.block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    run = (kb * bk <= ib * bq + (bq - 1) + offset) if cfg.causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * cfg.sm_scale
+        if cfg.causal:
+            rows = ib * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            cols = kb * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows + offset, s, _NEG_INF)
+        p = jnp.exp(s - lse[0][:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[0][:, None]) * cfg.sm_scale
+        dq_s[...] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, d)
+
+    @pl.when(kb == nk - 1)
+    def _final():
+        dq_ref[0, 0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _bwd_pallas(cfg: _Cfg, q, k, v, o, lse, do):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(cfg.block_q, sq)
+    bk = min(cfg.block_k, sk)
+    cfg = dataclasses.replace(cfg, block_q=bq, block_k=bk)
+    nq, nk = sq // bq, sk // bk
+    offset = sk - sq
+    # softmax-Jacobian diagonal, rowsum(dO * O) — cheap elementwise in
+    # XLA, shaped like the LSE so both ride the same block spec
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]               # (b,h,1,sq)
+    lse4 = lse[:, :, None, :]                             # (b,h,1,sq)
+
+    compiler_params = None
+    if pltpu is not None and not cfg.interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, cfg=cfg, offset=offset),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, j, i: (b_, h_, 0, i)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, j, i: (b_, h_, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=cfg.interpret,
+    )(q, k, v, do, lse4, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, cfg=cfg, offset=offset),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, i, j: (b_, h_, 0, i)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, i, j: (b_, h_, 0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=compiler_params,
+        interpret=cfg.interpret,
+    )(q, k, v, do, lse4, delta)
+    return dq, dk, dv
+
+
 def _flash_bwd(cfg: _Cfg, res, do):
     q, k, v, o, lse = res
+    if cfg.bwd == "pallas":
+        return _bwd_pallas(cfg, q, k, v, o, lse, do)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bk = min(cfg.block_k, sk)
@@ -203,15 +374,23 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     sm_scale: Optional[float] = None,
                     block_q: int = 512,
                     block_k: int = 512,
-                    interpret: bool = False) -> jnp.ndarray:
+                    interpret: bool = False,
+                    backward: str = "pallas") -> jnp.ndarray:
     """Flash attention over (batch, heads, seq, head_dim) arrays.
 
     Requires seq divisible by the (clamped) block sizes. ``interpret=True``
-    runs the Pallas kernel in interpreter mode (CPU tests).
+    runs the Pallas kernels in interpreter mode (CPU tests).
+    ``backward`` selects the VJP implementation: "pallas" (VMEM-blocked
+    dq/dk/dv kernels recomputing P from the saved LSE) or "xla"
+    (the lax.scan formulation, kept for parity checks).
     """
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    if backward not in ("pallas", "xla"):
+        raise ValueError(f"backward must be 'pallas' or 'xla', "
+                         f"got {backward!r}")
     cfg = _Cfg(causal=causal, sm_scale=float(sm_scale),
-               block_q=block_q, block_k=block_k, interpret=interpret)
+               block_q=block_q, block_k=block_k, interpret=interpret,
+               bwd=backward)
     return _flash(cfg, q, k, v)

@@ -114,11 +114,17 @@ class PopulationBasedTraining(TrialScheduler):
         source = controller.get_trial(source_id)
         if source is None or source is trial:
             return self.CONTINUE
-        new_config = _explore(source.config, self.mutations,
-                              self.resample_prob, self._rng)
+        new_config = self._make_exploit_config(source.config, t)
         controller.exploit_trial(trial, source, new_config)
         self.perturbation_count += 1
         return self.CONTINUE
+
+    def _make_exploit_config(self, source_config: Dict,
+                             t: float) -> Dict:
+        """EXPLORE hook: PBT mutates randomly; PB2 overrides with its
+        GP-UCB selection (reference: pb2.py explore())."""
+        return _explore(source_config, self.mutations,
+                        self.resample_prob, self._rng)
 
     # -- synchronous mode (reference pbt.py `synch=True`) --------------
     # Trials PAUSE at each perturbation boundary (t >= round*interval)
@@ -164,8 +170,8 @@ class PopulationBasedTraining(TrialScheduler):
                 source = controller.get_trial(self._rng.choice(pool))
                 if source is None:
                     continue
-                new_config = _explore(source.config, self.mutations,
-                                      self.resample_prob, self._rng)
+                new_config = self._make_exploit_config(
+                    source.config, self._round * self.interval)
                 controller.exploit_trial(target, source, new_config)
                 self.perturbation_count += 1
         for tid in cohort:

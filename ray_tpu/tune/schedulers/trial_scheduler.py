@@ -14,6 +14,10 @@ class TrialScheduler:
     CONTINUE = "CONTINUE"
     PAUSE = "PAUSE"
     STOP = "STOP"
+    #: the scheduler already enacted its own lifecycle change (e.g. a
+    #: resource reallocation restarted the actor): the controller must
+    #: take no further action on this result
+    NOOP = "NOOP"
 
     def __init__(self, metric: Optional[str] = None,
                  mode: Optional[str] = None):

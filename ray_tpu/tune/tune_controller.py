@@ -157,6 +157,19 @@ class TuneController:
         trial actor runs outside it (reference tuner semantics)."""
         factory = self._resource_request(config)
         opts: Dict[str, Any] = {"num_cpus": 1.0}
+        override = getattr(trial, "resource_override", None)
+        if override:
+            # ResourceChangingScheduler reallocation (reference:
+            # resource_changing_scheduler.py swaps the trial's
+            # PlacementGroupFactory): the override wins over the
+            # trainable's static request
+            opts["num_cpus"] = float(override.get("CPU", 1.0))
+            if override.get("TPU"):
+                opts["num_tpus"] = float(override["TPU"])
+            actor_cls = ray_tpu.remote(**opts)(_TrialActor)
+            return actor_cls.remote(
+                self.trainable_cls, config, pg,
+                self._trial_storage(trial).trial_dir)
         if factory is not None and pg is not None \
                 and not factory.head_bundle_is_empty:
             head = factory.bundles[0]
@@ -174,7 +187,11 @@ class TuneController:
             self._trial_storage(trial).trial_dir)
 
     def _start_trial(self, trial: Trial) -> None:
-        factory = self._resource_request(trial.config)
+        # a reallocation override replaces the trainable's static
+        # request wholesale — reserving the factory's placement group
+        # AND the override's CPUs would double-book the cluster
+        factory = None if getattr(trial, "resource_override", None) \
+            else self._resource_request(trial.config)
         pg = factory() if factory is not None else None
         trial.local_dir = self._trial_storage(trial).trial_dir
         first_start = trial.actor is None and trial.status == PENDING \
@@ -253,6 +270,27 @@ class TuneController:
             "on_trial_error" if status == ERROR else "on_trial_complete",
             self._cb_iteration, self.trials, trial)
         self._snapshot()
+
+    # -- ResourceChangingScheduler hook -------------------------------
+    def reallocate_trial(self, trial: Trial,
+                         resources: Dict[str, float]) -> bool:
+        """Restart the trial's actor under a new resource allocation,
+        restoring from a fresh checkpoint (reference:
+        resource_changing_scheduler.py pauses the trial with a new
+        PlacementGroupFactory). Returns True when the restart cycle was
+        performed — the scheduler then returns NOOP so the normal
+        decision path doesn't double-submit."""
+        if trial.actor is None:
+            trial.resource_override = dict(resources)
+            return False
+        if self._save_trial_checkpoint(trial) is None:
+            return False
+        trial.resource_override = dict(resources)
+        trial.restore_pending = trial.checkpoint
+        self._release_trial_resources(trial)
+        trial.status = PENDING
+        self._start_trial(trial)
+        return True
 
     # -- PBT hook -----------------------------------------------------
     def exploit_trial(self, target: Trial, source: Trial,
@@ -382,6 +420,8 @@ class TuneController:
             self._stop_trial(trial, TERMINATED)
         elif decision == TrialScheduler.PAUSE:
             self._pause_trial(trial)
+        elif decision == TrialScheduler.NOOP:
+            pass  # scheduler already restarted/parked the trial itself
         else:
             self._submit_train(trial)
 

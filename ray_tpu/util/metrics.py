@@ -118,6 +118,26 @@ class Histogram(Metric):
             counts[bisect.bisect_left(self._bounds, value)] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
 
+    def _samples(self) -> List[str]:
+        out = [f"# TYPE {self._name} histogram"]
+        with self._lock:
+            for key, counts in self._counts.items():
+                cum = 0
+                for bound, c in zip(self._bounds, counts):
+                    cum += c
+                    out.append(
+                        f"{self._name}_bucket"
+                        f"{_fmt_labels(key, le=bound)} {cum}")
+                cum += counts[-1]
+                out.append(
+                    f'{self._name}_bucket{_fmt_labels(key, le="+Inf")} '
+                    f"{cum}")
+                out.append(f"{self._name}_count{_fmt_labels(key)} {cum}")
+                out.append(
+                    f"{self._name}_sum{_fmt_labels(key)} "
+                    f"{self._sums[key]}")
+        return out
+
     def bound(self, tags: Optional[Dict[str, str]] = None
               ) -> "_BoundHistogram":
         """Pre-resolved-label handle (see Counter.bound)."""
@@ -151,26 +171,6 @@ class _BoundHistogram:
                 self._key, [0] * (len(m._bounds) + 1))
             counts[bisect.bisect_left(m._bounds, value)] += 1
             m._sums[self._key] = m._sums.get(self._key, 0.0) + value
-
-    def _samples(self) -> List[str]:
-        out = [f"# TYPE {self._name} histogram"]
-        with self._lock:
-            for key, counts in self._counts.items():
-                cum = 0
-                for bound, c in zip(self._bounds, counts):
-                    cum += c
-                    out.append(
-                        f"{self._name}_bucket"
-                        f"{_fmt_labels(key, le=bound)} {cum}")
-                cum += counts[-1]
-                out.append(
-                    f'{self._name}_bucket{_fmt_labels(key, le="+Inf")} '
-                    f"{cum}")
-                out.append(f"{self._name}_count{_fmt_labels(key)} {cum}")
-                out.append(
-                    f"{self._name}_sum{_fmt_labels(key)} "
-                    f"{self._sums[key]}")
-        return out
 
 
 def _escape(v) -> str:

@@ -75,17 +75,18 @@ def test_capacity_and_delete_reuse(session):
     name, store = session
     client = NativeShmClient(name)
     big = (1 << 20) - 4096
-    # physical segment = 2x nominal (fallback-allocation headroom):
-    # two "big" objects fit, the third does not.
-    a, b = _oid(), _oid()
-    client.put_bytes(a, b"a" * big)
-    client.put_bytes(b, b"b" * big)
+    # physical segment = 4x nominal (plasma-style fallback-allocation
+    # headroom: the in-flight working set may exceed the budget): four
+    # "big" objects fit, the fifth does not.
+    fits = [_oid() for _ in range(4)]
+    for i, oid in enumerate(fits):
+        client.put_bytes(oid, bytes([97 + i]) * big)
     with pytest.raises(ObjectStoreFullError):
         client.create(_oid(), big)
-    store.delete(a)
+    store.delete(fits[0])
     c = _oid()
-    client.put_bytes(c, b"c" * big)  # space reused after delete
-    assert bytes(client.get_view(c))[:1] == b"c"
+    client.put_bytes(c, b"z" * big)  # space reused after delete
+    assert bytes(client.get_view(c))[:1] == b"z"
     client.close()
 
 

@@ -185,3 +185,48 @@ def test_flash_cross_length_causal():
                           interpret=True)
     np.testing.assert_allclose(np.asarray(jnp.swapaxes(out, 1, 2)),
                                np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_backward_matches_reference_and_xla():
+    """The Pallas dq/dk/dv kernels (P recomputed from the saved LSE)
+    must match both the dense reference gradients and the lax.scan
+    backward they replace, causal and not, incl. sq < sk."""
+    rng = jax.random.PRNGKey(21)
+
+    def ref_grads(q, k, v, causal, do):
+        def f(q, k, v):
+            return jnp.sum(attention_reference(
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2), causal=causal)
+                * jnp.swapaxes(do, 1, 2))
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    def flash_grads(q, k, v, causal, do, backward):
+        def f(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=causal, block_q=64, block_k=64,
+                interpret=True, backward=backward) * do)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for causal, (sq, sk) in [(False, (128, 128)), (True, (128, 128)),
+                             (True, (64, 128))]:
+        ks = jax.random.split(jax.random.fold_in(rng, sq + sk), 4)
+        q = jax.random.normal(ks[0], (1, 2, sq, 64))
+        k = jax.random.normal(ks[1], (1, 2, sk, 64))
+        v = jax.random.normal(ks[2], (1, 2, sk, 64))
+        do = jax.random.normal(ks[3], (1, 2, sq, 64))
+        g_ref = ref_grads(q, k, v, causal, do)
+        g_pal = flash_grads(q, k, v, causal, do, "pallas")
+        g_xla = flash_grads(q, k, v, causal, do, "xla")
+        for name, a, b in (("dq", g_pal[0], g_ref[0]),
+                           ("dk", g_pal[1], g_ref[1]),
+                           ("dv", g_pal[2], g_ref[2])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3,
+                err_msg=f"{name} causal={causal} sq={sq}")
+        for name, a, b in (("dq", g_pal[0], g_xla[0]),
+                           ("dk", g_pal[1], g_xla[1]),
+                           ("dv", g_pal[2], g_xla[2])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3,
+                err_msg=f"{name} vs xla causal={causal}")

@@ -1,0 +1,342 @@
+"""Decision-logic unit tests for PB2, BOHB, and the resource-changing
+scheduler, plus direct domain-translation tests for the HyperOpt /
+BayesOpt searcher wrappers (reference: python/ray/tune/schedulers/
+pb2.py, hb_bohb.py, resource_changing_scheduler.py; search/hyperopt/,
+search/bayesopt/). All pure in-process — no cluster."""
+
+import math
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from ray_tpu.tune.schedulers import (
+    DistributeResources, HyperBandForBOHB, PB2,
+    ResourceChangingScheduler, TrialScheduler, TuneBOHB)
+from ray_tpu.tune.search.sample import Categorical, Float, Integer
+
+
+class _Trial:
+    def __init__(self, trial_id, config):
+        self.trial_id = trial_id
+        self.config = config
+        self.checkpoint = object()
+
+
+class _Ctl:
+    """Just enough TuneController for scheduler decision logic."""
+
+    def __init__(self, trials):
+        self.trials = trials
+        self._by_id = {t.trial_id: t for t in trials}
+        self.exploits = []
+        self.reallocations = []
+        self.realloc_ok = True
+
+    def get_trial(self, tid):
+        return self._by_id.get(tid)
+
+    def is_live(self, tid):
+        return tid in self._by_id
+
+    def exploit_trial(self, target, source, new_config):
+        self.exploits.append((target.trial_id, source.trial_id,
+                              new_config))
+        target.config = new_config
+
+    def unpause_trial(self, trial):
+        pass
+
+    def reallocate_trial(self, trial, resources):
+        self.reallocations.append((trial.trial_id, dict(resources)))
+        return self.realloc_ok
+
+
+# ------------------------------------------------------------------ PB2
+def test_pb2_requires_bounds():
+    with pytest.raises(ValueError, match="bounds"):
+        PB2(metric="score", mode="max")
+
+
+def test_pb2_exploits_bottom_trial_within_bounds():
+    bounds = {"lr": [1e-5, 1e-1], "width": [8.0, 64.0]}
+    pb2 = PB2(metric="score", mode="max", perturbation_interval=2,
+              hyperparam_bounds=bounds, seed=0)
+    trials = [_Trial(f"t{i}", {"lr": 1e-3 * (i + 1),
+                               "width": 16.0 + i}) for i in range(4)]
+    ctl = _Ctl(trials)
+    # two reporting rounds so score deltas feed the GP observations
+    for t_step in (1, 2):
+        for i, tr in enumerate(trials):
+            pb2.on_trial_result(
+                ctl, tr, {"training_iteration": t_step,
+                          "score": float(i) * t_step})
+    assert ctl.exploits, "bottom-quantile trial was not exploited"
+    target_id, source_id, cfg = ctl.exploits[0]
+    assert target_id == "t0"          # worst trial exploits
+    assert source_id == "t3"          # ...the best
+    # explored config stays inside the declared bounds
+    assert bounds["lr"][0] <= cfg["lr"] <= bounds["lr"][1]
+    assert bounds["width"][0] <= cfg["width"] <= bounds["width"][1]
+    # lr spans 4 decades -> log-scaled encoding
+    assert "lr" in pb2._log_keys and "width" not in pb2._log_keys
+
+
+def test_pb2_gp_explore_uses_observations():
+    bounds = {"x": [0.0, 1.0]}
+    pb2 = PB2(metric="score", mode="max", hyperparam_bounds=bounds,
+              seed=1)
+    # seed observations: reward deltas are maximal near x=0.8
+    for i in range(24):
+        x = i / 23.0
+        vec = pb2._encode(1.0, {"x": x})
+        pb2._obs.append((1.0, vec, 1.0 - abs(x - 0.8)))
+    picks = [pb2._gp_explore({}, 1.0)["x"] for _ in range(8)]
+    # the GP-UCB argmax concentrates near the good region
+    assert sum(1 for p in picks if 0.55 <= p <= 1.0) >= 6, picks
+
+
+# ----------------------------------------------------------------- BOHB
+def test_tunebohb_random_before_min_points():
+    space = {"lr": Float(1e-4, 1e-1, log=True), "units": Integer(4, 64)}
+    s = TuneBOHB(space, metric="score", mode="max", min_points=8,
+                 seed=0)
+    cfg = s.suggest("a")
+    assert 1e-4 <= cfg["lr"] <= 1e-1
+    assert 4 <= cfg["units"] < 64 and isinstance(cfg["units"], int)
+
+
+def test_tunebohb_model_concentrates_on_good_region():
+    space = {"x": Float(0.0, 1.0)}
+    s = TuneBOHB(space, metric="score", mode="max", min_points=8,
+                 random_fraction=0.0, seed=3)
+    # good scores cluster at x ~ 0.2
+    for i in range(30):
+        x = i / 29.0
+        s.observe({"x": x}, budget=9.0, score=1.0 - abs(x - 0.2))
+    picks = [s.suggest(f"t{i}")["x"] for i in range(10)]
+    near = sum(1 for p in picks if abs(p - 0.2) < 0.25)
+    assert near >= 7, picks
+
+
+def test_tunebohb_decodes_categorical_and_int():
+    space = {"act": Categorical(["relu", "tanh", "gelu"]),
+             "n": Integer(1, 9)}
+    s = TuneBOHB(space, metric="score", mode="max", min_points=2,
+                 random_fraction=0.0, seed=0)
+    for i in range(10):
+        s.observe({"act": "tanh", "n": 5}, budget=1.0,
+                  score=1.0 if i % 2 else 0.1)
+    cfg = s.suggest("x")
+    assert cfg["act"] in ("relu", "tanh", "gelu")
+    assert isinstance(cfg["n"], int) and 1 <= cfg["n"] <= 9
+
+
+def test_hyperband_for_bohb_feeds_searcher():
+    space = {"x": Float(0.0, 1.0)}
+    searcher = TuneBOHB(space, metric="score", mode="max", min_points=2)
+    sched = HyperBandForBOHB(searcher=searcher, metric="score",
+                             mode="max", max_t=16, grace_period=1,
+                             reduction_factor=2)
+    trials = [_Trial(f"t{i}", {"x": i / 4}) for i in range(4)]
+    ctl = _Ctl(trials)
+    for tr in trials:
+        sched.on_trial_add(ctl, tr)
+        sched.on_trial_result(ctl, tr, {"training_iteration": 2,
+                                        "score": tr.config["x"]})
+    # partial-budget observations reached the searcher's KDE data
+    assert sum(len(v) for v in searcher._data.values()) == 4
+
+
+# --------------------------------------------- ResourceChangingScheduler
+def test_distribute_resources_splits_budget_evenly():
+    policy = DistributeResources(total_cpus=8, total_tpus=4)
+    trials = [_Trial(f"t{i}", {}) for i in range(4)]
+    ctl = _Ctl(trials)
+    out = policy(ctl, trials[0])
+    assert out == {"CPU": 2.0, "TPU": 1.0}
+    # population thins -> survivors grow
+    ctl.trials = trials[:2]
+    ctl._by_id = {t.trial_id: t for t in ctl.trials}
+    out = policy(ctl, trials[0])
+    assert out == {"CPU": 4.0, "TPU": 2.0}
+
+
+def test_resource_changing_scheduler_reallocates_once():
+    sched = ResourceChangingScheduler(
+        resources_allocation_function=DistributeResources(
+            total_cpus=4))
+    trials = [_Trial("a", {}), _Trial("b", {})]
+    ctl = _Ctl(trials)
+    d1 = sched.on_trial_result(ctl, trials[0], {"score": 1})
+    assert d1 == TrialScheduler.NOOP
+    assert ctl.reallocations == [("a", {"CPU": 2.0})]
+    # same allocation again -> no churn, normal CONTINUE
+    d2 = sched.on_trial_result(ctl, trials[0], {"score": 2})
+    assert d2 == TrialScheduler.CONTINUE
+    assert len(ctl.reallocations) == 1
+    # population thins -> reallocation fires again with more CPU
+    ctl.trials = trials[:1]
+    ctl._by_id = {"a": trials[0]}
+    d3 = sched.on_trial_result(ctl, trials[0], {"score": 3})
+    assert d3 == TrialScheduler.NOOP
+    assert ctl.reallocations[-1] == ("a", {"CPU": 4.0})
+
+
+def test_resource_changing_falls_back_when_controller_declines():
+    sched = ResourceChangingScheduler(
+        resources_allocation_function=DistributeResources(
+            total_cpus=4))
+    trials = [_Trial("a", {})]
+    ctl = _Ctl(trials)
+    ctl.realloc_ok = False   # e.g. no checkpoint yet
+    d = sched.on_trial_result(ctl, trials[0], {"score": 1})
+    assert d == TrialScheduler.CONTINUE
+
+
+# ----------------------------------- HyperOpt wrapper domain translation
+class _FakeHp:
+    def __init__(self, log):
+        self.log = log
+
+    def uniform(self, k, lo, hi):
+        self.log.append(("uniform", k, lo, hi))
+        return ("uniform", k)
+
+    def loguniform(self, k, lo, hi):
+        self.log.append(("loguniform", k, lo, hi))
+        return ("loguniform", k)
+
+    def qloguniform(self, k, lo, hi, q):
+        self.log.append(("qloguniform", k, lo, hi, q))
+        return ("qloguniform", k)
+
+    def randint(self, k, lo, hi):
+        self.log.append(("randint", k, lo, hi))
+        return ("randint", k)
+
+    def choice(self, k, cats):
+        self.log.append(("choice", k, list(cats)))
+        return ("choice", k)
+
+
+def _install_fake_hyperopt(monkeypatch, vals):
+    calls = []
+    fake = types.ModuleType("hyperopt")
+    fake.hp = _FakeHp(calls)
+    fake.Domain = lambda fn, space: ("domain", space)
+    fake.JOB_STATE_DONE = 2
+    fake.JOB_STATE_ERROR = 3
+    fake.STATUS_OK = "ok"
+
+    class _Trials:
+        def __init__(self):
+            self.trials = []
+
+        def insert_trial_docs(self, docs):
+            self.trials.extend(docs)
+
+        def refresh(self):
+            pass
+
+    fake.Trials = _Trials
+    fake.tpe = types.SimpleNamespace(
+        suggest=lambda ids, domain, trials, seed, n_startup_jobs: [
+            {"tid": len(trials.trials),
+             "misc": {"vals": {k: [v] for k, v in vals.items()}}}])
+    monkeypatch.setitem(sys.modules, "hyperopt", fake)
+    return calls
+
+
+def test_hyperopt_space_translation_and_clamping(monkeypatch):
+    calls = _install_fake_hyperopt(
+        monkeypatch, vals={"lr": 0.02, "layers": 99.0, "act": 1})
+    from ray_tpu.tune.search.searcher import HyperOptSearch
+    s = HyperOptSearch(metric="score", mode="max")
+    space = {"lr": Float(1e-4, 1e-1, log=True),
+             "layers": Integer(1, 8, log=True),
+             "act": Categorical(["relu", "tanh"]),
+             "const": 7}
+    s.set_search_properties("score", "max", space)
+    kinds = {c[0]: c for c in calls}
+    # log float -> loguniform with LOG-space bounds
+    assert kinds["loguniform"][2] == pytest.approx(math.log(1e-4))
+    assert kinds["loguniform"][3] == pytest.approx(math.log(1e-1))
+    # log int -> qloguniform (hyperopt has no log-int primitive)
+    assert "qloguniform" in kinds
+    # categorical -> choice with the original categories
+    assert kinds["choice"][2] == ["relu", "tanh"]
+
+    cfg = s.suggest("t1")
+    # categorical decoded from hp.choice INDEX
+    assert cfg["act"] == "tanh"
+    # out-of-range int sample clamps into [lower, upper)
+    assert cfg["layers"] == 7
+    assert cfg["lr"] == pytest.approx(0.02)
+    # constants pass through untouched
+    assert cfg["const"] == 7
+
+
+def test_hyperopt_reports_loss_sign(monkeypatch):
+    _install_fake_hyperopt(monkeypatch, vals={"lr": 0.01})
+    from ray_tpu.tune.search.searcher import HyperOptSearch
+    s = HyperOptSearch(metric="score", mode="max")
+    s.set_search_properties("score", "max",
+                            {"lr": Float(1e-3, 1e-1)})
+    s.suggest("t1")
+    s.on_trial_complete("t1", result={"score": 5.0})
+    done = s._trials.trials[0]
+    assert done["result"]["loss"] == -5.0   # max -> negated loss
+    assert done["state"] == 2
+
+
+# ----------------------------------- BayesOpt wrapper domain translation
+def _install_fake_bayesopt(monkeypatch, raw):
+    fake = types.ModuleType("bayes_opt")
+    registered = []
+
+    class _BO:
+        def __init__(self, f=None, pbounds=None, random_state=None,
+                     allow_duplicate_points=None, **kw):
+            self.pbounds = pbounds
+
+        def suggest(self, *a, **kw):
+            return dict(raw)
+
+        def register(self, params=None, target=None):
+            registered.append((params, target))
+
+    class _Utility:
+        def __init__(self, *a, **kw):
+            pass
+
+    fake.BayesianOptimization = _BO
+    fake.UtilityFunction = _Utility
+    monkeypatch.setitem(sys.modules, "bayes_opt", fake)
+    return registered
+
+
+def test_bayesopt_rejects_categorical(monkeypatch):
+    _install_fake_bayesopt(monkeypatch, raw={})
+    from ray_tpu.tune.search.searcher import BayesOptSearch
+    s = BayesOptSearch(metric="score", mode="max")
+    with pytest.raises(ValueError, match="continuous"):
+        s.set_search_properties(
+            "score", "max", {"act": Categorical(["a", "b"])})
+
+
+def test_bayesopt_integer_rounding_clamping_and_register(monkeypatch):
+    registered = _install_fake_bayesopt(
+        monkeypatch, raw={"units": 63.7, "lr": 0.5})
+    from ray_tpu.tune.search.searcher import BayesOptSearch
+    s = BayesOptSearch(metric="score", mode="min")
+    s.set_search_properties(
+        "score", "min", {"units": Integer(4, 32), "lr": Float(0, 1)})
+    cfg = s.suggest("t1")
+    # integer samples round then clamp into [lower, upper)
+    assert cfg["units"] == 31
+    assert cfg["lr"] == pytest.approx(0.5)
+    s.on_trial_complete("t1", result={"score": 2.0})
+    params, target = registered[0]
+    assert target == -2.0    # min mode negates for the maximizer
