@@ -2267,7 +2267,13 @@ class Controller:
 
     # -------------------------------------------------------- observability
     def _h_state_query(self, identity: bytes, m: dict) -> None:
-        what = m["what"]
+        self._reply(identity, m["rid"], {
+            "rows": self.state_rows(m["what"], m.get("limit"))})
+
+    def state_rows(self, what: str, limit: Optional[int] = None):
+        """Loop-thread-only state snapshot (shared by the wire state
+        API and the dashboard head, which holds a direct reference)."""
+        m = {"limit": limit} if limit else {}
         if what == "nodes":
             rows = [{
                 "node_id": n.node_id.hex(), "alive": n.alive,
@@ -2308,7 +2314,7 @@ class Controller:
             rows = self.task_events[-m.get("limit", 100_000):]
         else:
             rows = []
-        self._reply(identity, m["rid"], {"rows": rows})
+        return rows
 
     def _h_timeline(self, identity: bytes, m: dict) -> None:
         self.task_events.extend(m["events"])

@@ -54,6 +54,12 @@ class DashboardHead:
             pass
 
     # ----------------------------------------------------- cluster state
+    def state(self, what: str, limit: int = 1000):
+        """Live state rows for the UI (same snapshot the wire state API
+        serves; reference: dashboard state_aggregator over GCS)."""
+        return self.controller.call_on_loop(
+            lambda: self.controller.state_rows(what, limit))
+
     def cluster_status(self) -> dict:
         # controller state is single-thread-owned: snapshot on its loop
         return self.controller.call_on_loop(self._cluster_status_locked)
@@ -117,11 +123,42 @@ def _make_handler(head: DashboardHead):
             # /api/jobs/<id>[/logs|/stop]
             return parts[2] if len(parts) >= 3 else None
 
+        def _html(self, text: str) -> None:
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         # -- routes --
         def do_GET(self):
-            path = urlparse(self.path).path.rstrip("/")
+            parsed = urlparse(self.path)
+            path = parsed.path.rstrip("/")
             try:
-                if path == "/api/jobs":
+                if path in ("", "/index.html"):
+                    from ray_tpu.dashboard.static_ui import INDEX_HTML
+                    self._html(INDEX_HTML)
+                elif path.startswith("/api/state/"):
+                    what = path.split("/")[-1]
+                    if what not in ("nodes", "actors", "tasks",
+                                    "objects", "placement_groups",
+                                    "jobs"):
+                        self._json({"error": f"unknown state {what!r}"},
+                                   404)
+                        return
+                    from urllib.parse import parse_qs
+                    q = parse_qs(parsed.query)
+                    try:
+                        limit = int(q.get("limit", ["1000"])[0])
+                    except ValueError:
+                        self._json({"error": "limit must be an int"},
+                                   400)
+                        return
+                    self._json({"rows": head.state(what, limit)})
+                elif path == "/api/timeline":
+                    self._json(head.state("timeline", 100_000))
+                elif path == "/api/jobs":
                     self._json(head.job_manager.list_jobs())
                 elif path == "/api/version":
                     from ray_tpu import __version__

@@ -7,6 +7,14 @@ task output checkpointed to storage (``task_executor.py:50``,
 skips completed tasks and replays only the rest.
 """
 
+from ray_tpu.workflow.events import (
+    EventListener,
+    HTTPListener,
+    TimerListener,
+    deliver_event,
+    start_http_event_provider,
+    wait_for_event,
+)
 from ray_tpu.workflow.api import (
     delete,
     get_metadata,
@@ -20,7 +28,13 @@ from ray_tpu.workflow.api import (
 )
 
 __all__ = [
+    "EventListener",
+    "HTTPListener",
+    "TimerListener",
     "delete",
+    "deliver_event",
+    "start_http_event_provider",
+    "wait_for_event",
     "get_metadata",
     "get_output",
     "get_status",

@@ -82,3 +82,42 @@ def test_cluster_status_endpoint(cluster):
         out = json.loads(resp.read())
     assert out["nodes"] and out["nodes"][0]["alive"]
     assert "resources_total" in out["nodes"][0]
+
+
+def test_dashboard_web_ui_serves_live_data(cluster):
+    """The static UI (reference: dashboard/client, scoped to tables)
+    loads at / and its state endpoints return live cluster rows."""
+    addr = _dashboard_address(cluster)
+
+    # a bit of live state to observe
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    a = Pinger.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+    html = urllib.request.urlopen(addr + "/", timeout=30).read().decode()
+    assert "ray-tpu dashboard" in html
+    assert "/api/state/nodes" in html      # the UI polls the state API
+
+    nodes = json.load(urllib.request.urlopen(
+        addr + "/api/state/nodes", timeout=30))
+    assert len(nodes["rows"]) == 1 and nodes["rows"][0]["alive"]
+
+    actors = json.load(urllib.request.urlopen(
+        addr + "/api/state/actors", timeout=30))
+    assert any(r["state"] == "ALIVE" for r in actors["rows"])
+
+    tasks = json.load(urllib.request.urlopen(
+        addr + "/api/state/tasks?limit=10", timeout=30))
+    assert isinstance(tasks["rows"], list)
+
+    # timeline download is valid chrome-trace JSON (a list of events)
+    ray_tpu.timeline()  # flush events
+    tl = json.load(urllib.request.urlopen(
+        addr + "/api/timeline", timeout=30))
+    assert isinstance(tl, list)
+
+    ray_tpu.kill(a)
