@@ -23,6 +23,7 @@ from ray_tpu.rllib.offline import (
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
     "APPO",
@@ -58,5 +59,7 @@ __all__ = [
     "PPOConfig",
     "RLModule",
     "RLModuleSpec",
+    "SAC",
+    "SACConfig",
     "compute_gae",
 ]

@@ -251,15 +251,11 @@ class DQN(Algorithm):
             probe.close()
         except Exception:
             pass
-        self.learner = DQNLearner(
-            self.module_spec, learning_rate=cfg.lr, gamma=cfg.gamma,
-            grad_clip=cfg.grad_clip,
-            target_update_freq=cfg.target_network_update_freq,
-            double_q=cfg.double_q, seed=cfg.seed)
+        self.learner = self._make_learner()
         self.buffer = ReplayBuffer(
             cfg.replay_buffer_capacity, obs_shape, seed=cfg.seed)
         n_runners = max(1, cfg.num_env_runners)
-        runner_cls = ray_tpu.remote(num_cpus=1)(DQNEnvRunner)
+        runner_cls = ray_tpu.remote(num_cpus=1)(self._runner_cls())
         self.env_runners = [
             runner_cls.remote(env_creator, self.module_spec,
                               cfg.num_envs_per_env_runner, cfg.seed, i)
@@ -267,6 +263,19 @@ class DQN(Algorithm):
         self._sync_weights()
         self._timesteps = 0
         self._return_window: List[float] = []
+
+    # overridable by off-policy variants (SAC) so setup() builds the
+    # right learner/runners ONCE instead of a kill-and-recreate pass
+    def _make_learner(self):
+        cfg = self.config
+        return DQNLearner(
+            self.module_spec, learning_rate=cfg.lr, gamma=cfg.gamma,
+            grad_clip=cfg.grad_clip,
+            target_update_freq=cfg.target_network_update_freq,
+            double_q=cfg.double_q, seed=cfg.seed)
+
+    def _runner_cls(self):
+        return DQNEnvRunner
 
     def _sync_weights(self) -> None:
         w_ref = ray_tpu.put(self.learner.get_weights())

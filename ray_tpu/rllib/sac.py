@@ -1,0 +1,232 @@
+"""SAC (discrete): soft actor-critic with twin Q networks and learned
+temperature.
+
+Reference: ``rllib/algorithms/sac/sac.py`` + the torch loss in
+``sac/torch/sac_torch_learner.py`` (twin critics, polyak target sync,
+entropy temperature tuned toward a target entropy). The discrete-action
+formulation follows Christodoulou 2019 (expectations over the action
+distribution instead of reparameterized samples) — the reference's SAC
+is continuous-first, so the discrete path matches what its
+``target_entropy="auto"`` machinery computes for ``Discrete`` spaces.
+TPU-native shape: like DQN, the whole update (both critic losses, the
+policy loss, the temperature loss, three adams, and the polyak sync) is
+one jitted XLA program.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNEnvRunner
+from ray_tpu.rllib.models import init_mlp, mlp_forward
+from ray_tpu.rllib.rl_module import RLModuleSpec
+
+
+class SACEnvRunner(DQNEnvRunner):
+    """Exploration = sampling from the categorical policy (reference:
+    SAC explores with its stochastic policy; epsilon is ignored)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # install the stochastic forward ONCE: DQNEnvRunner.sample asks
+        # forward_inference for actions, and SAC's actions are draws
+        # from the softmax policy, not the argmax
+        module = self._module
+        rng = self._rng
+        na = module.spec.num_actions
+
+        def sample_policy(params, obs):
+            import jax
+            import jax.numpy as jnp
+            from ray_tpu.rllib.models import actor_critic_forward
+            logits, _ = actor_critic_forward(
+                params, jnp.asarray(obs, jnp.float32))
+            p = np.asarray(jax.nn.softmax(logits), np.float64)
+            cum = np.cumsum(p, axis=-1)
+            r = rng.random((p.shape[0], 1))
+            # clamp: float cumsums can end below 1.0, and (r < cum)
+            # all-False would silently argmax to action 0
+            return np.minimum((r < cum).argmax(axis=-1)
+                              + ((r >= cum[:, -1:]).ravel()
+                                 * (na - 1)).astype(np.int64),
+                              na - 1)
+
+        module.forward_inference = sample_policy
+
+    def sample(self, num_steps: int, epsilon: float = 0.0):
+        return super().sample(num_steps, epsilon=0.0)
+
+
+class SACLearner:
+    """Twin soft Q + categorical policy + learned log-alpha, one jitted
+    update with polyak target sync."""
+
+    def __init__(self, module_spec: RLModuleSpec, *,
+                 actor_lr: float, critic_lr: float, alpha_lr: float,
+                 gamma: float, tau: float,
+                 target_entropy: Optional[float],
+                 grad_clip: Optional[float], seed: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        self.module = module_spec.build()
+        self._gamma = gamma
+        self._tau = tau
+        na = module_spec.num_actions
+        # reference target_entropy="auto" for Discrete: 0.98 * log|A|
+        self._target_entropy = target_entropy if target_entropy \
+            is not None else 0.98 * math.log(na)
+
+        def maybe_clip(tx):
+            return optax.chain(optax.clip_by_global_norm(grad_clip),
+                               tx) if grad_clip else tx
+
+        self._pi_opt = maybe_clip(optax.adam(actor_lr))
+        self._q_opt = maybe_clip(optax.adam(critic_lr))
+        self._a_opt = optax.adam(alpha_lr)
+
+        keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+        sizes = [module_spec.observation_dim,
+                 *module_spec.hiddens, na]
+        pi = self.module.init(keys[0])
+        q1 = init_mlp(keys[1], sizes)
+        q2 = init_mlp(keys[2], sizes)
+        self._state = {
+            "pi": pi, "q1": q1, "q2": q2,
+            "q1_t": jax.tree.map(lambda x: x.copy(), q1),
+            "q2_t": jax.tree.map(lambda x: x.copy(), q2),
+            "log_alpha": jnp.zeros(()),
+            "pi_opt": self._pi_opt.init(pi),
+            "q_opt": self._q_opt.init({"q1": q1, "q2": q2}),
+            "a_opt": self._a_opt.init(jnp.zeros(())),
+        }
+        self._jit_update = jax.jit(self._update, donate_argnums=(0,))
+
+    def _policy_dist(self, pi_params, obs):
+        import jax
+        out = self.module.forward_train(pi_params, obs)
+        logp = jax.nn.log_softmax(out["action_logits"])
+        import jax.numpy as jnp
+        return jnp.exp(logp), logp
+
+    def _update(self, state, batch):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        obs, next_obs = batch["obs"], batch["next_obs"]
+        acts = batch["actions"]
+        alpha = jnp.exp(state["log_alpha"])
+
+        # -- soft target: y = r + gamma * E_a'[minQt - alpha * logpi] --
+        p_next, logp_next = self._policy_dist(state["pi"], next_obs)
+        q1t = mlp_forward(state["q1_t"], next_obs)
+        q2t = mlp_forward(state["q2_t"], next_obs)
+        v_next = jnp.sum(
+            p_next * (jnp.minimum(q1t, q2t) - alpha * logp_next), -1)
+        y = batch["rewards"] + self._gamma \
+            * (1.0 - batch["dones"]) * jax.lax.stop_gradient(v_next)
+
+        def q_loss(qs):
+            idx = jnp.arange(obs.shape[0])
+            l1 = jnp.mean((mlp_forward(qs["q1"], obs)[idx, acts]
+                           - y) ** 2)
+            l2 = jnp.mean((mlp_forward(qs["q2"], obs)[idx, acts]
+                           - y) ** 2)
+            return l1 + l2, (l1, l2)
+
+        (qf_loss, (l1, l2)), q_grads = jax.value_and_grad(
+            q_loss, has_aux=True)({"q1": state["q1"],
+                                   "q2": state["q2"]})
+        q_updates, q_opt = self._q_opt.update(
+            q_grads, state["q_opt"], {"q1": state["q1"],
+                                      "q2": state["q2"]})
+        qs = optax.apply_updates({"q1": state["q1"],
+                                  "q2": state["q2"]}, q_updates)
+
+        # -- policy: E_a[alpha * logpi - minQ] --------------------------
+        def pi_loss(pi_params):
+            p, logp = self._policy_dist(pi_params, obs)
+            minq = jnp.minimum(mlp_forward(qs["q1"], obs),
+                               mlp_forward(qs["q2"], obs))
+            loss = jnp.mean(jnp.sum(
+                p * (alpha * logp - jax.lax.stop_gradient(minq)), -1))
+            entropy = -jnp.mean(jnp.sum(p * logp, -1))
+            return loss, entropy
+
+        (pl, entropy), pi_grads = jax.value_and_grad(
+            pi_loss, has_aux=True)(state["pi"])
+        pi_updates, pi_opt = self._pi_opt.update(
+            pi_grads, state["pi_opt"], state["pi"])
+        pi = optax.apply_updates(state["pi"], pi_updates)
+
+        # -- temperature toward the target entropy ----------------------
+        def a_loss(log_alpha):
+            return jnp.exp(log_alpha) * jax.lax.stop_gradient(
+                entropy - self._target_entropy)
+
+        al, a_grad = jax.value_and_grad(a_loss)(state["log_alpha"])
+        a_updates, a_opt = self._a_opt.update(
+            a_grad, state["a_opt"], state["log_alpha"])
+        log_alpha = optax.apply_updates(state["log_alpha"], a_updates)
+
+        # -- polyak sync -------------------------------------------------
+        tau = self._tau
+        polyak = lambda t, o: jax.tree.map(  # noqa: E731
+            lambda a, b: (1 - tau) * a + tau * b, t, o)
+
+        metrics = {
+            "qf_loss": qf_loss, "q1_loss": l1, "q2_loss": l2,
+            "policy_loss": pl, "alpha_loss": al,
+            "alpha": jnp.exp(log_alpha), "entropy": entropy,
+            "total_loss": qf_loss + pl + al,
+        }
+        return {
+            "pi": pi, "q1": qs["q1"], "q2": qs["q2"],
+            "q1_t": polyak(state["q1_t"], qs["q1"]),
+            "q2_t": polyak(state["q2_t"], qs["q2"]),
+            "log_alpha": log_alpha,
+            "pi_opt": pi_opt, "q_opt": q_opt, "a_opt": a_opt,
+        }, metrics
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self._state, metrics = self._jit_update(self._state, jb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        # the runners need only the policy subtree
+        return self._state["pi"]
+
+
+class SACConfig(DQNConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self.lr = 3e-4                  # actor lr
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.tau = 0.01
+        self.target_entropy: Optional[float] = None   # auto
+        self.train_batch_size = 64
+        self.num_steps_sampled_before_learning_starts = 500
+        self.updates_per_step = 4
+
+
+class SAC(DQN):
+    config_cls = SACConfig
+
+    def _make_learner(self):
+        cfg = self.config
+        return SACLearner(
+            self.module_spec, actor_lr=cfg.lr, critic_lr=cfg.critic_lr,
+            alpha_lr=cfg.alpha_lr, gamma=cfg.gamma, tau=cfg.tau,
+            target_entropy=cfg.target_entropy, grad_clip=cfg.grad_clip,
+            seed=cfg.seed)
+
+    def _runner_cls(self):
+        return SACEnvRunner
