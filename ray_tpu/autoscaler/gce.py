@@ -52,48 +52,113 @@ class TPUApiError(RuntimeError):
         self.status = status
 
 
-def _default_token_fn() -> str:
+def _default_token_fn() -> Dict[str, Any]:
+    """Fetch an access token from the GCE metadata server. Returns the
+    raw token payload ({access_token, expires_in, ...})."""
     req = urllib.request.Request(
         METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"})
     with urllib.request.urlopen(req, timeout=10) as resp:
-        return json.loads(resp.read())["access_token"]
+        return json.loads(resp.read())
+
+
+#: HTTP statuses worth retrying (reference: gcp/node.py:618's
+#: has_retriable_http_code — rate limits and transient server errors)
+_RETRYABLE_STATUSES = (429, 500, 502, 503, 504)
 
 
 class TPUApiClient:
     """Thin REST client for the Cloud TPU v2 API.
 
     ``request_fn(method, url, body_dict_or_None) -> dict`` is the whole
-    transport; tests inject a fake, production uses `_urllib_request`.
+    transport; tests inject a fake, production uses `_urllib_request` —
+    which retries 429/5xx and network errors with exponential backoff +
+    jitter, caches the metadata token until shortly before expiry, and
+    refreshes it once on a 401 (reference: gcp/node.py retry semantics).
     """
 
     def __init__(self, project: str, zone: str,
                  request_fn: Optional[Callable[..., dict]] = None,
-                 token_fn: Optional[Callable[[], str]] = None):
+                 token_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 max_retries: int = 5):
         self.project = project
         self.zone = zone
         self._token_fn = token_fn or _default_token_fn
         self._request = request_fn or self._urllib_request
+        self._sleep = sleep_fn
+        self._max_retries = max_retries
+        self._token: Optional[str] = None
+        self._token_expiry = 0.0
+        self._rng = __import__("random").Random()
 
     @property
     def parent(self) -> str:
         return f"projects/{self.project}/locations/{self.zone}"
 
+    # ------------------------------------------------------------- token
+    def _get_token(self) -> str:
+        if self._token is None or time.monotonic() >= self._token_expiry:
+            payload = self._token_fn()
+            if isinstance(payload, str):
+                # legacy injectable token_fns return the bare token
+                self._token, self._token_expiry = payload, float("inf")
+            else:
+                self._token = payload["access_token"]
+                # refresh 60s early so in-flight requests never carry a
+                # token that expires mid-call
+                self._token_expiry = time.monotonic() + max(
+                    30.0, float(payload.get("expires_in", 3600)) - 60.0)
+        return self._token
+
+    def _invalidate_token(self) -> None:
+        self._token = None
+        self._token_expiry = 0.0
+
+    def _backoff(self, attempt: int) -> None:
+        # exponential with full jitter, capped (reference retry shape)
+        delay = min(30.0, 2.0 ** attempt)
+        self._sleep(delay * (0.5 + 0.5 * self._rng.random()))
+
     def _urllib_request(self, method: str, url: str,
                         body: Optional[dict]) -> dict:
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Authorization": f"Bearer {self._token_fn()}",
-                     "Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                payload = resp.read()
-        except urllib.error.HTTPError as e:  # surface the API's message
-            detail = e.read().decode(errors="replace")[:500]
-            raise TPUApiError(
-                f"TPU API {method} {url} -> {e.code}: {detail}",
-                status=e.code) from e
-        return json.loads(payload) if payload else {}
+        attempt = 0
+        refreshed = False
+        while True:
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={"Authorization": f"Bearer {self._get_token()}",
+                         "Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = resp.read()
+                return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")[:500]
+                if e.code == 401 and not refreshed:
+                    # token expired server-side (clock skew, revocation):
+                    # refresh once and retry immediately
+                    refreshed = True
+                    self._invalidate_token()
+                    continue
+                if e.code not in _RETRYABLE_STATUSES \
+                        or attempt >= self._max_retries:
+                    raise TPUApiError(
+                        f"TPU API {method} {url} -> {e.code}: {detail}",
+                        status=e.code) from e
+                logger.warning("gce: %s %s -> %s (attempt %d); retrying",
+                               method, url, e.code, attempt + 1)
+            except urllib.error.URLError as e:
+                # transport-level failure (DNS, conn reset): retryable
+                if attempt >= self._max_retries:
+                    raise TPUApiError(
+                        f"TPU API {method} {url} unreachable: "
+                        f"{e.reason}") from e
+                logger.warning("gce: %s %s unreachable (%s, attempt %d);"
+                               " retrying", method, url, e.reason,
+                               attempt + 1)
+            self._backoff(attempt)
+            attempt += 1
 
     # ------------------------------------------------------------ nodes
     def create_node(self, node_id: str, body: dict) -> dict:
@@ -137,8 +202,15 @@ class TPUApiClient:
             time.sleep(poll_s)
             op = self.get_operation(op["name"])
         if "error" in op:
+            # surface the operation metadata alongside the error: the
+            # TPU API puts the target node + verb there, which is what
+            # an operator needs to act on the failure
+            meta = op.get("metadata") or {}
+            ctx = ", ".join(f"{k}={meta[k]}" for k in
+                            ("target", "verb", "apiVersion") if k in meta)
             raise TPUApiError(
-                f"operation {op.get('name')} failed: {op['error']}")
+                f"operation {op.get('name')} failed: {op['error']}"
+                + (f" ({ctx})" if ctx else ""))
         return op
 
 

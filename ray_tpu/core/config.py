@@ -50,6 +50,16 @@ class Config:
     #: can share one host (cluster_utils tests) — large objects are instead
     #: prefaulted per-create, and recycled extents stay warm.
     object_store_prefault_bytes: int = 256 << 20
+    #: Owner-local small objects (reference: the in-process memory store +
+    #: owner-based object directory, core_worker's ownership model). When
+    #: on, inline-sized objects (puts and task returns at or below
+    #: max_inline_object_size) are tracked ONLY by their owner: no
+    #: controller directory entry, no REF_DELTAS traffic, freed by owner
+    #: GC. A ref that escapes (serialized, passed as a task arg) promotes
+    #: the object to controller tracking and publishes its value so
+    #: borrowers and dep-parked tasks resolve exactly as before.
+    #: RAY_TPU_OWNER_LOCAL_OBJECTS=0 restores controller-tracked objects.
+    owner_local_objects: bool = True
 
     # --- scheduler (reference: hybrid_scheduling_policy.h) ---
     #: Pack onto a node until its critical-resource utilization crosses this

@@ -127,6 +127,10 @@ class Controller:
         # + audit strike counts (directory-hole detection)
         self._waiter_since: Dict[bytes, float] = {}
         self._hole_strikes: Dict[bytes, int] = {}
+        # owner-local objects a borrower is parked on: object_id ->
+        # owner identity we asked to publish (FETCH_OBJECT). Resolved by
+        # the owner's PUT_OBJECT; audited against owner death.
+        self._owner_fetches: Dict[bytes, bytes] = {}
         # worker -> last runtime-env key (env-affinity dispatch)
         self._worker_env: Dict[bytes, str] = {}
         # worker identity -> owning driver identity: workers leased to a
@@ -594,6 +598,7 @@ class Controller:
                     self._dispatch(task_id)
         self._waiter_since.pop(object_id_b, None)
         self._hole_strikes.pop(object_id_b, None)
+        self._owner_fetches.pop(object_id_b, None)
         waiters = self.local_waiters.pop(object_id_b, [])
         for identity, rid in waiters:
             self._answer_location(identity, rid, object_id_b)
@@ -615,6 +620,15 @@ class Controller:
                 self._reconstruct(e)
             elif e is None and object_id_b not in self._waiter_since:
                 self._waiter_since[object_id_b] = time.monotonic()
+            owner_b = m.get("owner")
+            if owner_b and owner_b != identity and e is None \
+                    and object_id_b not in self._owner_fetches:
+                # owner-local object (never published): ask the owner to
+                # publish its value; the PUT_OBJECT it sends resolves
+                # this waiter through _object_created
+                self._owner_fetches[object_id_b] = owner_b
+                self._send(owner_b, P.FETCH_OBJECT,
+                           {"object_id": object_id_b})
             self.local_waiters[object_id_b].append((identity, m["rid"]))
 
     def _answer_location(self, identity: bytes, rid: bytes, object_id_b: bytes,
@@ -1303,12 +1317,29 @@ class Controller:
                     self._h_submit_task(m.get("owner") or identity,
                                         {"spec": spec})
                     return
+            recorded = []
             for r in m.get("results", []):
+                if r.get("inline") is None and not r.get("node_id"):
+                    # owner-local result (inline meta trimmed by the
+                    # worker, or a bare error result): the owner holds
+                    # the value/error and its lifecycle — no directory
+                    # entry, no refcounts (recording an error entry here
+                    # would leak it forever: the owner never promoted
+                    # these returns, so no deltas ever arrive). A parked
+                    # borrower resolves via FETCH_OBJECT, so it must NOT
+                    # be woken (and failed) here. Crash-window caveat,
+                    # matching the reference's in-process store: if the
+                    # worker dies with its direct TASK_RESULT unflushed,
+                    # the value is unrecoverable (no controller backup).
+                    continue
                 if self.refs.is_released(r["object_id"]) and \
                         r["object_id"] not in self._pending_frees:
                     # zero confirmed past the grace window: don't
                     # resurrect. Grace-pending zeros still record — the
                     # deferred free (or a resurrecting +1) decides.
+                    # Still wake waiters (pre-change behavior): a parked
+                    # get on a freed object should fail now, not hang.
+                    recorded.append(r["object_id"])
                     continue
                 e = self._entry(r["object_id"])
                 e.owner = m.get("owner", identity)
@@ -1323,8 +1354,9 @@ class Controller:
                     # resubmit) failing on since-freed args must not
                     # poison an object that already has data
                     e.error = m["error"]
-            for r in m.get("results", []):
-                self._object_created(r["object_id"])
+                recorded.append(r["object_id"])
+            for b in recorded:
+                self._object_created(b)
             return
         if m.get("owner_report"):
             # the OWNER reports a task that will never execute (dead
@@ -1405,7 +1437,22 @@ class Controller:
         # record results
         owner = (t.spec.owner.binary() if t and t.spec.owner else m.get("owner"))
         results_meta = []
+        wake = []
         for r in m.get("results", []):
+            if m.get("owner_notified") and r.get("inline") is None \
+                    and not r.get("node_id") \
+                    and (m.get("error") is None
+                         or m.get("is_actor_task")):
+                # owner-local result of a direct (actor) call: owner
+                # holds it; nothing to record or forward, and any parked
+                # borrower resolves via FETCH_OBJECT — not here. Actor
+                # call ERRORS are owner-local too (their returns were
+                # never promoted — recording would leak the entry);
+                # controller-path task errors still record, because
+                # their returns were promoted at submit and dep-parked
+                # tasks fail fast off the entry.
+                continue
+            wake.append(r["object_id"])
             if self.refs.is_released(r["object_id"]):
                 rb = r["object_id"]
                 if self.local_waiters.get(rb) or self.dep_waiters.get(rb):
@@ -1472,9 +1519,12 @@ class Controller:
             if owner_identity is not None:
                 self._send(owner_identity, P.TASK_RESULT, {
                     "task_id": tid, "results": results_meta,
-                    "error": m.get("error")})
-        for r in m.get("results", []):
-            self._object_created(r["object_id"])
+                    "error": m.get("error"),
+                    # the controller recorded these results: the owner
+                    # must promote owner-local returns to tracked
+                    "via_controller": True})
+        for b in wake:
+            self._object_created(b)
         self._maybe_schedule()
 
     def _find_owner_identity(self, t: Optional[PendingTask], m: dict,
@@ -1553,7 +1603,8 @@ class Controller:
         owner_identity = self._find_owner_identity(t, {}, b"")
         if owner_identity:
             self._send(owner_identity, P.TASK_RESULT, {
-                "task_id": tid, "results": results_meta, "error": err})
+                "task_id": tid, "results": results_meta, "error": err,
+                "via_controller": True})
         row = self.task_table.get(tid)
         if row is not None:
             row["state"] = "FAILED"
@@ -1598,7 +1649,8 @@ class Controller:
             owner_identity = self._find_owner_identity(t, {}, b"")
             if owner_identity:
                 self._send(owner_identity, P.TASK_RESULT,
-                           {"task_id": tid, "results": results, "error": err})
+                           {"task_id": tid, "results": results,
+                            "error": err, "via_controller": True})
         elif t.worker is not None:
             # dispatched: tell the worker to skip it if still queued
             # worker-side, or interrupt itself if it is the running task
@@ -1677,7 +1729,8 @@ class Controller:
             results = [{"object_id": oid.binary(), "error": err}
                        for oid in spec.return_ids()]
             self._send(identity, P.TASK_RESULT, {
-                "task_id": spec.task_id.binary(), "results": results, "error": err})
+                "task_id": spec.task_id.binary(), "results": results,
+                "error": err, "via_controller": True})
             return
         worker = self.actor_workers.get(aid)
         if info.state != "ALIVE" or worker is None:
@@ -1714,7 +1767,7 @@ class Controller:
                        for oid in spec.return_ids()]
             self._send(caller, P.TASK_RESULT, {
                 "task_id": spec.task_id.binary(), "results": results,
-                "error": error})
+                "error": error, "via_controller": True})
 
     def _h_kill_actor(self, identity: bytes, m: dict) -> None:
         aid = m["actor_id"]
@@ -2053,7 +2106,8 @@ class Controller:
         owner_identity = self._find_owner_identity(t, {}, b"")
         if owner_identity:
             self._send(owner_identity, P.TASK_RESULT, {
-                "task_id": tid, "results": results, "error": err})
+                "task_id": tid, "results": results, "error": err,
+                "via_controller": True})
 
     def _on_actor_died(self, aid: bytes, worker_identity: bytes) -> None:
         """Actor restart state machine (reference: gcs_actor_manager.h
@@ -2212,6 +2266,21 @@ class Controller:
                 self._hole_strikes.pop(b, None)
                 continue
             self.local_waiters[b] = live
+            owner_b = self._owner_fetches.get(b)
+            if owner_b is not None and owner_b not in self.peers:
+                # waiting on an owner-local object whose owner is gone:
+                # nothing can ever publish it — fail fast (reference:
+                # OwnerDiedError semantics for in-process-store objects)
+                from ray_tpu.exceptions import ObjectLostError
+                err = P.dumps(ObjectLostError(
+                    ObjectID(b), "the object's owner died before "
+                    "publishing this owner-local object"))
+                for ident, rid in self.local_waiters.pop(b, []):
+                    self._reply(ident, rid, {"error": err})
+                self._owner_fetches.pop(b, None)
+                self._waiter_since.pop(b, None)
+                self._hole_strikes.pop(b, None)
+                continue
             if now - self._waiter_since[b] < 15.0:
                 continue
             if self._object_expected(b):
@@ -2220,6 +2289,10 @@ class Controller:
                 continue
             strikes = self._hole_strikes.get(b, 0) + 1
             self._hole_strikes[b] = strikes
+            if owner_b is not None and strikes in (1, 5, 30):
+                # re-ask a live owner (the first FETCH_OBJECT may have
+                # been dropped in a reconnect window)
+                self._send(owner_b, P.FETCH_OBJECT, {"object_id": b})
             if strikes in (1, 5, 30):
                 # cheap repair probes; directory holes (producer killed
                 # between store and report) resolve on the first one

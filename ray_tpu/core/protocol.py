@@ -54,6 +54,9 @@ TASK_HANDBACK = b"HBK"       # worker->controller {specs: [...]}
 PUT_OBJECT = b"PUT"          # seal notification {object_id, node_id, size, owner}
 FREE_OBJECT = b"FRE"         # controller->node {object_id}
 GET_LOCATION = b"LOC"        # {object_id} -> {node_id|None, inline|None}
+FETCH_OBJECT = b"FOB"        # controller->owner {object_id}: publish this
+                             # owner-local object's value (PUT_OBJECT) so a
+                             # parked borrower/dep can resolve
 PULL_OBJECT = b"PUL"         # controller->dest node: pull this object
 PULL_REQUEST = b"PRQ"        # dest->src node DIRECT: stream it to me
 PUSH_OBJECT = b"PSH"         # src->dest node DIRECT: chunked payload

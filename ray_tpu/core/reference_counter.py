@@ -45,6 +45,11 @@ class ReferenceCounter:
         # __del__ must never lock: it appends here (GIL-atomic) and the
         # next locked operation drains the queue
         self._deferred_decrefs: "deque[ObjectID]" = deque()
+        #: owner-local objects (reference: in-process store objects the
+        #: GCS never hears about): counts are kept locally but produce NO
+        #: controller deltas until promoted (ref escape / controller-path
+        #: submit). Keyed by object id binary.
+        self._untracked: set = set()
 
     def set_flush_fn(self, fn: Callable[[Dict[bytes, int]], None]) -> None:
         self._flush_fn = fn
@@ -77,11 +82,17 @@ class ReferenceCounter:
             table.pop(object_id, None)
         else:
             table[object_id] = n
+        key = object_id.binary()
+        untracked = key in self._untracked
         if d < 0 and n <= 0 \
                 and self._local.get(object_id, 0) == 0 \
                 and self._submitted.get(object_id, 0) == 0:
             zeros.append(object_id)
-        key = object_id.binary()
+            if untracked:
+                # fully dead: no promotion record needed, set stays bounded
+                self._untracked.discard(key)
+        if untracked:
+            return
         # A +1/-1 pair inside one flush window still nets to a 0-delta
         # entry that MUST be flushed: dropping it would hide the
         # object's entire lifecycle from the controller (never "ever
@@ -116,6 +127,44 @@ class ReferenceCounter:
                 self._pending_deltas = {}
         self._fire(flush, zeros)
 
+    # -- owner-local (untracked) objects --
+    def mark_untracked(self, object_id: ObjectID) -> None:
+        """Suppress controller deltas for this object: the owner tracks it
+        locally only. Must be called BEFORE the first add_local_reference
+        for the object."""
+        with self._lock:
+            self._untracked.add(object_id.binary())
+
+    def is_untracked(self, object_id_b: bytes) -> bool:
+        with self._lock:
+            return object_id_b in self._untracked
+
+    def promote(self, object_id: ObjectID) -> int:
+        """Stop suppressing deltas and inject the object's CURRENT live
+        count as one pending delta, so the controller's table picks up as
+        if it had been tracked from the start. Returns the injected count,
+        or -1 if the object was not untracked (already promoted / never
+        suppressed). An injected 0 is meaningful: it tells the controller
+        the object lived and fully died (frees the directory entry)."""
+        flush = None
+        zeros: List[ObjectID] = []
+        with self._lock:
+            self._drain_deferred_locked(zeros)
+            key = object_id.binary()
+            if key not in self._untracked:
+                n = -1
+            else:
+                self._untracked.discard(key)
+                n = self._local.get(object_id, 0) + \
+                    self._submitted.get(object_id, 0)
+                self._pending_deltas[key] = \
+                    self._pending_deltas.get(key, 0) + n
+                if len(self._pending_deltas) >= self._flush_threshold:
+                    flush = self._pending_deltas
+                    self._pending_deltas = {}
+        self._fire(flush, zeros)
+        return n
+
     def flush(self) -> None:
         zeros: List[ObjectID] = []
         with self._lock:
@@ -142,7 +191,11 @@ class ReferenceCounter:
             out: Dict[bytes, int] = {}
             for table in (self._local, self._submitted):
                 for oid, n in table.items():
-                    out[oid.binary()] = out.get(oid.binary(), 0) + n
+                    b = oid.binary()
+                    if b in self._untracked:
+                        continue  # owner-local: the controller never
+                        # tracked it and must not start now
+                    out[b] = out.get(b, 0) + n
         self._fire(None, zeros)
         return out
 

@@ -80,6 +80,20 @@ class Runtime:
         self._eager_lock = threading.Lock()
         self._empty_args_blob: Optional[bytes] = None
 
+        # Owner-local small objects (reference: the in-process store +
+        # owner-based object directory — the GCS never hears about
+        # small objects). Inline puts and task returns stay out of the
+        # controller's directory/refcount tables until a ref ESCAPES
+        # (pickled or passed as a task arg), at which point the object
+        # is promoted and its value published. Guarded by _meta_lock.
+        self._owner_local = bool(
+            getattr(self.config, "owner_local_objects", False))
+        #: owner-local oids whose meta/value live only in this process
+        self._local_objects: Dict[bytes, None] = {}
+        #: oids to publish to the controller the moment their result
+        #: arrives (escaped-while-pending, or a borrower FETCH_OBJECT)
+        self._publish_on_result: Dict[bytes, None] = {}
+
         # Direct normal-task transport (reference: worker leases,
         # direct_task_transport.h): the driver leases workers from the
         # controller and pushes dependency-free default-shape tasks to
@@ -107,6 +121,10 @@ class Runtime:
         self._direct_backlog_bytes = 0
         #: a LEASE_WORKERS request is outstanding (initial or top-up)
         self._lease_req_inflight = False
+        #: after an empty top-up grant (cluster fully leased — usually by
+        #: us), don't re-ask until this deadline: each empty round trip
+        #: costs a controller hop and grants nothing
+        self._lease_topup_backoff = 0.0
 
         # object_id(bytes) -> result meta {"inline"|"node_id"/"size"|"error"}
         self._meta: Dict[bytes, dict] = {}
@@ -456,6 +474,8 @@ class Runtime:
                 self.pg_cond.notify_all()
         elif mtype == P.RECONNECT:
             self._on_reconnect(m.get("gen"))
+        elif mtype == P.FETCH_OBJECT:
+            self._on_fetch_object(m)
         elif mtype == P.LEASE_REVOKED:
             self._on_lease_revoked(m["worker"], m.get("dead", True))
         elif mtype == P.LEASE_GRANT:
@@ -527,6 +547,11 @@ class Runtime:
         with self._inflight_lock:
             specs = list(self._inflight_specs.values())
         for spec in specs:
+            if self._owner_local:
+                # the resubmit runs controller-path: its results will be
+                # directory-recorded, so the returns must be tracked
+                for oid in spec.return_ids():
+                    self.reference_counter.promote(oid)
             self._send(P.SUBMIT_TASK, {"spec": spec})
         # actor address long-polls in flight at the crash died with the
         # old controller's waiter lists: re-issue them or every call
@@ -578,8 +603,17 @@ class Runtime:
         with self._lock:
             self._put_counter += 1
             oid = ObjectID.for_put(self.current_task_id, self._put_counter)
-        ref = ObjectRef(oid, self.worker_id)
+        # store BEFORE creating the ref: inline values become owner-local
+        # (no controller entry, no ref deltas) and the suppression must be
+        # in place before the ref's +1 registers
         meta = self._store_value(oid, value, notify=True)
+        if meta.get("node_id") is None and self._owner_local:
+            b = oid.binary()
+            self.reference_counter.mark_untracked(oid)
+            with self._meta_lock:
+                self._local_objects[b] = None
+                self._meta[b] = meta
+        ref = ObjectRef(oid, self.worker_id)
         try:
             from ray_tpu.core.metric_defs import runtime_metrics
             m = runtime_metrics()
@@ -605,9 +639,61 @@ class Runtime:
             self._escaped_refs[object_id_b] = None
             while len(self._escaped_refs) > 65536:
                 self._escaped_refs.popitem(last=False)
+        if self._owner_local and \
+                object_id_b in self.reference_counter._untracked:
+            # unlocked pre-filter (common case: not ours / already
+            # promoted); promote() re-checks under its lock
+            self._promote_escaped(object_id_b)
+
+    def _promote_escaped(self, object_id_b: bytes) -> None:
+        """An owner-local ref is leaving this process: hand the object's
+        lifecycle to the controller (inject our live count as deltas) and
+        publish its value so borrowers and dep-parked tasks can resolve —
+        the lazy analog of the PUT_OBJECT every put used to send."""
+        n = self.reference_counter.promote(ObjectID(object_id_b))
+        if n < 0:
+            return
+        with self._meta_lock:
+            meta = self._meta.get(object_id_b)
+            if meta is None:
+                # result not here yet: publish the moment it lands
+                self._publish_on_result[object_id_b] = None
+        if meta is not None:
+            self._publish_object(object_id_b, meta)
+
+    def _publish_object(self, object_id_b: bytes, meta: dict) -> None:
+        payload = {"object_id": object_id_b}
+        for k in ("inline", "node_id", "size", "error"):
+            v = meta.get(k)
+            if v is not None:
+                payload[k] = v
+        self._send(P.PUT_OBJECT, payload)
+
+    def _on_fetch_object(self, m: dict) -> None:
+        """Controller asks us (the owner) to publish an owner-local
+        object a borrower is parked on."""
+        b = m["object_id"]
+        with self._meta_lock:
+            meta = self._meta.get(b)
+            if meta is None:
+                self._publish_on_result[b] = None
+        if meta is not None:
+            self._publish_object(b, meta)
 
     def _on_owner_zero(self, oid: ObjectID) -> None:
         b = oid.binary()
+        if self._owner_local:
+            with self._meta_lock:
+                was_local = self._local_objects.pop(b, False) is not False
+                if was_local:
+                    self._meta.pop(b, None)
+            if was_local:
+                # owner-local value: our copy is the only (or, if
+                # escaped+published, a redundant) one — free it now.
+                # NOTE _publish_on_result stays: an escaped-while-pending
+                # borrower may still need the publish when it lands.
+                self.memory_store.delete(oid)
+                return
         with self._eager_lock:
             if b not in self._eager_owned or b in self._escaped_refs:
                 return
@@ -637,7 +723,9 @@ class Runtime:
             self.memory_store.put(oid, value)
             blob = serialized.to_bytes()
             meta = {"object_id": b, "inline": blob, "size": size}
-            if notify:
+            if notify and not self._owner_local:
+                # owner-local mode publishes lazily on ref escape
+                # (mark_ref_escaped) instead of on every put
                 self._send(P.PUT_OBJECT, {"object_id": b, "inline": blob})
         else:
             # large objects live ONLY in shm — duplicating the value in
@@ -736,9 +824,38 @@ class Runtime:
             self._unpin_task_args(done_spec)
             self._on_direct_task_result(m["task_id"])
         err = m.get("error")
+        rc = self.reference_counter
+        via_controller = m.get("via_controller")
         for r in m.get("results", []):
             b = r["object_id"]
             failed = err is not None or r.get("error") is not None
+            publish = drop = local_mark = False
+            # ---- refcount classification OUTSIDE _meta_lock: promote()
+            # and local_count() can fire owner-zero, which takes
+            # _meta_lock (observed self-deadlock on the pump thread) ----
+            if self._owner_local:
+                if err is not None and r.get("error") is None:
+                    # carry the task error into the stored meta so a
+                    # FETCH_OBJECT publish reproduces it for borrowers
+                    # (the controller no longer records it)
+                    r = dict(r, error=err)
+                untracked = b in rc._untracked  # unlocked peek: promote
+                # re-checks under its own lock
+                if untracked and (via_controller
+                                  or r.get("node_id") is not None):
+                    # controller-path task (its directory records the
+                    # results) or shm result (the extent is
+                    # controller-side state): counts must flow
+                    rc.promote(ObjectID(b))
+                elif untracked:
+                    local_mark = True  # stays owner-local
+                else:
+                    # promoted earlier (escape) or dead-before-arrival
+                    with self._meta_lock:
+                        pending_pub = b in self._publish_on_result
+                    if not pending_pub and \
+                            rc.local_count(ObjectID(b)) == 0:
+                        drop = True
             with self._meta_lock:
                 existing = self._meta.get(b)
                 if not known and failed and existing is not None \
@@ -753,7 +870,30 @@ class Runtime:
                     # legitimately re-runs tasks whose spec we already
                     # retired.
                     continue
-                self._meta[b] = r
+                if self._owner_local:
+                    publish = b in self._publish_on_result
+                    if publish:
+                        del self._publish_on_result[b]
+                        drop = False  # escaped meanwhile: must record
+                    if drop:
+                        # every ref died before the result arrived and
+                        # nothing escaped: drop it. A shm extent (or a
+                        # controller-recorded entry, for controller-path
+                        # tasks) still exists — a 0-delta tells the
+                        # controller the object lived and fully died.
+                        pass
+                    else:
+                        if local_mark:
+                            self._local_objects[b] = None
+                        self._meta[b] = r
+                else:
+                    self._meta[b] = r
+            if drop:
+                if r.get("node_id") is not None or via_controller:
+                    self._send(P.REF_DELTAS, {"deltas": {b: 0}})
+                continue
+            if publish:
+                self._publish_object(b, r)
             oid = ObjectID(b)
             # materialize lazily at get(); but wake any waiter now
             self.memory_store.put(oid, _MetaReady(r))
@@ -809,7 +949,8 @@ class Runtime:
         # store as _MetaReady). Block with the caller's timeout either way.
         owned = ref.owner is not None and ref.owner == self.worker_id
         if not owned:
-            self._ensure_location_probe(b)
+            self._ensure_location_probe(
+                b, ref.owner.binary() if ref.owner is not None else None)
         from ray_tpu.core.memory_store import WeakCacheExpired
         token = self._enter_blocked()
         try:
@@ -978,7 +1119,8 @@ class Runtime:
             hooked.append((oid, cb))
             self.memory_store.on_ready(oid, cb)
             if ref.owner is None or ref.owner != self.worker_id:
-                self._ensure_location_probe(b)
+                self._ensure_location_probe(
+                    b, ref.owner.binary() if ref.owner is not None else None)
         with lock:
             if count[0] >= num_returns:
                 done.set()
@@ -1000,7 +1142,8 @@ class Runtime:
                     pending.append(ref)
         return ready, pending
 
-    def _ensure_location_probe(self, object_id_b: bytes) -> None:
+    def _ensure_location_probe(self, object_id_b: bytes,
+                               owner_b: Optional[bytes] = None) -> None:
         """Ask the controller (once) where an object lives; the reply lands
         in the meta table + memory store from the pump thread. The
         controller holds the request server-side until the object exists,
@@ -1025,8 +1168,13 @@ class Runtime:
             self.memory_store.put(ObjectID(b), _MetaReady(reply))
 
         rid = self.replies.new_request(callback=on_reply)
-        self._send(P.GET_LOCATION, {"object_id": object_id_b, "rid": rid,
-                                    "want_node": self.node_id.binary()})
+        msg = {"object_id": object_id_b, "rid": rid,
+               "want_node": self.node_id.binary()}
+        if owner_b is not None:
+            # lets the controller fetch an owner-local object's value
+            # from its owner when the directory has no entry
+            msg["owner"] = owner_b
+        self._send(P.GET_LOCATION, msg)
 
     def register_completion_callback(self, ref: ObjectRef, cb: Callable) -> None:
         oid = ref.id()
@@ -1106,13 +1254,26 @@ class Runtime:
         # ref) is measurable on the fan-out hot path
         rc = self.reference_counter
         refs = []
+        owner_local = self._owner_local
         for oid in spec.return_ids():
+            if owner_local:
+                # returns start owner-local (suppressed deltas); promoted
+                # below if the task spills to the controller path, at
+                # result arrival if the result is shm, or on ref escape
+                rc.mark_untracked(oid)
             r = ObjectRef(oid, self.worker_id, _register=False)
             rc.add_local_reference(r)
             r._registered = True
             refs.append(r)
         for _, oid in spec.arg_refs:
             self.reference_counter.add_submitted_task_ref(oid)
+            if owner_local and oid.binary() in rc._untracked:
+                # a top-level arg ref leaves this process without being
+                # pickled (it rides spec.arg_refs as a raw id): that is
+                # an escape — the consumer and any dep-parking need the
+                # object controller-visible. Shm objects are already
+                # directory-tracked; only owner-local ones promote.
+                self._promote_escaped(oid.binary())
         # deltas ride the threshold/periodic flush — flushing per submit
         # would cost a REF_DELTAS apply per task on the controller loop
         if spec.is_actor_task:
@@ -1124,6 +1285,12 @@ class Runtime:
             with self._inflight_lock:
                 self._inflight_specs[spec.task_id.binary()] = spec
             if not self._try_direct_submit(spec):
+                if owner_local:
+                    # controller-path task: the controller records its
+                    # results in the directory, so the return refs must
+                    # be controller-tracked from the start
+                    for oid in spec.return_ids():
+                        rc.promote(oid)
                 if spec.arg_refs:
                     # owner-side dependency seeding: attach what we know
                     # about arg objects so the controller can resolve
@@ -1185,6 +1352,7 @@ class Runtime:
                 # (the controller parks what it can't grant yet).
                 took = self._backlog_locked(spec)
                 if took and not self._lease_req_inflight and \
+                        time.monotonic() >= self._lease_topup_backoff and \
                         len(self._direct_backlog) > \
                         len(self._lease_pool) * \
                         self.config.dispatch_pipeline_depth:
@@ -1262,11 +1430,21 @@ class Runtime:
                     # with no direct tasks inflight there are no
                     # completions to drain them otherwise
                     sends = self._drain_backlog_locked()
+                elif self._lease_pool:
+                    # empty TOP-UP grant: the cluster is fully leased
+                    # (usually by us). We still hold workers with tasks
+                    # in flight, so completions WILL drain the backlog
+                    # at direct-path cost — spilling it to the
+                    # controller here ping-pongs ~half of every big
+                    # burst onto the slow path (measured: 1012/2000
+                    # spilled, tasks_async capped at ~4.4k/s). Keep the
+                    # pool, just stop re-asking for a while.
+                    self._lease_topup_backoff = time.monotonic() + 2.0
                 else:
-                    # nothing grantable right now; retry later. Tasks
-                    # optimistically backlogged while the request was
-                    # in flight must not starve — route them through
-                    # the controller after all.
+                    # nothing grantable and we hold no capacity at all;
+                    # retry later. Tasks optimistically backlogged while
+                    # the request was in flight must not starve — route
+                    # them through the controller after all.
                     self._lease_state = "none"
                     self._lease_backoff_until = time.monotonic() + 2.0
                     while self._direct_backlog:
@@ -1275,6 +1453,11 @@ class Runtime:
                 self._send_direct(w, P.TASK_DISPATCH,
                                   {"spec": spec, "driver_leased": True})
             for spec in spill:
+                if self._owner_local:
+                    # spilling to the controller path: returns become
+                    # directory-recorded — track them
+                    for oid in spec.return_ids():
+                        self.reference_counter.promote(oid)
                 self._send(P.SUBMIT_TASK, {"spec": spec})
 
         rid = self.replies.new_request(callback=on_reply)
@@ -1345,6 +1528,9 @@ class Runtime:
                 if spec is not None:
                     resubmit.append(spec)
         for spec in resubmit:
+            if self._owner_local:
+                for oid in spec.return_ids():
+                    self.reference_counter.promote(oid)
             self._send(P.SUBMIT_TASK, {"spec": spec})
 
     def _release_all_leases(self) -> None:
@@ -1357,6 +1543,9 @@ class Runtime:
             self._direct_backlog.clear()
             self._direct_backlog_bytes = 0
         for spec in backlog:
+            if self._owner_local:
+                for oid in spec.return_ids():
+                    self.reference_counter.promote(oid)
             self._send(P.SUBMIT_TASK, {"spec": spec})
         if pool:
             try:
@@ -1494,12 +1683,22 @@ class Runtime:
         exist (error propagation through the object graph)."""
         blob = P.dumps(err)
         results = []
+        untracked = self.reference_counter._untracked
         for oid in spec.return_ids():
-            meta = {"object_id": oid.binary(), "error": blob}
+            b = oid.binary()
+            meta = {"object_id": b, "error": blob}
+            local = self._owner_local and b in untracked
             with self._meta_lock:
-                self._meta[oid.binary()] = meta
+                self._meta[b] = meta
+                if local:
+                    # owner-local error object: nobody else can be parked
+                    # on it (escape would have promoted it) — keep it out
+                    # of the controller's directory. A later escape
+                    # publishes the error meta like any owner-local value.
+                    self._local_objects[b] = None
             self.memory_store.put(oid, _MetaReady(meta))
-            results.append({"object_id": oid.binary()})
+            if not local:
+                results.append({"object_id": b})
         self._unpin_task_args(spec)
         try:
             self._send(P.TASK_DONE, {

@@ -374,6 +374,7 @@ class WorkerExecutor:
                      and spec.max_retries != 0)
         direct_ok = owner_b is not None and not may_retry
         result_msg = None
+        driver_leased = bool(m.get("driver_leased"))
         if direct_ok:
             # shallow-copy the metas: TASK_DONE carries the same list,
             # and a same-process owner stores these dicts directly
@@ -383,10 +384,30 @@ class WorkerExecutor:
                 "error": error_blob,
                 "actor_id": spec.actor_id.binary() if spec.is_actor_task
                 else None,
+                # controller-path dispatch: the controller records these
+                # results in its directory, so the owner must promote
+                # owner-local returns to tracked (covers retry re-routes
+                # of originally-direct tasks too)
+                "via_controller": not driver_leased
+                and not spec.is_actor_task,
             })
+        done_results = results
+        if direct_ok and self.runtime._owner_local and error_blob is None \
+                and (driver_leased or spec.is_actor_task):
+            # owner-local mode, direct dispatch (driver lease / actor
+            # call): the owner (which just got TASK_RESULT) is the
+            # authority for inline results — the controller neither
+            # records nor needs their bytes. Shm results keep full
+            # metas (the directory tracks extents). Controller-path
+            # tasks are NOT trimmed: the controller records their
+            # results and unparks dependents from them.
+            done_results = [r if r.get("node_id") is not None
+                            else {"object_id": r["object_id"],
+                                  "size": r.get("size", 0)}
+                            for r in results]
         done = {
             "task_id": tid_b,
-            "results": results,
+            "results": done_results,
             "error": error_blob,
             "retriable": retriable,
             "owner": owner_b,

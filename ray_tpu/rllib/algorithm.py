@@ -34,10 +34,38 @@ def _resolve_env_creator(env, env_config) -> Callable[[], Any]:
     raise ValueError(f"Cannot resolve env: {env!r}")
 
 
+def spec_for_spaces(obs_space, act_space, hiddens,
+                    dist_for_box: str = "gaussian") -> RLModuleSpec:
+    """Build the module spec from gymnasium spaces: Discrete ->
+    categorical head, Box -> diagonal-Gaussian head (reference: the
+    model catalog's action-distribution selection,
+    ``rllib/models/catalog.py`` get_action_dist)."""
+    obs_dim = int(np.prod(obs_space.shape))
+    if hasattr(act_space, "n"):  # Discrete
+        return RLModuleSpec(observation_dim=obs_dim,
+                            num_actions=int(act_space.n),
+                            hiddens=tuple(hiddens))
+    if hasattr(act_space, "low"):  # Box
+        return RLModuleSpec(
+            observation_dim=obs_dim,
+            action_dim=int(np.prod(act_space.shape)),
+            dist=dist_for_box,
+            action_low=tuple(np.asarray(act_space.low,
+                                        np.float32).ravel()),
+            action_high=tuple(np.asarray(act_space.high,
+                                         np.float32).ravel()),
+            hiddens=tuple(hiddens))
+    raise ValueError(f"Unsupported action space: {act_space!r}")
+
+
 class Algorithm(Trainable):
     """Subclasses define ``loss_fn`` + ``loss_config`` via config."""
 
     config_cls = AlgorithmConfig
+    #: whether this algorithm's loss handles Box-space (Gaussian)
+    #: policies — PPO and SAC do; discrete-only losses fail fast at
+    #: build time instead of a KeyError inside the first jitted update
+    supports_continuous = False
 
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
@@ -61,12 +89,13 @@ class Algorithm(Trainable):
         cfg = self.config = self._algo_config
         env_creator = _resolve_env_creator(cfg.env, cfg.env_config)
         probe = env_creator()
-        obs_space = probe.observation_space
-        act_space = probe.action_space
-        self.module_spec = RLModuleSpec(
-            observation_dim=int(np.prod(obs_space.shape)),
-            num_actions=int(act_space.n),
-            hiddens=tuple(cfg.model.get("fcnet_hiddens", (64, 64))))
+        self.module_spec = spec_for_spaces(
+            probe.observation_space, probe.action_space,
+            cfg.model.get("fcnet_hiddens", (64, 64)))
+        if self.module_spec.is_continuous and not self.supports_continuous:
+            raise ValueError(
+                f"{type(self).__name__} supports Discrete action spaces "
+                f"only; use PPO or SAC for Box spaces")
         try:
             probe.close()
         except Exception:
@@ -169,11 +198,13 @@ class Algorithm(Trainable):
     def get_policy_weights(self):
         return self.learner_group.get_weights()
 
-    def compute_single_action(self, obs: np.ndarray) -> int:
+    def compute_single_action(self, obs: np.ndarray):
         if self._cached_weights is None:
             self._cached_weights = self.learner_group.get_weights()
         action = self._inference_module.forward_inference(
             self._cached_weights, np.asarray([obs]))
+        if self.module_spec.is_continuous:
+            return np.asarray(action[0])
         return int(action[0])
 
     def cleanup(self) -> None:

@@ -25,12 +25,14 @@ class ReplayBuffer:
     """Uniform ring buffer (reference:
     ``rllib/utils/replay_buffers/replay_buffer.py``)."""
 
-    def __init__(self, capacity: int, obs_shape, seed: int = 0):
+    def __init__(self, capacity: int, obs_shape, seed: int = 0,
+                 action_shape=(), action_dtype=np.int64):
         self.capacity = capacity
         self._rng = np.random.default_rng(seed)
         self.obs = np.zeros((capacity,) + tuple(obs_shape), np.float32)
         self.next_obs = np.zeros_like(self.obs)
-        self.actions = np.zeros((capacity,), np.int64)
+        self.actions = np.zeros((capacity,) + tuple(action_shape),
+                                action_dtype)
         self.rewards = np.zeros((capacity,), np.float32)
         self.dones = np.zeros((capacity,), np.float32)
         self._idx = 0
@@ -85,6 +87,21 @@ class DQNEnvRunner:
     def ping(self) -> bool:
         return True
 
+    # --- hooks the continuous SAC runner overrides --------------------
+    def _make_act_buf(self, shape) -> np.ndarray:
+        return np.zeros(shape, np.int64)
+
+    def _select_actions(self, epsilon: float) -> np.ndarray:
+        greedy = self._module.forward_inference(self._params, self._obs)
+        n_envs = len(self._envs)
+        explore = self._rng.random(n_envs) < epsilon
+        random_a = self._rng.integers(
+            0, self._module.spec.num_actions, size=n_envs)
+        return np.where(explore, random_a, greedy)
+
+    def _env_action(self, action):
+        return int(action)
+
     def sample(self, num_steps: int, epsilon: float
                ) -> Dict[str, np.ndarray]:
         assert self._params is not None, "set_weights first"
@@ -92,26 +109,28 @@ class DQNEnvRunner:
         shape = (num_steps, n_envs)
         obs_buf = np.zeros(shape + self._obs.shape[1:], np.float32)
         next_buf = np.zeros_like(obs_buf)
-        act_buf = np.zeros(shape, np.int64)
+        act_buf = self._make_act_buf(shape)
         rew_buf = np.zeros(shape, np.float32)
         done_buf = np.zeros(shape, np.float32)
         for t in range(num_steps):
-            greedy = self._module.forward_inference(self._params, self._obs)
-            explore = self._rng.random(n_envs) < epsilon
-            random_a = self._rng.integers(
-                0, self._module.spec.num_actions, size=n_envs)
-            actions = np.where(explore, random_a, greedy)
+            actions = self._select_actions(epsilon)
             obs_buf[t] = self._obs
             act_buf[t] = actions
             for i, env in enumerate(self._envs):
-                out = env.step(int(actions[i]))
+                out = env.step(self._env_action(actions[i]))
                 if len(out) == 5:
                     obs, rew, terminated, truncated, _ = out
                     done = terminated or truncated
                 else:
                     obs, rew, done, _ = out
+                    terminated = done
                 rew_buf[t, i] = rew
-                done_buf[t, i] = float(done)
+                # bootstrap mask: only TERMINATION zeroes the next-state
+                # value. A time-limit truncation is not a terminal state
+                # — treating it as one biases every Q/V target at the
+                # boundary (on Pendulum, the ONLY episode end is
+                # truncation, which sank SAC below its learning bar)
+                done_buf[t, i] = float(terminated)
                 next_buf[t, i] = obs
                 self._ep_returns[i] += float(rew)
                 if done:
@@ -238,22 +257,37 @@ class DQNConfig(AlgorithmConfig):
 class DQN(Algorithm):
     config_cls = DQNConfig
 
+    #: SAC overrides: Box action spaces need a Gaussian policy, which
+    #: plain Q-learning does not have
+    supports_continuous = False
+
     def setup(self, _cfg: Dict) -> None:
+        from ray_tpu.rllib.algorithm import spec_for_spaces
         cfg = self.config = self._algo_config
         env_creator = _resolve_env_creator(cfg.env, cfg.env_config)
         probe = env_creator()
         obs_shape = probe.observation_space.shape
-        self.module_spec = RLModuleSpec(
-            observation_dim=int(np.prod(obs_shape)),
-            num_actions=int(probe.action_space.n),
-            hiddens=tuple(cfg.model.get("fcnet_hiddens", (64, 64))))
+        self.module_spec = spec_for_spaces(
+            probe.observation_space, probe.action_space,
+            cfg.model.get("fcnet_hiddens", (64, 64)),
+            dist_for_box="squashed_gaussian")
+        if self.module_spec.is_continuous and not self.supports_continuous:
+            raise ValueError(
+                f"{type(self).__name__} supports Discrete action spaces "
+                f"only; use SAC for Box spaces")
         try:
             probe.close()
         except Exception:
             pass
         self.learner = self._make_learner()
-        self.buffer = ReplayBuffer(
-            cfg.replay_buffer_capacity, obs_shape, seed=cfg.seed)
+        if self.module_spec.is_continuous:
+            self.buffer = ReplayBuffer(
+                cfg.replay_buffer_capacity, obs_shape, seed=cfg.seed,
+                action_shape=(self.module_spec.action_dim,),
+                action_dtype=np.float32)
+        else:
+            self.buffer = ReplayBuffer(
+                cfg.replay_buffer_capacity, obs_shape, seed=cfg.seed)
         n_runners = max(1, cfg.num_env_runners)
         runner_cls = ray_tpu.remote(num_cpus=1)(self._runner_cls())
         self.env_runners = [

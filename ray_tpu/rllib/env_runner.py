@@ -86,10 +86,18 @@ class EnvRunner:
         import jax
         assert self._params is not None, "set_weights first"
         n_envs = len(self._envs)
+        spec = self._module.spec
+        continuous = spec.is_continuous
         cur0 = self._transformed_obs()
         obs_buf = np.zeros((num_steps, n_envs) + cur0.shape[1:],
                            np.float32)
-        act_buf = np.zeros((num_steps, n_envs), np.int64)
+        if continuous:
+            act_buf = np.zeros((num_steps, n_envs, spec.action_dim),
+                               np.float32)
+            low = np.asarray(spec.action_low, np.float32)
+            high = np.asarray(spec.action_high, np.float32)
+        else:
+            act_buf = np.zeros((num_steps, n_envs), np.int64)
         logp_buf = np.zeros((num_steps, n_envs), np.float32)
         val_buf = np.zeros((num_steps, n_envs), np.float32)
         rew_buf = np.zeros((num_steps, n_envs), np.float32)
@@ -105,7 +113,12 @@ class EnvRunner:
             logp_buf[t] = logps
             val_buf[t] = values
             for i, env in enumerate(self._envs):
-                out = env.step(int(actions[i]))
+                # the stored action is the RAW sample (ratios in the
+                # loss need the sampled point); the env sees it clipped
+                # to the Box bounds (reference: unsquashed DiagGaussian
+                # + action clipping at the env boundary)
+                out = env.step(np.clip(actions[i], low, high)
+                               if continuous else int(actions[i]))
                 if len(out) == 5:
                     obs, rew, terminated, truncated, _ = out
                     done = terminated or truncated

@@ -53,3 +53,69 @@ def actor_critic_forward(params: Dict, obs: jnp.ndarray
     logits = mlp_forward(params["pi"], obs)
     value = mlp_forward(params["vf"], obs)[..., 0]
     return logits, value
+
+
+# ---------------------------------------------------------------- Box spaces
+# Diagonal-Gaussian policies for continuous control (reference:
+# ``rllib/models/torch/torch_distributions.py`` TorchDiagGaussian /
+# TorchSquashedGaussian, and ``sac/sac_torch_model.py:15`` which builds
+# Box-space Gaussian heads). One pi MLP emits [mean, log_std] so PPO and
+# SAC share the head; the squashed variants add the tanh log-det
+# correction SAC's entropy term needs.
+
+LOG_STD_MIN = -20.0
+LOG_STD_MAX = 2.0
+_LOG_2PI = float(np.log(2.0 * np.pi))
+
+
+def init_gaussian_actor_critic(key, obs_dim: int, action_dim: int,
+                               hiddens: Sequence[int] = (64, 64)) -> Dict:
+    k1, k2 = jax.random.split(key)
+    return {
+        "pi": init_mlp(k1, [obs_dim, *hiddens, 2 * action_dim],
+                       scale=0.01),
+        "vf": init_mlp(k2, [obs_dim, *hiddens, 1], scale=1.0),
+    }
+
+
+def gaussian_actor_critic_forward(params: Dict, obs: jnp.ndarray
+                                  ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                             jnp.ndarray]:
+    """Returns (mean [B, A], log_std [B, A], value [B])."""
+    out = mlp_forward(params["pi"], obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    value = mlp_forward(params["vf"], obs)[..., 0]
+    return mean, log_std, value
+
+
+def diag_gaussian_logp(mean: jnp.ndarray, log_std: jnp.ndarray,
+                       x: jnp.ndarray) -> jnp.ndarray:
+    """Log-density of x under N(mean, diag(exp(log_std)^2)); sums the
+    action axis -> [B]."""
+    z = (x - mean) * jnp.exp(-log_std)
+    return jnp.sum(-0.5 * z ** 2 - log_std - 0.5 * _LOG_2PI, axis=-1)
+
+
+def diag_gaussian_entropy(log_std: jnp.ndarray) -> jnp.ndarray:
+    """Entropy of the diagonal Gaussian, summed over actions -> [B]."""
+    return jnp.sum(log_std + 0.5 * (_LOG_2PI + 1.0), axis=-1)
+
+
+def tanh_logp_correction(pre_tanh: jnp.ndarray) -> jnp.ndarray:
+    """log|det d tanh(u)/du| summed over the action axis -> [B].
+    Numerically-stable form: log(1 - tanh(u)^2)
+    = 2 * (log 2 - u - softplus(-2u))."""
+    return jnp.sum(
+        2.0 * (jnp.log(2.0) - pre_tanh
+               - jax.nn.softplus(-2.0 * pre_tanh)), axis=-1)
+
+
+def squashed_gaussian_sample(key, mean: jnp.ndarray, log_std: jnp.ndarray
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reparameterized tanh-squashed sample; returns (action in (-1, 1),
+    log-prob [B] with the tanh correction applied)."""
+    std = jnp.exp(log_std)
+    u = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+    logp = diag_gaussian_logp(mean, log_std, u) - tanh_logp_correction(u)
+    return jnp.tanh(u), logp

@@ -22,10 +22,22 @@ def ppo_loss(fwd_out: Dict[str, jnp.ndarray],
              vf_loss_coeff: float = 0.5,
              entropy_coeff: float = 0.0,
              vf_clip_param: float = 10.0):
-    logits = fwd_out["action_logits"]
     values = fwd_out["vf_preds"]
-    logp_all = jax.nn.log_softmax(logits)
-    logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+    if "action_mean" in fwd_out:
+        # Box space: diagonal Gaussian (reference: TorchDiagGaussian in
+        # ppo_torch_learner — same clip objective over continuous logp)
+        from ray_tpu.rllib.models import (diag_gaussian_entropy,
+                                          diag_gaussian_logp)
+        mean = fwd_out["action_mean"]
+        log_std = fwd_out["action_log_std"]
+        logp = diag_gaussian_logp(mean, log_std, batch["actions"])
+        entropy = jnp.mean(diag_gaussian_entropy(log_std))
+    else:
+        logits = fwd_out["action_logits"]
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
 
     adv = batch["advantages"]
     adv = (adv - adv.mean()) / (adv.std() + 1e-8)
@@ -37,9 +49,6 @@ def ppo_loss(fwd_out: Dict[str, jnp.ndarray],
 
     vf_err = jnp.square(values - batch["value_targets"])
     vf_loss = jnp.mean(jnp.clip(vf_err, 0.0, vf_clip_param ** 2))
-
-    entropy = -jnp.mean(
-        jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
 
     total = policy_loss + vf_loss_coeff * vf_loss \
         - entropy_coeff * entropy
@@ -67,6 +76,7 @@ class PPOConfig(AlgorithmConfig):
 
 class PPO(Algorithm):
     config_cls = PPOConfig
+    supports_continuous = True
 
     def loss_fn(self):
         return ppo_loss

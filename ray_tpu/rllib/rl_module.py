@@ -16,14 +16,28 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.rllib.models import actor_critic_forward, init_actor_critic
+from ray_tpu.rllib.models import (
+    actor_critic_forward, diag_gaussian_logp,
+    gaussian_actor_critic_forward, init_actor_critic,
+    init_gaussian_actor_critic)
 
 
 @dataclasses.dataclass
 class RLModuleSpec:
     observation_dim: int
-    num_actions: int
+    num_actions: int = 0
     hiddens: tuple = (64, 64)
+    #: "categorical" (Discrete) or "gaussian" (Box — diagonal Gaussian,
+    #: unsquashed; the env-runner clips to the space bounds like the
+    #: reference's TorchDiagGaussian + action clipping)
+    dist: str = "categorical"
+    action_dim: int = 0
+    action_low: tuple = ()
+    action_high: tuple = ()
+
+    @property
+    def is_continuous(self) -> bool:
+        return self.dist != "categorical"
 
     def build(self) -> "RLModule":
         return RLModule(self)
@@ -32,9 +46,14 @@ class RLModuleSpec:
 class RLModule:
     def __init__(self, spec: RLModuleSpec):
         self.spec = spec
-        self._jit_infer = jax.jit(self._infer)
+        self._jit_infer = jax.jit(
+            self._infer_gaussian if spec.is_continuous else self._infer)
 
     def init(self, key) -> Dict:
+        if self.spec.is_continuous:
+            return init_gaussian_actor_critic(
+                key, self.spec.observation_dim, self.spec.action_dim,
+                self.spec.hiddens)
         return init_actor_critic(
             key, self.spec.observation_dim, self.spec.num_actions,
             self.spec.hiddens)
@@ -42,6 +61,11 @@ class RLModule:
     # -- train path (used inside the jitted learner update) -----------
     def forward_train(self, params: Dict, obs: jnp.ndarray
                       ) -> Dict[str, jnp.ndarray]:
+        if self.spec.is_continuous:
+            mean, log_std, value = gaussian_actor_critic_forward(
+                params, obs)
+            return {"action_mean": mean, "action_log_std": log_std,
+                    "vf_preds": value}
         logits, value = actor_critic_forward(params, obs)
         return {"action_logits": logits, "vf_preds": value}
 
@@ -54,6 +78,14 @@ class RLModule:
             jnp.arange(logits.shape[0]), action]
         return action, logp, value
 
+    @staticmethod
+    def _infer_gaussian(params, obs, key):
+        mean, log_std, value = gaussian_actor_critic_forward(params, obs)
+        action = mean + jnp.exp(log_std) * jax.random.normal(
+            key, mean.shape, mean.dtype)
+        logp = diag_gaussian_logp(mean, log_std, action)
+        return action, logp, value
+
     def forward_exploration(self, params: Dict, obs: np.ndarray,
                             key) -> Tuple[np.ndarray, np.ndarray,
                                           np.ndarray]:
@@ -63,6 +95,12 @@ class RLModule:
 
     def forward_inference(self, params: Dict, obs: np.ndarray
                           ) -> np.ndarray:
+        if self.spec.is_continuous:
+            mean, _, _ = gaussian_actor_critic_forward(
+                params, jnp.asarray(obs, jnp.float32))
+            return np.clip(np.asarray(mean),
+                           np.asarray(self.spec.action_low, np.float32),
+                           np.asarray(self.spec.action_high, np.float32))
         logits, _ = actor_critic_forward(
             params, jnp.asarray(obs, jnp.float32))
         return np.asarray(jnp.argmax(logits, axis=-1))

@@ -1,14 +1,17 @@
-"""SAC (discrete): soft actor-critic with twin Q networks and learned
-temperature.
+"""SAC: soft actor-critic with twin Q networks and learned temperature
+— continuous (tanh-squashed Gaussian) and discrete (categorical).
 
 Reference: ``rllib/algorithms/sac/sac.py`` + the torch loss in
 ``sac/torch/sac_torch_learner.py`` (twin critics, polyak target sync,
-entropy temperature tuned toward a target entropy). The discrete-action
-formulation follows Christodoulou 2019 (expectations over the action
-distribution instead of reparameterized samples) — the reference's SAC
-is continuous-first, so the discrete path matches what its
+entropy temperature tuned toward a target entropy) and the Box-space
+Gaussian policy model in ``sac/sac_torch_model.py:15``. The continuous
+path is the canonical SAC: reparameterized tanh-squashed samples,
+Q(s, a) critics over concatenated state-action, target entropy
+``-action_dim``. The discrete-action formulation follows Christodoulou
+2019 (expectations over the action distribution instead of
+reparameterized samples), matching what the reference's
 ``target_entropy="auto"`` machinery computes for ``Discrete`` spaces.
-TPU-native shape: like DQN, the whole update (both critic losses, the
+TPU-native shape: either way the whole update (both critic losses, the
 policy loss, the temperature loss, three adams, and the polyak sync) is
 one jitted XLA program.
 """
@@ -204,6 +207,194 @@ class SACLearner:
         return self._state["pi"]
 
 
+class ContinuousSACEnvRunner(DQNEnvRunner):
+    """Rollout actor for Box spaces: actions are reparameterized
+    tanh-squashed Gaussian samples; the replay buffer stores the
+    squashed action in (-1, 1) (what the critics see), the env gets it
+    rescaled to the space bounds. The stepping loop is DQNEnvRunner's —
+    only action selection and the env-action transform differ."""
+
+    def __init__(self, env_creator, module_spec: RLModuleSpec,
+                 num_envs: int = 1, seed: int = 0,
+                 worker_index: int = 0):
+        import jax
+        super().__init__(env_creator, module_spec, num_envs, seed,
+                         worker_index)
+        self._key = jax.random.PRNGKey(seed * 10_003 + worker_index + 1)
+        low = np.asarray(module_spec.action_low, np.float32)
+        high = np.asarray(module_spec.action_high, np.float32)
+        self._center = (low + high) / 2.0
+        self._scale = (high - low) / 2.0
+
+    def _make_act_buf(self, shape) -> np.ndarray:
+        return np.zeros(shape + (self._module.spec.action_dim,),
+                        np.float32)
+
+    def _select_actions(self, epsilon: float) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.rllib.models import (LOG_STD_MAX, LOG_STD_MIN,
+                                          mlp_forward)
+        self._key, sub = jax.random.split(self._key)
+        out = mlp_forward(self._params,
+                          jnp.asarray(self._obs, jnp.float32))
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        u = mean + jnp.exp(log_std) * jax.random.normal(
+            sub, mean.shape, mean.dtype)
+        return np.asarray(jnp.tanh(u), np.float32)
+
+    def _env_action(self, action):
+        return self._center + self._scale * action
+
+
+class ContinuousSACLearner:
+    """Canonical SAC (Haarnoja 2018, as in the reference's torch
+    learner): twin Q(s, a), tanh-squashed reparameterized policy,
+    learned temperature toward target entropy -|A|. One jitted update."""
+
+    def __init__(self, module_spec: RLModuleSpec, *,
+                 actor_lr: float, critic_lr: float, alpha_lr: float,
+                 gamma: float, tau: float,
+                 target_entropy: Optional[float],
+                 grad_clip: Optional[float], seed: int):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        self.spec = module_spec
+        self._gamma = gamma
+        self._tau = tau
+        adim = module_spec.action_dim
+        self._target_entropy = (target_entropy if target_entropy
+                                is not None else -float(adim))
+
+        def maybe_clip(tx):
+            return optax.chain(optax.clip_by_global_norm(grad_clip),
+                               tx) if grad_clip else tx
+
+        self._pi_opt = maybe_clip(optax.adam(actor_lr))
+        self._q_opt = maybe_clip(optax.adam(critic_lr))
+        self._a_opt = optax.adam(alpha_lr)
+
+        keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+        obs_dim = module_spec.observation_dim
+        h = list(module_spec.hiddens)
+        pi = init_mlp(keys[0], [obs_dim, *h, 2 * adim], scale=0.01)
+        q_sizes = [obs_dim + adim, *h, 1]
+        q1 = init_mlp(keys[1], q_sizes)
+        q2 = init_mlp(keys[2], q_sizes)
+        self._state = {
+            "pi": pi, "q1": q1, "q2": q2,
+            "q1_t": jax.tree.map(lambda x: x.copy(), q1),
+            "q2_t": jax.tree.map(lambda x: x.copy(), q2),
+            "log_alpha": jnp.zeros(()),
+            "pi_opt": self._pi_opt.init(pi),
+            "q_opt": self._q_opt.init({"q1": q1, "q2": q2}),
+            "a_opt": self._a_opt.init(jnp.zeros(())),
+            "key": keys[0],
+        }
+        self._jit_update = jax.jit(self._update, donate_argnums=(0,))
+
+    @staticmethod
+    def _pi_sample(pi_params, obs, key):
+        """Reparameterized squashed sample + its log-prob."""
+        import jax.numpy as jnp
+        from ray_tpu.rllib.models import (LOG_STD_MAX, LOG_STD_MIN,
+                                          squashed_gaussian_sample)
+        out = mlp_forward(pi_params, obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        return squashed_gaussian_sample(key, mean, log_std)
+
+    @staticmethod
+    def _q(q_params, obs, act):
+        import jax.numpy as jnp
+        return mlp_forward(q_params, jnp.concatenate([obs, act], -1)
+                           )[..., 0]
+
+    def _update(self, state, batch):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        obs, next_obs = batch["obs"], batch["next_obs"]
+        acts = batch["actions"]
+        alpha = jnp.exp(state["log_alpha"])
+        key, k_next, k_pi = jax.random.split(state["key"], 3)
+
+        # -- critic target: y = r + g (minQt(s', a') - a logpi(a'|s'))
+        a_next, logp_next = self._pi_sample(state["pi"], next_obs,
+                                            k_next)
+        q_next = jnp.minimum(self._q(state["q1_t"], next_obs, a_next),
+                             self._q(state["q2_t"], next_obs, a_next))
+        y = batch["rewards"] + self._gamma * (1.0 - batch["dones"]) \
+            * jax.lax.stop_gradient(q_next - alpha * logp_next)
+
+        def q_loss(qs):
+            l1 = jnp.mean((self._q(qs["q1"], obs, acts) - y) ** 2)
+            l2 = jnp.mean((self._q(qs["q2"], obs, acts) - y) ** 2)
+            return l1 + l2, (l1, l2)
+
+        (qf_loss, (l1, l2)), q_grads = jax.value_and_grad(
+            q_loss, has_aux=True)({"q1": state["q1"],
+                                   "q2": state["q2"]})
+        q_updates, q_opt = self._q_opt.update(
+            q_grads, state["q_opt"], {"q1": state["q1"],
+                                      "q2": state["q2"]})
+        qs = optax.apply_updates({"q1": state["q1"],
+                                  "q2": state["q2"]}, q_updates)
+
+        # -- policy: E[alpha * logpi(a|s) - minQ(s, a)], a reparam'd --
+        def pi_loss(pi_params):
+            a, logp = self._pi_sample(pi_params, obs, k_pi)
+            minq = jnp.minimum(self._q(qs["q1"], obs, a),
+                               self._q(qs["q2"], obs, a))
+            return jnp.mean(alpha * logp - minq), -jnp.mean(logp)
+
+        (pl, entropy), pi_grads = jax.value_and_grad(
+            pi_loss, has_aux=True)(state["pi"])
+        pi_updates, pi_opt = self._pi_opt.update(
+            pi_grads, state["pi_opt"], state["pi"])
+        pi = optax.apply_updates(state["pi"], pi_updates)
+
+        # -- temperature toward target entropy -|A| -------------------
+        def a_loss(log_alpha):
+            return -jnp.exp(log_alpha) * jax.lax.stop_gradient(
+                self._target_entropy - entropy)
+
+        al, a_grad = jax.value_and_grad(a_loss)(state["log_alpha"])
+        a_updates, a_opt = self._a_opt.update(
+            a_grad, state["a_opt"], state["log_alpha"])
+        log_alpha = optax.apply_updates(state["log_alpha"], a_updates)
+
+        tau = self._tau
+        polyak = lambda t, o: jax.tree.map(  # noqa: E731
+            lambda a, b: (1 - tau) * a + tau * b, t, o)
+        metrics = {
+            "qf_loss": qf_loss, "q1_loss": l1, "q2_loss": l2,
+            "policy_loss": pl, "alpha_loss": al,
+            "alpha": jnp.exp(log_alpha), "entropy": entropy,
+            "total_loss": qf_loss + pl + al,
+        }
+        return {
+            "pi": pi, "q1": qs["q1"], "q2": qs["q2"],
+            "q1_t": polyak(state["q1_t"], qs["q1"]),
+            "q2_t": polyak(state["q2_t"], qs["q2"]),
+            "log_alpha": log_alpha,
+            "pi_opt": pi_opt, "q_opt": q_opt, "a_opt": a_opt,
+            "key": key,
+        }, metrics
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self._state, metrics = self._jit_update(self._state, jb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return self._state["pi"]
+
+
 class SACConfig(DQNConfig):
     def __init__(self, algo_class=None):
         super().__init__(algo_class or SAC)
@@ -219,14 +410,32 @@ class SACConfig(DQNConfig):
 
 class SAC(DQN):
     config_cls = SACConfig
+    supports_continuous = True
 
     def _make_learner(self):
         cfg = self.config
-        return SACLearner(
+        cls = ContinuousSACLearner if self.module_spec.is_continuous \
+            else SACLearner
+        return cls(
             self.module_spec, actor_lr=cfg.lr, critic_lr=cfg.critic_lr,
             alpha_lr=cfg.alpha_lr, gamma=cfg.gamma, tau=cfg.tau,
             target_entropy=cfg.target_entropy, grad_clip=cfg.grad_clip,
             seed=cfg.seed)
 
     def _runner_cls(self):
+        if self.module_spec.is_continuous:
+            return ContinuousSACEnvRunner
         return SACEnvRunner
+
+    def compute_single_action(self, obs: np.ndarray):
+        if not self.module_spec.is_continuous:
+            return super().compute_single_action(obs)
+        import jax.numpy as jnp
+        from ray_tpu.rllib.models import mlp_forward as _fwd
+        out = _fwd(self.learner.get_weights(),
+                   jnp.asarray(obs[None], jnp.float32))
+        mean = np.asarray(jnp.split(out, 2, axis=-1)[0][0])
+        low = np.asarray(self.module_spec.action_low, np.float32)
+        high = np.asarray(self.module_spec.action_high, np.float32)
+        center, scale = (low + high) / 2.0, (high - low) / 2.0
+        return center + scale * np.tanh(mean)

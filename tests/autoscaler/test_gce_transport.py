@@ -1,0 +1,164 @@
+"""Fault-injection tests for the GCE TPU API transport: retry/backoff
+on 429/5xx and network errors, 401 token refresh, non-retryable errors
+surfaced immediately, and LRO failures carrying operation metadata.
+
+Reference: ``python/ray/autoscaler/_private/gcp/node.py:618`` retry
+semantics (has_retriable_http_code + exponential backoff)."""
+
+import io
+import json
+import urllib.error
+
+import pytest
+
+from ray_tpu.autoscaler.gce import TPUApiClient, TPUApiError
+
+
+class _FakeHTTP:
+    """Scripted urllib.request.urlopen replacement: pops one scripted
+    outcome per call. An outcome is ('ok', dict), ('http', code, body)
+    or ('net', reason)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests = []  # (method, url, auth_header)
+
+    def __call__(self, req, timeout=None):
+        self.requests.append((req.get_method(), req.full_url,
+                              req.headers.get("Authorization")))
+        kind, *rest = self.script.pop(0)
+        if kind == "ok":
+            class _Resp:
+                def __init__(self, payload):
+                    self._p = payload
+
+                def read(self):
+                    return self._p
+
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *a):
+                    return False
+            return _Resp(json.dumps(rest[0]).encode())
+        if kind == "http":
+            code, body = rest
+            raise urllib.error.HTTPError(
+                req.full_url, code, "err", {}, io.BytesIO(body.encode()))
+        raise urllib.error.URLError(rest[0])
+
+
+def _client(script, monkeypatch, tokens=None, max_retries=5):
+    http = _FakeHTTP(script)
+    monkeypatch.setattr("urllib.request.urlopen", http)
+    sleeps = []
+    toks = list(tokens or [{"access_token": "tok0", "expires_in": 3600}])
+    calls = {"n": 0}
+
+    def token_fn():
+        t = toks[min(calls["n"], len(toks) - 1)]
+        calls["n"] += 1
+        return t
+
+    client = TPUApiClient("proj", "us-central2-b", token_fn=token_fn,
+                          sleep_fn=sleeps.append,
+                          max_retries=max_retries)
+    client._rng.seed(0)
+    return client, http, sleeps, calls
+
+
+def test_500_then_success_retries_with_backoff(monkeypatch):
+    client, http, sleeps, _ = _client(
+        [("http", 500, "boom"), ("http", 503, "busy"),
+         ("ok", {"nodes": []})], monkeypatch)
+    assert client.list_nodes() == []
+    assert len(http.requests) == 3
+    assert len(sleeps) == 2
+    # exponential: second wait drawn from a doubled base
+    assert 0.5 <= sleeps[0] <= 1.0
+    assert 1.0 <= sleeps[1] <= 2.0
+
+
+def test_429_rate_limit_is_retried(monkeypatch):
+    client, http, sleeps, _ = _client(
+        [("http", 429, "rate limited"), ("ok", {"nodes": []})],
+        monkeypatch)
+    assert client.list_nodes() == []
+    assert len(sleeps) == 1
+
+
+def test_400_is_not_retried(monkeypatch):
+    client, http, sleeps, _ = _client(
+        [("http", 400, "bad request")], monkeypatch)
+    with pytest.raises(TPUApiError) as ei:
+        client.list_nodes()
+    assert ei.value.status == 400
+    assert "bad request" in str(ei.value)
+    assert sleeps == []
+    assert len(http.requests) == 1
+
+
+def test_retries_exhausted_raises_with_status(monkeypatch):
+    client, http, sleeps, _ = _client(
+        [("http", 503, "down")] * 4, monkeypatch, max_retries=3)
+    with pytest.raises(TPUApiError) as ei:
+        client.list_nodes()
+    assert ei.value.status == 503
+    assert len(http.requests) == 4  # initial + 3 retries
+
+
+def test_network_error_is_retried(monkeypatch):
+    client, http, sleeps, _ = _client(
+        [("net", "connection reset"), ("ok", {"nodes": []})],
+        monkeypatch)
+    assert client.list_nodes() == []
+    assert len(sleeps) == 1
+
+
+def test_401_refreshes_token_once(monkeypatch):
+    client, http, sleeps, calls = _client(
+        [("http", 401, "expired"), ("ok", {"nodes": []})], monkeypatch,
+        tokens=[{"access_token": "tok0", "expires_in": 3600},
+                {"access_token": "tok1", "expires_in": 3600}])
+    assert client.list_nodes() == []
+    # no backoff for the refresh retry; second request carries new token
+    assert sleeps == []
+    assert calls["n"] == 2
+    assert http.requests[0][2] == "Bearer tok0"
+    assert http.requests[1][2] == "Bearer tok1"
+
+
+def test_401_twice_surfaces_error(monkeypatch):
+    client, http, sleeps, _ = _client(
+        [("http", 401, "expired"), ("http", 401, "still expired")],
+        monkeypatch)
+    with pytest.raises(TPUApiError) as ei:
+        client.list_nodes()
+    assert ei.value.status == 401
+
+
+def test_token_cached_until_expiry(monkeypatch):
+    client, http, sleeps, calls = _client(
+        [("ok", {"nodes": []}), ("ok", {"nodes": []})], monkeypatch)
+    client.list_nodes()
+    client.list_nodes()
+    assert calls["n"] == 1  # one fetch serves both requests
+
+
+def test_wait_operation_error_includes_metadata():
+    ops = {"op/1": {
+        "name": "op/1", "done": True,
+        "error": {"code": 8, "message": "quota exceeded"},
+        "metadata": {"target": "nodes/ray-x", "verb": "create"}}}
+
+    def request_fn(method, url, body):
+        return ops[url.rsplit("/v2/", 1)[1]]
+
+    client = TPUApiClient("proj", "z", request_fn=request_fn)
+    with pytest.raises(TPUApiError) as ei:
+        client.wait_operation({"name": "op/1", "done": False},
+                              timeout_s=5.0, poll_s=0.0)
+    msg = str(ei.value)
+    assert "quota exceeded" in msg
+    assert "target=nodes/ray-x" in msg
+    assert "verb=create" in msg

@@ -1,0 +1,137 @@
+"""Continuous-action (Box-space) policies: Gaussian PPO + canonical SAC.
+
+Reference: the reference's SAC is continuous-first
+(``rllib/algorithms/sac/sac.py``; ``sac/sac_torch_model.py:15`` builds
+Box-space Gaussian policies with tanh squashing) and its PPO handles Box
+spaces through ``TorchDiagGaussian``. These tests cover the same
+surface: structural one-iteration checks plus a real Pendulum-v1
+learning threshold (reference tuned example
+``rllib/tuned_examples/sac/pendulum-sac.yaml`` stops around -250)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("gymnasium")
+
+from ray_tpu.rllib import PPOConfig, SACConfig  # noqa: E402
+from ray_tpu.rllib.models import (  # noqa: E402
+    diag_gaussian_entropy, diag_gaussian_logp, squashed_gaussian_sample,
+    tanh_logp_correction)
+
+
+def test_squashed_gaussian_logp_matches_numeric():
+    """tanh log-det correction against a numeric change-of-variables
+    check: logp_tanh(a) = logp_normal(u) - log|d tanh(u)/du|."""
+    import jax
+    import jax.numpy as jnp
+    key = jax.random.PRNGKey(0)
+    mean = jnp.asarray([[0.3, -0.7]])
+    log_std = jnp.asarray([[-0.5, 0.2]])
+    a, logp = squashed_gaussian_sample(key, mean, log_std)
+    assert a.shape == (1, 2)
+    assert np.all(np.abs(np.asarray(a)) < 1.0)
+    u = np.arctanh(np.asarray(a))
+    base = diag_gaussian_logp(mean, log_std, jnp.asarray(u))
+    corr = np.log(1.0 - np.tanh(u) ** 2).sum(-1)
+    np.testing.assert_allclose(np.asarray(logp),
+                               np.asarray(base) - corr, rtol=1e-4)
+    # the stable form equals the naive log(1 - tanh^2)
+    np.testing.assert_allclose(
+        np.asarray(tanh_logp_correction(jnp.asarray(u))), corr,
+        rtol=1e-4)
+
+
+def test_diag_gaussian_entropy_value():
+    import jax.numpy as jnp
+    log_std = jnp.zeros((4, 3))
+    # entropy of a unit diagonal Gaussian: D/2 * log(2*pi*e)
+    expect = 3 * 0.5 * np.log(2 * np.pi * np.e)
+    np.testing.assert_allclose(
+        np.asarray(diag_gaussian_entropy(log_std)), expect, rtol=1e-5)
+
+
+def test_ppo_pendulum_one_iteration(ray_session):
+    """PPO builds a Gaussian policy for a Box space and completes a
+    train step with finite losses; actions flow back to the env as
+    float vectors."""
+    config = (PPOConfig()
+              .environment("Pendulum-v1")
+              .env_runners(num_env_runners=1, num_envs_per_env_runner=2)
+              .training(train_batch_size=200, minibatch_size=64,
+                        num_epochs=2, lr=3e-4)
+              .debugging(seed=0))
+    algo = config.build()
+    try:
+        assert algo.module_spec.is_continuous
+        assert algo.module_spec.action_dim == 1
+        result = algo.train()
+        m = result["learner"]
+        assert np.isfinite(m["policy_loss"])
+        assert np.isfinite(m["entropy"])
+        a = algo.compute_single_action(np.zeros(3, np.float32))
+        assert a.shape == (1,)
+        assert -2.0 <= float(a[0]) <= 2.0
+    finally:
+        algo.cleanup()
+
+
+def test_sac_pendulum_one_iteration(ray_session):
+    """Continuous SAC: twin Q(s, a), squashed-Gaussian actor, learned
+    temperature — one train step with finite metrics."""
+    config = (SACConfig()
+              .environment("Pendulum-v1")
+              .env_runners(num_env_runners=1, num_envs_per_env_runner=1)
+              .training(train_batch_size=64, updates_per_step=1,
+                        rollout_fragment_length=8,
+                        num_steps_sampled_before_learning_starts=8)
+              .debugging(seed=0))
+    algo = config.build()
+    try:
+        assert algo.module_spec.is_continuous
+        result = algo.train()
+        m = result["learner"]
+        for k in ("qf_loss", "policy_loss", "alpha", "entropy"):
+            assert np.isfinite(m[k]), (k, m)
+        a = algo.compute_single_action(np.zeros(3, np.float32))
+        assert a.shape == (1,) and -2.0 <= float(a[0]) <= 2.0
+    finally:
+        algo.cleanup()
+
+
+def test_dqn_rejects_box_space(ray_session):
+    from ray_tpu.rllib import DQNConfig
+    config = DQNConfig().environment("Pendulum-v1")
+    with pytest.raises(ValueError, match="Discrete"):
+        config.build()
+
+
+@pytest.mark.slow
+def test_sac_pendulum_reaches_minus_300(ray_session):
+    """The real bar: Pendulum-v1 mean return >= -300 (random play is
+    ~-1200; the reference's pendulum-sac tuned example stops at about
+    -250). SAC should get there within ~30k env steps."""
+    config = (SACConfig()
+              .environment("Pendulum-v1")
+              .env_runners(num_env_runners=1, num_envs_per_env_runner=1)
+              .training(train_batch_size=256, updates_per_step=4,
+                        rollout_fragment_length=16, lr=3e-4,
+                        critic_lr=3e-4, alpha_lr=3e-4, tau=0.005,
+                        gamma=0.99,
+                        num_steps_sampled_before_learning_starts=1_000)
+              .debugging(seed=0))
+    algo = config.build()
+    best = -np.inf
+    try:
+        for _ in range(2_500):
+            result = algo.train()
+            if result["episode_return_mean"] == result[
+                    "episode_return_mean"]:  # not NaN
+                best = max(best, result["episode_return_mean"])
+            if best >= -300.0:
+                break
+            assert result["num_env_steps_sampled_lifetime"] < 60_000, (
+                f"SAC failed to reach -300 on Pendulum within 60k steps "
+                f"(best={best:.1f})")
+        assert best >= -300.0, f"SAC best return {best:.1f}"
+    finally:
+        algo.cleanup()
