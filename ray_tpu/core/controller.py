@@ -131,6 +131,9 @@ class Controller:
         # owner identity we asked to publish (FETCH_OBJECT). Resolved by
         # the owner's PUT_OBJECT; audited against owner death.
         self._owner_fetches: Dict[bytes, bytes] = {}
+        # rid -> (Event, slot) for in-flight worker profile requests
+        # (dashboard HTTP threads wait; _h_profile_result fulfills)
+        self._profile_waiters: Dict[bytes, tuple] = {}
         # worker -> last runtime-env key (env-affinity dispatch)
         self._worker_env: Dict[bytes, str] = {}
         # worker identity -> owning driver identity: workers leased to a
@@ -747,8 +750,33 @@ class Controller:
             self._pending_leases = [
                 (d, n) for d, n in self._pending_leases if d != identity]
             self._pending_leases.append((identity, remaining))
+            # multi-driver fairness: if another driver is hogging the
+            # worker pool, rebalance toward this request now
+            self._rebalance_leases()
+
+    def _lease_quota(self) -> int:
+        """Per-driver lease cap while several drivers want capacity.
+        Measured rationale (perf multi_client phase): with one driver
+        holding every CPU, the other drivers bounce between empty
+        grants and the controller path, feeding the starvation
+        reclaimer — aggregate throughput of 4 drivers fell BELOW one.
+        An equal split keeps every driver on the direct path."""
+        claimants = set(self.driver_leases.values())
+        claimants.update(d for d, _ in self._pending_leases)
+        n = max(1, len(claimants))
+        capacity = sum(len(node.all_workers)
+                       for node in self.nodes.values() if node.alive)
+        # ceil: a floor quota would strand capacity % n workers idle
+        # forever (every driver clamped below them)
+        return max(1, -(-capacity // n))
 
     def _grant_leases(self, identity: bytes, want: int) -> List[bytes]:
+        if self._pending_leases or len(
+                set(self.driver_leases.values()) - {identity}) > 0:
+            # other drivers hold or want leases: stay inside the quota
+            have = sum(1 for d in self.driver_leases.values()
+                       if d == identity)
+            want = min(want, max(0, self._lease_quota() - have))
         granted: List[bytes] = []
         for node in self.nodes.values():
             if not node.alive:
@@ -785,6 +813,32 @@ class Controller:
             if len(got) < n:
                 still.append((driver, n - len(got)))
         self._pending_leases = still
+
+    def _rebalance_leases(self) -> None:
+        """Revoke over-quota leases from hogging drivers so parked
+        requests of under-quota drivers can be granted (reference: the
+        raylet returns leased workers when other lease requests queue;
+        here the quota makes the split explicit). Stable: only drivers
+        ABOVE the quota lose leases, only down to the quota."""
+        if not self._pending_leases:
+            return
+        quota = self._lease_quota()
+        counts: Dict[bytes, int] = {}
+        for d in self.driver_leases.values():
+            counts[d] = counts.get(d, 0) + 1
+        pending = {d for d, _ in self._pending_leases
+                   if counts.get(d, 0) < quota}
+        if not pending:
+            return
+        for w, d in list(self.driver_leases.items()):
+            if counts.get(d, 0) <= quota:
+                continue
+            if w in self._lease_blocked:
+                continue
+            counts[d] -= 1
+            self._send(d, P.LEASE_REVOKED, {"worker": w, "dead": False})
+            self._reclaim_driver_lease(w)
+        self._grant_parked_leases()
 
     def _h_release_leases(self, identity: bytes, m: dict) -> None:
         for w in m.get("workers", ()):
@@ -2355,6 +2409,15 @@ class Controller:
                 "labels": dict(n.resources.labels),
                 "num_workers": len(n.all_workers), "stats": dict(n.stats, wait_worker=None),
             } for n in self.nodes.values()]
+        elif what == "node_processes":
+            # per-node-agent process stats (reference: the reporter
+            # agent's per-process psutil feed, flattened per worker)
+            rows = []
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                for p in n.stats.get("processes") or []:
+                    rows.append(dict(p, node_id=n.node_id.hex()))
         elif what == "tasks":
             rows = list(self.task_table.values())[-m.get("limit", 1000):]
         elif what == "actors":
@@ -2388,6 +2451,45 @@ class Controller:
         else:
             rows = []
         return rows
+
+    # -------------------------------------------------- worker profiling
+    def profile_worker(self, worker_identity_b: bytes,
+                       duration_s: float = 2.0,
+                       timeout_s: float = 30.0) -> Optional[dict]:
+        """Ask a worker to sample its own stacks and return the
+        collapsed-stack flamegraph artifact (reference: the dashboard's
+        on-demand py-spy via profile_manager.py:79; here the worker's
+        in-process sampler, which needs no external tooling). Called
+        from the dashboard's HTTP threads."""
+        import os as _os
+        rid = _os.urandom(8)
+        ev = threading.Event()
+        slot: dict = {}
+        self._profile_waiters[rid] = (ev, slot)
+        def send_if_known():
+            if worker_identity_b not in self.peers:
+                # a spawned-but-unregistered worker can't be reached by
+                # identity; fail fast instead of timing out
+                return False
+            self._send(worker_identity_b, P.PROFILE_SELF,
+                       {"rid": rid, "duration_s": duration_s})
+            return True
+
+        try:
+            if not self.call_on_loop(send_if_known):
+                return {"error": "worker is not registered "
+                        "(still booting, or gone)"}
+            if not ev.wait(timeout_s):
+                return None
+            return slot.get("data")
+        finally:
+            self._profile_waiters.pop(rid, None)
+
+    def _h_profile_result(self, identity: bytes, m: dict) -> None:
+        ent = self._profile_waiters.get(m.get("rid") or b"")
+        if ent is not None:
+            ent[1]["data"] = m
+            ent[0].set()
 
     def _h_timeline(self, identity: bytes, m: dict) -> None:
         self.task_events.extend(m["events"])
@@ -2435,6 +2537,7 @@ class Controller:
         P.CREATE_PG: _h_create_pg,
         P.REMOVE_PG: _h_remove_pg,
         P.HEARTBEAT: _h_heartbeat,
+        P.PROFILE_RESULT: _h_profile_result,
         P.PING: _h_ping,
         P.WORKER_EXIT: _h_worker_exit,
         P.NOTIFY_BLOCKED: _h_notify_blocked,

@@ -22,7 +22,7 @@ import sys
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import zmq
 
@@ -64,6 +64,8 @@ class NodeManager:
         self.shm = make_client(self.shm_session)
 
         self.workers: Dict[bytes, subprocess.Popen] = {}  # identity -> proc
+        #: pid -> psutil.Process, persistent so cpu_percent deltas work
+        self._psutil_cache: Dict[int, Any] = {}
         self._worker_started: Dict[bytes, float] = {}     # identity -> ts
         self._oom_killed: Dict[bytes, bool] = {}          # identity -> True
         self._requested_workers: set = set()   # controller-requested ids
@@ -421,9 +423,48 @@ class NodeManager:
         except Exception:
             pass
 
+    def _collect_process_stats(self) -> list:
+        """Per-process CPU/RSS of this node's workers + the node manager
+        itself (reference: dashboard/modules/reporter/reporter_agent.py
+        publishes per-process psutil stats from every node). psutil's
+        cpu_percent needs a persistent Process handle between calls, so
+        handles are cached by pid."""
+        try:
+            import psutil
+        except ImportError:
+            return []
+        cache = self._psutil_cache
+        with self._workers_lock:
+            entries = [(w.hex(), "worker", p.pid)
+                       for w, p in self.workers.items()
+                       if p.poll() is None]
+        entries.append(("", "node_manager", os.getpid()))
+        out = []
+        for ident, kind, pid in entries:
+            try:
+                pr = cache.get(pid)
+                if pr is None:
+                    pr = cache[pid] = psutil.Process(pid)
+                    pr.cpu_percent(interval=None)  # prime the counter
+                mi = pr.memory_info()
+                out.append({
+                    "worker_id": ident, "kind": kind, "pid": pid,
+                    "cpu_percent": pr.cpu_percent(interval=None),
+                    "rss": mi.rss,
+                    "num_threads": pr.num_threads(),
+                })
+            except Exception:
+                cache.pop(pid, None)
+        for pid in [p for p in cache
+                    if p not in {e[2] for e in entries}]:
+            del cache[pid]
+        return out
+
     def _heartbeat_loop(self) -> None:
         period = self.config.health_check_period_ms / 1000.0
+        beat = 0
         while not self._stopped.wait(period):
+            beat += 1
             # Native store: reclaim read-references held by dead PIDs
             # (plasma's disconnected-client cleanup).
             reap = getattr(self.store, "reap_dead_readers", None)
@@ -446,6 +487,13 @@ class NodeManager:
                 stats["mem_percent"] = psutil.virtual_memory().percent
             except Exception:
                 pass
+            if beat % 5 == 0:
+                # per-process stats every 5th beat: psutil walks /proc,
+                # which is too costly for the 1s heartbeat itself
+                try:
+                    stats["processes"] = self._collect_process_stats()
+                except Exception:
+                    pass
             try:
                 from ray_tpu.core.metric_defs import update_from_state
                 update_from_state(store_stats=stats, node_stats=stats)

@@ -42,7 +42,12 @@ KILL_ACTOR = b"KIL"
 GET_ACTOR = b"GAC"           # lookup by name
 ACTOR_ADDR = b"AAD"          # caller->controller {actor_id} -> {worker}|{dead}
                              # (long-poll: held until the actor is ALIVE)
-ACTOR_CALL = b"ACL"          # caller->actor worker DIRECT {spec}
+ACTOR_CALL = b"ACL"          # caller->actor worker DIRECT {spec} or
+                             # compact {tmpl, caller, task_id, seq, ...}
+TMPL_MISS = b"TMS"           # worker->caller DIRECT {task_id, tmpl}:
+                             # resend the call with its full spec (the
+                             # template was evicted or its registration
+                             # message was lost)
 CANCEL_QUEUED = b"CQD"       # ->worker direct {task_id, force}
 # blocked-worker protocol (reference: NotifyDirectCallTaskBlocked /
 # NotifyUnblocked — a worker blocked in ray.get releases its cpu and
@@ -100,6 +105,11 @@ PING = b"PNG"                # driver->controller liveness poke: lets a
 NODE_UPDATE = b"NUP"
 WORKER_EXIT = b"WEX"
 STATE_QUERY = b"STQ"         # {what, filters} -> rows
+PROFILE_SELF = b"PRF"        # controller->worker {rid, duration_s}:
+                             # sample your own stacks (dashboard
+                             # profiling; reference: reporter agent's
+                             # py-spy endpoints)
+PROFILE_RESULT = b"PRR"      # worker->controller {rid, collapsed, ...}
 TIMELINE_EVENTS = b"TLE"     # worker->controller task event batch
 PUBSUB = b"PUB"              # {channel, data} fanout
 SUBSCRIBE = b"SSC"           # {channel}

@@ -40,6 +40,10 @@ from ray_tpu.exceptions import GetTimeoutError
 logger = logging.getLogger(__name__)
 
 
+#: flusher-queue target marker for deferrable controller messages
+_DEFER = object()
+
+
 class _ArgPlaceholder:
     """Marks a positional arg that was a top-level ObjectRef."""
     __slots__ = ("index",)
@@ -316,10 +320,32 @@ class Runtime:
             except Exception:
                 pass
 
+    def _send_deferred(self, mtype: bytes, payload: Any) -> None:
+        """Queue a controller-bound message that tolerates a few ms of
+        delay (TASK_DONE accounting for direct tasks — the owner already
+        has the result; the controller only records). The flusher holds
+        these up to ~3ms / 64 messages and ships ONE batch, so a sync
+        call loop costs the controller one handler pass per batch
+        instead of one per call."""
+        self._out_q.put((_DEFER, mtype, payload))
+
     def _flush_loop(self) -> None:
+        deferred: List[Tuple[bytes, Any]] = []
+        deferred_at = 0.0
         while True:
             try:
-                item = self._out_q.get()
+                if deferred:
+                    # bounded hold: wake in time to honor the 3ms window
+                    wait = max(0.0,
+                               deferred_at + 0.003 - time.monotonic())
+                    try:
+                        item = self._out_q.get(timeout=wait)
+                    except Empty:
+                        self._flush_box(None, deferred)
+                        deferred = []
+                        continue
+                else:
+                    item = self._out_q.get()
             except Exception:
                 return
             batch = [item]
@@ -348,6 +374,11 @@ class Runtime:
                 # a list item is a multi-message put (_send_many)
                 for target, mtype, payload in (
                         it if isinstance(it, list) else (it,)):
+                    if target is _DEFER:
+                        if not deferred:
+                            deferred_at = time.monotonic()
+                        deferred.append((mtype, payload))
+                        continue
                     if target is None and mtype == P.SUBMIT_TASK:
                         specs.append(payload["spec"])
                         continue
@@ -355,6 +386,12 @@ class Runtime:
                         close_specs()
                     boxes.setdefault(target, []).append((mtype, payload))
             close_specs()
+            if deferred and (stop or len(deferred) >= 64
+                             or boxes.get(None)):
+                # ship alongside a controller-bound flush (free ride on
+                # the same MSG_BATCH), at the size cap, or at shutdown
+                boxes.setdefault(None, []).extend(deferred)
+                deferred = []
             for target, msgs in boxes.items():
                 self._flush_box(target, msgs)
             if time.time() - self._last_peer_prune > 30.0:
@@ -476,6 +513,13 @@ class Runtime:
             self._on_reconnect(m.get("gen"))
         elif mtype == P.FETCH_OBJECT:
             self._on_fetch_object(m)
+        elif mtype == P.TMPL_MISS:
+            self._on_tmpl_miss(m)
+        elif mtype == P.PROFILE_SELF:
+            # sampling sleeps for the requested duration: never on the
+            # pump thread
+            threading.Thread(target=self._run_self_profile, args=(m,),
+                             name="self-profile", daemon=True).start()
         elif mtype == P.LEASE_REVOKED:
             self._on_lease_revoked(m["worker"], m.get("dead", True))
         elif mtype == P.LEASE_GRANT:
@@ -669,6 +713,22 @@ class Runtime:
                 payload[k] = v
         self._send(P.PUT_OBJECT, payload)
 
+    def _run_self_profile(self, m: dict) -> None:
+        """Dashboard-requested self-profile (reference: the reporter
+        agent's py-spy endpoint; this is the in-process sampler that
+        needs no external tooling). Replies with collapsed stacks — the
+        flamegraph input format."""
+        try:
+            from ray_tpu.util.profiling import sample_self
+            s = sample_self(min(float(m.get("duration_s", 2.0)), 30.0),
+                            interval_s=0.005)
+            payload = {"rid": m.get("rid"), "collapsed": s.collapsed(),
+                       "num_samples": s.num_samples,
+                       "worker_id": self.worker_id.hex()}
+        except Exception as e:  # noqa: BLE001
+            payload = {"rid": m.get("rid"), "error": str(e)[:200]}
+        self._send(P.PROFILE_RESULT, payload)
+
     def _on_fetch_object(self, m: dict) -> None:
         """Controller asks us (the owner) to publish an owner-local
         object a borrower is parked on."""
@@ -708,7 +768,9 @@ class Runtime:
             self.memory_store.delete(oid)
             if not self._stopped.is_set():
                 try:
-                    self._send(P.OWNER_FREE, {"object_ids": [b]})
+                    # deferrable: the extent is already recycled; the
+                    # controller only drops bookkeeping
+                    self._send_deferred(P.OWNER_FREE, {"object_ids": [b]})
                 except Exception:
                     pass
 
@@ -1561,18 +1623,24 @@ class Runtime:
         directly to that worker — the controller is only consulted for the
         address (long-poll held until ALIVE) and for liveness pubsub."""
         aid = spec.actor_id.binary()
-        action = None  # ("direct", worker) | ("dead", err) | "queued"
+        action = None  # ("dead", err) | "resolve" | "queued" | "sent"
         with self._actors_lock:
             st = self._actors.get(aid)
             if st is None:
                 st = self._actors[aid] = {
                     "state": "RESOLVING", "worker": None, "queue": [],
-                    "inflight": {}, "error": None}
+                    "inflight": {}, "error": None, "tmpls": {}}
                 st["queue"].append(spec)
                 action = "resolve"
             elif st["state"] == "DIRECT":
                 st["inflight"][spec.task_id.binary()] = spec
-                action = ("direct", st["worker"])
+                # enqueue INSIDE the lock: template registration and its
+                # compact calls must hit the peer channel in assignment
+                # order, or the worker sees a compact call it can't
+                # expand
+                self._send_direct(st["worker"], P.ACTOR_CALL,
+                                  self._actor_call_msg(st, spec))
+                action = "sent"
             elif st["state"] == "DEAD":
                 action = ("dead", st["error"])
             else:  # RESOLVING
@@ -1580,10 +1648,53 @@ class Runtime:
                 action = "queued"
         if action == "resolve":
             self._resolve_actor(aid)
-        elif isinstance(action, tuple) and action[0] == "direct":
-            self._send_direct(action[1], P.ACTOR_CALL, {"spec": spec})
         elif isinstance(action, tuple) and action[0] == "dead":
             self._fail_actor_task_local(spec, action[1])
+
+    def _on_tmpl_miss(self, m: dict) -> None:
+        """The actor worker lost the template for a compact call
+        (evicted, or the registration message was dropped): resend that
+        call with its FULL spec — which also re-registers the template
+        for subsequent compact calls. Without this the dropped call
+        would hang its ray.get forever."""
+        tid_b = m.get("task_id") or b""
+        with self._actors_lock:
+            for st in self._actors.values():
+                spec = st["inflight"].get(tid_b)
+                if spec is not None and st["state"] == "DIRECT":
+                    # the worker's view of our templates is stale: start
+                    # a fresh numbering so every method re-registers,
+                    # then resend this call full (which re-registers its
+                    # own template in the same message)
+                    st["tmpls"] = {}
+                    self._send_direct(st["worker"], P.ACTOR_CALL,
+                                      self._actor_call_msg(st, spec))
+                    return
+
+    def _actor_call_msg(self, st: dict, spec: TaskSpec) -> dict:
+        """Wire form of one actor call. The spec is mostly static per
+        method: ship it once as a TEMPLATE, then only the dynamic fields
+        (reference: the submitter's push_normal_task payload is protobuf
+        with the same static/dynamic split done by field encoding).
+        Caller holds _actors_lock."""
+        if spec.runtime_env or spec.resources:
+            # rare per-call variability: don't template
+            return {"spec": spec}
+        key = (spec.function, spec.name, spec.num_returns,
+               spec.max_retries, spec.retry_exceptions,
+               spec.concurrency_group)
+        tmpls = st["tmpls"]
+        tid = tmpls.get(key)
+        me = self.worker_id.binary()
+        if tid is None:
+            tid = tmpls[key] = len(tmpls) + 1
+            return {"spec": spec, "tmpl": tid, "caller": me}
+        return {"tmpl": tid, "caller": me,
+                "task_id": spec.task_id.binary(),
+                "seq": spec.sequence_number,
+                "args_blob": spec.args_blob,
+                "arg_refs": spec.arg_refs or None,
+                "arg_metas": spec.arg_metas}
 
     def _resolve_actor(self, aid: bytes) -> None:
         hexid = ActorID(aid).hex()
@@ -1623,12 +1734,13 @@ class Runtime:
                 worker = reply["worker"]
                 st["state"] = "DIRECT"
                 st["worker"] = worker
+                st["tmpls"] = {}  # templates are per worker incarnation
                 to_send = st["queue"]
                 st["queue"] = []
                 for s in to_send:
                     st["inflight"][s.task_id.binary()] = s
-        for s in to_send:
-            self._send_direct(worker, P.ACTOR_CALL, {"spec": s})
+                    self._send_direct(worker, P.ACTOR_CALL,
+                                      self._actor_call_msg(st, s))
         for s in to_fail:
             self._fail_actor_task_local(s, err)
 

@@ -143,7 +143,7 @@ def _make_handler(head: DashboardHead):
                     what = path.split("/")[-1]
                     if what not in ("nodes", "actors", "tasks",
                                     "objects", "placement_groups",
-                                    "jobs"):
+                                    "jobs", "node_processes"):
                         self._json({"error": f"unknown state {what!r}"},
                                    404)
                         return
@@ -166,6 +166,37 @@ def _make_handler(head: DashboardHead):
                                 "ray_tpu_session": head.session_dir})
                 elif path == "/api/cluster_status":
                     self._json(head.cluster_status())
+                elif path.startswith("/api/nodes/") \
+                        and path.endswith("/profile"):
+                    # /api/nodes/<node_hex>/profile?worker=<hex>&
+                    # duration=2 -> collapsed-stack flamegraph artifact
+                    # (reference: reporter agent's on-demand profiling,
+                    # profile_manager.py:79)
+                    from urllib.parse import parse_qs
+                    q = parse_qs(parsed.query)
+                    worker_hex = (q.get("worker") or [""])[0]
+                    if not worker_hex:
+                        self._json(
+                            {"error": "worker query param required "
+                             "(hex identity from "
+                             "/api/state/node_processes)"}, 400)
+                        return
+                    try:
+                        duration = float(
+                            (q.get("duration") or ["2"])[0])
+                    except ValueError:
+                        self._json({"error": "bad duration"}, 400)
+                        return
+                    result = head.controller.profile_worker(
+                        bytes.fromhex(worker_hex),
+                        duration_s=min(duration, 30.0))
+                    if result is None:
+                        self._json({"error": "profile timed out "
+                                    "(worker gone?)"}, 504)
+                    elif result.get("error"):
+                        self._json({"error": result["error"]}, 500)
+                    else:
+                        self._text(result.get("collapsed") or "")
                 elif path.startswith("/api/jobs/") and path.endswith("/logs"):
                     jid = self._job_id_from(path)
                     if head.job_manager.get_job_info(jid) is None:

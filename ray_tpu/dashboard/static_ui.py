@@ -37,6 +37,7 @@ INDEX_HTML = """<!doctype html>
 <p><a class="button" href="/api/timeline" download="timeline.json">
   Download task timeline (Chrome trace)</a></p>
 <h2>Nodes</h2><table id="nodes"></table>
+<h2>Worker processes</h2><table id="procs"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
@@ -46,15 +47,16 @@ const esc = (s) => s.replace(/&/g, "&amp;").replace(/</g, "&lt;")
   .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
 const fmt = (v) => v === null || v === undefined ? "" :
   esc(typeof v === "object" ? JSON.stringify(v) : String(v));
-function table(el, rows, cols) {
+function table(el, rows, cols, raw) {
   if (!rows || !rows.length) {
     el.innerHTML = "<tr><td class='muted'>none</td></tr>"; return;
   }
   cols = cols || Object.keys(rows[0]);
+  raw = raw || [];
   let html = "<tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
   for (const r of rows) {
     html += "<tr>" + cols.map(c => {
-      let v = fmt(r[c]);
+      let v = raw.includes(c) ? (r[c] || "") : fmt(r[c]);
       if (c === "alive" || c === "state" || c === "status") {
         const good = v === "true" || v === "ALIVE" || v === "RUNNING"
           || v === "FINISHED" || v === "SUCCEEDED" || v === "CREATED";
@@ -72,12 +74,13 @@ async function j(url) {
 }
 async function refresh() {
   try {
-    const [ver, status, nodes, jobs, actors, pgs, tasks] =
+    const [ver, status, nodes, jobs, actors, pgs, tasks, procs] =
       await Promise.all([
         j("/api/version"), j("/api/cluster_status"),
         j("/api/state/nodes"), j("/api/jobs"),
         j("/api/state/actors"), j("/api/state/placement_groups"),
-        j("/api/state/tasks?limit=50")]);
+        j("/api/state/tasks?limit=50"),
+        j("/api/state/node_processes")]);
     document.getElementById("version").textContent =
       "v" + ver.version + " — " + ver.ray_tpu_session;
     const st = status.task_states || {};
@@ -91,6 +94,17 @@ async function refresh() {
     table(document.getElementById("nodes"), nodes.rows,
       ["node_id", "alive", "resources_total", "resources_available",
        "num_workers", "labels"]);
+    // live per-process stats from each node's agent feed; the profile
+    // link returns the worker's collapsed-stack flamegraph artifact
+    const prows = (procs.rows || []).map(p => ({
+      node: (p.node_id || "").slice(0, 12), kind: p.kind, pid: p.pid,
+      "cpu %": p.cpu_percent,
+      "rss MiB": Math.round((p.rss || 0) / 1048576),
+      threads: p.num_threads,
+      profile: p.worker_id ?
+        `<a class="button" href="/api/nodes/${p.node_id}/profile` +
+        `?worker=${p.worker_id}&duration=2">sample</a>` : ""}));
+    table(document.getElementById("procs"), prows, null, ["profile"]);
     table(document.getElementById("jobs"), jobs.jobs || jobs);
     table(document.getElementById("actors"), actors.rows,
       ["actor_id", "state", "name", "namespace", "num_restarts",

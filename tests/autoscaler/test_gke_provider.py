@@ -1,0 +1,254 @@
+"""GKE/KubeRay-shaped provider against a mocked Kubernetes API
+(reference behavior:
+``python/ray/autoscaler/_private/kuberay/node_provider.py`` — scale-up
+PATCHes workerGroupSpecs replicas, scale-down names pods in
+workersToDelete; the operator reconciles). No network: the injectable
+transport is the test double, which plays the operator role."""
+
+import re
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import NodeTypeConfig, StandardAutoscaler
+from ray_tpu.autoscaler.gke import (
+    GKETPUNodeProvider, K8sApiClient, K8sApiError, LABEL_CLUSTER,
+    LABEL_GROUP, LABEL_NODE_ID)
+
+
+class MockK8s:
+    """Simulates the apiserver + the KubeRay-style operator: PATCHed
+    replicas with pendingNodeIds materialize as `hosts_per_group` pods
+    per replica; workersToDelete removes that replica's pods."""
+
+    def __init__(self, cluster="testclus", hosts_per_group=None):
+        self.cluster = cluster
+        self.hosts = hosts_per_group or {}
+        self.cr = {
+            "metadata": {"name": cluster},
+            "spec": {"workerGroupSpecs": [
+                {"groupName": "v5e-64-group", "replicas": 0,
+                 "pendingNodeIds": [],
+                 "scaleStrategy": {"workersToDelete": []}},
+                {"groupName": "v5e-16-group", "replicas": 0,
+                 "pendingNodeIds": [],
+                 "scaleStrategy": {"workersToDelete": []}},
+            ]},
+        }
+        self.pods = {}  # name -> pod
+        self.calls = []
+        self.patches = []
+
+    # -- operator reconcile: pending node ids become pods ---------------
+    def reconcile(self):
+        for spec in self.cr["spec"]["workerGroupSpecs"]:
+            group = spec["groupName"]
+            for nid in list(spec.get("pendingNodeIds", [])):
+                n = self.hosts.get(group, 1)
+                for h in range(n):
+                    name = f"{nid}-host-{h}"
+                    self.pods[name] = {
+                        "metadata": {"name": name, "labels": {
+                            LABEL_CLUSTER: self.cluster,
+                            LABEL_GROUP: group,
+                            LABEL_NODE_ID: nid}},
+                        "status": {"phase": "Running"}}
+                spec["pendingNodeIds"].remove(nid)
+            for nid in list(spec["scaleStrategy"]["workersToDelete"]):
+                for name in [n for n, p in self.pods.items()
+                             if p["metadata"]["labels"]
+                             .get(LABEL_NODE_ID) == nid]:
+                    del self.pods[name]
+                spec["scaleStrategy"]["workersToDelete"].remove(nid)
+
+    # -- transport -------------------------------------------------------
+    def __call__(self, method, path, body):
+        self.calls.append((method, path))
+        if method == "GET" and "/raytpuclusters/" in path:
+            import copy
+            return copy.deepcopy(self.cr)
+        if method == "PATCH" and "/raytpuclusters/" in path:
+            self.patches.append(body)
+            for op in body:
+                m = re.match(r"/spec/workerGroupSpecs/(\d+)(/.*)",
+                             op["path"])
+                idx, rest = int(m.group(1)), m.group(2)
+                spec = self.cr["spec"]["workerGroupSpecs"][idx]
+                if rest == "/replicas":
+                    assert op["op"] == "replace"
+                    spec["replicas"] = op["value"]
+                elif rest == "/pendingNodeIds/-":
+                    spec.setdefault("pendingNodeIds", []).append(
+                        op["value"])
+                elif rest == "/scaleStrategy/workersToDelete/-":
+                    spec["scaleStrategy"]["workersToDelete"].append(
+                        op["value"])
+                else:
+                    raise AssertionError(f"unexpected patch {op}")
+            return {}
+        if method == "GET" and "/pods" in path:
+            sel = path.split("labelSelector=")[1].split("&")[0]
+            k, v = sel.split("=", 1)
+            return {"items": [
+                p for p in self.pods.values()
+                if p["metadata"]["labels"].get(k) == v]}
+        raise AssertionError(f"unexpected request {method} {path}")
+
+
+def make_provider(mock=None, resolve=None):
+    mock = mock or MockK8s(hosts_per_group={"v5e-64-group": 16,
+                                            "v5e-16-group": 4})
+    api = K8sApiClient("ray-ns", request_fn=mock)
+    cfg = {
+        "namespace": "ray-ns",
+        "cluster_name": "testclus",
+        "pods_cache_ttl_s": 0.0,
+        "groups": {"v5e_64": "v5e-64-group", "v5e_16": "v5e-16-group"},
+        "resources": {
+            "v5e_64": {"TPU": 64.0, "TPU-v5litepod-64-head": 1.0},
+            "v5e_16": {"TPU": 16.0, "TPU-v5litepod-16-head": 1.0},
+        },
+    }
+    return GKETPUNodeProvider(cfg, api=api,
+                              resolve_internal=resolve), mock
+
+
+# -------------------------------------------------------------- provider
+def test_create_node_bumps_replicas_and_registers_pending():
+    provider, mock = make_provider()
+    nid = provider.create_node("v5e_64", {"TPU": 64})
+    spec = mock.cr["spec"]["workerGroupSpecs"][0]
+    assert spec["replicas"] == 1
+    assert nid in spec["pendingNodeIds"]
+    # pending inventory before any pod exists
+    assert nid in provider.non_terminated_nodes()
+    assert provider.node_type(nid) == "v5e_64"
+    assert provider.node_resources(nid)["TPU-v5litepod-64-head"] == 1.0
+
+
+def test_pods_appear_and_count_hosts():
+    provider, mock = make_provider()
+    nid = provider.create_node("v5e_64", {})
+    mock.reconcile()
+    assert provider.non_terminated_nodes() == [nid]
+    assert provider.expected_internal_count(nid) == 16
+
+
+def test_unknown_group_raises():
+    provider, _ = make_provider()
+    with pytest.raises(KeyError, match="no worker group"):
+        provider.create_node("tpu9000", {})
+
+
+def test_terminate_uses_workers_to_delete_protocol():
+    provider, mock = make_provider()
+    nid = provider.create_node("v5e_16", {})
+    mock.reconcile()
+    provider.terminate_node(nid)
+    spec = mock.cr["spec"]["workerGroupSpecs"][1]
+    assert spec["replicas"] == 0
+    assert nid in spec["scaleStrategy"]["workersToDelete"]
+    mock.reconcile()
+    assert provider.non_terminated_nodes() == []
+    # double-terminate is a no-op
+    provider.terminate_node(nid)
+
+
+def test_foreign_cluster_pods_invisible():
+    provider, mock = make_provider()
+    mock.pods["foreign"] = {
+        "metadata": {"name": "foreign", "labels": {
+            LABEL_CLUSTER: "other", LABEL_NODE_ID: "x"}},
+        "status": {"phase": "Running"}}
+    assert provider.non_terminated_nodes() == []
+
+
+def test_transport_retries_5xx(monkeypatch):
+    calls = {"n": 0}
+
+    def flaky(method, path, body):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            import urllib.error
+            raise urllib.error.HTTPError(path, 503, "busy", {}, None)
+        return {"items": []}
+
+    # the injectable request_fn IS the transport: retry semantics live
+    # in _urllib_request, exercised via the gce-style fault tests; here
+    # we only assert the client surfaces non-retryable errors
+    api = K8sApiClient("ns", request_fn=flaky)
+    with pytest.raises(Exception):
+        api.list_pods("a=b")
+
+
+# ---------------------------------------------- gang autoscaling (mock)
+class StubController:
+    def __init__(self):
+        self.leases = {}
+        self._lease_node = {}
+        self.actors = {}
+        self.drained = []
+        outer = self
+
+        class Sched:
+            def set_draining(self, node_id, flag):
+                outer.drained.append((node_id.binary(), flag))
+        self.scheduler = Sched()
+        self.snap = {"demand": [], "busy_nodes": set(),
+                     "alive_nodes": set()}
+
+    def call_on_loop(self, fn):
+        return fn()
+
+
+def test_gang_demand_scales_workergroup_and_drains_down():
+    """The VERDICT-r4 ask end-to-end: pending TPU-v5e-64-head demand
+    creates a workergroup scale-up (ONE slice), the slice's 16 host pods
+    join, and a drained-idle slice scales back down via
+    workersToDelete."""
+    host_ids = {}
+    provider, mock = make_provider(
+        resolve=lambda nid: host_ids.get(nid, []))
+    ctl = StubController()
+    ctl.snap["demand"] = [{"TPU-v5litepod-64-head": 1.0, "TPU": 64.0}]
+    types = [
+        NodeTypeConfig("v5e_64",
+                       {"TPU": 64.0, "TPU-v5litepod-64-head": 1.0},
+                       min_workers=0, max_workers=4),
+        NodeTypeConfig("v5e_16",
+                       {"TPU": 16.0, "TPU-v5litepod-16-head": 1.0},
+                       min_workers=0, max_workers=4),
+    ]
+    asc = StandardAutoscaler(ctl, provider, types, idle_timeout_s=0.0)
+    asc._snapshot = lambda: ctl.snap
+
+    out = asc.update()
+    assert len(out["launched"]) == 1
+    nid = out["launched"][0]
+    assert mock.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+
+    # booting slice absorbs the demand: no duplicate scale-up
+    out2 = asc.update()
+    assert out2["launched"] == []
+    assert mock.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+
+    # operator creates the 16 host pods; hosts register with the
+    # controller
+    mock.reconcile()
+    ids = [bytes([i]) * 28 for i in range(16)]
+    host_ids[nid] = ids
+    ctl.snap["demand"] = []
+    ctl.snap["alive_nodes"] = set(ids)
+    ctl.snap["busy_nodes"] = set(ids[:1])
+    out3 = asc.update()
+    assert out3["terminated"] == []  # one busy host vetoes the slice
+
+    ctl.snap["busy_nodes"] = set()
+    out4 = asc.update()
+    assert out4["terminated"] == [nid]
+    spec = mock.cr["spec"]["workerGroupSpecs"][0]
+    assert spec["replicas"] == 0
+    assert nid in spec["scaleStrategy"]["workersToDelete"]
+    drained = {b for b, flag in ctl.drained if flag}
+    assert drained == set(ids)
+    mock.reconcile()
+    assert provider.non_terminated_nodes() == []

@@ -1,0 +1,113 @@
+"""Per-node agent feed + on-demand worker profiling (reference:
+``dashboard/agent.py:28`` runs a DashboardAgent on every node publishing
+per-process psutil stats via ``modules/reporter/reporter_agent.py``, and
+``profile_manager.py:79`` serves on-demand profiles)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=2, _num_initial_workers=2,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _get(addr, path):
+    with urllib.request.urlopen(addr + path, timeout=30) as r:
+        body = r.read()
+        ctype = r.headers.get("Content-Type", "")
+    return body, ctype
+
+
+def _dashboard_address(info):
+    with open(os.path.join(info["session_dir"], "dashboard.json")) as f:
+        return json.load(f)["address"]
+
+
+def test_node_process_stats_flow_to_state_api(cluster):
+    pytest.importorskip("psutil")
+    addr = _dashboard_address(cluster)
+    # make the workers do something so cpu counters move
+    @ray_tpu.remote
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 0.2:
+            pass
+        return os.getpid()
+    ray_tpu.get([spin.remote() for _ in range(4)])
+
+    deadline = time.time() + 30
+    rows = []
+    while time.time() < deadline:
+        body, _ = _get(addr, "/api/state/node_processes")
+        rows = json.loads(body)["rows"]
+        if any(r["kind"] == "worker" for r in rows):
+            break
+        time.sleep(1.0)
+    workers = [r for r in rows if r["kind"] == "worker"]
+    assert workers, rows
+    for r in workers:
+        assert r["pid"] > 0
+        assert r["rss"] > 0
+        assert r["num_threads"] >= 1
+        assert "cpu_percent" in r
+        assert r["node_id"]
+        assert len(r["worker_id"]) > 0
+    # the node manager reports itself too
+    assert any(r["kind"] == "node_manager" for r in rows)
+
+
+def test_profile_endpoint_returns_flamegraph_artifact(cluster):
+    pytest.importorskip("psutil")
+    addr = _dashboard_address(cluster)
+
+    @ray_tpu.remote
+    def burn(seconds):
+        t0 = time.time()
+        x = 0
+        while time.time() - t0 < seconds:
+            x += 1
+        return x
+
+    @ray_tpu.remote
+    def whoami():
+        from ray_tpu.core.global_state import global_worker
+        w = global_worker()
+        return w.worker_id.hex(), w.node_id.hex()
+
+    # a REGISTERED worker (node_processes also lists still-booting
+    # workers, which cannot be profiled yet); keep it busy so the
+    # sample catches real frames
+    worker_hex, node_hex = ray_tpu.get(whoami.remote())
+    ref = burn.remote(4.0)
+    body, ctype = _get(
+        addr, f"/api/nodes/{node_hex}/profile"
+              f"?worker={worker_hex}&duration=1")
+    text = body.decode()
+    # collapsed-stack flamegraph format: "frame;frame;... count" lines
+    assert text.strip(), "empty profile"
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    assert all(ln.rsplit(" ", 1)[1].isdigit() for ln in lines)
+    assert any(";" in ln for ln in lines)
+    ray_tpu.get(ref)
+
+
+def test_profile_unknown_worker_times_out_cleanly(cluster):
+    addr = _dashboard_address(cluster)
+    fake = os.urandom(28).hex()
+    req = urllib.request.Request(
+        addr + f"/api/nodes/{'0' * 12}/profile?worker={fake}&duration=1")
+    try:
+        urllib.request.urlopen(req, timeout=60)
+        raise AssertionError("expected an HTTP error")
+    except urllib.error.HTTPError as e:
+        assert e.code in (500, 504)

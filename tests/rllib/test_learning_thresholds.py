@@ -38,9 +38,10 @@ def test_ppo_cartpole_reward_150_within_100k_steps(ray_session):
 
 @pytest.mark.slow
 def test_appo_cartpole_learns(ray_session):
-    """APPO (V-trace + clip) must clearly learn CartPole: well past
-    random play (~20) inside a small step budget. The full 150 bar is
-    PPO's; APPO's async staleness needs more steps than a CI slot."""
+    """APPO (V-trace + clip) must reach the reference's CartPole bar:
+    reward >= 150 (``rllib/tuned_examples/ppo/cartpole-ppo.yaml:4-6``
+    stops at 150; APPO's async staleness just needs a larger iteration
+    budget to get there)."""
     config = (APPOConfig()
               .environment("CartPole-v1")
               .env_runners(num_env_runners=2, num_envs_per_env_runner=8)
@@ -50,12 +51,12 @@ def test_appo_cartpole_learns(ray_session):
     algo = config.build()
     best = -np.inf
     try:
-        for _ in range(60):
+        for _ in range(150):
             result = algo.train()
             best = max(best, result["episode_return_mean"])
-            if best >= 100.0:
+            if best >= 150.0:
                 break
-        assert best >= 100.0, f"APPO best return {best:.1f}"
+        assert best >= 150.0, f"APPO best return {best:.1f}"
     finally:
         algo.cleanup()
 
@@ -81,9 +82,10 @@ def test_appo_one_iteration(ray_session):
 
 @pytest.mark.slow
 def test_sac_cartpole_learns(ray_session):
-    """Discrete SAC (twin soft Q + learned temperature) must clearly
-    learn CartPole: well past random play (~20) in a CI-sized budget,
-    with the temperature staying finite and positive."""
+    """Discrete SAC (twin soft Q + learned temperature) must reach the
+    reference's CartPole bar: reward >= 150 (the same threshold
+    ``cartpole-ppo.yaml`` stops at), with the temperature staying
+    finite and positive."""
     from ray_tpu.rllib import SACConfig
     config = (SACConfig()
               .environment("CartPole-v1")
@@ -94,12 +96,12 @@ def test_sac_cartpole_learns(ray_session):
     algo = config.build()
     best = -np.inf
     try:
-        for _ in range(400):
+        for _ in range(1_000):
             result = algo.train()
             best = max(best, result["episode_return_mean"])
-            if best >= 60.0:
+            if best >= 150.0:
                 break
-        assert best >= 60.0, f"SAC best return {best:.1f}"
+        assert best >= 150.0, f"SAC best return {best:.1f}"
         alpha = result["learner"].get("alpha")
         assert alpha is not None and 0.0 < alpha < 10.0
     finally:
