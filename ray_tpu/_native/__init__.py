@@ -123,6 +123,10 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_uint32]
         lib.ns_base.restype = ctypes.c_void_p
+        lib.ns_largest_free.restype = ctypes.c_uint64
+        lib.ns_largest_free.argtypes = [ctypes.c_void_p]
+        lib.ns_compact.restype = ctypes.c_uint64
+        lib.ns_compact.argtypes = [ctypes.c_void_p]
         lib.ns_base.argtypes = [ctypes.c_void_p]
         lib.ns_total_size.restype = ctypes.c_uint64
         lib.ns_total_size.argtypes = [ctypes.c_void_p]

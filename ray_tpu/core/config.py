@@ -98,6 +98,11 @@ class Config:
     #: workers to warm per node when a driver connects (reference:
     #: prestart_worker_first_driver); 0 disables
     prestart_workers: int = 2
+    #: fork workers from a pre-imported zygote process (~ms per spawn)
+    #: instead of cold interpreter boots (~seconds). The lever behind
+    #: actor-burst throughput: every actor needs a fresh dedicated
+    #: worker. RAY_TPU_WORKER_ZYGOTE=0 restores cold spawns.
+    worker_zygote: bool = True
     #: Max workers a node will start per CPU if unspecified.
     workers_per_cpu: int = 1
 

@@ -110,6 +110,19 @@ class _Segment:
             ctypes.byref(size))
         return state, off.value, size.value
 
+    def acquire_for(self, oid: ObjectID, pid: int) -> int:
+        """Take a read reference on behalf of ANOTHER process (the
+        restore handshake — see NativeShmStore._lease_for_locked).
+        Reaped with the rest of the pid's references if it dies."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        return self.lib.ns_acquire(
+            self.handle, oid.binary(), pid, ctypes.byref(off),
+            ctypes.byref(size))
+
+    def release_for(self, oid: ObjectID, pid: int) -> None:
+        self.lib.ns_release(self.handle, oid.binary(), pid)
+
     def release(self, oid: ObjectID) -> None:
         self.lib.ns_release(self.handle, oid.binary(), os.getpid())
 
@@ -118,6 +131,14 @@ class _Segment:
 
     def reap(self) -> int:
         return self.lib.ns_reap(self.handle)
+
+    def largest_free(self) -> int:
+        return self.lib.ns_largest_free(self.handle)
+
+    def compact(self) -> int:
+        """Defragment movable (sealed, reader-free) extents; returns the
+        largest contiguous free run afterwards."""
+        return self.lib.ns_compact(self.handle)
 
     def stats(self):
         used = ctypes.c_uint64()
@@ -312,6 +333,15 @@ class NativeShmStore:
                 moved += 1
                 after, _, _ = self.seg.stats()
                 freed += max(0, before - after)
+            # Fragmentation defense: enough total bytes can be free with
+            # no CONTIGUOUS run large enough (pinned extents scattered
+            # across the arena) — compact the movable extents before the
+            # caller's create retries (observed: 17 MB creates failing
+            # at 25% utilization of a 192 MB arena)
+            used, cap, _ = self.seg.stats()
+            if cap - used >= bytes_needed and \
+                    self.seg.largest_free() < bytes_needed:
+                self.seg.compact()
         return freed
 
     def _maybe_evict_locked(self) -> None:
@@ -362,7 +392,23 @@ class NativeShmStore:
         if STORE_DEBUG:
             logger.info("SPILL %s", object_id.hex())
 
-    def maybe_restore(self, object_id: ObjectID) -> bool:
+    def _lease_for_locked(self, object_id: ObjectID,
+                          for_pid: Optional[int]) -> None:
+        """Take a reader lease ON BEHALF OF the requesting pid before
+        the restore RPC reply leaves this process. Closes the
+        restore-vs-respill race outright: the extent cannot be spilled
+        or evicted again until the requester maps it and releases (the
+        grace window only narrowed the race; under sustained spill
+        thrash the reply could arrive after the object was re-spilled
+        and the get would eventually give up). Leases of crashed
+        requesters are reclaimed by reap_dead_readers. Reference:
+        ``src/ray/raylet/local_object_manager.h:41`` — spilled objects
+        are pinned through the restore handshake."""
+        if for_pid:
+            self.seg.acquire_for(object_id, int(for_pid))
+
+    def maybe_restore(self, object_id: ObjectID,
+                      for_pid: Optional[int] = None) -> bool:
         with self._lock:
             spath = self._spilled.get(object_id)
             if spath is None:
@@ -371,10 +417,14 @@ class NativeShmStore:
                     logger.warning(
                         "RESTOREMISS %s state=%s nspilled=%d",
                         object_id.hex(), state, len(self._spilled))
-                return state == 2
+                if state == 2:
+                    self._lease_for_locked(object_id, for_pid)
+                    return True
+                return False
             if self.seg.lookup(object_id)[0] == 2:
                 # resident AND spilled (duplicate-execution re-create):
                 # the extent is current; keep the disk copy as backup
+                self._lease_for_locked(object_id, for_pid)
                 return True
             try:
                 size = os.stat(spath).st_size
@@ -384,6 +434,11 @@ class NativeShmStore:
                 self._spilled.pop(object_id, None)
                 return False
             off = self.seg.alloc(object_id, size)
+            if off == _FULL:
+                # fragmentation first: compaction is cheaper than
+                # spilling and may already open a large-enough run
+                self.seg.compact()
+                off = self.seg.alloc(object_id, size)
             if off == _FULL:
                 # Make room by SPILLING other unreferenced residents
                 # (never plain eviction here — an unspilled resident's
@@ -413,6 +468,7 @@ class NativeShmStore:
             self._spilled.pop(object_id, None)
             self._sealed[object_id] = size
             self._restore_grace[object_id] = time.monotonic() + 2.0
+            self._lease_for_locked(object_id, for_pid)
             return True
 
     def reap_dead_readers(self) -> int:

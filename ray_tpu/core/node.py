@@ -35,6 +35,69 @@ from ray_tpu.core.shm_store import make_client, make_store
 logger = logging.getLogger(__name__)
 
 
+class _ForkedWorker:
+    """Popen-shaped handle over a zygote-forked worker. The process is
+    reparented to init (double fork), so liveness is probed via /proc —
+    and pinned to the process's START TIME: init reaps these workers
+    immediately (no zombie holds the pid, unlike Popen children), so a
+    recycled pid would otherwise make a dead worker look alive forever
+    and let the OOM monitor SIGKILL an unrelated process."""
+
+    @staticmethod
+    def _starttime(pid: int) -> Optional[str]:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                parts = f.read().rsplit(") ", 1)[-1].split()
+            return parts[19]  # starttime: field 22, 20th after comm
+        except OSError:
+            return None
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self._birth = self._starttime(pid)
+
+    def _alive(self) -> bool:
+        st = self._starttime(self.pid)
+        return st is not None and st == self._birth
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        if self._alive():
+            return None
+        self.returncode = 0
+        return 0
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.02)
+        return self.returncode or 0
+
+    def terminate(self) -> None:
+        if not self._alive():
+            self.returncode = 0
+            return
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            self.returncode = 0
+
+    def kill(self) -> None:
+        if not self._alive():
+            # never signal a recycled pid (could be anyone's process)
+            self.returncode = 0
+            return
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            self.returncode = 0
+
+
 class NodeManager:
     def __init__(self, session_dir: str, resources: Dict[str, float],
                  labels: Optional[Dict[str, str]] = None,
@@ -110,6 +173,19 @@ class NodeManager:
         from queue import SimpleQueue
         self._store_rpc_q: "SimpleQueue" = SimpleQueue()
         self._store_rpc_thread: Optional[threading.Thread] = None
+        #: warm worker factory (see core/zygote.py): forks registered
+        #: workers in ~ms instead of seconds of interpreter+import boot
+        self._zygote: Optional[subprocess.Popen] = None
+        self._zygote_sock = os.path.join(
+            session_dir, f"zygote-{self.node_id.hex()[:12]}.sock")
+        #: spawn requests drain on dedicated spawner threads: the
+        #: zygote handshake waits for the forked child to be scheduled
+        #: once, which under a deep runqueue takes hundreds of ms — it
+        #: must never block the node message loop
+        self._spawn_q: "SimpleQueue" = SimpleQueue()
+        self._spawner_threads: List[threading.Thread] = []
+        self._zygote_started = False
+        self._spawn_init_lock = threading.Lock()
 
     # ------------------------------------------------------------------ run
     def _register_with_controller(self) -> None:
@@ -118,6 +194,84 @@ class NodeManager:
             "node_id": self.node_id.binary(), "resources": self.resources,
             "labels": self.labels, "pid": os.getpid(),
             "objects": self.store.contents()})
+
+    def _worker_base_env(self) -> Dict[str, str]:
+        """Env a worker needs beyond the inherited environment."""
+        env: Dict[str, str] = dict(self.worker_env)
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_SHM_SESSION"] = self.shm_session
+        # zygote-forked workers are reparented to init: the orphan
+        # watchdog must poll this pid, not getppid()
+        env["RAY_TPU_NODE_PID"] = str(os.getpid())
+        import ray_tpu
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        extra_paths = [pkg_parent, os.getcwd()]
+        existing = os.environ.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in extra_paths
+            + ([existing] if existing else []) if p)
+        return env
+
+    def _start_zygote(self) -> None:
+        """Lazy: launched by the first worker spawn, not node start — a
+        many-node virtual cluster (cluster_utils envelope) would
+        otherwise pay one zygote interpreter boot per node up front
+        (measured: 2x slower node join)."""
+        if self._zygote_started:
+            return
+        self._zygote_started = True
+        if not getattr(self.config, "worker_zygote", True):
+            return
+        env = dict(os.environ)
+        env.update(self._worker_base_env())
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(
+            log_dir, f"zygote-{self.node_id.hex()[:12]}.out"), "ab")
+        try:
+            self._zygote = subprocess.Popen(
+                [sys.executable, "-u", "-m", "ray_tpu.core.zygote",
+                 self._zygote_sock, str(os.getpid())],
+                env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        except Exception:
+            logger.exception("zygote failed to start; worker spawns "
+                             "fall back to cold boots")
+            self._zygote = None
+
+    def _zygote_spawn(self, env: Dict[str, str],
+                      log_path: str) -> Optional[int]:
+        """Ask the zygote for a forked worker; returns its pid, or None
+        when the zygote isn't usable (booting, dead, disabled). The
+        zygote forks and moves on immediately; the pid arrives from the
+        CHILD once it is first scheduled — so this call can wait a
+        while under load and must only run on spawner threads."""
+        z = self._zygote
+        if z is None or z.poll() is not None:
+            return None
+        import json as _json
+        import socket as _socket
+        try:
+            conn = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            conn.settimeout(30.0)
+            try:
+                conn.connect(self._zygote_sock)
+                conn.sendall((_json.dumps(
+                    {"env": env, "log_path": log_path})
+                    + "\n").encode())
+                data = b""
+                while not data.endswith(b"\n"):
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        return None
+                    data += chunk
+            finally:
+                conn.close()
+            return int(_json.loads(data)["pid"])
+        except Exception:
+            return None
 
     def start(self) -> None:
         self._register_with_controller()
@@ -149,6 +303,19 @@ class NodeManager:
                     p.kill()
                 except Exception:
                     pass
+        if self._zygote is not None:
+            try:
+                self._zygote.terminate()
+                self._zygote.wait(timeout=2)
+            except Exception:
+                try:
+                    self._zygote.kill()
+                except Exception:
+                    pass
+            try:
+                os.unlink(self._zygote_sock)
+            except OSError:
+                pass
         try:
             self.sock.close(0)
             self.direct_sock.close(0)
@@ -295,28 +462,54 @@ class NodeManager:
 
     # ------------------------------------------------------------- workers
     def _start_worker(self, requested: bool = True) -> None:
+        """Queue a worker spawn for the spawner threads — the zygote
+        handshake waits for the forked child's first schedule, which
+        must never stall the caller (message loop / heartbeat)."""
+        with self._spawn_init_lock:
+            # main thread (initial workers) and node-loop thread
+            # (controller TASK_ASSIGN) race here on first spawn
+            if not self._spawner_threads:
+                self._start_zygote()
+                for i in range(4):
+                    t = threading.Thread(target=self._spawner_loop,
+                                         name=f"node-spawner-{i}",
+                                         daemon=True)
+                    t.start()
+                    self._spawner_threads.append(t)
+        self._spawn_q.put(requested)
+
+    def _spawner_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                requested = self._spawn_q.get(timeout=1.0)
+            except Exception:
+                continue
+            try:
+                self._spawn_one(requested)
+            except Exception:
+                logger.exception("worker spawn failed")
+
+    def _spawn_one(self, requested: bool) -> None:
         worker_id = WorkerID.from_random()
-        env = dict(os.environ)
-        env.update(self.worker_env)
-        env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
-        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
-        env["RAY_TPU_SHM_SESSION"] = self.shm_session
-        # ensure workers can import ray_tpu (and the driver's cwd modules)
-        import ray_tpu
-        pkg_parent = os.path.dirname(os.path.dirname(
-            os.path.abspath(ray_tpu.__file__)))
-        extra_paths = [pkg_parent, os.getcwd()]
-        existing = env.get("PYTHONPATH", "")
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in extra_paths + ([existing] if existing else []) if p)
+        delta = self._worker_base_env()
+        delta["RAY_TPU_WORKER_ID"] = worker_id.hex()
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-u", "-m", "ray_tpu.core.worker"],
-            env=env, stdout=out, stderr=subprocess.STDOUT,
-            start_new_session=True)
+        log_path = os.path.join(
+            log_dir, f"worker-{worker_id.hex()[:12]}.out")
+        # warm path: fork from the zygote (~ms). Cold fallback: full
+        # interpreter boot (zygote still starting, crashed, or disabled)
+        pid = self._zygote_spawn(delta, log_path)
+        if pid is not None:
+            proc = _ForkedWorker(pid)
+        else:
+            env = dict(os.environ)
+            env.update(delta)
+            out = open(log_path, "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-u", "-m", "ray_tpu.core.worker"],
+                env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True)
         with self._workers_lock:
             self.workers[worker_id.binary()] = proc
             self._worker_started[worker_id.binary()] = time.monotonic()
@@ -562,8 +755,15 @@ class NodeManager:
                     int(m.get("bytes", 0)))
             elif op == "restore":
                 oid = ObjectID(m["object_id"])
-                result = self.store.maybe_restore(oid)
+                try:
+                    result = self.store.maybe_restore(
+                        oid, for_pid=m.get("pid"))
+                except TypeError:
+                    # python-store fallback without lease support
+                    result = self.store.maybe_restore(oid)
                 out["ok"] = result is True
+                out["leased"] = result is True and bool(m.get("pid")) \
+                    and hasattr(self.store, "seg")
                 # capacity-full restores are transient (see
                 # NativeShmStore.maybe_restore): tell the caller to
                 # retry instead of giving up

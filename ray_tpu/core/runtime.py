@@ -816,6 +816,20 @@ class Runtime:
                             gc.collect()
                         self._node_store_rpc("make_room", bytes=size)
                         if time.monotonic() >= deadline:
+                            from ray_tpu.core.native_store import (
+                                STORE_DEBUG)
+                            if STORE_DEBUG and hasattr(self.shm,
+                                                       "_segment"):
+                                seg = self.shm._segment()
+                                rows = seg.list_sealed()
+                                held = [(o.hex()[:12], sz, rc)
+                                        for o, sz, rc in rows if rc > 0]
+                                logger.warning(
+                                    "STOREFULL inventory: %d sealed, "
+                                    "%d reader-held (%d MB): %s",
+                                    len(rows), len(held),
+                                    sum(sz for _, sz, _ in held) >> 20,
+                                    held[:40])
                             raise
                         time.sleep(0.2)
                 serialized.write_to(view)
@@ -844,12 +858,24 @@ class Runtime:
         deadline = time.monotonic() + self.config.store_full_timeout_s
         while True:
             try:
+                # pid rides along so the node takes a reader lease FOR
+                # US before replying: the extent cannot be re-spilled in
+                # the reply->get_view window (the race that lost
+                # over-budget shuffles under sustained spill thrash)
                 reply = self._node_store_rpc(
-                    "restore", object_id=oid.binary(), timeout=60.0)
+                    "restore", object_id=oid.binary(), pid=os.getpid(),
+                    timeout=60.0)
             except Exception:
                 return None
             if reply.get("ok"):
                 view = self.shm.get_view(oid, timeout=5.0)
+                if reply.get("leased"):
+                    # balance the node-held handshake lease now that we
+                    # hold (or failed to take) our own
+                    try:
+                        self.shm._segment().release(oid)
+                    except Exception:
+                        pass
                 if view is not None:
                     return view
                 # re-spilled between reply and our lease: loop
@@ -1086,8 +1112,9 @@ class Runtime:
                 # plasma get gives up)
                 view = self._restore_local(oid)
             if view is not None:
-                value, _ = self.serialization.deserialize_from_view(view)
-                self._cache_shm_value(oid, value)
+                value, _, bufs = \
+                    self.serialization.deserialize_from_view_tracked(view)
+                self._cache_shm_value(oid, value, bufs)
                 return value
         # remote: ask controller to make it local (or hand us inline bytes)
         reply = self.request(P.GET_LOCATION, {
@@ -1110,25 +1137,40 @@ class Runtime:
         if view is None:
             from ray_tpu.exceptions import ObjectLostError
             raise ObjectLostError(oid)
-        value, _ = self.serialization.deserialize_from_view(view)
-        self._cache_shm_value(oid, value)
+        value, _, bufs = \
+            self.serialization.deserialize_from_view_tracked(view)
+        self._cache_shm_value(oid, value, bufs)
         return value
 
-    def _cache_shm_value(self, oid: ObjectID, value: Any) -> None:
+    def _cache_shm_value(self, oid: ObjectID, value: Any,
+                         buffer_views: Optional[list] = None) -> None:
         """Cache a zero-copy shm value WEAKLY and release the reader
-        ledger when the value is collected (reference: plasma buffers
-        pin an object only while the client still holds them). A strong
-        cache would pin the extent for the process lifetime — every
-        large task arg a worker ever saw would leak."""
+        ledger when the last ALIAS of the extent dies (reference:
+        plasma buffers pin an object only while the client still holds
+        them). A strong cache would pin the extent for the process
+        lifetime — every large task arg a worker ever saw would leak.
+
+        The release anchors are the out-of-band BUFFER VIEWS from
+        deserialization: arrow buffers and numpy bases reference
+        exactly these memoryview objects, so they die — by refcount,
+        no gc needed — precisely when the last table slice / array
+        view / concat product is gone. Finalizing on the VALUE is both
+        too early (a table can die while its buffers live on inside
+        derived objects — data corruption once the extent recycles)
+        and too late (arrow tables sit in reference cycles, so a busy
+        process pins consumed blocks until some distant gen-2 GC)."""
         import weakref
-        targets = _weakref_targets(value)
-        if not targets:
-            # nothing weakref-able aliases the extent (pure-copy value):
-            # release the ledger now and cache strongly
+        anchors = list(buffer_views or ())
+        if not anchors:
+            # legacy path (no tracked buffers): walk the value
+            anchors = _weakref_targets(value)
+        if not anchors:
+            # nothing aliases the extent (pure-copy value): release the
+            # ledger now and cache strongly
             self.memory_store.put(oid, value, force=True)
             self.shm.release(oid)
             return
-        remaining = [len(targets)]
+        remaining = [len(anchors)]
         shm = self.shm
 
         def _release(_=None):
@@ -1139,7 +1181,7 @@ class Runtime:
                 except Exception:
                     pass
 
-        for t in targets:
+        for t in anchors:
             weakref.finalize(t, _release)
         self.memory_store.put(oid, value, force=True, weak=True)
 

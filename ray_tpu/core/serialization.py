@@ -145,6 +145,18 @@ class SerializationContext:
         return value, list(deserialized)
 
     def deserialize_from_view(self, view: memoryview) -> Tuple[object, list]:
+        value, refs, _ = self.deserialize_from_view_tracked(view)
+        return value, refs
+
+    def deserialize_from_view_tracked(
+            self, view: memoryview) -> Tuple[object, list, list]:
+        """Like deserialize_from_view, but also returns the out-of-band
+        buffer views handed to pickle. Zero-copy consumers (arrow
+        buffers, numpy bases) hold references to EXACTLY these
+        memoryview objects for as long as any alias of the data lives —
+        they are the correct anchors for reader-lease lifetime (a
+        finalizer on the VALUE fires too early: a table can die while
+        its sliced/united buffers live on in other arrow objects)."""
         n_buffers, len_meta = struct.unpack_from("<IQ", view, 0)
         off = 12
         meta = bytes(view[off:off + len_meta])
@@ -156,7 +168,8 @@ class SerializationContext:
             off += 8
             buffers.append(view[off:off + blen])
             off += blen
-        return self.deserialize(meta, buffers)
+        value, refs = self.deserialize(meta, buffers)
+        return value, refs, buffers
 
 
 _OOB_BYTES_THRESHOLD = 4096

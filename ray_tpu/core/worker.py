@@ -578,17 +578,34 @@ class WorkerExecutor:
         return apply_runtime_env(env)
 
 
-def _orphan_watchdog(parent_pid: int) -> None:
+def _orphan_watchdog(parent_pid: int,
+                     node_pid: Optional[int] = None) -> None:
     """Exit when the spawning node manager's process dies (reference:
     workers poll raylet liveness and die with it — core_worker.cc
     CheckForRayletFailure). Workers start in their own session, so no
-    SIGHUP arrives; without this they outlive dead clusters."""
+    SIGHUP arrives; without this they outlive dead clusters.
+
+    Zygote-forked workers are NOT children of the node manager (the
+    double fork reparents them to init), and worse, the getppid()
+    captured at main() can be the short-lived intermediate fork parent
+    — its exit then looked exactly like node-manager death and killed
+    ~20% of workers in actor bursts. When the node manager's pid is
+    known (RAY_TPU_NODE_PID), poll THAT process directly."""
     while True:
         time.sleep(2.0)
-        if os.getppid() != parent_pid:
-            logging.getLogger(__name__).warning(
-                "node manager process died; worker exiting")
-            os._exit(1)
+        if node_pid is not None:
+            try:
+                os.kill(node_pid, 0)
+                continue
+            except ProcessLookupError:
+                pass
+            except PermissionError:
+                continue
+        elif os.getppid() == parent_pid:
+            continue
+        logging.getLogger(__name__).warning(
+            "node manager process died; worker exiting")
+        os._exit(1)
 
 
 def main() -> None:
@@ -601,7 +618,10 @@ def main() -> None:
         import faulthandler
         faulthandler.dump_traceback_later(
             float(dump_after), repeat=True)
-    threading.Thread(target=_orphan_watchdog, args=(os.getppid(),),
+    node_pid = os.environ.get("RAY_TPU_NODE_PID")
+    threading.Thread(target=_orphan_watchdog,
+                     args=(os.getppid(),
+                           int(node_pid) if node_pid else None),
                      daemon=True).start()
     # Honor an explicit platform override before any task imports jax.
     # (Env-var JAX_PLATFORMS alone is not enough in environments whose
@@ -615,10 +635,21 @@ def main() -> None:
     node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
     shm_session = os.environ["RAY_TPU_SHM_SESSION"]
+    boot_t0 = time.perf_counter()
+    bootprof = os.environ.get("RAY_TPU_WORKER_BOOTPROF")
+
+    def mark(stage: str) -> None:
+        if bootprof:
+            print(f"BOOT {stage} {time.perf_counter() - boot_t0:.3f} "
+                  f"cpu={time.process_time():.3f}", flush=True)
+
     runtime = Runtime("worker", session_dir, node_id, worker_id, shm_session)
+    mark("runtime")
     set_global_worker(runtime)
     runtime.register()
+    mark("registered")
     executor = WorkerExecutor(runtime)
+    mark("executor")
     profile_out = os.environ.get("RAY_TPU_PROFILE_WORKER")
     if profile_out:
         # drop a cProfile of the execution loop at exit (debugging aid:

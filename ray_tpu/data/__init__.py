@@ -21,11 +21,14 @@ from ray_tpu.data.datasource import (
     range,
     range_tensor,
     read_binary_files,
+    read_bigquery,
     read_images,
     read_csv,
     read_json,
+    read_mongo,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
     read_webdataset,
@@ -47,12 +50,15 @@ __all__ = [
     "from_torch",
     "range",
     "range_tensor",
+    "read_bigquery",
     "read_binary_files",
     "read_images",
     "read_csv",
     "read_json",
+    "read_mongo",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
     "read_tfrecords",
     "read_webdataset",

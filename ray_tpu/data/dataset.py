@@ -353,9 +353,11 @@ class Dataset:
         return BlockAccessor.concat(blocks).to_pandas()
 
     # -- writes -------------------------------------------------------
-    def write_parquet(self, path: str, **kwargs) -> None:
+    def write_parquet(self, path: str,
+                      partition_cols=None, **kwargs) -> None:
         from ray_tpu.data.datasource import write_blocks
-        write_blocks(self, path, "parquet")
+        write_blocks(self, path, "parquet",
+                     partition_cols=partition_cols)
 
     def write_csv(self, path: str, **kwargs) -> None:
         from ray_tpu.data.datasource import write_blocks

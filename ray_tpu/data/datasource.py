@@ -231,11 +231,47 @@ def read_images(paths, *, size: Optional[tuple] = None,
                                  "ReadImages", size_of=decoded_size)
 
 
-def read_parquet(paths, **kwargs) -> Dataset:
+def read_parquet(paths, *, split_row_groups: bool = True,
+                 **kwargs) -> Dataset:
+    """Parquet with driver-side metadata prefetch (reference:
+    ``datasource/parquet_datasource.py:153`` prefetches file metadata to
+    plan fragments): large files split into one read task per batch of
+    row groups, so a few big files still parallelize; ``columns=`` /
+    ``filters=`` push down into the arrow reader."""
     import pyarrow.parquet as pq
-    return _file_read_dataset(
-        paths, [".parquet"], lambda p: pq.read_table(p, **kwargs),
-        "ReadParquet")
+    files = _resolve_paths(paths, [".parquet"])
+    target = DataContext.get_current().target_max_block_size
+    tasks: List[Callable[[], Block]] = []
+    for p in files:
+        groups: List[List[int]] = []
+        # row-group reads honor only columns=; any other reader kwarg
+        # (filters, schema, memory_map, ...) forces the whole-file path
+        # so its semantics apply uniformly regardless of file size
+        if split_row_groups and not (set(kwargs) - {"columns"}):
+            try:
+                md = pq.ParquetFile(p).metadata  # footer only
+                cur: List[int] = []
+                cur_bytes = 0
+                for g in builtins.range(md.num_row_groups):
+                    sz = md.row_group(g).total_byte_size
+                    if cur and cur_bytes + sz > target:
+                        groups.append(cur)
+                        cur, cur_bytes = [], 0
+                    cur.append(g)
+                    cur_bytes += sz
+                if cur:
+                    groups.append(cur)
+            except Exception:
+                groups = []
+        if len(groups) > 1:
+            for idx in groups:
+                tasks.append(functools.partial(
+                    lambda p, idx: pq.ParquetFile(p).read_row_groups(
+                        idx, columns=kwargs.get("columns")), p, idx))
+        else:
+            tasks.append(functools.partial(
+                lambda p: pq.read_table(p, **kwargs), p))
+    return _make_dataset(tasks, "ReadParquet")
 
 
 def read_csv(paths, **kwargs) -> Dataset:
@@ -349,13 +385,128 @@ def read_webdataset(paths, **kwargs) -> Dataset:
                               "ReadWebDataset")
 
 
+def read_sql(sql: str, connection_factory: Callable[[], Any], *,
+             parallelism: int = 1) -> Dataset:
+    """Rows of a SQL query over any DB-API 2.0 connection (reference:
+    ``data/datasource/sql_datasource.py`` — connection factory + query;
+    shards parallelize via LIMIT/OFFSET exactly like the reference's
+    ``_read_stream`` pagination). ``connection_factory`` must be
+    picklable (e.g. ``functools.partial(sqlite3.connect, path)``)."""
+
+    def fetch(query: str) -> Block:
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(query)
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        return pa.table({c: [r[i] for r in rows]
+                         for i, c in enumerate(cols)})
+
+    if parallelism <= 1:
+        return _make_dataset([functools.partial(fetch, sql)], "ReadSQL")
+    if "order by" not in sql.lower():
+        # LIMIT/OFFSET shards over an unordered query have no stable
+        # row assignment: concurrent shards could duplicate/miss rows
+        raise ValueError(
+            "read_sql with parallelism > 1 requires an ORDER BY in the "
+            "query (LIMIT/OFFSET sharding needs a deterministic order)")
+    # shard by LIMIT/OFFSET over a deterministic total count (the
+    # derived table needs an alias on PostgreSQL/MySQL)
+    conn = connection_factory()
+    try:
+        cur = conn.cursor()
+        cur.execute(f"SELECT COUNT(*) FROM ({sql}) AS __rt_count")
+        total = int(cur.fetchone()[0])
+    finally:
+        conn.close()
+    per = max(1, -(-total // parallelism))
+    tasks = [functools.partial(
+        fetch, f"{sql} LIMIT {per} OFFSET {off}")
+        for off in builtins.range(0, max(total, 1), per)]
+    return _make_dataset(tasks, "ReadSQL")
+
+
+def read_bigquery(project_id: str, *, query: Optional[str] = None,
+                  dataset: Optional[str] = None,
+                  client_factory: Optional[Callable[[], Any]] = None
+                  ) -> Dataset:
+    """BigQuery rows (reference: ``datasource/bigquery_datasource.py``
+    over ``google.cloud.bigquery``). The client library is not in the
+    hermetic TPU image, so a ``client_factory`` is injectable; without
+    one, ``google.cloud.bigquery.Client`` is imported lazily."""
+    if query is None and dataset is None:
+        raise ValueError("read_bigquery needs query= or dataset= "
+                         "('dataset.table')")
+
+    def fetch() -> Block:
+        if client_factory is not None:
+            client = client_factory()
+        else:
+            try:
+                from google.cloud import bigquery
+            except ImportError as e:
+                raise ImportError(
+                    "google-cloud-bigquery is not installed in this "
+                    "image; pass client_factory= to inject a client"
+                ) from e
+            client = bigquery.Client(project=project_id)
+        if query is not None:
+            result = client.query(query).result()
+        else:
+            ds_id, table_id = dataset.split(".", 1)
+            result = client.list_rows(f"{project_id}.{ds_id}.{table_id}")
+        arrow = result.to_arrow()
+        return arrow
+
+    return _make_dataset([fetch], "ReadBigQuery")
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[List[dict]] = None,
+               client_factory: Optional[Callable[[], Any]] = None
+               ) -> Dataset:
+    """MongoDB documents (reference: ``datasource/mongo_datasource.py``
+    over pymongo/pymongoarrow). pymongo is not in the hermetic image, so
+    a ``client_factory`` is injectable. Documents become one row each;
+    ``_id`` is stringified."""
+
+    def fetch() -> Block:
+        if client_factory is not None:
+            client = client_factory()
+        else:
+            try:
+                import pymongo
+            except ImportError as e:
+                raise ImportError(
+                    "pymongo is not installed in this image; pass "
+                    "client_factory= to inject a client") from e
+            client = pymongo.MongoClient(uri)
+        coll = client[database][collection]
+        docs = list(coll.aggregate(pipeline) if pipeline
+                    else coll.find())
+        if not docs:
+            return pa.table({})
+        keys = sorted({k for d in docs for k in d})
+        cols = {}
+        for k in keys:
+            vals = [d.get(k) for d in docs]
+            if k == "_id":
+                vals = [str(v) for v in vals]
+            cols[k] = vals
+        return pa.table(cols)
+
+    return _make_dataset([fetch], "ReadMongo")
+
+
 # --------------------------------------------------------------- write
-def write_blocks(ds: Dataset, path: str, fmt: str) -> None:
+def write_blocks(ds: Dataset, path: str, fmt: str,
+                 partition_cols: Optional[List[str]] = None) -> None:
     os.makedirs(path, exist_ok=True)
-    for i, block in enumerate(ds.iter_blocks()):
-        if block.num_rows == 0:
-            continue
-        out = os.path.join(path, f"part-{i:05d}.{fmt}")
+
+    def write_one(block, out: str) -> None:
         if fmt == "parquet":
             import pyarrow.parquet as pq
             pq.write_table(block, out)
@@ -366,3 +517,32 @@ def write_blocks(ds: Dataset, path: str, fmt: str) -> None:
             block.to_pandas().to_json(out, orient="records", lines=True)
         else:
             raise ValueError(fmt)
+
+    for i, block in enumerate(ds.iter_blocks()):
+        if block.num_rows == 0:
+            continue
+        if partition_cols:
+            # hive-style partitioned layout (reference:
+            # ``datasource/parquet_datasource.py`` partitioned writes:
+            # path/key=value/.../part-*.ext, partition columns dropped
+            # from the file payload). dropna=False + the hive null
+            # bucket: pandas' default dropna would SILENTLY drop every
+            # row whose partition value is null.
+            df = block.to_pandas()
+            for c in partition_cols:
+                df[c] = df[c].fillna("__HIVE_DEFAULT_PARTITION__")
+            for j, (key, part) in enumerate(
+                    df.groupby(partition_cols, sort=True,
+                               dropna=False)):
+                if not isinstance(key, tuple):
+                    key = (key,)
+                sub = os.path.join(path, *(
+                    f"{c}={v}" for c, v in zip(partition_cols, key)))
+                os.makedirs(sub, exist_ok=True)
+                payload = pa.Table.from_pandas(
+                    part.drop(columns=list(partition_cols)),
+                    preserve_index=False)
+                write_one(payload, os.path.join(
+                    sub, f"part-{i:05d}-{j:03d}.{fmt}"))
+            continue
+        write_one(block, os.path.join(path, f"part-{i:05d}.{fmt}"))

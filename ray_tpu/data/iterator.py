@@ -27,7 +27,11 @@ def iter_batches_over_blocks(blocks: Iterator[Block],
                              shuffle_seed: Optional[int] = None
                              ) -> Iterator[Any]:
     """Re-chunk a block stream into fixed-size batches; optional local
-    shuffle buffer (reference ``iter_batches`` semantics)."""
+    shuffle buffer (reference ``iter_batches`` semantics). Consumed
+    blocks' shm reader leases release by REFCOUNT the moment the last
+    batch/slice alias dies (the lease anchors on the deserialization
+    buffer views — see Runtime._cache_shm_value), so streaming an
+    over-budget dataset keeps only the working set pinned."""
     rng = np.random.default_rng(shuffle_seed)
     carry: List[pa.Table] = []
     carry_rows = 0

@@ -11,7 +11,12 @@ from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.connectors import (
     ClipObs, Connector, ConnectorPipeline, FlattenObs, FrameStack,
     NormalizeObs)
+from ray_tpu.rllib.cql import CQL, CQLConfig
+from ray_tpu.rllib.ddpg import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.estimators import (
+    DirectMethod, DoublyRobust, FQEModel, ImportanceSampling,
+    WeightedImportanceSampling)
 from ray_tpu.rllib.env_runner import EnvRunner, compute_gae
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner import Learner, LearnerGroup
@@ -32,12 +37,20 @@ __all__ = [
     "AlgorithmConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
     "ClipObs",
     "Connector",
     "ConnectorPipeline",
+    "DDPG",
+    "DDPGConfig",
     "DQN",
     "DQNConfig",
+    "DirectMethod",
+    "DoublyRobust",
     "EnvRunner",
+    "FQEModel",
+    "ImportanceSampling",
     "FlattenObs",
     "FrameStack",
     "IMPALA",
@@ -61,5 +74,8 @@ __all__ = [
     "RLModuleSpec",
     "SAC",
     "SACConfig",
+    "TD3",
+    "TD3Config",
+    "WeightedImportanceSampling",
     "compute_gae",
 ]

@@ -1,0 +1,266 @@
+"""DDPG and TD3: deterministic-policy-gradient continuous control.
+
+Reference: ``rllib/algorithms/ddpg/ddpg.py`` (+ ``ddpg_torch_model.py``:
+deterministic tanh actor, Q(s, a) critic, target nets, OU/Gaussian
+action noise) and ``rllib/algorithms/td3/td3.py`` (DDPG + the three TD3
+fixes: twin critics, delayed policy updates, target policy smoothing).
+TPU-native shape, like SAC/DQN here: critic update, (possibly delayed)
+actor update, and polyak syncs compile into ONE jitted XLA program per
+step — the policy delay is a ``lax.cond`` on the step counter, not a
+host-side branch."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNEnvRunner
+from ray_tpu.rllib.models import init_mlp, mlp_forward
+from ray_tpu.rllib.rl_module import RLModuleSpec
+from ray_tpu.rllib.sac import SACConfig
+
+
+class DDPGEnvRunner(DQNEnvRunner):
+    """Rollout actor: deterministic tanh policy + Gaussian exploration
+    noise, clipped back into (-1, 1) (reference: ddpg's
+    GaussianNoise exploration). The replay buffer stores the noisy
+    squashed action; the env sees it rescaled to the Box bounds."""
+
+    def __init__(self, env_creator, module_spec: RLModuleSpec,
+                 num_envs: int = 1, seed: int = 0,
+                 worker_index: int = 0, noise_sigma: float = 0.1):
+        super().__init__(env_creator, module_spec, num_envs, seed,
+                         worker_index)
+        self._noise_sigma = noise_sigma
+        low = np.asarray(module_spec.action_low, np.float32)
+        high = np.asarray(module_spec.action_high, np.float32)
+        self._center = (low + high) / 2.0
+        self._scale = (high - low) / 2.0
+
+    def _make_act_buf(self, shape) -> np.ndarray:
+        return np.zeros(shape + (self._module.spec.action_dim,),
+                        np.float32)
+
+    def _select_actions(self, epsilon: float) -> np.ndarray:
+        import jax.numpy as jnp
+        mu = np.asarray(jnp.tanh(mlp_forward(
+            self._params, jnp.asarray(self._obs, jnp.float32))),
+            np.float32)
+        noise = self._rng.normal(0.0, self._noise_sigma, mu.shape)
+        return np.clip(mu + noise, -1.0, 1.0).astype(np.float32)
+
+    def _env_action(self, action):
+        return self._center + self._scale * action
+
+
+class DDPGLearner:
+    """Q(s, a) critic(s) + deterministic actor + targets, one jitted
+    update. ``twin_q``/``policy_delay``/``smooth_target_noise`` give the
+    TD3 variant (reference: td3.py sets exactly these on ddpg)."""
+
+    def __init__(self, module_spec: RLModuleSpec, *,
+                 actor_lr: float, critic_lr: float, gamma: float,
+                 tau: float, grad_clip: Optional[float], seed: int,
+                 twin_q: bool = False, policy_delay: int = 1,
+                 smooth_target_noise: float = 0.0,
+                 smooth_target_clip: float = 0.5):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        self.spec = module_spec
+        self._gamma = gamma
+        self._tau = tau
+        self._twin = twin_q
+        self._delay = max(1, policy_delay)
+        self._noise = smooth_target_noise
+        self._noise_clip = smooth_target_clip
+        adim = module_spec.action_dim
+        obs_dim = module_spec.observation_dim
+        h = list(module_spec.hiddens)
+
+        def maybe_clip(tx):
+            return optax.chain(optax.clip_by_global_norm(grad_clip),
+                               tx) if grad_clip else tx
+
+        self._pi_opt = maybe_clip(optax.adam(actor_lr))
+        self._q_opt = maybe_clip(optax.adam(critic_lr))
+
+        keys = jax.random.split(jax.random.PRNGKey(seed), 4)
+        pi = init_mlp(keys[0], [obs_dim, *h, adim], scale=0.01)
+        q_sizes = [obs_dim + adim, *h, 1]
+        qs = {"q1": init_mlp(keys[1], q_sizes)}
+        if twin_q:
+            qs["q2"] = init_mlp(keys[2], q_sizes)
+        self._state = {
+            "pi": pi, "qs": qs,
+            "pi_t": jax.tree.map(lambda x: x.copy(), pi),
+            "qs_t": jax.tree.map(lambda x: x.copy(), qs),
+            "pi_opt": self._pi_opt.init(pi),
+            "q_opt": self._q_opt.init(qs),
+            "steps": jnp.zeros((), jnp.int32),
+            "key": keys[3],
+        }
+        self._jit_update = jax.jit(self._update, donate_argnums=(0,))
+
+    @staticmethod
+    def _mu(pi_params, obs):
+        import jax.numpy as jnp
+        return jnp.tanh(mlp_forward(pi_params, obs))
+
+    @staticmethod
+    def _q(q_params, obs, act):
+        import jax.numpy as jnp
+        return mlp_forward(q_params, jnp.concatenate([obs, act], -1)
+                           )[..., 0]
+
+    def _update(self, state, batch):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        obs, next_obs = batch["obs"], batch["next_obs"]
+        acts = batch["actions"]
+        key, k_noise = jax.random.split(state["key"])
+
+        # -- target action, optionally smoothed (TD3 fix #3) ----------
+        a_next = self._mu(state["pi_t"], next_obs)
+        if self._noise > 0.0:
+            eps = jnp.clip(
+                self._noise * jax.random.normal(k_noise, a_next.shape,
+                                                a_next.dtype),
+                -self._noise_clip, self._noise_clip)
+            a_next = jnp.clip(a_next + eps, -1.0, 1.0)
+        q_next = self._q(state["qs_t"]["q1"], next_obs, a_next)
+        if self._twin:
+            q_next = jnp.minimum(
+                q_next, self._q(state["qs_t"]["q2"], next_obs, a_next))
+        y = batch["rewards"] + self._gamma * (1.0 - batch["dones"]) \
+            * jax.lax.stop_gradient(q_next)
+
+        def q_loss(qs):
+            l = jnp.mean((self._q(qs["q1"], obs, acts) - y) ** 2)
+            if self._twin:
+                l = l + jnp.mean((self._q(qs["q2"], obs, acts) - y) ** 2)
+            return l
+
+        qf_loss, q_grads = jax.value_and_grad(q_loss)(state["qs"])
+        q_updates, q_opt = self._q_opt.update(
+            q_grads, state["q_opt"], state["qs"])
+        qs = optax.apply_updates(state["qs"], q_updates)
+
+        # -- delayed deterministic policy gradient (TD3 fix #2) -------
+        def pi_loss(pi_params):
+            return -jnp.mean(self._q(qs["q1"], obs,
+                                     self._mu(pi_params, obs)))
+
+        pl, pi_grads = jax.value_and_grad(pi_loss)(state["pi"])
+        pi_updates, pi_opt = self._pi_opt.update(
+            pi_grads, state["pi_opt"], state["pi"])
+        pi_new = optax.apply_updates(state["pi"], pi_updates)
+
+        steps = state["steps"] + 1
+        tau = self._tau
+        polyak = lambda t, o: jax.tree.map(  # noqa: E731
+            lambda a, b: (1 - tau) * a + tau * b, t, o)
+
+        def do_policy():
+            return (pi_new, pi_opt, polyak(state["pi_t"], pi_new))
+
+        def skip_policy():
+            return (state["pi"], state["pi_opt"], state["pi_t"])
+
+        pi, pi_opt_out, pi_t = jax.lax.cond(
+            steps % self._delay == 0, do_policy, skip_policy)
+
+        metrics = {
+            "qf_loss": qf_loss, "policy_loss": pl,
+            "q_mean": jnp.mean(self._q(qs["q1"], obs, acts)),
+            "total_loss": qf_loss + pl,
+        }
+        return {
+            "pi": pi, "qs": qs,
+            "pi_t": pi_t, "qs_t": polyak(state["qs_t"], qs),
+            "pi_opt": pi_opt_out, "q_opt": q_opt,
+            "steps": steps, "key": key,
+        }, metrics
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self._state, metrics = self._jit_update(self._state, jb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return self._state["pi"]
+
+
+class DDPGConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DDPG)
+        self.lr = 1e-3                 # actor
+        self.critic_lr = 1e-3
+        self.tau = 0.005
+        self.exploration_noise = 0.1
+        self.twin_q = False
+        self.policy_delay = 1
+        self.smooth_target_noise = 0.0
+        self.smooth_target_clip = 0.5
+
+
+class DDPG(DQN):
+    config_cls = DDPGConfig
+    supports_continuous = True
+
+    def setup(self, _cfg: Dict) -> None:
+        super().setup(_cfg)
+        if not self.module_spec.is_continuous:
+            raise ValueError(
+                "DDPG/TD3 are continuous-control algorithms; use DQN or "
+                "discrete SAC for Discrete action spaces")
+
+    def _make_learner(self):
+        cfg = self.config
+        return DDPGLearner(
+            self.module_spec, actor_lr=cfg.lr, critic_lr=cfg.critic_lr,
+            gamma=cfg.gamma, tau=cfg.tau, grad_clip=cfg.grad_clip,
+            seed=cfg.seed, twin_q=cfg.twin_q,
+            policy_delay=cfg.policy_delay,
+            smooth_target_noise=cfg.smooth_target_noise,
+            smooth_target_clip=cfg.smooth_target_clip)
+
+    def _runner_cls(self):
+        noise = self.config.exploration_noise
+
+        class _Runner(DDPGEnvRunner):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, noise_sigma=noise, **kw)
+        _Runner.__name__ = "DDPGEnvRunner"
+        return _Runner
+
+    def compute_single_action(self, obs: np.ndarray):
+        import jax.numpy as jnp
+        mu = np.asarray(jnp.tanh(mlp_forward(
+            self.learner.get_weights(),
+            jnp.asarray(obs[None], jnp.float32))))[0]
+        low = np.asarray(self.module_spec.action_low, np.float32)
+        high = np.asarray(self.module_spec.action_high, np.float32)
+        return (low + high) / 2.0 + (high - low) / 2.0 * mu
+
+
+class TD3Config(DDPGConfig):
+    """Reference: ``td3.py`` — DDPG defaults flipped to the TD3 paper's
+    (twin critics, delay 2, smoothed targets, higher noise)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or TD3)
+        self.twin_q = True
+        self.policy_delay = 2
+        self.smooth_target_noise = 0.2
+        self.smooth_target_clip = 0.5
+        self.lr = 1e-3
+        self.critic_lr = 1e-3
+
+
+class TD3(DDPG):
+    config_cls = TD3Config

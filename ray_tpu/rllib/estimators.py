@@ -1,0 +1,220 @@
+"""Off-policy evaluation: estimate a target policy's value from
+behavior data without running it in the environment.
+
+Reference: ``rllib/offline/estimators/`` —
+``importance_sampling.py`` (IS), ``weighted_importance_sampling.py``
+(WIS), ``direct_method.py`` (DM over a fitted-Q model) and
+``doubly_robust.py`` (DR). Estimators consume episode-structured
+batches carrying behavior action log-probs (``logp``) and a
+``target_logp_fn(obs, actions) -> logp`` for the evaluated policy. The
+FQE model behind DM/DR is a small jitted TD-regression, consistent with
+the jitted learner stack everywhere else in this rllib.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def split_episodes(batch: Dict[str, np.ndarray]) -> List[Dict[str, np.ndarray]]:
+    """Split a flat step batch into episodes at done=1 boundaries."""
+    dones = np.asarray(batch["dones"]).astype(bool)
+    out = []
+    start = 0
+    for t, d in enumerate(dones):
+        if d:
+            out.append({k: np.asarray(v)[start:t + 1]
+                        for k, v in batch.items()})
+            start = t + 1
+    if start < len(dones):
+        out.append({k: np.asarray(v)[start:]
+                    for k, v in batch.items()})
+    return [e for e in out if len(e["obs"])]
+
+
+def _episode_weights(ep: Dict[str, np.ndarray], target_logp_fn) -> np.ndarray:
+    """Cumulative importance ratios w_t = prod_{i<=t} pi(a|s)/b(a|s)."""
+    tlogp = np.asarray(target_logp_fn(ep["obs"], ep["actions"]),
+                       np.float64)
+    blogp = np.asarray(ep["logp"], np.float64)
+    # clip per-step log-ratios: one pathological step otherwise blows
+    # the product past float range (reference clips ratios similarly)
+    step = np.clip(tlogp - blogp, -20.0, 20.0)
+    return np.exp(np.cumsum(step))
+
+
+class ImportanceSampling:
+    """Per-step IS (reference: importance_sampling.py): V = E over
+    episodes of sum_t gamma^t w_t r_t."""
+
+    def __init__(self, target_logp_fn: Callable, gamma: float = 0.99):
+        self.target_logp_fn = target_logp_fn
+        self.gamma = gamma
+
+    def estimate(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        vals, behavior = [], []
+        for ep in split_episodes(batch):
+            w = _episode_weights(ep, self.target_logp_fn)
+            g = self.gamma ** np.arange(len(w))
+            r = np.asarray(ep["rewards"], np.float64)
+            vals.append(float(np.sum(g * w * r)))
+            behavior.append(float(np.sum(g * r)))
+        return {"v_target": float(np.mean(vals)),
+                "v_behavior": float(np.mean(behavior)),
+                "num_episodes": len(vals)}
+
+
+class WeightedImportanceSampling:
+    """Per-step WIS (reference: weighted_importance_sampling.py):
+    ratios are normalized by their per-timestep mean across episodes —
+    biased but far lower variance than IS."""
+
+    def __init__(self, target_logp_fn: Callable, gamma: float = 0.99):
+        self.target_logp_fn = target_logp_fn
+        self.gamma = gamma
+
+    def estimate(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        eps = split_episodes(batch)
+        ws = [_episode_weights(ep, self.target_logp_fn) for ep in eps]
+        T = max((len(w) for w in ws), default=0)
+        # mean cumulative ratio at each t over episodes still running
+        norm = np.zeros(T)
+        cnt = np.zeros(T)
+        for w in ws:
+            norm[:len(w)] += w
+            cnt[:len(w)] += 1
+        norm = norm / np.maximum(cnt, 1)
+        vals, behavior = [], []
+        for ep, w in zip(eps, ws):
+            g = self.gamma ** np.arange(len(w))
+            r = np.asarray(ep["rewards"], np.float64)
+            wn = w / np.maximum(norm[:len(w)], 1e-12)
+            vals.append(float(np.sum(g * wn * r)))
+            behavior.append(float(np.sum(g * r)))
+        return {"v_target": float(np.mean(vals)),
+                "v_behavior": float(np.mean(behavior)),
+                "num_episodes": len(vals)}
+
+
+class FQEModel:
+    """Fitted Q evaluation (reference: ``fqe_torch_model.py``): a small
+    Q(s, .) MLP trained by TD toward the TARGET policy's next-action
+    expectation — one jitted update."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 target_probs_fn: Callable, gamma: float = 0.99,
+                 lr: float = 1e-3, hiddens=(64, 64), seed: int = 0):
+        import jax
+        import optax
+        from ray_tpu.rllib.models import init_mlp
+        self.num_actions = num_actions
+        self.target_probs_fn = target_probs_fn
+        self.gamma = gamma
+        self._opt = optax.adam(lr)
+        self._params = init_mlp(
+            jax.random.PRNGKey(seed),
+            [obs_dim, *hiddens, num_actions])
+        self._opt_state = self._opt.init(self._params)
+        self._jit_step = jax.jit(self._step)
+
+    def _step(self, params, opt_state, batch):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from ray_tpu.rllib.models import mlp_forward
+
+        def loss(p):
+            q = mlp_forward(p, batch["obs"])
+            q_sa = q[jnp.arange(q.shape[0]), batch["actions"]]
+            q_next = mlp_forward(p, batch["next_obs"])
+            v_next = jnp.sum(batch["next_probs"] * q_next, axis=-1)
+            y = batch["rewards"] + self.gamma \
+                * (1.0 - batch["dones"]) * jax.lax.stop_gradient(v_next)
+            return jnp.mean((q_sa - y) ** 2)
+
+        l, grads = jax.value_and_grad(loss)(params)
+        updates, opt_state = self._opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, l
+
+    def train(self, batch: Dict[str, np.ndarray], iters: int = 200,
+              minibatch: int = 256, seed: int = 0) -> float:
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        n = len(batch["obs"])
+        next_probs = np.asarray(
+            self.target_probs_fn(batch["next_obs"]), np.float32)
+        loss = 0.0
+        for _ in range(iters):
+            idx = rng.integers(0, n, size=min(minibatch, n))
+            jb = {
+                "obs": jnp.asarray(batch["obs"][idx], jnp.float32),
+                "next_obs": jnp.asarray(batch["next_obs"][idx],
+                                        jnp.float32),
+                "actions": jnp.asarray(batch["actions"][idx]),
+                "rewards": jnp.asarray(batch["rewards"][idx],
+                                       jnp.float32),
+                "dones": jnp.asarray(batch["dones"][idx], jnp.float32),
+                "next_probs": jnp.asarray(next_probs[idx]),
+            }
+            self._params, self._opt_state, l = self._jit_step(
+                self._params, self._opt_state, jb)
+            loss = float(l)
+        return loss
+
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+        from ray_tpu.rllib.models import mlp_forward
+        return np.asarray(mlp_forward(
+            self._params, jnp.asarray(obs, jnp.float32)))
+
+    def v_values(self, obs: np.ndarray) -> np.ndarray:
+        probs = np.asarray(self.target_probs_fn(obs), np.float64)
+        return np.sum(probs * self.q_values(obs), axis=-1)
+
+
+class DirectMethod:
+    """DM (reference: direct_method.py): V = E[ V_FQE(s_0) ]."""
+
+    def __init__(self, fqe: FQEModel):
+        self.fqe = fqe
+
+    def estimate(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        eps = split_episodes(batch)
+        v0 = [float(self.fqe.v_values(ep["obs"][:1])[0]) for ep in eps]
+        return {"v_target": float(np.mean(v0)),
+                "num_episodes": len(v0)}
+
+
+class DoublyRobust:
+    """DR (reference: doubly_robust.py): the DM baseline plus the
+    importance-weighted TD correction — unbiased like IS, low-variance
+    like DM."""
+
+    def __init__(self, fqe: FQEModel, target_logp_fn: Callable,
+                 gamma: float = 0.99):
+        self.fqe = fqe
+        self.target_logp_fn = target_logp_fn
+        self.gamma = gamma
+
+    def estimate(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        vals = []
+        for ep in split_episodes(batch):
+            obs = np.asarray(ep["obs"], np.float64)
+            acts = np.asarray(ep["actions"])
+            r = np.asarray(ep["rewards"], np.float64)
+            T = len(r)
+            w = _episode_weights(ep, self.target_logp_fn)
+            w_prev = np.concatenate([[1.0], w[:-1]])
+            q = self.fqe.q_values(ep["obs"])
+            q_sa = q[np.arange(T), acts]
+            v = self.fqe.v_values(ep["obs"])
+            v_next = np.concatenate([v[1:], [0.0]])
+            dones = np.asarray(ep["dones"], np.float64)
+            g = self.gamma ** np.arange(T)
+            correction = w * (r + self.gamma * (1 - dones) * v_next
+                              - q_sa)
+            vals.append(float(v[0] + np.sum(g * correction)))
+        return {"v_target": float(np.mean(vals)),
+                "num_episodes": len(vals)}

@@ -132,6 +132,21 @@ class ServeController:
         with self._lock:
             return self._apps.get(app_name)
 
+    def app_has_method(self, app_name: str, method: str) -> bool:
+        """Whether the app's ingress deployment defines ``method`` — the
+        gRPC proxy maps user-service RPC names onto deployment methods
+        (reference: serve's gRPC ingress method routing)."""
+        if method.startswith("_"):
+            return False
+        with self._lock:
+            name = self._apps.get(app_name)
+            info = self._deployments.get(name) if name else None
+            if info is None:
+                return False
+            fc = info.deployment.func_or_class
+            return isinstance(fc, type) and callable(
+                getattr(fc, method, None))
+
     def list_applications(self) -> List[str]:
         with self._lock:
             return sorted(self._apps)

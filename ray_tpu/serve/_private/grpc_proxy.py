@@ -24,17 +24,51 @@ from __future__ import annotations
 
 import json
 from concurrent import futures
-from typing import Any
+from typing import Any, Optional
 
 SERVICE = "ray_tpu.serve.ServeAPIService"
 
 
+class _ForwardingServicer:
+    """Servicer for a USER-DEFINED gRPC service (reference:
+    ``src/ray/protobuf/serve.proto:150`` UserDefinedService +
+    ``gRPCOptions.grpc_servicer_functions``): the user passes their
+    protoc-generated ``add_XServicer_to_server`` functions, which look
+    up RPC method names on this object via ``getattr`` — every method
+    resolves to a forwarder that routes the TYPED request message to
+    the target application's ingress deployment (the deployment method
+    named like the RPC if it exists, else ``__call__``) and returns the
+    deployment's TYPED response message, which the generated handler
+    serializes with the user's proto."""
+
+    def __init__(self, proxy: "GrpcProxy"):
+        self._proxy = proxy
+
+    def __getattr__(self, method_name: str):
+        if method_name.startswith("_"):
+            raise AttributeError(method_name)
+        proxy = self._proxy
+
+        def forward(request, context):
+            md = dict(context.invocation_metadata() or ())
+            app = md.get("application", "default")
+            try:
+                return proxy._dispatch(app, (request,), {},
+                                       method=method_name)
+            except BaseException as e:  # noqa: BLE001
+                import grpc
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        return forward
+
+
 class GrpcProxy:
     """Actor hosting the gRPC server (one per cluster, like the HTTP
-    proxy actor)."""
+    proxy actor). ``grpc_servicer_functions`` registers user-defined
+    proto services alongside the built-in JSON ServeAPIService."""
 
     def __init__(self, controller, host: str = "127.0.0.1",
-                 port: int = 9000):
+                 port: int = 9000, grpc_servicer_functions=()):
         import grpc
 
         import ray_tpu
@@ -80,6 +114,16 @@ class GrpcProxy:
             futures.ThreadPoolExecutor(max_workers=8))
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        # user-defined typed services: protoc-generated (or compatible)
+        # add_*Servicer_to_server callables, exactly the reference's
+        # gRPCOptions.grpc_servicer_functions contract
+        servicer = _ForwardingServicer(self)
+        for fn in grpc_servicer_functions or ():
+            if isinstance(fn, str):
+                import importlib
+                mod, _, attr = fn.rpartition(".")
+                fn = getattr(importlib.import_module(mod), attr)
+            fn(servicer, self._server)
         bound = self._server.add_insecure_port(f"{host}:{port}")
         if bound == 0 and port != 0:
             raise OSError(
@@ -89,7 +133,8 @@ class GrpcProxy:
         self._host = host
         self._server.start()
 
-    def _dispatch(self, app: str, args: tuple, kwargs: dict) -> Any:
+    def _dispatch(self, app: str, args: tuple, kwargs: dict,
+                  method: Optional[str] = None) -> Any:
         ingress = self._ray.get(
             self._controller.get_app_ingress.remote(app))
         if ingress is None:
@@ -97,10 +142,25 @@ class GrpcProxy:
         cached = self._handles.get(app)
         if cached is None or cached[0] != ingress:
             from ray_tpu.serve.handle import DeploymentHandle
+            # per-app cache: (ingress, handle, method-routing verdicts);
+            # invalidated wholesale on redeploy (ingress change)
             cached = (ingress,
-                      DeploymentHandle(ingress, self._controller, app))
+                      DeploymentHandle(ingress, self._controller, app),
+                      {})
             self._handles[app] = cached
-        return cached[1].remote(*args, **kwargs).result(timeout_s=60)
+        handle = cached[1]
+        if method:
+            has = cached[2].get(method)
+            if has is None:
+                has = cached[2][method] = self._ray.get(
+                    self._controller.app_has_method.remote(app, method))
+            if has:
+                # typed user-service RPC: route to the deployment
+                # method named like the RPC (reference: serve's gRPC
+                # ingress maps RPC names onto deployment methods)
+                return getattr(handle, method).remote(
+                    *args, **kwargs).result(timeout_s=60)
+        return handle.remote(*args, **kwargs).result(timeout_s=60)
 
     def address(self) -> str:
         return f"{self._host}:{self._port}"

@@ -122,7 +122,8 @@ def start(http_options: Optional[Dict[str, Any]] = None,
                               max_concurrency=16)(GrpcProxy)
         _grpc_proxy_actor = gcls.remote(
             controller, grpc_options.get("host", "127.0.0.1"),
-            grpc_options.get("port", 9000))
+            grpc_options.get("port", 9000),
+            grpc_options.get("grpc_servicer_functions", ()))
 
 
 def grpc_proxy_address() -> Optional[str]:
