@@ -454,6 +454,13 @@ class NativeShmStore:
                     if off != _FULL:
                         break
             if off == _EXISTS:
+                # duplicate execution re-created the extent while we
+                # looked at the spill index: it is resident — and the
+                # handshake lease must STILL be taken (the node reports
+                # leased=True on every ok reply; an unbalanced release
+                # would zero the requester's own reader ref and let
+                # compaction move the extent under its live view)
+                self._lease_for_locked(object_id, for_pid)
                 return True
             if off == _FULL:
                 # the backing copy EXISTS but the segment can't admit it

@@ -186,6 +186,7 @@ class NodeManager:
         self._spawner_threads: List[threading.Thread] = []
         self._zygote_started = False
         self._spawn_init_lock = threading.Lock()
+        self._spawn_count = 0
 
     # ------------------------------------------------------------------ run
     def _register_with_controller(self) -> None:
@@ -468,14 +469,21 @@ class NodeManager:
         with self._spawn_init_lock:
             # main thread (initial workers) and node-loop thread
             # (controller TASK_ASSIGN) race here on first spawn
+            self._spawn_count += 1
             if not self._spawner_threads:
-                self._start_zygote()
                 for i in range(4):
                     t = threading.Thread(target=self._spawner_loop,
                                          name=f"node-spawner-{i}",
                                          daemon=True)
                     t.start()
                     self._spawner_threads.append(t)
+            if self._spawn_count > self.num_initial_workers + 2:
+                # demand outgrew the initial pool (an actor burst or a
+                # scale-up): the warm factory pays for itself from here.
+                # Small clusters (most tests) never boot it — the first
+                # few spawns use the cold path either way while the
+                # zygote warms up.
+                self._start_zygote()
         self._spawn_q.put(requested)
 
     def _spawner_loop(self) -> None:

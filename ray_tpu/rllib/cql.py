@@ -212,9 +212,9 @@ class CQL(Trainable):
 
     def compute_single_action(self, obs: np.ndarray):
         import jax.numpy as jnp
-        from ray_tpu.rllib.models import mlp_forward
-        out = mlp_forward(self.learner.get_weights(),
-                          jnp.asarray(obs[None], jnp.float32))
+        from ray_tpu.rllib.models import relu_mlp_forward
+        out = relu_mlp_forward(self.learner.get_weights(),
+                               jnp.asarray(obs[None], jnp.float32))
         mean = np.asarray(jnp.split(out, 2, axis=-1)[0][0])
         return self._center + self._scale * np.tanh(mean)
 
@@ -246,15 +246,18 @@ class CQL(Trainable):
     def step(self) -> Dict[str, Any]:
         cfg = self.config
         metrics: Dict[str, float] = {}
-        for _ in range(cfg.updates_per_step):
-            batch = self.reader.sample(cfg.train_batch_size)
-            metrics = self.learner.update({
-                "obs": batch["obs"].astype(np.float32),
-                "next_obs": batch["next_obs"].astype(np.float32),
-                "actions": batch["actions"].astype(np.float32),
-                "rewards": batch["rewards"].astype(np.float32),
-                "dones": batch["dones"].astype(np.float32)})
-            self._timesteps += cfg.train_batch_size
+        k = cfg.updates_per_step
+        if k > 0:
+            stacked = {key: [] for key in
+                       ("obs", "next_obs", "actions", "rewards",
+                        "dones")}
+            for _ in range(k):
+                batch = self.reader.sample(cfg.train_batch_size)
+                for key in stacked:
+                    stacked[key].append(batch[key].astype(np.float32))
+            metrics = self.learner.update_many(
+                {key: np.stack(v) for key, v in stacked.items()})
+            self._timesteps += cfg.train_batch_size * k
         result = {"learner": metrics,
                   "num_env_steps_sampled_lifetime": self._timesteps}
         if cfg.evaluation_episodes:

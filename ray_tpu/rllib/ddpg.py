@@ -16,7 +16,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNEnvRunner
-from ray_tpu.rllib.models import init_mlp, mlp_forward
+from ray_tpu.rllib.models import init_mlp, relu_mlp_forward
 from ray_tpu.rllib.rl_module import RLModuleSpec
 from ray_tpu.rllib.sac import SACConfig
 
@@ -44,7 +44,7 @@ class DDPGEnvRunner(DQNEnvRunner):
 
     def _select_actions(self, epsilon: float) -> np.ndarray:
         import jax.numpy as jnp
-        mu = np.asarray(jnp.tanh(mlp_forward(
+        mu = np.asarray(jnp.tanh(relu_mlp_forward(
             self._params, jnp.asarray(self._obs, jnp.float32))),
             np.float32)
         noise = self._rng.normal(0.0, self._noise_sigma, mu.shape)
@@ -106,12 +106,12 @@ class DDPGLearner:
     @staticmethod
     def _mu(pi_params, obs):
         import jax.numpy as jnp
-        return jnp.tanh(mlp_forward(pi_params, obs))
+        return jnp.tanh(relu_mlp_forward(pi_params, obs))
 
     @staticmethod
     def _q(q_params, obs, act):
         import jax.numpy as jnp
-        return mlp_forward(q_params, jnp.concatenate([obs, act], -1)
+        return relu_mlp_forward(q_params, jnp.concatenate([obs, act], -1)
                            )[..., 0]
 
     def _update(self, state, batch):
@@ -191,6 +191,10 @@ class DDPGLearner:
         self._state, metrics = self._jit_update(self._state, jb)
         return {k: float(v) for k, v in metrics.items()}
 
+    def update_many(self, batches):
+        from ray_tpu.rllib.dqn import _scanned_update
+        return _scanned_update(self, batches)
+
     def get_weights(self):
         return self._state["pi"]
 
@@ -240,7 +244,7 @@ class DDPG(DQN):
 
     def compute_single_action(self, obs: np.ndarray):
         import jax.numpy as jnp
-        mu = np.asarray(jnp.tanh(mlp_forward(
+        mu = np.asarray(jnp.tanh(relu_mlp_forward(
             self.learner.get_weights(),
             jnp.asarray(obs[None], jnp.float32))))[0]
         low = np.asarray(self.module_spec.action_low, np.float32)

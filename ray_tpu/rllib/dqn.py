@@ -55,6 +55,15 @@ class ReplayBuffer:
                 "actions": self.actions[idx], "rewards": self.rewards[idx],
                 "dones": self.dones[idx]}
 
+    def sample_many(self, k: int, n: int) -> Dict[str, np.ndarray]:
+        """K independent minibatches stacked [K, n, ...] — feeds the
+        learners' scanned multi-update (one XLA dispatch for a whole
+        update burst instead of K)."""
+        idx = self._rng.integers(0, self._size, size=(k, n))
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx],
+                "rewards": self.rewards[idx], "dones": self.dones[idx]}
+
     def __len__(self) -> int:
         return self._size
 
@@ -230,8 +239,35 @@ class DQNLearner:
         self._state, metrics = self._jit_update(self._state, jb)
         return {k: float(v) for k, v in metrics.items()}
 
+    def update_many(self, batches: Dict[str, np.ndarray]
+                    ) -> Dict[str, float]:
+        return _scanned_update(self, batches)
+
     def get_weights(self):
         return self._state["params"]
+
+
+def _scanned_update(learner, batches: Dict[str, np.ndarray]
+                    ) -> Dict[str, float]:
+    """Run K minibatch updates as ONE jitted ``lax.scan`` over stacked
+    [K, B, ...] batches (TPU-native: an off-policy train step is K tiny
+    programs host-dispatched back-to-back otherwise — the scan turns
+    the whole update burst into a single XLA program). Shared by the
+    DQN-skeleton learners (DQN / SAC / DDPG / TD3 / CQL). Returns the
+    LAST update's metrics, matching the sequential loop it replaces."""
+    import jax
+    import jax.numpy as jnp
+    jit = getattr(learner, "_jit_update_many", None)
+    if jit is None:
+        def _many(state, stacked):
+            def body(st, b):
+                return learner._update(st, b)
+            return jax.lax.scan(body, state, stacked)
+        jit = learner._jit_update_many = jax.jit(
+            _many, donate_argnums=(0,))
+    jb = {k: jnp.asarray(v) for k, v in batches.items()}
+    learner._state, metrics = jit(learner._state, jb)
+    return {k: float(v[-1]) for k, v in metrics.items()}
 
 
 class DQNConfig(AlgorithmConfig):
@@ -337,10 +373,16 @@ class DQN(Algorithm):
 
         metrics: Dict[str, float] = {}
         if self._timesteps >= cfg.num_steps_sampled_before_learning_starts:
-            for _ in range(cfg.updates_per_step):
+            if cfg.updates_per_step > 1:
+                metrics = self.learner.update_many(
+                    self.buffer.sample_many(cfg.updates_per_step,
+                                            cfg.train_batch_size))
+                self._sync_weights()
+            elif cfg.updates_per_step == 1:
                 metrics = self.learner.update(
                     self.buffer.sample(cfg.train_batch_size))
-            self._sync_weights()
+                self._sync_weights()
+            # updates_per_step == 0: collection only, no training
 
         returns: List[float] = []
         for r in ray_tpu.get(

@@ -210,7 +210,11 @@ class DoublyRobust:
             q = self.fqe.q_values(ep["obs"])
             q_sa = q[np.arange(T), acts]
             v = self.fqe.v_values(ep["obs"])
-            v_next = np.concatenate([v[1:], [0.0]])
+            # bootstrap from the actual next states: a truncated
+            # trailing episode's final step must use V(s_{T+1}), not 0
+            # (the batch carries next_obs; dones zeroes the terminal
+            # case below either way)
+            v_next = self.fqe.v_values(ep["next_obs"])
             dones = np.asarray(ep["dones"], np.float64)
             g = self.gamma ** np.arange(T)
             correction = w * (r + self.gamma * (1 - dones) * v_next

@@ -38,6 +38,14 @@ def mlp_forward(layers: List[Dict], x: jnp.ndarray,
     return x
 
 
+def relu_mlp_forward(layers: List[Dict], x: jnp.ndarray) -> jnp.ndarray:
+    """ReLU MLP: the continuous-control nets (SAC/DDPG/TD3/CQL critics
+    and actors) use ReLU like the reference's torch models — tanh
+    hidden layers saturate regressing the large-magnitude Q targets of
+    reward-dense control tasks (Pendulum returns reach -1600)."""
+    return mlp_forward(layers, x, activation=jax.nn.relu)
+
+
 def init_actor_critic(key, obs_dim: int, num_actions: int,
                       hiddens: Sequence[int] = (64, 64)) -> Dict:
     k1, k2 = jax.random.split(key)

@@ -25,7 +25,7 @@ import numpy as np
 
 from ray_tpu.rllib.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNEnvRunner
-from ray_tpu.rllib.models import init_mlp, mlp_forward
+from ray_tpu.rllib.models import init_mlp, mlp_forward, relu_mlp_forward
 from ray_tpu.rllib.rl_module import RLModuleSpec
 
 
@@ -202,6 +202,10 @@ class SACLearner:
         self._state, metrics = self._jit_update(self._state, jb)
         return {k: float(v) for k, v in metrics.items()}
 
+    def update_many(self, batches):
+        from ray_tpu.rllib.dqn import _scanned_update
+        return _scanned_update(self, batches)
+
     def get_weights(self):
         # the runners need only the policy subtree
         return self._state["pi"]
@@ -234,10 +238,10 @@ class ContinuousSACEnvRunner(DQNEnvRunner):
         import jax
         import jax.numpy as jnp
         from ray_tpu.rllib.models import (LOG_STD_MAX, LOG_STD_MIN,
-                                          mlp_forward)
+                                          relu_mlp_forward)
         self._key, sub = jax.random.split(self._key)
-        out = mlp_forward(self._params,
-                          jnp.asarray(self._obs, jnp.float32))
+        out = relu_mlp_forward(self._params,
+                               jnp.asarray(self._obs, jnp.float32))
         mean, log_std = jnp.split(out, 2, axis=-1)
         log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
         u = mean + jnp.exp(log_std) * jax.random.normal(
@@ -301,7 +305,7 @@ class ContinuousSACLearner:
         import jax.numpy as jnp
         from ray_tpu.rllib.models import (LOG_STD_MAX, LOG_STD_MIN,
                                           squashed_gaussian_sample)
-        out = mlp_forward(pi_params, obs)
+        out = relu_mlp_forward(pi_params, obs)
         mean, log_std = jnp.split(out, 2, axis=-1)
         log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
         return squashed_gaussian_sample(key, mean, log_std)
@@ -309,8 +313,8 @@ class ContinuousSACLearner:
     @staticmethod
     def _q(q_params, obs, act):
         import jax.numpy as jnp
-        return mlp_forward(q_params, jnp.concatenate([obs, act], -1)
-                           )[..., 0]
+        return relu_mlp_forward(
+            q_params, jnp.concatenate([obs, act], -1))[..., 0]
 
     def _update(self, state, batch):
         import jax
@@ -391,6 +395,10 @@ class ContinuousSACLearner:
         self._state, metrics = self._jit_update(self._state, jb)
         return {k: float(v) for k, v in metrics.items()}
 
+    def update_many(self, batches):
+        from ray_tpu.rllib.dqn import _scanned_update
+        return _scanned_update(self, batches)
+
     def get_weights(self):
         return self._state["pi"]
 
@@ -431,7 +439,7 @@ class SAC(DQN):
         if not self.module_spec.is_continuous:
             return super().compute_single_action(obs)
         import jax.numpy as jnp
-        from ray_tpu.rllib.models import mlp_forward as _fwd
+        from ray_tpu.rllib.models import relu_mlp_forward as _fwd
         out = _fwd(self.learner.get_weights(),
                    jnp.asarray(obs[None], jnp.float32))
         mean = np.asarray(jnp.split(out, 2, axis=-1)[0][0])

@@ -1,0 +1,153 @@
+"""Unit tests for the two round-5 store/runtime mechanisms:
+
+- the zygote fork-server (core/zygote.py): warm spawns, pid identity
+  pinning, parent-death cleanup (reference: worker_pool.h:104 prestart
+  semantics, taken to the spawn path itself);
+- native-segment compaction (ns_compact): movable extents defragment
+  around pinned ones so large creates survive pinned-scatter arenas.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu.core.ids import ObjectID
+
+
+# ------------------------------------------------------------- compaction
+@pytest.fixture
+def segment(tmp_path):
+    from ray_tpu import _native
+    from ray_tpu.core import native_store
+    lib = _native.load()
+    if lib is None:
+        pytest.skip("native store unavailable")
+    name = f"test-compact-{os.getpid()}-{time.time_ns() % 100000}"
+    seg = native_store._Segment(lib, name, capacity=32 << 20, nslots=512)
+    yield seg
+    seg.close(unlink=True)
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID(i.to_bytes(4, "big") * 7)
+
+
+def test_compact_defragments_around_pinned(segment):
+    # interleave 1MB extents; pin every other one with a reader lease
+    n = 16
+    size = 1 << 20
+    for i in range(n):
+        off = segment.alloc(_oid(i), size)
+        assert off not in (2**64 - 1, 2**64 - 2)
+        segment.seal(_oid(i))
+    pinned = []
+    for i in range(0, n, 2):
+        state, _, _ = segment.acquire(_oid(i))
+        assert state == 2
+        pinned.append(i)
+    # free the unpinned ones -> 8 scattered 1MB holes, no 8MB run
+    for i in range(1, n, 2):
+        assert segment.evict(_oid(i)) > 0
+    big = 8 << 20
+    largest = segment.largest_free()
+    after = segment.compact()
+    assert after >= big, (largest, after)
+    # pinned extents still readable and untouched
+    for i in pinned:
+        state, off, sz = segment.lookup(_oid(i))
+        assert state == 2 and sz == size
+    # a big alloc now fits
+    off = segment.alloc(_oid(999), big)
+    assert off not in (2**64 - 1, 2**64 - 2)
+
+
+def test_compact_preserves_data(segment):
+    import numpy as np
+    rng = np.random.default_rng(0)
+    blobs = {}
+    for i in range(8):
+        data = rng.integers(0, 255, size=256 * 1024, dtype=np.uint8)
+        off = segment.alloc(_oid(i), data.nbytes)
+        segment.view[off:off + data.nbytes] = data.tobytes()
+        segment.seal(_oid(i))
+        blobs[i] = data
+    # evict evens to create holes, compact, verify odds byte-exact
+    for i in range(0, 8, 2):
+        assert segment.evict(_oid(i)) > 0
+    segment.compact()
+    for i in range(1, 8, 2):
+        state, off, sz = segment.lookup(_oid(i))
+        assert state == 2
+        got = bytes(segment.view[off:off + sz])
+        assert got == blobs[i].tobytes(), f"extent {i} corrupted"
+
+
+# ---------------------------------------------------------------- zygote
+def _spawn_via_zygote(sock_path, env, log_path, timeout=30.0):
+    import json
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    conn.settimeout(timeout)
+    conn.connect(sock_path)
+    conn.sendall((json.dumps({"env": env, "log_path": log_path})
+                  + "\n").encode())
+    data = b""
+    while not data.endswith(b"\n"):
+        chunk = conn.recv(4096)
+        if not chunk:
+            break
+        data += chunk
+    conn.close()
+    return json.loads(data)["pid"]
+
+
+def test_zygote_parent_death_cleanup(tmp_path):
+    """The zygote exits (and unlinks its socket) when the watched
+    parent pid dies — unclean node deaths must not leak daemons."""
+    sock = str(tmp_path / "zyg.sock")
+    # watch a short-lived process as the 'node manager'
+    fake_parent = subprocess.Popen([sys.executable, "-c",
+                                    "import time; time.sleep(2)"])
+    z = subprocess.Popen(
+        [sys.executable, "-u", "-m", "ray_tpu.core.zygote", sock,
+         str(fake_parent.pid)],
+        env={**os.environ},
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 30
+        while not os.path.exists(sock) and time.time() < deadline:
+            time.sleep(0.1)
+        assert os.path.exists(sock)
+        fake_parent.wait(timeout=10)
+        z.wait(timeout=15)   # exits within one 5s poll cycle
+        assert not os.path.exists(sock)
+    finally:
+        for p in (fake_parent, z):
+            try:
+                p.kill()
+            except Exception:
+                pass
+
+
+def test_forked_worker_handle_pid_identity():
+    from ray_tpu.core.node import _ForkedWorker
+    # a live process: ourselves
+    me = _ForkedWorker(os.getpid())
+    assert me.poll() is None
+    # a dead process: spawn+reap a child, then probe its pid
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    h = _ForkedWorker(p.pid)
+    assert h.poll() == 0
+    # kill() on a dead/recycled pid must be a no-op
+    h.kill()
+    # identity pinning: fake a handle whose birth doesn't match the
+    # current owner of the pid -> treated as dead, never signaled
+    imposter = _ForkedWorker(os.getpid())
+    imposter._birth = "0"
+    assert imposter.poll() == 0
+    imposter.kill()
+    assert os.getpid()  # we were not signaled
