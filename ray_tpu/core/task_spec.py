@@ -24,7 +24,7 @@ from ray_tpu.core.ids import (
 )
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class FunctionDescriptor:
     """Stable key for a remote function / actor class."""
     module: str
@@ -46,7 +46,7 @@ class FunctionDescriptor:
                 (self.module, self.qualname, self.function_hash))
 
 
-@dataclass
+@dataclass(slots=True)
 class SchedulingStrategy:
     """Union of the reference's scheduling strategies
     (python/ray/util/scheduling_strategies.py)."""
@@ -73,7 +73,7 @@ class SchedulingStrategy:
             self.hard_labels, self.soft_labels))
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskSpec:
     task_id: TaskID
     job_id: JobID
