@@ -1,0 +1,164 @@
+"""Owner-local small objects (reference: the in-process memory store +
+owner-based object directory — ``core_worker``'s ownership model: the
+GCS never hears about small objects until they are shared).
+
+Round-5 semantics under test: inline puts/returns produce NO controller
+directory entry or ref-delta traffic until a ref ESCAPES (pickled into
+another object or passed as a task arg), at which point the owner
+promotes the object and publishes its value; borrowers parked on
+unpublished objects resolve via controller-mediated FETCH_OBJECT; and a
+dead owner surfaces ObjectLost instead of hanging."""
+
+import time
+
+import pytest
+
+import ray_tpu
+import ray_tpu.api as api
+from ray_tpu.core.global_state import global_worker
+
+
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=4, _num_initial_workers=2,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _controller():
+    return api._head.controller
+
+
+def _num_objects():
+    ctrl = _controller()
+    return ctrl.call_on_loop(lambda: len(ctrl.objects))
+
+
+def test_inline_puts_create_no_directory_entries(cluster):
+    before = _num_objects()
+    refs = [ray_tpu.put({"i": i}) for i in range(50)]
+    assert ray_tpu.get(refs[7]) == {"i": 7}
+    # no controller entries for unescaped inline puts
+    assert _num_objects() <= before + 1
+    del refs
+    time.sleep(0.5)
+    assert _num_objects() <= before + 1
+
+
+def test_escape_promotes_and_publishes(cluster):
+    ctrl = _controller()
+    inner = ray_tpu.put(41)
+    b = inner.binary()
+    assert ctrl.call_on_loop(lambda: ctrl.objects.get(b)) is None
+    # escape: nest the ref inside another object
+    outer = ray_tpu.put([inner])
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        e = ctrl.call_on_loop(lambda: ctrl.objects.get(b))
+        if e is not None and e.inline is not None:
+            break
+        time.sleep(0.05)
+    assert e is not None and e.inline is not None, \
+        "escaped inline object was not published to the directory"
+    # and the borrower path round-trips
+    got = ray_tpu.get(ray_tpu.get(outer)[0])
+    assert got == 41
+
+
+def test_borrower_resolves_unpublished_ref_via_owner_fetch(cluster):
+    # a worker puts an object and returns only the REF; the driver
+    # (borrower) must resolve it even though the worker's put was
+    # owner-local — via the controller-mediated FETCH_OBJECT
+    @ray_tpu.remote
+    def make():
+        return [ray_tpu.put({"deep": 123})]
+
+    inner = ray_tpu.get(make.remote())[0]
+    assert ray_tpu.get(inner, timeout=30) == {"deep": 123}
+
+
+def test_task_returns_stay_owner_local_until_consumed(cluster):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    # warm: leases must be READY — cold submissions legitimately spill
+    # to the controller path, whose results ARE directory-recorded
+    ray_tpu.get([f.remote(0) for _ in range(30)])
+    time.sleep(3.0)
+    before = _num_objects()
+    refs = [f.remote(i) for i in range(64)]
+    assert ray_tpu.get(refs) == [i * 2 for i in range(64)]
+    after = _num_objects()
+    # direct-path inline results never reach the directory (a few may
+    # straggle through the controller path during lease top-ups)
+    assert after - before < 16, (before, after)
+    del refs
+    deadline = time.time() + 15
+    while time.time() < deadline and _num_objects() > before + 2:
+        time.sleep(0.5)
+    assert _num_objects() <= before + 2
+
+
+def test_dependent_task_on_pending_inline_result(cluster):
+    # B depends on A's (owner-local) pending result: the escape at B's
+    # submission registers a deferred publish, which must unpark B at
+    # the controller when A's result lands
+    @ray_tpu.remote
+    def slow_one():
+        time.sleep(0.5)
+        return 20
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    a = slow_one.remote()
+    c = add.remote(a, 22)
+    assert ray_tpu.get(c, timeout=60) == 42
+
+
+def test_escaped_ref_survives_owner_death(cluster):
+    # returning a nested ref IS an escape: the owner publishes the
+    # value, so the object outlives the owner
+    @ray_tpu.remote
+    class Owner:
+        def make(self):
+            self._keep = ray_tpu.put({"v": 7})
+            return [self._keep]
+
+    o = Owner.remote()
+    ref = ray_tpu.get(o.make.remote())[0]
+    assert ray_tpu.get(ref, timeout=30) == {"v": 7}
+    ray_tpu.kill(o)
+    time.sleep(1.0)
+    assert ray_tpu.get(ref, timeout=30) == {"v": 7}
+
+
+def test_owner_death_fails_borrower_fast(cluster):
+    # a ref whose object NEVER escaped (reconstructed from raw bytes —
+    # no pickle of the ObjectRef, so no publish): once the owner dies,
+    # the borrower's get must fail via the controller's owner-death
+    # audit instead of hanging toward the 5-minute give-up
+    from ray_tpu.core.ids import ObjectID, WorkerID
+    from ray_tpu.core.object_ref import ObjectRef
+
+    @ray_tpu.remote
+    class Owner:
+        def make_raw(self):
+            from ray_tpu.core.global_state import global_worker
+            self._keep = ray_tpu.put(b"never-escapes")
+            w = global_worker()
+            # hand out raw identifiers, NOT the ref object
+            return self._keep.binary(), w.worker_id.binary()
+
+    o = Owner.remote()
+    oid_b, owner_b = ray_tpu.get(o.make_raw.remote())
+    ref = ObjectRef(ObjectID(oid_b), WorkerID(owner_b))
+    ray_tpu.kill(o)
+    time.sleep(2.0)
+    t0 = time.time()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=120)
+    assert time.time() - t0 < 120, "owner-death get should fail fast"
