@@ -276,11 +276,11 @@ def main() -> Dict[str, float]:
     # phases are seconds long and this box's effective CPU swings ~2x.
     results = {}
     for name, fn, reps in (
-            ("tasks_sync_per_s", bench_tasks_sync, 2),
-            ("tasks_async_per_s", bench_tasks_async, 2),
-            ("actor_calls_sync_per_s", bench_actor_sync, 2),
+            ("tasks_sync_per_s", bench_tasks_sync, 3),
+            ("tasks_async_per_s", bench_tasks_async, 3),
+            ("actor_calls_sync_per_s", bench_actor_sync, 3),
             ("actor_calls_async_per_s", bench_actor_async, 2),
-            ("put_gib_per_s", bench_put, 2),
+            ("put_gib_per_s", bench_put, 3),
             ("put_bytes_gib_per_s", bench_put_bytes, 2),
             ("multi_client_tasks_async_per_s", bench_multi_client_tasks,
              1),
