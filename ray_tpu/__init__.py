@@ -37,6 +37,7 @@ from ray_tpu.exceptions import (
     TaskError,
     ActorError,
     ActorDiedError,
+    ActorUnavailableError,
     ObjectLostError,
     TaskCancelledError,
     OutOfMemoryError,
@@ -75,6 +76,7 @@ __all__ = [
     "TaskError",
     "ActorError",
     "ActorDiedError",
+    "ActorUnavailableError",
     "ObjectLostError",
     "TaskCancelledError",
     "OutOfMemoryError",

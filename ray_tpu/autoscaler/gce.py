@@ -115,9 +115,11 @@ class TPUApiClient:
         self._token_expiry = 0.0
 
     def _backoff(self, attempt: int) -> None:
-        # exponential with full jitter, capped (reference retry shape)
-        delay = min(30.0, 2.0 ** attempt)
-        self._sleep(delay * (0.5 + 0.5 * self._rng.random()))
+        # shared retry shape (util/backoff.py): exponential, capped;
+        # equal jitter keeps the floor the transport tests assert on
+        from ray_tpu.util.backoff import backoff_delay
+        self._sleep(backoff_delay(attempt, base=1.0, cap=30.0,
+                                  jitter="equal", rng=self._rng))
 
     def _urllib_request(self, method: str, url: str,
                         body: Optional[dict]) -> dict:

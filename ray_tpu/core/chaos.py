@@ -1,0 +1,370 @@
+"""Deterministic, seed-driven fault injection for the control plane.
+
+The reference gates releases on fault injection — ``testing_rpc_failure``
+in ``ray_config_def.h`` lets any RPC be dropped/delayed by config, and the
+chaos test utils SIGKILL raylets and workers mid-run. This module is that
+subsystem for this runtime: every process's transport choke point
+(``Runtime._flush_box``, ``NodeManager._send``/``_send_direct``,
+``Controller._send``) consults one seeded PRNG stream before a message
+hits the wire, so a failing run replays from its seed.
+
+Three layers:
+
+- **Message faults** (:class:`ChaosInjector`): per-message-type drop /
+  delay / duplicate plus peer severing, decided from
+  ``random.Random(f"{seed}:{stream}")`` where ``stream`` names the
+  process role (``driver``, ``controller``, ``node``, ``worker:<n>`` —
+  workers get a stable spawn index via ``RAY_TPU_CHAOS_ID``). Each
+  message consumes a fixed number of draws, so the decision sequence for
+  a given (seed, stream, config) is reproducible.
+- **Duplicate hardening** (:class:`SeqDeduper`): while injection is
+  active every injectable payload is stamped with a per-process wire
+  sequence number and receivers drop replays — the duplication fault
+  continuously proves the at-least-once dedup path.
+- **Process faults** (:class:`ChaosMonkey`): driver/test-side scheduler
+  for SIGKILLing workers and node managers mid-task and for controller
+  pause/restart, driven by the same seed.
+
+Activation is environment-driven so it propagates to every spawned
+process: ``RAY_TPU_CHAOS_SEED=<int>`` turns injection on;
+``RAY_TPU_CHAOS_CONFIG=<json>`` tunes probabilities (fields of
+:class:`ChaosConfig`). Production runs never touch this module's hot
+path — the injector handle is ``None`` and every hook is a single
+attribute check.
+
+Determinism note: decision *streams* are bit-reproducible per process;
+end-to-end message interleaving still depends on OS scheduling. The
+contract chaos tests rely on is that a fixed (seed, config, workload)
+exercises the same fault mix and the asserted invariants (no hangs,
+typed errors, drained refcounts, no leaked processes) hold on every
+replay.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import logging
+import os
+import random
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+ENV_SEED = "RAY_TPU_CHAOS_SEED"
+ENV_CONFIG = "RAY_TPU_CHAOS_CONFIG"
+ENV_STREAM_ID = "RAY_TPU_CHAOS_ID"
+
+#: message types whose loss the runtime cannot recover from — the
+#: registration handshake and RPC replies have no retransmit, and
+#: RECONNECT is itself the recovery signal. Never injected.
+PROTECTED_TYPES = frozenset({"REG", "REGR", "BYE", "RPL", "ERR", "RCN"})
+
+#: default targets for a scalar ``drop_prob``: message types with proven
+#: drop-recovery machinery (TASK_RESULT -> owner grace-then-probe;
+#: PUT_OBJECT -> directory-hole audits + LOCATE_OBJECT; PING/HEARTBEAT
+#: -> periodic). Dropping e.g. TASK_DISPATCH needs an explicit per-type
+#: entry — there is no retransmit for it yet, a seeded drop would turn
+#: into a designed-in hang rather than a found bug.
+DEFAULT_DROPPABLE = frozenset({"RES", "PUT", "PNG", "HBT"})
+
+
+@dataclass
+class ChaosConfig:
+    """Fault mix for one chaos run. ``drop``/``dup``/``delay`` map a
+    message-type name (``"RES"``, ``"PUT"``, ... or ``"*"``) to a
+    probability and override the scalar ``*_prob`` defaults."""
+
+    seed: int = 0
+    drop_prob: float = 0.0            # over DEFAULT_DROPPABLE
+    dup_prob: float = 0.0             # over all unprotected types
+    delay_prob: float = 0.0           # over all unprotected types
+    delay_range_s: Tuple[float, float] = (0.002, 0.1)
+    drop: Dict[str, float] = field(default_factory=dict)
+    dup: Dict[str, float] = field(default_factory=dict)
+    delay: Dict[str, float] = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls) -> Optional["ChaosConfig"]:
+        seed_raw = os.environ.get(ENV_SEED)
+        cfg_raw = os.environ.get(ENV_CONFIG)
+        if not seed_raw and not cfg_raw:
+            return None
+        cfg = cls()
+        if cfg_raw:
+            try:
+                data = json.loads(cfg_raw)
+            except ValueError:
+                logger.warning("chaos: unparseable %s; injection disabled",
+                               ENV_CONFIG)
+                return None
+            for k, v in data.items():
+                if k == "delay_range_s":
+                    cfg.delay_range_s = (float(v[0]), float(v[1]))
+                elif hasattr(cfg, k):
+                    setattr(cfg, k, v)
+        if seed_raw:
+            try:
+                cfg.seed = int(seed_raw)
+            except ValueError:
+                logger.warning("chaos: non-integer %s=%r; injection "
+                               "disabled", ENV_SEED, seed_raw)
+                return None
+        return cfg
+
+    def env(self) -> Dict[str, str]:
+        """Env vars that reproduce this config in a child process."""
+        return {
+            ENV_SEED: str(self.seed),
+            ENV_CONFIG: json.dumps({
+                "drop_prob": self.drop_prob, "dup_prob": self.dup_prob,
+                "delay_prob": self.delay_prob,
+                "delay_range_s": list(self.delay_range_s),
+                "drop": self.drop, "dup": self.dup, "delay": self.delay,
+            }),
+        }
+
+    def _prob(self, table: Dict[str, float], scalar: float,
+              scalar_set: Optional[frozenset], name: str) -> float:
+        if name in PROTECTED_TYPES:
+            return 0.0
+        if name in table:
+            return table[name]
+        if "*" in table:
+            return table["*"]
+        if scalar_set is None or name in scalar_set:
+            return scalar
+        return 0.0
+
+    def drop_p(self, name: str) -> float:
+        return self._prob(self.drop, self.drop_prob, DEFAULT_DROPPABLE, name)
+
+    def dup_p(self, name: str) -> float:
+        return self._prob(self.dup, self.dup_prob, None, name)
+
+    def delay_p(self, name: str) -> float:
+        return self._prob(self.delay, self.delay_prob, None, name)
+
+
+class SeqDeduper:
+    """Receiver-side at-least-once filter: drops payloads whose
+    ``(sender tag, wire seq)`` was already seen. Bounded LRU — chaos
+    duplicates arrive within a handful of messages of the original, so a
+    few thousand entries of history is orders of magnitude more than the
+    replay window."""
+
+    def __init__(self, cap: int = 8192):
+        self._cap = cap
+        self._seen: "collections.OrderedDict[tuple, None]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def seen(self, key) -> bool:
+        try:
+            hash(key)
+        except TypeError:
+            return False
+        with self._lock:
+            if key in self._seen:
+                self.dropped += 1
+                return True
+            self._seen[key] = None
+            while len(self._seen) > self._cap:
+                self._seen.popitem(last=False)
+            return False
+
+
+class ChaosInjector:
+    """Per-process message-fault decider. ``plan_send`` is the single
+    entry point the transports call; it returns the (possibly empty)
+    list of ``(delay_s, payload)`` copies to actually ship."""
+
+    def __init__(self, config: ChaosConfig, stream: str):
+        self.config = config
+        self.stream = stream
+        self._rng = random.Random(f"{config.seed}:{stream}")
+        self._lock = threading.Lock()
+        #: peers cut off (drop everything both directions this process
+        #: sees). ``None`` severs the controller link.
+        self._severed: set = set()
+        #: receiver dedup key: unique per process *instance* (not per
+        #: replay — it only needs to distinguish senders at a receiver)
+        self._tag = os.urandom(8)
+        self._seq = itertools.count(1)
+        self.stats: "collections.Counter" = collections.Counter()
+
+    def rng_for(self, name: str) -> random.Random:
+        """Independent deterministic stream for an auxiliary consumer
+        (e.g. the lease backoff), so its draws don't perturb the message
+        decision sequence."""
+        return random.Random(f"{self.config.seed}:{self.stream}:{name}")
+
+    # ------------------------------------------------------------- sever
+    def sever(self, peer: Optional[bytes]) -> None:
+        with self._lock:
+            self._severed.add(peer)
+
+    def heal(self, peer: Optional[bytes] = None) -> None:
+        with self._lock:
+            if peer is None:
+                self._severed.clear()
+            else:
+                self._severed.discard(peer)
+
+    # -------------------------------------------------------------- plan
+    def plan_send(self, target: Optional[bytes], mtype: bytes,
+                  payload: Any) -> List[Tuple[float, Any]]:
+        """Decide the fate of one outgoing message. ``target`` is the
+        peer identity (``None`` = the controller link). Returns
+        ``[(delay_s, payload), ...]``: empty list = dropped, two entries
+        = duplicated. Injectable dict payloads are stamped with a wire
+        sequence number for receiver-side dedup."""
+        name = mtype.decode("ascii", "replace")
+        if name in PROTECTED_TYPES:
+            return [(0.0, payload)]
+        cfg = self.config
+        with self._lock:
+            if self._severed and (target in self._severed):
+                self.stats[("sever", name)] += 1
+                return []
+            # fixed draw count per message keeps the stream replayable
+            r_drop = self._rng.random()
+            r_dup = self._rng.random()
+            r_delay = self._rng.random()
+            r_amount = self._rng.random()
+            n = next(self._seq)
+        if r_drop < cfg.drop_p(name):
+            self.stats[("drop", name)] += 1
+            return []
+        if isinstance(payload, dict):
+            payload = dict(payload, __wseq__=(self._tag, n))
+        lo, hi = cfg.delay_range_s
+        delay = lo + r_amount * (hi - lo) \
+            if r_delay < cfg.delay_p(name) else 0.0
+        if delay > 0.0:
+            self.stats[("delay", name)] += 1
+        out = [(delay, payload)]
+        if isinstance(payload, dict) and r_dup < cfg.dup_p(name):
+            # the copy carries the SAME wire seq: receivers must drop it
+            self.stats[("dup", name)] += 1
+            out.append((0.0, payload))
+        return out
+
+
+def maybe_injector(role: str) -> Optional[ChaosInjector]:
+    """The per-process activation hook: returns an injector when chaos
+    env vars are set, else ``None`` (the common case — callers keep a
+    ``None`` handle and skip every chaos branch)."""
+    cfg = ChaosConfig.from_env()
+    if cfg is None:
+        return None
+    sid = os.environ.get(ENV_STREAM_ID, "")
+    stream = f"{role}:{sid}" if sid else role
+    inj = ChaosInjector(cfg, stream)
+    logger.warning("chaos: fault injection ACTIVE (seed=%d stream=%s)",
+                   cfg.seed, stream)
+    return inj
+
+
+def check_dedup(dedup: Optional[SeqDeduper], payload: Any) -> bool:
+    """Receiver-side hook: pops the wire seq stamp and returns True when
+    the payload is a duplicate that must be discarded."""
+    if dedup is None or not isinstance(payload, dict):
+        return False
+    key = payload.pop("__wseq__", None)
+    return key is not None and dedup.seen(key)
+
+
+class ChaosMonkey:
+    """Process-level fault scheduler for tests: SIGKILLs workers and
+    node managers mid-task and pauses/restarts the controller, all
+    ordered by one seeded PRNG (reference: the chaos/node-killer test
+    utils). Operates on the in-process head (``ray_tpu.api._head``) of
+    the calling driver."""
+
+    def __init__(self, seed: int, head=None):
+        self.rng = random.Random(f"{seed}:monkey")
+        self._head = head
+        self.log: List[tuple] = []
+
+    def _get_head(self):
+        if self._head is not None:
+            return self._head
+        import ray_tpu.api as api
+        return api._head
+
+    # ------------------------------------------------------------ workers
+    def worker_pids(self) -> Dict[bytes, int]:
+        node = self._get_head().node
+        with node._workers_lock:
+            return {ident: proc.pid
+                    for ident, proc in node.workers.items()}
+
+    def kill_random_worker(self, exclude: Tuple[int, ...] = ()
+                           ) -> Optional[int]:
+        """SIGKILL one currently-registered worker of the head node,
+        chosen deterministically; returns its pid (None if no
+        candidates)."""
+        pids = self.worker_pids()
+        candidates = sorted(p for p in pids.values() if p not in exclude)
+        if not candidates:
+            return None
+        victim = self.rng.choice(candidates)
+        self.log.append(("kill_worker", victim))
+        try:
+            os.kill(victim, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        return victim
+
+    def kill_node_proc(self, proc) -> None:
+        """SIGKILL a standalone node-manager process (a
+        ``cluster_utils`` node's subprocess)."""
+        self.log.append(("kill_node", proc.pid))
+        try:
+            proc.kill()
+        except Exception:
+            pass
+
+    # --------------------------------------------------------- controller
+    def restart_controller(self):
+        """kill -9 equivalent for the in-process controller: abandon it
+        without any state flush (durability must come from the WAL
+        alone) and start a fresh one on the same session."""
+        from ray_tpu.core.controller import Controller
+        head = self._get_head()
+        old = head.controller
+        self.log.append(("restart_controller",))
+        old._shutdown.set()
+        try:
+            old._wake_send.send(b"")
+        except Exception:
+            pass
+        if old._thread is not None:
+            old._thread.join(timeout=10)
+        head.controller = Controller(head.session_dir, old.config)
+        head.controller.start()
+        return head.controller
+
+    def pause_controller(self, seconds: float) -> threading.Thread:
+        """Wedge the controller event loop for ``seconds`` (GC-pause /
+        overload simulation). Returns the thread holding the loop."""
+        head = self._get_head()
+        self.log.append(("pause_controller", seconds))
+
+        def hold():
+            try:
+                head.controller.call_on_loop(
+                    lambda: time.sleep(seconds), timeout=seconds + 30.0)
+            except Exception:
+                pass
+
+        t = threading.Thread(target=hold, name="chaos-pause", daemon=True)
+        t.start()
+        return t

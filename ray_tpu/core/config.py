@@ -133,6 +133,14 @@ class Config:
     task_oom_retries: int = 15
     oom_retry_delay_s: float = 1.0
 
+    # --- retries / fault tolerance hardening ---
+    #: Lease/reconnect retry backoff: exponential with full jitter,
+    #: base * 2^attempt capped at the cap (reference retry shape; the
+    #: chaos harness forces many drivers to retry at once — full jitter
+    #: de-correlates the herd). Replaces the historical fixed 2.0s sleep.
+    lease_backoff_base_s: float = 0.5
+    lease_backoff_cap_s: float = 10.0
+
     # --- dashboard / job REST (reference: dashboard/head.py) ---
     dashboard_enabled: bool = True
     #: 0 picks an ephemeral port; the chosen address is written to

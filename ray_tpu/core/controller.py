@@ -28,6 +28,7 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 import zmq
 
+from ray_tpu.core import chaos as CH
 from ray_tpu.core import protocol as P
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
@@ -104,6 +105,10 @@ class Controller:
     def __init__(self, session_dir: str, config: Config):
         self.session_dir = session_dir
         self.config = config
+        # seeded fault injection (chaos.py): None in production
+        self._chaos = CH.maybe_injector("controller")
+        self._chaos_dedup = CH.SeqDeduper() if self._chaos is not None \
+            else None
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.ROUTER)
         self.sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
@@ -374,6 +379,22 @@ class Controller:
         """Thread-safe send. Loop-thread sends are buffered per peer and
         flushed at the end of the handling cycle (order-preserving);
         cross-thread sends are marshaled through the wake channel."""
+        if self._chaos is not None:
+            for delay_s, pl in self._chaos.plan_send(
+                    identity, mtype, payload):
+                if delay_s > 0.0:
+                    # the timer thread re-enters via the cross-thread
+                    # marshal path, which is safe from any thread
+                    t = threading.Timer(delay_s, self._send_now,
+                                        args=(identity, mtype, pl))
+                    t.daemon = True
+                    t.start()
+                else:
+                    self._send_now(identity, mtype, pl)
+            return
+        self._send_now(identity, mtype, payload)
+
+    def _send_now(self, identity: bytes, mtype: bytes, payload: Any) -> None:
         if threading.current_thread() is self._thread:
             box = self._outbox.get(identity)
             if box is None:
@@ -435,6 +456,9 @@ class Controller:
         self._dispatch_msg(identity, mtype, payload)
 
     def _dispatch_msg(self, identity: bytes, mtype: bytes, payload: Any) -> None:
+        if self._chaos_dedup is not None and CH.check_dedup(
+                self._chaos_dedup, payload):
+            return  # injected duplicate of a message already handled
         if identity not in self.peers and mtype != P.REGISTER:
             # a peer from before a controller restart: process its message
             # (handlers tolerate unknown senders) and ask it to re-announce
@@ -2203,8 +2227,19 @@ class Controller:
         t = self.tasks.pop(tid, None)
         if t is None:
             return
-        from ray_tpu.exceptions import ActorDiedError
-        err = P.dumps(ActorDiedError(t.spec.actor_id, "worker died"))
+        from ray_tpu.exceptions import ActorDiedError, ActorUnavailableError
+        info = self.actors.get(t.spec.actor_id.binary())
+        will_restart = info is not None and info.state != "DEAD" and (
+            info.spec.max_restarts < 0
+            or info.num_restarts < info.spec.max_restarts)
+        if will_restart:
+            # the actor is coming back: the racing call is unavailable,
+            # not dead — callers holding the handle may retry
+            err = P.dumps(ActorUnavailableError(
+                t.spec.actor_id, "actor worker died mid-call; the actor "
+                "is restarting"))
+        else:
+            err = P.dumps(ActorDiedError(t.spec.actor_id, "worker died"))
         results = [{"object_id": oid.binary(), "error": err}
                    for oid in t.spec.return_ids()]
         owner_identity = self._find_owner_identity(t, {}, b"")

@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 
 import zmq
 
+from ray_tpu.core import chaos as CH
 from ray_tpu.core import direct as D
 from ray_tpu.core import protocol as P
 from ray_tpu.core.config import Config, get_config
@@ -187,6 +188,13 @@ class NodeManager:
         self._zygote_started = False
         self._spawn_init_lock = threading.Lock()
         self._spawn_count = 0
+        # seeded fault injection (chaos.py): None in production
+        self._chaos = CH.maybe_injector("node")
+        self._chaos_dedup = CH.SeqDeduper() if self._chaos is not None \
+            else None
+        #: chaos-delayed direct sends parked by timer threads; drained
+        #: by the message loop (peer sockets are loop-thread-only)
+        self._chaos_delayed: "deque" = deque()
 
     # ------------------------------------------------------------------ run
     def _register_with_controller(self) -> None:
@@ -329,6 +337,19 @@ class NodeManager:
         self.store.destroy()
 
     def _send(self, mtype: bytes, payload) -> None:
+        if self._chaos is not None:
+            for delay_s, pl in self._chaos.plan_send(None, mtype, payload):
+                if delay_s > 0.0:
+                    t = threading.Timer(delay_s, self._send_now,
+                                        args=(mtype, pl))
+                    t.daemon = True
+                    t.start()
+                else:
+                    self._send_now(mtype, pl)
+            return
+        self._send_now(mtype, payload)
+
+    def _send_now(self, mtype: bytes, payload) -> None:
         with self._send_lock:
             self.sock.send_multipart([mtype, P.dumps(payload)])
 
@@ -348,6 +369,15 @@ class NodeManager:
                     self._start_stream(requester, m)
                 except Exception:
                     logger.exception("pull retry failed")
+            while self._chaos_delayed:
+                # chaos-delayed direct sends: already stamped/planned —
+                # ship as-is from the loop thread that owns peer sockets
+                target, mtype, pl = self._chaos_delayed.popleft()
+                try:
+                    self._peer_sock(target).send_multipart(
+                        [mtype, P.dumps(pl)])
+                except Exception:
+                    pass
             if self.sock in events:
                 while True:
                     try:
@@ -388,6 +418,21 @@ class NodeManager:
         return s
 
     def _send_direct(self, target: bytes, mtype: bytes, payload) -> None:
+        if self._chaos is not None:
+            for delay_s, pl in self._chaos.plan_send(target, mtype,
+                                                     payload):
+                if delay_s > 0.0:
+                    # peer sockets are loop-thread-only: the timer parks
+                    # the send; the loop drains it on its next wakeup
+                    t = threading.Timer(
+                        delay_s, self._chaos_delayed.append,
+                        args=((target, mtype, pl),))
+                    t.daemon = True
+                    t.start()
+                else:
+                    self._peer_sock(target).send_multipart(
+                        [mtype, P.dumps(pl)])
+            return
         self._peer_sock(target).send_multipart([mtype, P.dumps(payload)])
 
     def _prune_peer_socks(self, idle_s: float = 120.0) -> None:
@@ -403,6 +448,9 @@ class NodeManager:
                     pass
 
     def _handle(self, mtype: bytes, m: dict) -> None:
+        if self._chaos_dedup is not None and CH.check_dedup(
+                self._chaos_dedup, m):
+            return  # injected duplicate of a message already handled
         if mtype == P.MSG_BATCH:
             for sub_type, sub_payload in m["msgs"]:
                 try:
@@ -484,23 +532,29 @@ class NodeManager:
                 # few spawns use the cold path either way while the
                 # zygote warms up.
                 self._start_zygote()
-        self._spawn_q.put(requested)
+            spawn_idx = self._spawn_count
+        self._spawn_q.put((requested, spawn_idx))
 
     def _spawner_loop(self) -> None:
         while not self._stopped.is_set():
             try:
-                requested = self._spawn_q.get(timeout=1.0)
+                requested, spawn_idx = self._spawn_q.get(timeout=1.0)
             except Exception:
                 continue
             try:
-                self._spawn_one(requested)
+                self._spawn_one(requested, spawn_idx)
             except Exception:
                 logger.exception("worker spawn failed")
 
-    def _spawn_one(self, requested: bool) -> None:
+    def _spawn_one(self, requested: bool, spawn_idx: int = 0) -> None:
         worker_id = WorkerID.from_random()
         delta = self._worker_base_env()
         delta["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        if self._chaos is not None:
+            # stable chaos stream id: the Nth worker this node spawns
+            # draws the same fault decisions on every replay (worker
+            # ids are random and would de-correlate seeds)
+            delta[CH.ENV_STREAM_ID] = str(spawn_idx)
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(
@@ -708,6 +762,9 @@ class NodeManager:
     # admits work against a byte budget); the controller only names the
     # source. Chunks ride the direct node-to-node channel.
     def _handle_direct(self, sender: bytes, mtype: bytes, m: dict) -> None:
+        if self._chaos_dedup is not None and CH.check_dedup(
+                self._chaos_dedup, m):
+            return  # injected duplicate of a message already handled
         if mtype == P.STORE_RPC:
             # spill/restore move megabytes through disk: never on the
             # message loop (it also carries heartbeats and transfers).

@@ -23,6 +23,7 @@ from queue import Empty, SimpleQueue
 
 import zmq
 
+from ray_tpu.core import chaos as CH
 from ray_tpu.core import direct as D
 from ray_tpu.core import protocol as P
 from ray_tpu.core.config import Config, get_config
@@ -65,6 +66,24 @@ class Runtime:
         self.worker_id = worker_id or WorkerID.from_random()
         self.job_id = JobID.from_int(0)
         self.config: Config = get_config()
+
+        # seeded fault injection (chaos.py): None in production — every
+        # hook below is a single attribute check when disabled
+        self._chaos = CH.maybe_injector(kind)
+        self._chaos_dedup = CH.SeqDeduper() if self._chaos is not None \
+            else None
+        # lease/reconnect retry backoff: exponential with full jitter
+        # (replaces the old fixed 2.0s sleeps — under chaos every driver
+        # retrying in lockstep hammered the restarted controller)
+        from ray_tpu.util.backoff import ExponentialBackoff
+        _bo_rng = self._chaos.rng_for("lease-backoff") \
+            if self._chaos is not None else None
+        self._lease_backoff = ExponentialBackoff(
+            base=self.config.lease_backoff_base_s,
+            cap=self.config.lease_backoff_cap_s, rng=_bo_rng)
+        self._topup_backoff = ExponentialBackoff(
+            base=self.config.lease_backoff_base_s,
+            cap=self.config.lease_backoff_cap_s, rng=_bo_rng)
 
         self.memory_store = InProcessStore()
         self.reference_counter = ReferenceCounter(self._flush_ref_deltas)
@@ -404,6 +423,11 @@ class Runtime:
                    msgs: List[Tuple[bytes, Any]]) -> None:
         if not msgs:
             return
+        # getattr: unit tests drive _flush_box on bare fakes
+        if getattr(self, "_chaos", None) is not None:
+            msgs = self._chaos_filter(target, msgs)
+            if not msgs:
+                return
         send = self._sock_send if target is None else \
             (lambda mt, blob: self._peer_sock(target).send_multipart([mt, blob]))
         try:
@@ -421,6 +445,26 @@ class Runtime:
                     if not self._stopped.is_set():
                         logger.exception(
                             "%s: dropping unsendable %s", self.kind, mtype)
+
+    def _chaos_filter(self, target: Optional[bytes],
+                      msgs: List[Tuple[bytes, Any]]
+                      ) -> List[Tuple[bytes, Any]]:
+        """Fault-injection choke point for every outgoing message (the
+        flusher thread owns all sends, so one hook covers the controller
+        DEALER and every peer channel). Dropped messages vanish here;
+        delayed ones re-enter the flusher queue on a timer; duplicates
+        ship twice with one wire seq (receivers dedup)."""
+        out: List[Tuple[bytes, Any]] = []
+        for mtype, payload in msgs:
+            for delay_s, pl in self._chaos.plan_send(target, mtype, payload):
+                if delay_s > 0.0:
+                    t = threading.Timer(delay_s, self._out_q.put,
+                                        args=((target, mtype, pl),))
+                    t.daemon = True
+                    t.start()
+                else:
+                    out.append((mtype, pl))
+        return out
 
     def request(self, mtype: bytes, payload: dict,
                 timeout: Optional[float] = None) -> dict:
@@ -475,6 +519,9 @@ class Runtime:
                                          self.kind, frames[1])
 
     def _on_message(self, mtype: bytes, m: dict) -> None:
+        if self._chaos_dedup is not None and CH.check_dedup(
+                self._chaos_dedup, m):
+            return  # injected duplicate of a message already handled
         if mtype == P.MSG_BATCH:
             for sub_type, sub_payload in m["msgs"]:
                 try:
@@ -580,7 +627,11 @@ class Runtime:
             self._direct_backlog.clear()  # inflight resubmit covers them
             self._direct_backlog_bytes = 0
             self._lease_state = "none"
-            self._lease_backoff_until = time.monotonic() + 2.0
+            # jittered: every driver re-leasing in lockstep against a
+            # freshly-restarted controller is exactly the thundering
+            # herd full jitter de-correlates
+            self._lease_backoff_until = time.monotonic() + \
+                self._lease_backoff.next_delay()
         self._send(P.REGISTER, self._register_msg())
         for channel in list(self.pubsub_handlers):
             if channel != "*":
@@ -1529,6 +1580,8 @@ class Runtime:
                 if workers:
                     self._lease_pool.extend(workers)
                     self._lease_state = "ready"
+                    self._lease_backoff.reset()
+                    self._topup_backoff.reset()
                     # tasks backlogged while this request was in
                     # flight: dispatch onto the fresh capacity NOW —
                     # with no direct tasks inflight there are no
@@ -1542,15 +1595,18 @@ class Runtime:
                     # controller here ping-pongs ~half of every big
                     # burst onto the slow path (measured: 1012/2000
                     # spilled, tasks_async capped at ~4.4k/s). Keep the
-                    # pool, just stop re-asking for a while.
-                    self._lease_topup_backoff = time.monotonic() + 2.0
+                    # pool, just stop re-asking for a while (growing,
+                    # jittered: repeat empty grants back off further).
+                    self._lease_topup_backoff = time.monotonic() + \
+                        self._topup_backoff.next_delay()
                 else:
                     # nothing grantable and we hold no capacity at all;
                     # retry later. Tasks optimistically backlogged while
                     # the request was in flight must not starve — route
                     # them through the controller after all.
                     self._lease_state = "none"
-                    self._lease_backoff_until = time.monotonic() + 2.0
+                    self._lease_backoff_until = time.monotonic() + \
+                        self._lease_backoff.next_delay()
                     while self._direct_backlog:
                         spill.append(self._pop_backlog_locked())
             for w, spec in sends:
@@ -1574,6 +1630,7 @@ class Runtime:
             self._lease_pool.extend(workers)
             if self._lease_pool:
                 self._lease_state = "ready"
+                self._lease_backoff.reset()
             sends = self._drain_backlog_locked()
         for w, spec in sends:
             self._send_direct(w, P.TASK_DISPATCH,
@@ -1622,7 +1679,8 @@ class Runtime:
                 lost = []
             if not self._lease_pool:
                 self._lease_state = "none"
-                self._lease_backoff_until = time.monotonic() + 1.0
+                self._lease_backoff_until = time.monotonic() + \
+                    self._lease_backoff.next_delay()
                 # no leases left: the local backlog would never drain
                 while self._direct_backlog:
                     resubmit.append(self._pop_backlog_locked())
@@ -1808,11 +1866,17 @@ class Runtime:
                 st["inflight"] = {}
                 st["queue"] = retry + st["queue"]
                 need_resolve = True
-            from ray_tpu.exceptions import ActorDiedError
+            # the actor is NOT dead — calls that raced the restart and
+            # are not retriable surface the typed "temporarily
+            # unreachable" error (reference: ActorUnavailableError),
+            # so callers can distinguish retry-me from gone-for-good
+            from ray_tpu.exceptions import ActorUnavailableError
             for s in to_fail:
                 self._fail_actor_task_local(
-                    s, ActorDiedError(ActorID(aid),
-                                      "actor restarting; task not retriable"))
+                    s, ActorUnavailableError(
+                        ActorID(aid),
+                        "actor restarting; call not retriable "
+                        "(max_task_retries=0)"))
             if need_resolve:
                 self._resolve_actor(aid)
         elif state == "DEAD":

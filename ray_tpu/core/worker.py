@@ -436,7 +436,12 @@ class WorkerExecutor:
             })
         done_results = results
         if direct_ok and self.runtime._owner_local and error_blob is None \
+                and self.runtime._chaos is None \
                 and (driver_leased or spec.is_actor_task):
+            # (chaos gate: the trim assumes the direct RES push always
+            # lands. Under fault injection RES may be dropped, and the
+            # owner's grace-then-probe fallback can only recover if the
+            # controller directory kept the full result meta.)
             # owner-local mode, direct dispatch (driver lease / actor
             # call): the owner (which just got TASK_RESULT) is the
             # authority for inline results — the controller neither
@@ -635,6 +640,14 @@ def main() -> None:
     node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
     shm_session = os.environ["RAY_TPU_SHM_SESSION"]
+    if os.environ.get("RAY_TPU_CHAOS_SEED"):
+        # header line so a red chaos run maps worker logs to the seeded
+        # decision stream that produced them
+        logging.getLogger(__name__).warning(
+            "chaos: worker %s under fault injection (seed=%s stream "
+            "id=%s)", worker_id.hex()[:12],
+            os.environ.get("RAY_TPU_CHAOS_SEED"),
+            os.environ.get("RAY_TPU_CHAOS_ID", ""))
     boot_t0 = time.perf_counter()
     bootprof = os.environ.get("RAY_TPU_WORKER_BOOTPROF")
 

@@ -69,7 +69,23 @@ class ActorDiedError(ActorError):
 
 
 class ActorUnavailableError(ActorError):
-    """Actor temporarily unreachable (e.g. restarting)."""
+    """Actor temporarily unreachable (e.g. restarting).
+
+    Raised for calls that race an actor restart and are not retriable
+    (``max_task_retries=0``). Unlike :class:`ActorDiedError` the actor
+    may become ALIVE again — callers holding the handle can retry;
+    retriable calls are instead queued transparently until the actor
+    re-resolves (reference: python/ray/exceptions.py
+    ActorUnavailableError semantics).
+    """
+
+    def __init__(self, actor_id=None, reason: str = "actor is restarting"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} unavailable: {reason}")
+
+    def __reduce__(self):
+        return (ActorUnavailableError, (self.actor_id, self.reason))
 
 
 class ObjectLostError(RayTpuError):

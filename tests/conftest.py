@@ -30,6 +30,24 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Failure-header replay line: any test that fails while chaos
+    injection is active prints the seed (and config) that reproduces
+    its fault schedule — a red chaos run is replayable from the log
+    alone."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.failed:
+        seed = os.environ.get("RAY_TPU_CHAOS_SEED")
+        if seed:
+            line = f"replay with: RAY_TPU_CHAOS_SEED={seed}"
+            cfg = os.environ.get("RAY_TPU_CHAOS_CONFIG")
+            if cfg:
+                line += f" RAY_TPU_CHAOS_CONFIG='{cfg}'"
+            rep.sections.append(("chaos seed", line))
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu

@@ -1,0 +1,313 @@
+"""Seeded chaos-injection tests (reference: the release-gating fault
+injection — ``testing_rpc_failure`` in ``ray_config_def.h`` plus the
+chaos/node-killer test utils).
+
+Every integration test here runs the full runtime under a deterministic
+fault schedule drawn from ``RAY_TPU_CHAOS_SEED``: per-message-type
+drops, duplicates and delays at every transport choke point, SIGKILLed
+workers mid-task, and (in the soak) a kill -9 controller restart. The
+asserted invariants are the fault-model contract:
+
+- no hangs: every submitted ref resolves within the deadline,
+- every ref resolves to a value or a *typed* ``RayTpuError``,
+- refcounts drain once the driver drops its refs,
+- no worker processes leak past shutdown.
+
+A red run prints its seed in the failure header (see conftest) —
+re-exporting that env var replays the same fault schedule.
+"""
+
+import gc
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import chaos
+from ray_tpu.exceptions import GetTimeoutError, RayTpuError
+
+# ----------------------------------------------------------------- units
+
+
+def test_injector_deterministic_stream():
+    cfg = chaos.ChaosConfig(seed=7, drop_prob=0.3, dup_prob=0.3,
+                            delay_prob=0.3)
+    a = chaos.ChaosInjector(cfg, "worker:1")
+    b = chaos.ChaosInjector(cfg, "worker:1")
+    plans_a = [a.plan_send(None, b"RES", {"i": i}) for i in range(64)]
+    plans_b = [b.plan_send(None, b"RES", {"i": i}) for i in range(64)]
+    # identical (seed, stream, config) -> identical decision sequence
+    assert [(len(p), [d for d, _ in p]) for p in plans_a] == \
+        [(len(p), [d for d, _ in p]) for p in plans_b]
+    # a different stream draws a different sequence
+    c = chaos.ChaosInjector(cfg, "worker:2")
+    plans_c = [c.plan_send(None, b"RES", {"i": i}) for i in range(64)]
+    assert [len(p) for p in plans_a] != [len(p) for p in plans_c]
+    # faults actually fired
+    assert any(len(p) == 0 for p in plans_a)      # drops
+    assert any(len(p) == 2 for p in plans_a)      # duplicates
+    assert any(d > 0 for p in plans_a for d, _ in p)  # delays
+
+
+def test_protected_types_never_injected():
+    cfg = chaos.ChaosConfig(seed=3, drop_prob=1.0, dup_prob=1.0,
+                            delay_prob=1.0,
+                            drop={"*": 1.0}, dup={"*": 1.0},
+                            delay={"*": 1.0})
+    inj = chaos.ChaosInjector(cfg, "driver")
+    for mtype in (b"REG", b"REGR", b"BYE", b"RPL", b"ERR", b"RCN"):
+        plans = [inj.plan_send(None, mtype, {"x": 1}) for _ in range(8)]
+        assert all(p == [(0.0, {"x": 1})] for p in plans), mtype
+
+
+def test_scalar_drop_prob_only_hits_recoverable_types():
+    cfg = chaos.ChaosConfig(seed=5, drop_prob=1.0)
+    inj = chaos.ChaosInjector(cfg, "driver")
+    assert inj.plan_send(None, b"RES", {"x": 1}) == []
+    # TASK_DISPATCH has no retransmit: a scalar drop_prob must not
+    # touch it (needs an explicit per-type entry)
+    assert len(inj.plan_send(None, b"DSP", {"x": 1})) == 1
+
+
+def test_seq_dedup_drops_replay():
+    cfg = chaos.ChaosConfig(seed=9, dup_prob=1.0)
+    inj = chaos.ChaosInjector(cfg, "driver")
+    dedup = chaos.SeqDeduper()
+    plans = inj.plan_send(None, b"DON", {"v": 1})
+    assert len(plans) == 2  # original + duplicate, same wire seq
+    first, second = dict(plans[0][1]), dict(plans[1][1])
+    assert not chaos.check_dedup(dedup, first)
+    assert chaos.check_dedup(dedup, second)  # replay filtered
+    # the stamp is stripped before the handler sees the payload
+    assert "__wseq__" not in first
+
+
+def test_severed_peer_drops_everything():
+    cfg = chaos.ChaosConfig(seed=1)
+    inj = chaos.ChaosInjector(cfg, "driver")
+    inj.sever(b"peer-1")
+    assert inj.plan_send(b"peer-1", b"ACL", {"x": 1}) == []
+    assert len(inj.plan_send(b"peer-2", b"ACL", {"x": 1})) == 1
+    inj.heal(b"peer-1")
+    assert len(inj.plan_send(b"peer-1", b"ACL", {"x": 1})) == 1
+
+
+def test_config_env_roundtrip(monkeypatch):
+    cfg = chaos.ChaosConfig(seed=42, drop_prob=0.1, dup_prob=0.2,
+                            delay_prob=0.3, delay_range_s=(0.01, 0.05),
+                            drop={"PUT": 0.5})
+    for k, v in cfg.env().items():
+        monkeypatch.setenv(k, v)
+    back = chaos.ChaosConfig.from_env()
+    assert back is not None
+    assert (back.seed, back.drop_prob, back.dup_prob, back.delay_prob) \
+        == (42, 0.1, 0.2, 0.3)
+    assert back.delay_range_s == (0.01, 0.05)
+    assert back.drop == {"PUT": 0.5}
+    monkeypatch.delenv(chaos.ENV_SEED)
+    monkeypatch.delenv(chaos.ENV_CONFIG)
+    assert chaos.ChaosConfig.from_env() is None
+
+
+def test_backoff_full_jitter_bounds():
+    import random
+
+    from ray_tpu.util.backoff import ExponentialBackoff, backoff_delay
+    rng = random.Random(0)
+    for attempt in range(12):
+        d = backoff_delay(attempt, base=0.5, cap=10.0, rng=rng)
+        assert 0.0 <= d <= min(10.0, 0.5 * 2 ** attempt)
+    bo = ExponentialBackoff(base=0.5, cap=10.0, rng=random.Random(1))
+    delays = [bo.next_delay() for _ in range(8)]
+    assert all(0.0 <= d <= 10.0 for d in delays)
+    assert bo.attempt == 8
+    bo.reset()
+    assert bo.attempt == 0
+
+
+# ----------------------------------------------------------- integration
+
+#: the mix every integration test runs under; drop targets are the
+#: types with proven recovery machinery (see chaos.DEFAULT_DROPPABLE)
+CHAOS_MIX = {"drop_prob": 0.02, "dup_prob": 0.05, "delay_prob": 0.05,
+             "delay_range_s": [0.001, 0.05]}
+
+
+def _chaos_env(seed, mix=CHAOS_MIX):
+    os.environ[chaos.ENV_SEED] = str(seed)
+    os.environ[chaos.ENV_CONFIG] = json.dumps(mix)
+
+
+def _clear_chaos_env():
+    os.environ.pop(chaos.ENV_SEED, None)
+    os.environ.pop(chaos.ENV_CONFIG, None)
+
+
+def _assert_workers_reaped(observed_pids, deadline_s=30.0):
+    deadline = time.monotonic() + deadline_s
+    pending = set(observed_pids)
+    while pending and time.monotonic() < deadline:
+        for pid in list(pending):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                pending.discard(pid)
+            except PermissionError:
+                pass
+        if pending:
+            time.sleep(0.25)
+    assert not pending, f"leaked worker processes: {sorted(pending)}"
+
+
+def _assert_refcounts_drain(runtime, deadline_s=25.0):
+    deadline = time.monotonic() + deadline_s
+    counts = None
+    while time.monotonic() < deadline:
+        gc.collect()
+        try:
+            runtime.reference_counter.flush()
+        except Exception:
+            pass
+        counts = runtime.reference_counter.all_counts()
+        if not counts:
+            return
+        time.sleep(0.25)
+    assert not counts, f"refcounts did not drain: {len(counts)} live"
+
+
+def _run_chaos_workload(seed, n_tasks, n_actor_calls, kills,
+                        restart_controller, deadline_s):
+    """Submit a seeded mix of tasks + actor calls while the monkey
+    kills workers (and optionally the controller) on a deterministic
+    schedule, then check the end-state invariants."""
+    _chaos_env(seed)
+    try:
+        ray_tpu.init(num_cpus=4, _num_initial_workers=2,
+                     ignore_reinit_error=True)
+        import ray_tpu.api as api
+        from ray_tpu.core.global_state import global_worker
+        monkey = chaos.ChaosMonkey(seed, head=api._head)
+        observed_pids = set(monkey.worker_pids().values())
+
+        @ray_tpu.remote(max_retries=8)
+        def work(i):
+            time.sleep(0.002)
+            return i * 2
+
+        @ray_tpu.remote(max_restarts=100, max_task_retries=8)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        # named+detached: actor state survives the controller kill -9
+        # (anonymous actors are not WAL-persisted, by design)
+        counter = Counter.options(name=f"chaos-{seed}",
+                                  lifetime="detached").remote()
+        kill_at = sorted(monkey.rng.sample(
+            range(10, n_tasks - 5), kills)) if kills else []
+        restart_at = n_tasks // 2 if restart_controller else -1
+        every = max(1, n_tasks // max(1, n_actor_calls))
+
+        refs, arefs = [], []
+        for i in range(n_tasks):
+            refs.append(work.remote(i))
+            if i % every == 0 and len(arefs) < n_actor_calls:
+                arefs.append(counter.inc.remote())
+            if i in kill_at:
+                monkey.kill_random_worker()
+                observed_pids |= set(monkey.worker_pids().values())
+            if i == restart_at:
+                monkey.restart_controller()
+        while len(arefs) < n_actor_calls:
+            arefs.append(counter.inc.remote())
+        observed_pids |= set(monkey.worker_pids().values())
+
+        # ---- invariant: no hangs; plain tasks all retry to success
+        deadline = time.monotonic() + deadline_s
+        vals = ray_tpu.get(refs, timeout=deadline_s)
+        assert vals == [i * 2 for i in range(n_tasks)]
+        # ---- invariant: actor calls resolve to a value or a TYPED error
+        ok, typed_errors = 0, []
+        for r in arefs:
+            remaining = max(5.0, deadline - time.monotonic())
+            try:
+                v = ray_tpu.get(r, timeout=remaining)
+                assert isinstance(v, int) and v >= 1
+                ok += 1
+            except GetTimeoutError:
+                raise AssertionError(
+                    f"hung actor call (seed={seed}, "
+                    f"monkey log={monkey.log})")
+            except RayTpuError as e:
+                typed_errors.append(type(e).__name__)
+        assert ok >= 1, f"no actor call survived: {typed_errors}"
+        observed_pids |= set(monkey.worker_pids().values())
+
+        # ---- invariant: refcounts drain once the driver drops refs
+        del refs, arefs, vals
+        _assert_refcounts_drain(global_worker())
+        return observed_pids, ok, typed_errors, monkey
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            _clear_chaos_env()
+
+
+@pytest.mark.chaos
+def test_chaos_smoke():
+    """Tier-1 chaos coverage: seeded drops/dups/delays at every
+    transport plus one worker SIGKILL — small enough to stay fast."""
+    observed, ok, errs, _ = _run_chaos_workload(
+        seed=7101, n_tasks=90, n_actor_calls=45, kills=1,
+        restart_controller=False, deadline_s=150.0)
+    # ---- invariant: no leaked worker processes after shutdown
+    _assert_workers_reaped(observed)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1101, 2202, 3303])
+def test_chaos_soak(seed):
+    """The full soak: >=300 tasks + >=120 actor calls under seeded
+    kills, drops, duplicates and delays, plus one controller kill -9
+    mid-stream. Replays deterministically per seed."""
+    observed, ok, errs, monkey = _run_chaos_workload(
+        seed=seed, n_tasks=300, n_actor_calls=120, kills=3,
+        restart_controller=True, deadline_s=420.0)
+    assert ("restart_controller",) in monkey.log
+    assert sum(1 for e in monkey.log if e[0] == "kill_worker") >= 1
+    _assert_workers_reaped(observed)
+
+
+@pytest.mark.chaos
+def test_chaos_controller_pause_recovers():
+    """A wedged controller loop (GC-pause simulation) must only delay
+    traffic, never lose it."""
+    _chaos_env(4404, mix={"dup_prob": 0.05, "delay_prob": 0.05})
+    try:
+        ray_tpu.init(num_cpus=4, _num_initial_workers=2,
+                     ignore_reinit_error=True)
+        import ray_tpu.api as api
+        monkey = chaos.ChaosMonkey(4404, head=api._head)
+
+        @ray_tpu.remote(max_retries=4)
+        def echo(i):
+            return i
+
+        refs = [echo.remote(i) for i in range(20)]
+        monkey.pause_controller(2.0)
+        refs += [echo.remote(100 + i) for i in range(20)]
+        vals = ray_tpu.get(refs, timeout=120)
+        assert vals == list(range(20)) + list(range(100, 120))
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            _clear_chaos_env()

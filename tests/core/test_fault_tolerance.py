@@ -85,6 +85,84 @@ def test_actor_restart(ray_start_regular):
         pytest.fail("actor did not restart")
 
 
+def test_inflight_call_during_restart_is_unavailable(ray_start_regular):
+    """A call racing an actor restart surfaces the typed
+    ActorUnavailableError (the actor is NOT dead — the handle keeps
+    working after the restart), while queued retriable calls are
+    transparently replayed once the actor is ALIVE again."""
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            self.calls += 1
+            return self.calls
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.ping.remote(), timeout=120) == 1
+    # the in-flight non-retriable call dies with the worker: typed
+    # "temporarily unreachable", NOT ActorDiedError
+    with pytest.raises(ray_tpu.ActorUnavailableError):
+        ray_tpu.get(p.die.remote(), timeout=60)
+    # the actor restarts and the same handle keeps working
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            assert ray_tpu.get(p.ping.remote(), timeout=30) >= 1
+            break
+        except ray_tpu.ActorError:
+            time.sleep(0.5)
+    else:
+        pytest.fail("actor did not come back after restart")
+
+
+def test_pull_timeout_when_holder_node_dies():
+    """Object-pull timeout path (pull_timeout_s): the only holder node
+    is SIGKILLed while the object is being pulled. The destination's
+    pulls time out, the controller retries up to its cap, and — with no
+    lineage to reconstruct from (actor-produced result) — every waiter
+    fails with a typed ObjectLostError instead of hanging."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args=dict(
+        num_cpus=2, _num_initial_workers=1,
+        _system_config={
+            "pull_timeout_s": 2.0,
+            # keep heartbeats "healthy" so the node's death is NOT
+            # detected within the test: the pull-timeout machinery must
+            # fail the object on its own
+            "health_check_failure_threshold": 1000,
+        }))
+    try:
+        node_b = cluster.add_node(num_cpus=1, resources={"pin": 1})
+
+        @ray_tpu.remote(resources={"pin": 1}, max_restarts=0)
+        class Holder:
+            def make(self):
+                return np.ones(512 * 1024, dtype=np.uint8)  # shm-sized
+
+        h = Holder.remote()
+        ref = h.make.remote()
+        # wait until the object is sealed on node B (the actor replied)
+        ray_tpu.wait([ref], timeout=60)
+        # SIGKILL the holder node manager mid-pull window
+        node_b.proc.kill()
+        node_b.proc.wait(timeout=10)
+        t0 = time.monotonic()
+        with pytest.raises(ray_tpu.ObjectLostError):
+            ray_tpu.get(ref, timeout=120)
+        # the failure came from pull-timeout retries, not a quick path
+        assert time.monotonic() - t0 >= 2.0
+    finally:
+        cluster.shutdown()
+
+
 def test_actor_no_restart_dies(ray_start_regular):
     @ray_tpu.remote
     class Mortal:
