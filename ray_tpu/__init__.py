@@ -42,6 +42,8 @@ from ray_tpu.exceptions import (
     TaskCancelledError,
     OutOfMemoryError,
     GetTimeoutError,
+    RpcTimeoutError,
+    DeliveryFailedError,
 )
 from ray_tpu.runtime_context import RuntimeContext
 
@@ -81,4 +83,6 @@ __all__ = [
     "TaskCancelledError",
     "OutOfMemoryError",
     "GetTimeoutError",
+    "RpcTimeoutError",
+    "DeliveryFailedError",
 ]

@@ -17,10 +17,26 @@ Three layers:
   workers get a stable spawn index via ``RAY_TPU_CHAOS_ID``). Each
   message consumes a fixed number of draws, so the decision sequence for
   a given (seed, stream, config) is reproducible.
+- **Scheduled partitions** (``ChaosConfig.partitions``): a time-indexed
+  sever matrix — ``{"start": s, "end": s, "a": role, "b": role}`` cuts
+  BOTH directions of the matching link (controller<->node,
+  controller<->peer, node<->node) for the window, measured from each
+  process's injector creation, then heals. Unlike probabilistic drops a
+  partition cuts *everything* on the link, protected types included —
+  real partitions don't read message headers. Recovery comes from the
+  reliable-delivery layer (``core/reliable.py``) retransmitting the
+  critical set after the heal, plus the periodic/reconnect machinery.
 - **Duplicate hardening** (:class:`SeqDeduper`): while injection is
   active every injectable payload is stamped with a per-process wire
   sequence number and receivers drop replays — the duplication fault
-  continuously proves the at-least-once dedup path.
+  continuously proves the at-least-once dedup path (the reliable layer
+  runs its own always-on instance against retransmit duplicates).
+- **Disk faults** (:class:`DiskFaultInjector`): seeded ``EIO`` /
+  ``ENOSPC`` / truncated-read faults on the spill path
+  (``native_store.py`` spill writes and restore reads), proving the
+  store degrades gracefully — retry with backoff, fall back to re-pull
+  from another holder, and only then surface a typed
+  ``ObjectLostError``.
 - **Process faults** (:class:`ChaosMonkey`): driver/test-side scheduler
   for SIGKILLing workers and node managers mid-task and for controller
   pause/restart, driven by the same seed.
@@ -65,20 +81,38 @@ ENV_STREAM_ID = "RAY_TPU_CHAOS_ID"
 #: RECONNECT is itself the recovery signal. Never injected.
 PROTECTED_TYPES = frozenset({"REG", "REGR", "BYE", "RPL", "ERR", "RCN"})
 
-#: default targets for a scalar ``drop_prob``: message types with proven
-#: drop-recovery machinery (TASK_RESULT -> owner grace-then-probe;
-#: PUT_OBJECT -> directory-hole audits + LOCATE_OBJECT; PING/HEARTBEAT
-#: -> periodic). Dropping e.g. TASK_DISPATCH needs an explicit per-type
-#: entry — there is no retransmit for it yet, a seeded drop would turn
-#: into a designed-in hang rather than a found bug.
-DEFAULT_DROPPABLE = frozenset({"RES", "PUT", "PNG", "HBT"})
+#: default targets for a scalar ``drop_prob``: message types with
+#: drop-recovery machinery. PING/HEARTBEAT are periodic; everything
+#: else is covered by the reliable-delivery layer's ack/retransmit
+#: (core/reliable.py) — which is what finally let the scalar mix cover
+#: the whole critical one-way control plane (TASK_DISPATCH, ACTOR_CALL,
+#: TASK_ASSIGN, TASK_DONE) instead of a hand-picked safe subset.
+#: Request/reply types (SUB, KVO, ...) still need an explicit per-type
+#: entry: their drop surfaces as the caller's RpcTimeoutError, which is
+#: a worse failure mode to inject by default.
+DEFAULT_DROPPABLE = frozenset({"RES", "PUT", "PNG", "HBT",
+                               "DSP", "ACL", "ASG", "DON"})
 
 
 @dataclass
 class ChaosConfig:
     """Fault mix for one chaos run. ``drop``/``dup``/``delay`` map a
     message-type name (``"RES"``, ``"PUT"``, ... or ``"*"``) to a
-    probability and override the scalar ``*_prob`` defaults."""
+    probability and override the scalar ``*_prob`` defaults.
+
+    ``partitions`` is the scheduled sever matrix: a list of
+    ``{"start": s, "end": s, "a": side, "b": side}`` windows (seconds
+    from injector creation) where a side is one of ``"controller"``,
+    ``"node"``, ``"driver"``, ``"worker"`` or ``"*"``. A window cuts
+    every message, both directions, on links whose (sender role, target
+    class) match — see :meth:`ChaosInjector._partitioned`. Driver and
+    worker targets are indistinguishable at the sender (both are opaque
+    28-byte DEALER identities), so either name matches any non-node
+    peer; node identities are recognized by their ``b"N"`` prefix.
+
+    ``disk``/``disk_fault_prob`` drive the spill-path disk faults
+    (ops: ``"spill_write"`` -> EIO/ENOSPC, ``"restore_read"`` ->
+    EIO/truncated read), consumed by :class:`DiskFaultInjector`."""
 
     seed: int = 0
     drop_prob: float = 0.0            # over DEFAULT_DROPPABLE
@@ -88,6 +122,9 @@ class ChaosConfig:
     drop: Dict[str, float] = field(default_factory=dict)
     dup: Dict[str, float] = field(default_factory=dict)
     delay: Dict[str, float] = field(default_factory=dict)
+    partitions: List[Dict] = field(default_factory=list)
+    disk_fault_prob: float = 0.0      # over all spill-path disk ops
+    disk: Dict[str, float] = field(default_factory=dict)
 
     @classmethod
     def from_env(cls) -> Optional["ChaosConfig"]:
@@ -126,6 +163,9 @@ class ChaosConfig:
                 "delay_prob": self.delay_prob,
                 "delay_range_s": list(self.delay_range_s),
                 "drop": self.drop, "dup": self.dup, "delay": self.delay,
+                "partitions": self.partitions,
+                "disk_fault_prob": self.disk_fault_prob,
+                "disk": self.disk,
             }),
         }
 
@@ -149,6 +189,9 @@ class ChaosConfig:
 
     def delay_p(self, name: str) -> float:
         return self._prob(self.delay, self.delay_prob, None, name)
+
+    def disk_p(self, op: str) -> float:
+        return self.disk.get(op, self.disk.get("*", self.disk_fault_prob))
 
 
 class SeqDeduper:
@@ -188,8 +231,12 @@ class ChaosInjector:
     def __init__(self, config: ChaosConfig, stream: str):
         self.config = config
         self.stream = stream
+        self.role = stream.split(":", 1)[0]
         self._rng = random.Random(f"{config.seed}:{stream}")
         self._lock = threading.Lock()
+        #: scheduled-partition clock origin: windows are seconds from
+        #: injector creation (process start for spawned processes)
+        self._t0 = time.monotonic()
         #: peers cut off (drop everything both directions this process
         #: sees). ``None`` severs the controller link.
         self._severed: set = set()
@@ -217,6 +264,44 @@ class ChaosInjector:
             else:
                 self._severed.discard(peer)
 
+    # -------------------------------------------------- partitions
+    @staticmethod
+    def _side_matches_role(side: str, role: str) -> bool:
+        return side == "*" or side == role or \
+            (side in ("driver", "worker", "peer")
+             and role in ("driver", "worker"))
+
+    @staticmethod
+    def _target_class(target: Optional[bytes]) -> str:
+        if target is None:
+            return "controller"
+        if len(target) == 28 and target[:1] == b"N":
+            return "node"
+        return "peer"  # worker or driver: indistinguishable identities
+
+    @classmethod
+    def _side_matches_target(cls, side: str, tclass: str) -> bool:
+        return side == "*" or side == tclass or \
+            (side in ("driver", "worker", "peer") and tclass == "peer")
+
+    def _partitioned(self, target: Optional[bytes], now: float) -> bool:
+        """True when a scheduled partition window currently severs the
+        (this role -> target) link. Pure time check — consumes no RNG
+        draws, so adding partitions to a config shifts no other fault
+        decisions."""
+        t = now - self._t0
+        tclass = self._target_class(target)
+        for p in self.config.partitions:
+            if not (p.get("start", 0.0) <= t < p.get("end", float("inf"))):
+                continue
+            a, b = p.get("a", "*"), p.get("b", "*")
+            if (self._side_matches_role(a, self.role)
+                    and self._side_matches_target(b, tclass)) or \
+               (self._side_matches_role(b, self.role)
+                    and self._side_matches_target(a, tclass)):
+                return True
+        return False
+
     # -------------------------------------------------------------- plan
     def plan_send(self, target: Optional[bytes], mtype: bytes,
                   payload: Any) -> List[Tuple[float, Any]]:
@@ -226,6 +311,12 @@ class ChaosInjector:
         = duplicated. Injectable dict payloads are stamped with a wire
         sequence number for receiver-side dedup."""
         name = mtype.decode("ascii", "replace")
+        # scheduled partitions cut EVERYTHING on the link, protected
+        # types included — a real partition doesn't read headers
+        if self.config.partitions and \
+                self._partitioned(target, time.monotonic()):
+            self.stats[("partition", name)] += 1
+            return []
         if name in PROTECTED_TYPES:
             return [(0.0, payload)]
         cfg = self.config
@@ -279,6 +370,62 @@ def check_dedup(dedup: Optional[SeqDeduper], payload: Any) -> bool:
         return False
     key = payload.pop("__wseq__", None)
     return key is not None and dedup.seen(key)
+
+
+class DiskFaultInjector:
+    """Seeded fault decider for the spill path's disk I/O
+    (``native_store.py``). One deterministic stream per process,
+    independent of the message-fault draws (``:disk`` suffix), so
+    enabling disk faults shifts no message decisions.
+
+    Ops and fault kinds:
+
+    - ``spill_write``: ``"eio"`` | ``"enospc"`` — the spill write is
+      refused; the store keeps the object resident (it is still the
+      only copy) and retries on a later sweep.
+    - ``restore_read``: ``"eio"`` (transient — the store reports
+      ``"retry"`` until a strike cap, then declares the local backing
+      copy lost) | ``"truncate"`` (a torn file: immediately lost).
+    """
+
+    def __init__(self, config: ChaosConfig, stream: str):
+        self.config = config
+        self.stream = stream
+        self._rng = random.Random(f"{config.seed}:{stream}:disk")
+        self._lock = threading.Lock()
+        self.stats: "collections.Counter" = collections.Counter()
+
+    def fault(self, op: str) -> Optional[str]:
+        """Draw the fate of one disk operation: None (healthy) or a
+        fault kind. Fixed two draws per call keeps the stream
+        replayable."""
+        p = self.config.disk_p(op)
+        with self._lock:
+            r = self._rng.random()
+            r_kind = self._rng.random()
+        if p <= 0.0 or r >= p:
+            return None
+        if op == "spill_write":
+            kind = "enospc" if r_kind < 0.33 else "eio"
+        else:
+            kind = "truncate" if r_kind < 0.25 else "eio"
+        self.stats[(op, kind)] += 1
+        return kind
+
+
+def maybe_disk_injector(role: str) -> Optional[DiskFaultInjector]:
+    """Spill-path activation hook (mirrors :func:`maybe_injector`):
+    returns a disk-fault injector when chaos env vars are set with a
+    non-zero disk fault mix, else None."""
+    cfg = ChaosConfig.from_env()
+    if cfg is None or (cfg.disk_fault_prob <= 0.0 and not cfg.disk):
+        return None
+    sid = os.environ.get(ENV_STREAM_ID, "")
+    stream = f"{role}:{sid}" if sid else role
+    inj = DiskFaultInjector(cfg, stream)
+    logger.warning("chaos: disk-fault injection ACTIVE (seed=%d "
+                   "stream=%s)", cfg.seed, stream)
+    return inj
 
 
 class ChaosMonkey:
@@ -342,6 +489,10 @@ class ChaosMonkey:
         old = head.controller
         self.log.append(("restart_controller",))
         old._shutdown.set()
+        rel = getattr(old, "_reliable", None)
+        if rel is not None:
+            # a kill -9 takes the retransmit thread with it too
+            rel.stop()
         try:
             old._wake_send.send(b"")
         except Exception:

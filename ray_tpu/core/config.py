@@ -133,6 +133,31 @@ class Config:
     task_oom_retries: int = 15
     oom_retry_delay_s: float = 1.0
 
+    # --- reliable delivery (core/reliable.py: ack/retransmit for the
+    # critical one-way control messages; reference role: gRPC retries +
+    # raylet lease/reconnect give the reference at-least-once RPCs) ---
+    #: RAY_TPU_RELIABLE_DELIVERY=0 disables the sublayer (messages fall
+    #: back to fire-and-forget; chaos drops of the critical set become
+    #: designed-in hangs again).
+    reliable_delivery: bool = True
+    #: Retransmit backoff: equal-jitter exponential, base * 2^attempt
+    #: capped. The base floor (base/2) must exceed the batched-ack RTT.
+    retransmit_base_s: float = 0.25
+    retransmit_cap_s: float = 5.0
+    #: Give up (typed DeliveryFailedError via the on_fail hook) after
+    #: this many transmissions without an ack or peer-death notice.
+    #: Sized so a healed multi-second partition always recovers first.
+    retransmit_max_attempts: int = 12
+    #: Batched acks flush within this window (effectively piggybacking
+    #: on traffic bursts without a per-message ack send).
+    ack_flush_delay_s: float = 0.02
+    #: Actor-side in-order admission: how long a call may wait for a
+    #: missing predecessor (a dropped ACTOR_CALL being retransmitted)
+    #: before the gap is skipped (reference:
+    #: actor_scheduling_queue reorder wait). Sized to cover several
+    #: retransmit backoff rounds; bounds delay, never hangs.
+    actor_reorder_wait_s: float = 10.0
+
     # --- retries / fault tolerance hardening ---
     #: Lease/reconnect retry backoff: exponential with full jitter,
     #: base * 2^attempt capped at the cap (reference retry shape; the

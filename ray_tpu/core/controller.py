@@ -30,6 +30,7 @@ import zmq
 
 from ray_tpu.core import chaos as CH
 from ray_tpu.core import protocol as P
+from ray_tpu.core import reliable as RD
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from ray_tpu.core.reference_counter import GlobalRefTable
@@ -109,6 +110,14 @@ class Controller:
         self._chaos = CH.maybe_injector("controller")
         self._chaos_dedup = CH.SeqDeduper() if self._chaos is not None \
             else None
+        # reliable-delivery sublayer: TASK_DISPATCH/TASK_ASSIGN/
+        # TASK_RESULT to workers, nodes and owners get ack/retransmit;
+        # resends re-enter _send (thread-safe cross-thread marshal)
+        self._reliable = RD.maybe_transport(
+            config, lambda t, mt, pl: self._send(t, mt, pl),
+            lambda route, pl: self._send(route, P.MSG_ACK, pl),
+            rng=self._chaos.rng_for("retransmit")
+            if self._chaos is not None else None, name="controller")
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.ROUTER)
         self.sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
@@ -287,6 +296,8 @@ class Controller:
 
     def stop(self) -> None:
         self._shutdown.set()
+        if self._reliable is not None:
+            self._reliable.stop()
         with self._send_lock:
             pass
         try:
@@ -379,6 +390,11 @@ class Controller:
         """Thread-safe send. Loop-thread sends are buffered per peer and
         flushed at the end of the handling cycle (order-preserving);
         cross-thread sends are marshaled through the wake channel."""
+        if self._reliable is not None:
+            # stamp + ring-record critical one-way messages before the
+            # chaos filter (a dropped message must already be tracked);
+            # retransmitted payloads pass through untouched
+            payload = self._reliable.stamp(identity, mtype, payload)
         if self._chaos is not None:
             for delay_s, pl in self._chaos.plan_send(
                     identity, mtype, payload):
@@ -459,6 +475,9 @@ class Controller:
         if self._chaos_dedup is not None and CH.check_dedup(
                 self._chaos_dedup, payload):
             return  # injected duplicate of a message already handled
+        if self._reliable is not None and \
+                self._reliable.on_receive(identity, payload):
+            return  # retransmit duplicate of a handled message
         if identity not in self.peers and mtype != P.REGISTER:
             # a peer from before a controller restart: process its message
             # (handlers tolerate unknown senders) and ask it to re-announce
@@ -2149,6 +2168,10 @@ class Controller:
     def _h_worker_exit(self, identity: bytes, m: dict) -> None:
         """Node manager reports a worker process died."""
         worker_identity = m.get("worker_identity")
+        if worker_identity and self._reliable is not None:
+            # peer-death notice: the task failover below is the
+            # recovery — abandon retransmits into the dead worker
+            self._reliable.drop_target(worker_identity)
         node = self.nodes.get(m.get("node_id") or b"")
         if node is not None and worker_identity in node.all_workers:
             del node.all_workers[worker_identity]
@@ -2463,6 +2486,8 @@ class Controller:
 
     def _on_node_dead(self, node: NodeInfo) -> None:
         logger.warning("node %s declared dead", node.node_id.hex()[:12])
+        if self._reliable is not None:
+            self._reliable.drop_target(node.identity)
         node.alive = False
         node.resources.alive = False
         self.scheduler.remove_node(node.node_id)
@@ -2594,6 +2619,10 @@ class Controller:
         for identity in self.subs.get("*", ()):
             self._send(identity, P.PUBSUB, {"channel": channel, "data": data})
 
+    def _h_msg_ack(self, identity: bytes, m: dict) -> None:
+        if self._reliable is not None:
+            self._reliable.on_ack(m)
+
     def _h_shutdown(self, identity: bytes, m: dict) -> None:
         for node in self.nodes.values():
             self._send(node.identity, P.SHUTDOWN, {})
@@ -2632,5 +2661,6 @@ class Controller:
         P.TIMELINE_EVENTS: _h_timeline,
         P.SUBSCRIBE: _h_subscribe,
         P.PUBSUB: _h_pubsub,
+        P.MSG_ACK: _h_msg_ack,
         P.SHUTDOWN: _h_shutdown,
     }

@@ -12,6 +12,7 @@ class: the segment is the data plane.
 from __future__ import annotations
 
 import ctypes
+import errno
 import os
 import threading
 import time
@@ -209,6 +210,20 @@ class NativeShmStore:
         self._sealed: "OrderedDict[ObjectID, int]" = OrderedDict()
         self._pinned: Dict[ObjectID, int] = {}
         self._spilled: Dict[ObjectID, str] = {}
+        #: consecutive failed restore reads per object (disk faults):
+        #: below the cap the failure is reported transient ("retry");
+        #: at the cap the backing copy is declared lost so the
+        #: controller can re-pull from another holder
+        self._restore_strikes: Dict[ObjectID, int] = {}
+        #: seeded spill-path fault injection (chaos.py); None in
+        #: production — the spill/restore hot path stays untouched
+        self._disk_chaos = None
+        if spill_dir:
+            try:
+                from ray_tpu.core import chaos as _chaos
+                self._disk_chaos = _chaos.maybe_disk_injector("node")
+            except Exception:
+                pass
         #: freshly-restored objects are exempt from spilling briefly —
         #: without the grace window, memory pressure can re-spill an
         #: object between its restore RPC reply and the requester's
@@ -234,9 +249,19 @@ class NativeShmStore:
                               min(chunk, budget - off))
 
     # --- bookkeeping (same contract as ShmObjectStore) ---
-    def on_sealed(self, object_id: ObjectID, size: int) -> None:
+    def on_sealed(self, object_id: ObjectID, size: int,
+                  grace: bool = False) -> None:
         with self._lock:
             self._sealed[object_id] = size
+            if grace:
+                # fresh-arrival grace (transfer receives), same
+                # rationale as the restore grace: an object pulled FOR
+                # a waiting consumer must not be re-spilled before that
+                # consumer takes its read lease (observed as a
+                # re-pull/re-spill livelock when an over-budget
+                # object's only healthy copy is remote and the local
+                # backing copy is disk-faulted)
+                self._restore_grace[object_id] = time.monotonic() + 2.0
             self._maybe_evict_locked()
 
     def pin(self, object_id: ObjectID) -> None:
@@ -374,8 +399,29 @@ class NativeShmStore:
             # don't rewrite an existing backing copy: the object can be
             # in BOTH places when a duplicate execution (at-least-once
             # resubmit) re-created an already-spilled object's extent
-            with open(dst, "wb") as f:
-                f.write(self.seg.view[off:off + size])
+            try:
+                if self._disk_chaos is not None:
+                    kind = self._disk_chaos.fault("spill_write")
+                    if kind == "enospc":
+                        raise OSError(errno.ENOSPC,
+                                      "injected spill ENOSPC")
+                    if kind is not None:
+                        raise OSError(errno.EIO, "injected spill EIO")
+                with open(dst, "wb") as f:
+                    f.write(self.seg.view[off:off + size])
+            except OSError as e:
+                # the disk refused the spill (EIO/ENOSPC, injected or
+                # real): the extent is still the only copy — keep it
+                # resident, drop the partial file, and let a later
+                # sweep retry. Pressure degrades to no-progress here
+                # instead of data loss.
+                try:
+                    os.unlink(dst)
+                except OSError:
+                    pass
+                logger.warning("spill of %s failed (%s); keeping the "
+                               "object resident", object_id.hex()[:12], e)
+                return
         if self.seg.evict(object_id) == 0:
             # A live reader holds the extent; leave it resident. Only
             # remove the file WE just wrote — unlinking a pre-existing
@@ -407,8 +453,27 @@ class NativeShmStore:
         if for_pid:
             self.seg.acquire_for(object_id, int(for_pid))
 
+    def _local_copy_lost_locked(self, object_id: ObjectID,
+                                spath: str) -> str:
+        """The backing copy is unusable (persistent EIO / truncation):
+        forget it so location lookups stop routing here — the caller
+        reports the stale holder and the controller re-pulls from
+        another holder or reconstructs via lineage. Only after THOSE
+        fail does anything surface ObjectLostError."""
+        self._restore_strikes.pop(object_id, None)
+        self._spilled.pop(object_id, None)
+        try:
+            os.unlink(spath)
+        except OSError:
+            pass
+        return "lost"
+
     def maybe_restore(self, object_id: ObjectID,
                       for_pid: Optional[int] = None) -> bool:
+        """True = resident (restored or already there); "retry" =
+        transient pressure/fault, ask again; "lost" = the local backing
+        copy is gone for good (re-pull from another holder); False =
+        this node never had it."""
         with self._lock:
             spath = self._spilled.get(object_id)
             if spath is None:
@@ -468,10 +533,38 @@ class NativeShmStore:
                 # restore grace): transient — callers must retry, not
                 # declare the object lost
                 return "retry"
-            with open(spath, "rb") as f:
-                f.readinto(self.seg.view[off:off + size])
+            try:
+                with open(spath, "rb") as f:
+                    n_read = f.readinto(self.seg.view[off:off + size])
+                if self._disk_chaos is not None:
+                    kind = self._disk_chaos.fault("restore_read")
+                    if kind == "truncate":
+                        n_read = size // 2
+                    elif kind is not None:
+                        raise OSError(errno.EIO, "injected restore EIO")
+            except OSError as e:
+                # transient I/O failure: free the half-written extent so
+                # a retry can re-alloc, and back off through the caller.
+                # A few consecutive strikes declare the copy unusable.
+                self.seg.delete(object_id)
+                strikes = self._restore_strikes.get(object_id, 0) + 1
+                self._restore_strikes[object_id] = strikes
+                logger.warning("restore of %s failed (%s), strike %d",
+                               object_id.hex()[:12], e, strikes)
+                if strikes < 3:
+                    return "retry"
+                return self._local_copy_lost_locked(object_id, spath)
+            if n_read < size:
+                # truncated backing file (torn write, disk corruption):
+                # retrying cannot heal it — drop the copy immediately
+                self.seg.delete(object_id)
+                logger.warning(
+                    "restore of %s: truncated backing file (%d/%d "
+                    "bytes)", object_id.hex()[:12], n_read, size)
+                return self._local_copy_lost_locked(object_id, spath)
             self.seg.seal(object_id)
             os.unlink(spath)
+            self._restore_strikes.pop(object_id, None)
             self._spilled.pop(object_id, None)
             self._sealed[object_id] = size
             self._restore_grace[object_id] = time.monotonic() + 2.0

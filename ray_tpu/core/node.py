@@ -29,6 +29,7 @@ import zmq
 from ray_tpu.core import chaos as CH
 from ray_tpu.core import direct as D
 from ray_tpu.core import protocol as P
+from ray_tpu.core import reliable as RD
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
 from ray_tpu.core.shm_store import make_client, make_store
@@ -192,9 +193,17 @@ class NodeManager:
         self._chaos = CH.maybe_injector("node")
         self._chaos_dedup = CH.SeqDeduper() if self._chaos is not None \
             else None
-        #: chaos-delayed direct sends parked by timer threads; drained
-        #: by the message loop (peer sockets are loop-thread-only)
+        #: chaos-delayed direct sends (timer threads) and reliable-layer
+        #: direct acks parked here; drained by the message loop (peer
+        #: sockets are loop-thread-only)
         self._chaos_delayed: "deque" = deque()
+        # reliable-delivery sublayer: the node's critical one-way
+        # traffic is controller-bound (PUT_OBJECT announcements); it
+        # also acks the controller's TASK_ASSIGNs
+        self._reliable = RD.maybe_transport(
+            self.config, self._reliable_resend, self._reliable_ack,
+            rng=self._chaos.rng_for("retransmit")
+            if self._chaos is not None else None, name="node")
 
     # ------------------------------------------------------------------ run
     def _register_with_controller(self) -> None:
@@ -296,6 +305,8 @@ class NodeManager:
 
     def stop(self) -> None:
         self._stopped.set()
+        if self._reliable is not None:
+            self._reliable.stop()
         with self._workers_lock:
             procs = list(self.workers.values())
         for p in procs:
@@ -336,7 +347,28 @@ class NodeManager:
         self.shm.close()
         self.store.destroy()
 
+    def _reliable_resend(self, target, mtype: bytes, payload) -> None:
+        """Retransmit hook (reliable-layer thread): controller-bound
+        messages re-enter _send (chaos filter re-applied; the stamp is
+        idempotent); direct-channel resends park for the loop thread."""
+        if self._stopped.is_set():
+            return
+        if target is None:
+            self._send(mtype, payload)
+        else:
+            self._chaos_delayed.append((target, mtype, payload))
+
+    def _reliable_ack(self, route, payload) -> None:
+        if self._stopped.is_set():
+            return
+        if route is None:
+            self._send(P.MSG_ACK, payload)
+        else:
+            self._chaos_delayed.append((route, P.MSG_ACK, payload))
+
     def _send(self, mtype: bytes, payload) -> None:
+        if self._reliable is not None:
+            payload = self._reliable.stamp(None, mtype, payload)
         if self._chaos is not None:
             for delay_s, pl in self._chaos.plan_send(None, mtype, payload):
                 if delay_s > 0.0:
@@ -451,6 +483,12 @@ class NodeManager:
         if self._chaos_dedup is not None and CH.check_dedup(
                 self._chaos_dedup, m):
             return  # injected duplicate of a message already handled
+        if self._reliable is not None:
+            if mtype == P.MSG_ACK:
+                self._reliable.on_ack(m)
+                return
+            if self._reliable.on_receive(None, m):
+                return  # retransmit duplicate of a handled message
         if mtype == P.MSG_BATCH:
             for sub_type, sub_payload in m["msgs"]:
                 try:
@@ -765,6 +803,22 @@ class NodeManager:
         if self._chaos_dedup is not None and CH.check_dedup(
                 self._chaos_dedup, m):
             return  # injected duplicate of a message already handled
+        if self._reliable is not None:
+            if mtype == P.MSG_ACK:
+                self._reliable.on_ack(m)
+                return
+            if self._reliable.on_receive(sender, m):
+                return
+        if mtype == P.MSG_BATCH:
+            # a peer's flusher can coalesce several direct messages
+            # (e.g. concurrent STORE_RPCs) into one batch frame
+            for sub_type, sub_payload in m["msgs"]:
+                try:
+                    self._handle_direct(sender, sub_type, sub_payload)
+                except Exception:
+                    logger.exception("node: error in batched direct %s",
+                                     sub_type)
+            return
         if mtype == P.STORE_RPC:
             # spill/restore move megabytes through disk: never on the
             # message loop (it also carries heartbeats and transfers).
@@ -833,6 +887,15 @@ class NodeManager:
                 # NativeShmStore.maybe_restore): tell the caller to
                 # retry instead of giving up
                 out["retry"] = result == "retry"
+                if result == "lost":
+                    # the local backing copy is unusable (disk faults /
+                    # truncation): report ourselves as a stale holder so
+                    # the controller prunes the location and re-pulls
+                    # from another holder / reconstructs via lineage
+                    self._send(P.PULL_FAILED, {
+                        "object_id": m["object_id"],
+                        "src_node": self.node_id.binary(),
+                        "stale_src": True})
             else:
                 out["error"] = f"unknown store op {op!r}"
         except Exception as e:  # noqa: BLE001
@@ -1040,7 +1103,10 @@ class NodeManager:
             pull["deadline"] = time.monotonic() + self.config.pull_timeout_s
         if len(st["seqs"]) >= m["nchunks"]:
             self.shm.seal(oid)
-            self.store.on_sealed(oid, m["total"])
+            try:
+                self.store.on_sealed(oid, m["total"], grace=True)
+            except TypeError:
+                self.store.on_sealed(oid, m["total"])
             del self._incoming[b]
             self._finish_pull(b)
             self._send(P.PUT_OBJECT, {

@@ -19,6 +19,7 @@ import os
 import pickle
 import struct
 import threading
+import time
 from typing import Any, Dict, Optional
 
 # ---- message types ----
@@ -116,6 +117,11 @@ SUBSCRIBE = b"SSC"           # {channel}
 GENERIC_REPLY = b"RPL"
 ERROR_REPLY = b"ERR"
 MSG_BATCH = b"MBB"           # {msgs: [(mtype, payload), ...]} — wire batching
+MSG_ACK = b"ACK"             # {acks: [(sender_tag, [(lo, hi), ...])]}:
+                             # batched ack ranges for reliably-delivered
+                             # one-way messages (core/reliable.py). Never
+                             # itself tracked — a lost ack just costs one
+                             # deduped retransmit.
 
 _DUMPS_PROTO = 5
 
@@ -170,13 +176,16 @@ class ReplyWaiter:
         ev.set()
         return True
 
-    def wait(self, rid: bytes, timeout: Optional[float]) -> Any:
+    def wait(self, rid: bytes, timeout: Optional[float],
+             mtype: Optional[bytes] = None) -> Any:
+        started = time.monotonic()
         with self._lock:
             ev = self._events[rid]
         if not ev.wait(timeout):
             with self._lock:
                 self._events.pop(rid, None)
-            raise TimeoutError("control-plane RPC timed out")
+            from ray_tpu.exceptions import RpcTimeoutError
+            raise RpcTimeoutError(mtype, time.monotonic() - started)
         with self._lock:
             self._events.pop(rid, None)
             return self._replies.pop(rid)

@@ -26,6 +26,7 @@ import zmq
 from ray_tpu.core import chaos as CH
 from ray_tpu.core import direct as D
 from ray_tpu.core import protocol as P
+from ray_tpu.core import reliable as RD
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.ids import (
     ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID)
@@ -84,6 +85,14 @@ class Runtime:
         self._topup_backoff = ExponentialBackoff(
             base=self.config.lease_backoff_base_s,
             cap=self.config.lease_backoff_cap_s, rng=_bo_rng)
+        # reliable-delivery sublayer (core/reliable.py): critical one-way
+        # messages get ack/retransmit; retransmit duplicates are deduped
+        # receiver-side. Resends re-enter the flusher queue (thread-safe)
+        # and pass the chaos filter again like any first transmission.
+        self._reliable = RD.maybe_transport(
+            self.config, self._reliable_resend, self._reliable_ack,
+            rng=self._chaos.rng_for("retransmit")
+            if self._chaos is not None else None, name=kind)
 
         self.memory_store = InProcessStore()
         self.reference_counter = ReferenceCounter(self._flush_ref_deltas)
@@ -275,6 +284,19 @@ class Runtime:
                 logger.exception("completion callback failed")
 
     # ------------------------------------------------------------ transport
+    def _reliable_resend(self, target, mtype: bytes, payload) -> None:
+        """Retransmit hook (reliable-layer thread): re-enqueue through
+        the flusher so the resend takes the same path — stamped payloads
+        pass through ``stamp()`` untouched."""
+        if not self._stopped.is_set():
+            self._out_q.put((target, mtype, payload))
+
+    def _reliable_ack(self, route, payload) -> None:
+        """Batched-ack hook: ship back over the link the stamped
+        messages arrived on (None = the controller DEALER)."""
+        if not self._stopped.is_set():
+            self._out_q.put((route, P.MSG_ACK, payload))
+
     def _send(self, mtype: bytes, payload: Any) -> None:
         self._out_q.put((None, mtype, payload))
 
@@ -424,6 +446,11 @@ class Runtime:
         if not msgs:
             return
         # getattr: unit tests drive _flush_box on bare fakes
+        rel = getattr(self, "_reliable", None)
+        if rel is not None:
+            # stamp + ring-record critical one-way messages BEFORE the
+            # chaos filter: a dropped message must already be tracked
+            msgs = [(mt, rel.stamp(target, mt, pl)) for mt, pl in msgs]
         if getattr(self, "_chaos", None) is not None:
             msgs = self._chaos_filter(target, msgs)
             if not msgs:
@@ -471,7 +498,8 @@ class Runtime:
         rid = self.replies.new_request()
         payload = dict(payload, rid=rid)
         self._send(mtype, payload)
-        reply = self.replies.wait(rid, timeout or self.config.rpc_timeout_s)
+        reply = self.replies.wait(rid, timeout or self.config.rpc_timeout_s,
+                                  mtype=mtype)
         if isinstance(reply, dict) and reply.get("__error__"):
             raise RuntimeError(reply["data"])
         return reply
@@ -513,19 +541,29 @@ class Runtime:
                         break
                     try:
                         # [sender identity, mtype, payload]
-                        self._on_message(frames[1], P.loads(frames[2]))
+                        self._on_message(frames[1], P.loads(frames[2]),
+                                         source=frames[0])
                     except Exception:
                         logger.exception("%s: error handling direct %s",
                                          self.kind, frames[1])
 
-    def _on_message(self, mtype: bytes, m: dict) -> None:
+    def _on_message(self, mtype: bytes, m: dict, source=None) -> None:
         if self._chaos_dedup is not None and CH.check_dedup(
                 self._chaos_dedup, m):
             return  # injected duplicate of a message already handled
+        if self._reliable is not None:
+            if mtype == P.MSG_ACK:
+                self._reliable.on_ack(m)
+                return
+            # ``source`` routes the batched ack: None = controller
+            # link, else the direct-channel sender's identity. Local
+            # short-circuited sends are never stamped, so this no-ops.
+            if self._reliable.on_receive(source, m):
+                return  # retransmit duplicate of a handled message
         if mtype == P.MSG_BATCH:
             for sub_type, sub_payload in m["msgs"]:
                 try:
-                    self._on_message(sub_type, sub_payload)
+                    self._on_message(sub_type, sub_payload, source)
                 except Exception:
                     logger.exception("%s: error in batched %s", self.kind,
                                      sub_type)
@@ -662,6 +700,8 @@ class Runtime:
         self.reference_counter.flush()
         self.flush_timeline()
         self._stopped.set()
+        if self._reliable is not None:
+            self._reliable.stop()
         self._cb_queue.put(None)
         # sentinel after the final enqueues: FIFO guarantees they flush
         self._out_q.put(None)
@@ -944,7 +984,7 @@ class Runtime:
         node_identity = b"N" + self.node_id.binary()[:27]
         self._send_direct(node_identity, P.STORE_RPC,
                           dict(params, op=op, rid=rid))
-        return self.replies.wait(rid, timeout) or {}
+        return self.replies.wait(rid, timeout, mtype=P.STORE_RPC) or {}
 
     def _on_task_result(self, m: dict) -> None:
         aid = m.get("actor_id")
@@ -1167,31 +1207,41 @@ class Runtime:
                     self.serialization.deserialize_from_view_tracked(view)
                 self._cache_shm_value(oid, value, bufs)
                 return value
-        # remote: ask controller to make it local (or hand us inline bytes)
-        reply = self.request(P.GET_LOCATION, {
-            "object_id": oid.binary(), "want_node": self.node_id.binary()},
-            timeout=self.config.rpc_timeout_s * 4)
-        if reply.get("error") is not None:
-            err = P.loads(reply["error"])
-            self.memory_store.put(oid, None, error=err, force=True)
-            raise err
-        if reply.get("inline") is not None:
-            value, _ = self.serialization.deserialize_from_view(
-                memoryview(reply["inline"]))
-            self.memory_store.put(oid, value, force=True)
-            return value
-        if self.shm is None:
-            raise RuntimeError("no shm store attached; cannot fetch object")
-        view = self.shm.get_view(oid, timeout=self.config.rpc_timeout_s)
-        if view is None:
-            view = self._restore_local(oid)
-        if view is None:
-            from ray_tpu.exceptions import ObjectLostError
-            raise ObjectLostError(oid)
-        value, _, bufs = \
-            self.serialization.deserialize_from_view_tracked(view)
-        self._cache_shm_value(oid, value, bufs)
-        return value
+        # remote: ask controller to make it local (or hand us inline
+        # bytes). Bounded retry loop: the reply only lands once the
+        # object is supposedly local, but the local copy can be a
+        # disk-faulted spill — the node reports the stale holder
+        # (PULL_FAILED) while we re-ask, and the controller re-pulls
+        # from another holder / reconstructs before answering again.
+        # Only after the retries is the typed ObjectLostError raised.
+        for attempt in range(3):
+            reply = self.request(P.GET_LOCATION, {
+                "object_id": oid.binary(),
+                "want_node": self.node_id.binary()},
+                timeout=self.config.rpc_timeout_s * 4)
+            if reply.get("error") is not None:
+                err = P.loads(reply["error"])
+                self.memory_store.put(oid, None, error=err, force=True)
+                raise err
+            if reply.get("inline") is not None:
+                value, _ = self.serialization.deserialize_from_view(
+                    memoryview(reply["inline"]))
+                self.memory_store.put(oid, value, force=True)
+                return value
+            if self.shm is None:
+                raise RuntimeError(
+                    "no shm store attached; cannot fetch object")
+            view = self.shm.get_view(oid, timeout=2.0)
+            if view is None:
+                view = self._restore_local(oid)
+            if view is not None:
+                value, _, bufs = \
+                    self.serialization.deserialize_from_view_tracked(view)
+                self._cache_shm_value(oid, value, bufs)
+                return value
+            time.sleep(0.2 * (attempt + 1))
+        from ray_tpu.exceptions import ObjectLostError
+        raise ObjectLostError(oid)
 
     def _cache_shm_value(self, oid: ObjectID, value: Any,
                          buffer_views: Optional[list] = None) -> None:
@@ -1663,6 +1713,10 @@ class Runtime:
         still tracked here never reported a result). If it was merely
         reclaimed (queue starvation), its queued direct tasks still
         complete — just stop sending it new ones."""
+        if dead and self._reliable is not None:
+            # peer-death notice: the resubmit below IS the recovery;
+            # retransmitting into a dead worker only delays it
+            self._reliable.drop_target(worker)
         resubmit: List[TaskSpec] = []
         with self._lease_lock:
             try:
@@ -1767,16 +1821,31 @@ class Runtime:
                     # then resend this call full (which re-registers its
                     # own template in the same message)
                     st["tmpls"] = {}
-                    self._send_direct(st["worker"], P.ACTOR_CALL,
-                                      self._actor_call_msg(st, spec))
+                    self._send_direct(
+                        st["worker"], P.ACTOR_CALL,
+                        self._actor_call_msg(st, spec, keep_seq=True))
                     return
 
-    def _actor_call_msg(self, st: dict, spec: TaskSpec) -> dict:
+    def _actor_call_msg(self, st: dict, spec: TaskSpec,
+                        keep_seq: bool = False) -> dict:
         """Wire form of one actor call. The spec is mostly static per
         method: ship it once as a TEMPLATE, then only the dynamic fields
         (reference: the submitter's push_normal_task payload is protobuf
         with the same static/dynamic split done by field encoding).
-        Caller holds _actors_lock."""
+        Caller holds _actors_lock.
+
+        Sequence numbers are assigned HERE, at send time, one monotonic
+        stream per (this caller, actor incarnation) — reference:
+        CoreWorkerDirectActorTaskSubmitter's seq_no. The actor-side
+        sequencer (worker._CallSequencer) uses them to execute calls in
+        submission order even when the reliable layer's retransmits
+        deliver them out of order. ``keep_seq`` re-sends (TMPL_MISS)
+        reuse the call's original seq: the worker dropped that compact
+        call BEFORE sequencing, so the resend must fill its own slot —
+        a fresh seq would leave a permanent gap."""
+        if not keep_seq:
+            st["seq"] = st.get("seq", 0) + 1
+            spec.sequence_number = st["seq"]
         if spec.runtime_env or spec.resources:
             # rare per-call variability: don't template
             return {"spec": spec}
@@ -1833,6 +1902,12 @@ class Runtime:
             else:
                 worker = reply["worker"]
                 st["state"] = "DIRECT"
+                if worker != st["worker"]:
+                    # a NEW incarnation: its executor state is fresh, so
+                    # the seq stream restarts at 1 (the sequencer inits
+                    # per-caller streams there). A same-worker re-resolve
+                    # (controller restart) must keep the stream running.
+                    st["seq"] = 0
                 st["worker"] = worker
                 st["tmpls"] = {}  # templates are per worker incarnation
                 to_send = st["queue"]
@@ -1855,6 +1930,7 @@ class Runtime:
                 if st is None or st["state"] == "DEAD":
                     return
                 st["state"] = "RESOLVING"
+                old_worker = st["worker"]
                 st["worker"] = None
                 # inflight calls may or may not have executed; resubmit only
                 # those the user marked retriable (reference semantics:
@@ -1866,6 +1942,11 @@ class Runtime:
                 st["inflight"] = {}
                 st["queue"] = retry + st["queue"]
                 need_resolve = True
+            if old_worker is not None and self._reliable is not None:
+                # calls in flight to the restarting incarnation are
+                # resubmitted (or typed-failed) below: abandon their
+                # retransmits to the old worker
+                self._reliable.drop_target(old_worker)
             # the actor is NOT dead — calls that raced the restart and
             # are not retriable surface the typed "temporarily
             # unreachable" error (reference: ActorUnavailableError),
@@ -1888,9 +1969,14 @@ class Runtime:
                     return
                 st["state"] = "DEAD"
                 st["error"] = err
+                worker = st.get("worker")
                 to_fail = st["queue"] + list(st["inflight"].values())
                 st["queue"] = []
                 st["inflight"] = {}
+            if worker is not None and self._reliable is not None:
+                # stop retransmitting queued calls into the dead actor's
+                # worker — the local failure below is the recovery
+                self._reliable.drop_target(worker)
             for s in to_fail:
                 self._fail_actor_task_local(s, err)
 

@@ -79,7 +79,10 @@ class ShmObjectStore:
         self._spilled: Dict[ObjectID, str] = {}
 
     # --- server-side bookkeeping (node manager) ---
-    def on_sealed(self, object_id: ObjectID, size: int) -> None:
+    def on_sealed(self, object_id: ObjectID, size: int,
+                  grace: bool = False) -> None:
+        # ``grace`` (fresh-arrival spill grace) is a NativeShmStore
+        # refinement; the python fallback store accepts and ignores it
         with self._lock:
             self._sealed[object_id] = size
             self._used += size

@@ -40,6 +40,89 @@ from ray_tpu.exceptions import TaskCancelledError, TaskError
 logger = logging.getLogger(__name__)
 
 
+class _CallSequencer:
+    """In-order admission for direct actor calls (reference: the
+    ActorSchedulingQueue's seq_no ordering, actor_scheduling_queue.h).
+    The submitter numbers calls per (caller, actor incarnation) at send
+    time; this buffer releases them to the executor in that order,
+    absorbing the reordering the reliable layer's retransmits can
+    introduce (a dropped ACTOR_CALL is redelivered AFTER younger calls).
+
+    Never a hang, always bounded delay: a gap that doesn't fill within
+    ``hold_timeout`` is skipped (the missing call may genuinely never
+    arrive — its sender can die mid-stream), every stream starts at
+    seq 1 (submitters restart numbering per actor incarnation, so a
+    reordered FIRST pair is still caught), and seqs below the stream
+    cursor run immediately (controller-path retries of already-admitted
+    calls). In a fault-free run every call arrives in order, so this is
+    a dict lookup per call and nothing is ever held."""
+
+    def __init__(self, deliver, hold_timeout: float = 10.0):
+        self._deliver = deliver
+        self._hold_timeout = hold_timeout
+        self._lock = threading.Lock()
+        self._next: Dict[bytes, int] = {}
+        self._held: Dict[bytes, Dict[int, dict]] = {}
+        self._timers: Dict[bytes, threading.Timer] = {}
+
+    def admit(self, caller: bytes, seq: int, m: dict) -> None:
+        with self._lock:
+            nxt = self._next.get(caller, 1)
+            if seq > nxt:
+                held = self._held.setdefault(caller, {})
+                held[seq] = m
+                if len(held) > 512:
+                    # pathological gap (or a stream the sender reset
+                    # without us noticing): stop buffering, run in order
+                    self._flush_locked(caller)
+                elif caller not in self._timers:
+                    t = threading.Timer(self._hold_timeout,
+                                        self._on_timeout, args=(caller,))
+                    t.daemon = True
+                    self._timers[caller] = t
+                    t.start()
+                return
+            if seq == nxt:
+                nxt += 1
+            # delivery happens under the lock: a concurrent timeout
+            # flush must not interleave its batch with this one
+            self._deliver(m)
+            held = self._held.get(caller)
+            while held and nxt in held:
+                self._deliver(held.pop(nxt))
+                nxt += 1
+            self._next[caller] = nxt
+            if not held:
+                t = self._timers.pop(caller, None)
+                if t is not None:
+                    t.cancel()
+
+    def _on_timeout(self, caller: bytes) -> None:
+        with self._lock:
+            self._timers.pop(caller, None)
+            self._flush_locked(caller)
+
+    def _flush_locked(self, caller: bytes) -> None:
+        held = self._held.get(caller)
+        if not held:
+            return
+        # a skipped gap is legal (bounded-delay ordering, never a hang)
+        # but worth a line: at sane drop rates it means the missing
+        # call's sender died mid-stream
+        logger.warning(
+            "actor-call stream from %s: predecessor seq %d never "
+            "arrived within the reorder wait; running %d held calls",
+            caller.hex()[:8], self._next.get(caller, 1), len(held))
+        for seq in sorted(held):
+            self._deliver(held[seq])
+        self._next[caller] = max(self._next.get(caller, 1),
+                                 max(held) + 1)
+        held.clear()
+        t = self._timers.pop(caller, None)
+        if t is not None:
+            t.cancel()
+
+
 class WorkerExecutor:
     def __init__(self, runtime: Runtime):
         self.runtime = runtime
@@ -74,6 +157,13 @@ class WorkerExecutor:
         #: check could land in the queue after the drain and wedge behind
         #: the blocked serial thread)
         self._block_lock = threading.Lock()
+        #: per-caller in-order admission for direct actor calls (the
+        #: reliable layer redelivers drops out of order; see
+        #: _CallSequencer)
+        self._sequencer = _CallSequencer(
+            self._admit_actor,
+            hold_timeout=getattr(runtime.config,
+                                 "actor_reorder_wait_s", 10.0))
         self.runtime.set_dispatch_handler(self._on_dispatch)
         self.runtime.block_notifier = self
         self.runtime.busy_probe = \
@@ -198,6 +288,19 @@ class WorkerExecutor:
                     return
                 self._queue.put(m)
             return
+        if spec.is_actor_task and spec.sequence_number > 0 \
+                and spec.owner is not None:
+            # per-caller in-order admission: retransmitted calls can
+            # arrive after younger ones; the sequencer restores
+            # submission order before execution
+            self._sequencer.admit(spec.owner.binary(),
+                                  spec.sequence_number, m)
+            return
+        self._admit_actor(m)
+
+    def _admit_actor(self, m: dict) -> None:
+        """Queue one actor creation/call for execution (post-ordering)."""
+        spec: TaskSpec = m["spec"]
         if self.actor_instance is not None and spec.is_actor_task and (
                 self.actor_spec.max_concurrency > 1 or self.actor_spec.is_async_actor):
             # concurrent/async actors bypass the serial queue
@@ -436,12 +539,11 @@ class WorkerExecutor:
             })
         done_results = results
         if direct_ok and self.runtime._owner_local and error_blob is None \
-                and self.runtime._chaos is None \
                 and (driver_leased or spec.is_actor_task):
-            # (chaos gate: the trim assumes the direct RES push always
-            # lands. Under fault injection RES may be dropped, and the
-            # owner's grace-then-probe fallback can only recover if the
-            # controller directory kept the full result meta.)
+            # (The direct RES push is reliably delivered — ack +
+            # retransmit, core/reliable.py — so the trim is safe under
+            # injected drops too; the owner's grace-then-probe fallback
+            # now only covers worker death with the result unflushed.)
             # owner-local mode, direct dispatch (driver lease / actor
             # call): the owner (which just got TASK_RESULT) is the
             # authority for inline results — the controller neither

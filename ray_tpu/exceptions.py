@@ -129,6 +129,54 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     """``get(..., timeout=)`` expired before the object was ready."""
 
 
+class RpcTimeoutError(RayTpuError, TimeoutError):
+    """A control-plane request/reply RPC timed out.
+
+    Carries the wire message type and the elapsed wait so a timeout is
+    attributable from the exception alone (reference: gRPC deadline
+    exceeded statuses carry the method name). Subclasses TimeoutError so
+    pre-existing catch sites keep working.
+    """
+
+    def __init__(self, mtype: Optional[bytes] = None,
+                 elapsed_s: Optional[float] = None):
+        self.mtype = mtype
+        self.elapsed_s = elapsed_s
+        what = mtype.decode("ascii", "replace") if mtype else "?"
+        took = f" after {elapsed_s:.1f}s" if elapsed_s is not None else ""
+        super().__init__(
+            f"control-plane RPC {what} timed out{took}")
+
+    def __reduce__(self):
+        return (RpcTimeoutError, (self.mtype, self.elapsed_s))
+
+
+class DeliveryFailedError(RayTpuError):
+    """The reliable-delivery layer gave up on a one-way control message:
+    it was retransmitted to the attempt cap without an ack and the peer
+    was never declared dead. Surfaced through the transport's ``on_fail``
+    hook / ``failures`` list rather than raised at a call site — one-way
+    messages have no waiting caller.
+    """
+
+    def __init__(self, mtype: Optional[bytes] = None, target=None,
+                 attempts: int = 0, elapsed_s: float = 0.0):
+        self.mtype = mtype
+        self.target = target
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+        what = mtype.decode("ascii", "replace") if mtype else "?"
+        peer = target.hex()[:12] if isinstance(target, bytes) else \
+            ("controller" if target is None else repr(target))
+        super().__init__(
+            f"delivery of {what} to {peer} failed after {attempts} "
+            f"attempts over {elapsed_s:.1f}s (no ack, no death notice)")
+
+    def __reduce__(self):
+        return (DeliveryFailedError,
+                (self.mtype, self.target, self.attempts, self.elapsed_s))
+
+
 class ObjectStoreFullError(RayTpuError):
     """Shared-memory store is full and eviction/spill could not make room."""
 

@@ -66,9 +66,41 @@ def test_scalar_drop_prob_only_hits_recoverable_types():
     cfg = chaos.ChaosConfig(seed=5, drop_prob=1.0)
     inj = chaos.ChaosInjector(cfg, "driver")
     assert inj.plan_send(None, b"RES", {"x": 1}) == []
-    # TASK_DISPATCH has no retransmit: a scalar drop_prob must not
-    # touch it (needs an explicit per-type entry)
-    assert len(inj.plan_send(None, b"DSP", {"x": 1})) == 1
+    # with the retransmit/ack layer, dropping TASK_DISPATCH (and the
+    # rest of the critical one-way set) is recoverable — the scalar
+    # drop mix now covers the whole control plane
+    for mtype in (b"DSP", b"ACL", b"ASG", b"DON"):
+        assert inj.plan_send(None, mtype, {"x": 1}) == [], mtype
+    # request/reply types still need an explicit per-type entry: their
+    # recovery is the caller's RpcTimeoutError, not a retransmit
+    assert len(inj.plan_send(None, b"SUB", {"x": 1})) == 1
+
+
+def test_seq_dedup_cap_evicts_fifo():
+    """Cap-eviction contract (documented window): at overflow the
+    OLDEST entries are evicted first, and a late retransmit of an
+    evicted seq IS treated as new — the dedup window is the cap. The
+    retransmit layer keeps duplicates inside the window (a message is
+    acked or retried within a handful of messages), and every reliable
+    handler is first-wins, which bounds the blast radius of a
+    past-window replay."""
+    cap = 8192
+    dedup = chaos.SeqDeduper(cap=cap)
+    tag = b"sender-1"
+    for i in range(cap):
+        assert not dedup.seen((tag, i))
+    # replay inside the window: filtered
+    assert dedup.seen((tag, cap - 1))
+    assert dedup.dropped == 1
+    # overflow by one: seq 0 (FIFO-oldest) is evicted, newer survive
+    assert not dedup.seen((tag, cap))
+    assert not dedup.seen((tag, 0)), \
+        "evicted-oldest replay is (documented) treated as new"
+    # seq 1 was evicted by the (tag, 0) re-insert above — FIFO order —
+    # and its own re-insert evicts seq 2; seq 3 is still inside the
+    # window and filtered
+    assert not dedup.seen((tag, 1))
+    assert dedup.seen((tag, 3))
 
 
 def test_seq_dedup_drops_replay():
@@ -130,9 +162,21 @@ def test_backoff_full_jitter_bounds():
 # ----------------------------------------------------------- integration
 
 #: the mix every integration test runs under; drop targets are the
-#: types with proven recovery machinery (see chaos.DEFAULT_DROPPABLE)
+#: types with recovery machinery (see chaos.DEFAULT_DROPPABLE — since
+#: the retransmit/ack layer this covers the whole critical one-way set)
 CHAOS_MIX = {"drop_prob": 0.02, "dup_prob": 0.05, "delay_prob": 0.05,
              "delay_range_s": [0.001, 0.05]}
+
+#: the soak mix: >=5% drops across the widened droppable set
+#: (TASK_DISPATCH/ACTOR_CALL/TASK_ASSIGN/TASK_DONE included), one
+#: scheduled 2s controller<->node partition that heals mid-run, and
+#: seeded disk faults on the spill path (EIO/ENOSPC on spill writes,
+#: EIO/truncation on restore reads)
+SOAK_MIX = {"drop_prob": 0.05, "dup_prob": 0.05, "delay_prob": 0.05,
+            "delay_range_s": [0.001, 0.05],
+            "partitions": [{"start": 5.0, "end": 7.0,
+                            "a": "controller", "b": "node"}],
+            "disk": {"restore_read": 0.2, "spill_write": 0.15}}
 
 
 def _chaos_env(seed, mix=CHAOS_MIX):
@@ -178,14 +222,22 @@ def _assert_refcounts_drain(runtime, deadline_s=25.0):
 
 
 def _run_chaos_workload(seed, n_tasks, n_actor_calls, kills,
-                        restart_controller, deadline_s):
+                        restart_controller, deadline_s, mix=CHAOS_MIX,
+                        big_objects=0):
     """Submit a seeded mix of tasks + actor calls while the monkey
     kills workers (and optionally the controller) on a deterministic
-    schedule, then check the end-state invariants."""
-    _chaos_env(seed)
+    schedule, then check the end-state invariants. ``big_objects`` puts
+    that many shm-sized objects under a store budget small enough to
+    force spills, so the seeded disk faults on the spill path actually
+    fire; their gets must resolve to the value or a typed error."""
+    _chaos_env(seed, mix)
     try:
+        init_kw = {}
+        if big_objects:
+            # ~3 big objects fit the budget: the rest spill to disk
+            init_kw["object_store_memory"] = 24 << 20
         ray_tpu.init(num_cpus=4, _num_initial_workers=2,
-                     ignore_reinit_error=True)
+                     ignore_reinit_error=True, **init_kw)
         import ray_tpu.api as api
         from ray_tpu.core.global_state import global_worker
         monkey = chaos.ChaosMonkey(seed, head=api._head)
@@ -209,6 +261,12 @@ def _run_chaos_workload(seed, n_tasks, n_actor_calls, kills,
         # (anonymous actors are not WAL-persisted, by design)
         counter = Counter.options(name=f"chaos-{seed}",
                                   lifetime="detached").remote()
+        big_refs = []
+        if big_objects:
+            import numpy as np
+            for k in range(big_objects):
+                big_refs.append(ray_tpu.put(
+                    np.full(8 << 20, k % 251, dtype=np.uint8)))
         kill_at = sorted(monkey.rng.sample(
             range(10, n_tasks - 5), kills)) if kills else []
         restart_at = n_tasks // 2 if restart_controller else -1
@@ -249,8 +307,30 @@ def _run_chaos_workload(seed, n_tasks, n_actor_calls, kills,
         assert ok >= 1, f"no actor call survived: {typed_errors}"
         observed_pids |= set(monkey.worker_pids().values())
 
+        # ---- invariant: spilled-then-restored big objects resolve to
+        # their value or a typed error, never hang (injected disk
+        # faults can legitimately lose a put object's only copy after
+        # repeated EIO strikes — puts have no lineage to rebuild from)
+        big_ok = 0
+        for k, r in enumerate(big_refs):
+            remaining = max(10.0, deadline - time.monotonic())
+            try:
+                arr = ray_tpu.get(r, timeout=remaining)
+                assert arr.shape == (8 << 20,) and arr[0] == k % 251
+                big_ok += 1
+            except GetTimeoutError:
+                raise AssertionError(f"hung big-object get (seed={seed})")
+            except RayTpuError as e:
+                typed_errors.append(type(e).__name__)
+        if big_objects:
+            assert big_ok >= 1, \
+                f"every spilled object was lost: {typed_errors}"
+
         # ---- invariant: refcounts drain once the driver drops refs
-        del refs, arefs, vals
+        # (clear the loop leftovers too: ``r``/``arr`` in this frame
+        # would otherwise pin the last ref through the drain check)
+        r = arr = None  # noqa: F841
+        del refs, arefs, vals, big_refs, r, arr
         _assert_refcounts_drain(global_worker())
         return observed_pids, ok, typed_errors, monkey
     finally:
@@ -271,19 +351,185 @@ def test_chaos_smoke():
     _assert_workers_reaped(observed)
 
 
+#: collection-time override so tools/chaos_matrix.sh can run any seed
+#: list one at a time (one-command red-soak reproduction)
+SOAK_SEEDS = [int(s) for s in os.environ.get(
+    "RAY_TPU_CHAOS_SOAK_SEEDS", "1101,2202,3303").split(",")]
+
+
 @pytest.mark.chaos
+@pytest.mark.partition
 @pytest.mark.slow
-@pytest.mark.parametrize("seed", [1101, 2202, 3303])
+@pytest.mark.parametrize("seed", SOAK_SEEDS)
 def test_chaos_soak(seed):
     """The full soak: >=300 tasks + >=120 actor calls under seeded
-    kills, drops, duplicates and delays, plus one controller kill -9
-    mid-stream. Replays deterministically per seed."""
+    kills, >=5% drops across the whole critical message set (the
+    retransmit/ack layer recovers them), duplicates and delays, one
+    controller kill -9 mid-stream, one scheduled 2s controller<->node
+    partition that heals, and spill-path disk-fault injection over
+    forced big-object spills. Replays deterministically per seed."""
     observed, ok, errs, monkey = _run_chaos_workload(
         seed=seed, n_tasks=300, n_actor_calls=120, kills=3,
-        restart_controller=True, deadline_s=420.0)
+        restart_controller=True, deadline_s=420.0, mix=SOAK_MIX,
+        big_objects=8)
     assert ("restart_controller",) in monkey.log
     assert sum(1 for e in monkey.log if e[0] == "kill_worker") >= 1
     _assert_workers_reaped(observed)
+
+
+# ------------------------------------------------- spill-path disk faults
+
+
+class _ScriptedDisk:
+    """DiskFaultInjector stand-in with a scripted fault sequence."""
+
+    def __init__(self, **per_op):
+        self.script = {op: list(kinds) for op, kinds in per_op.items()}
+        self.stats = {}
+
+    def fault(self, op):
+        kinds = self.script.get(op)
+        return kinds.pop(0) if kinds else None
+
+
+def _seal_now(store, oid, size):
+    """on_sealed + clear the fresh-arrival grace so the sweep can spill
+    immediately (the unit tests drive eviction synchronously)."""
+    store.on_sealed(oid, size)
+    store._restore_grace.clear()
+
+
+def _native_store(tmp_path, capacity=4 << 20):
+    from ray_tpu import _native
+    from ray_tpu.core.native_store import NativeShmStore
+    if _native.load() is None:
+        pytest.skip("native store library unavailable")
+    name = f"chaos-disk-{os.getpid()}-{time.monotonic_ns()}"
+    return NativeShmStore(name, capacity, spill_dir=str(tmp_path))
+
+
+def test_spill_write_fault_degrades_gracefully(tmp_path):
+    """EIO/ENOSPC on a spill write must keep the object resident (it is
+    still the only copy) and clean up the partial file — the sweep
+    retries later instead of losing data."""
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.native_store import NativeShmClient
+    store = _native_store(tmp_path)
+    try:
+        client = NativeShmClient(store.session_name, lib=store.lib)
+        oid = ObjectID.from_random()
+        client.put_bytes(oid, b"x" * (1 << 20))
+        _seal_now(store, oid, 1 << 20)
+        store._disk_chaos = _ScriptedDisk(spill_write=["eio", "enospc"])
+        for _ in range(2):  # both fault kinds: no spill, no data loss
+            store.make_room(1 << 62)
+            assert store.contains(oid)
+            assert store._spilled == {}
+            assert os.listdir(str(tmp_path)) == []
+        # fault cleared: the next sweep spills for real
+        store.make_room(1 << 62)
+        assert store._spilled and store.contains(oid)
+        assert store.maybe_restore(oid) is True
+        view = client.get_view(oid, timeout=2.0)
+        assert view is not None and bytes(view[:4]) == b"xxxx"
+        client.close()
+    finally:
+        store.destroy()
+
+
+def test_restore_eio_retries_then_reports_local_loss(tmp_path):
+    """Injected EIO on restore reads: transient strikes surface as
+    'retry' (callers back off and re-ask), a third consecutive strike
+    declares the local backing copy unusable ('lost') so the controller
+    can re-pull from another holder; a truncated backing file is
+    dropped immediately."""
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.native_store import NativeShmClient
+    store = _native_store(tmp_path)
+    try:
+        client = NativeShmClient(store.session_name, lib=store.lib)
+        oid = ObjectID.from_random()
+        client.put_bytes(oid, b"y" * (1 << 20))
+        _seal_now(store, oid, 1 << 20)
+        store.make_room(1 << 62)
+        assert store._spilled
+        store._disk_chaos = _ScriptedDisk(restore_read=["eio"] * 3)
+        assert store.maybe_restore(oid) == "retry"
+        assert store.maybe_restore(oid) == "retry"
+        assert store.maybe_restore(oid) == "lost"
+        assert not store.contains(oid)  # backing copy dropped
+
+        # truncated read: immediately unusable (a torn file cannot heal)
+        oid2 = ObjectID.from_random()
+        client.put_bytes(oid2, b"z" * (1 << 20))
+        _seal_now(store, oid2, 1 << 20)
+        store.make_room(1 << 62)
+        store._disk_chaos = _ScriptedDisk(restore_read=["truncate"])
+        assert store.maybe_restore(oid2) == "lost"
+        assert not store.contains(oid2)
+
+        # a transient strike heals: success resets the counter
+        oid3 = ObjectID.from_random()
+        client.put_bytes(oid3, b"w" * (1 << 20))
+        _seal_now(store, oid3, 1 << 20)
+        store.make_room(1 << 62)
+        store._disk_chaos = _ScriptedDisk(restore_read=["eio"])
+        assert store.maybe_restore(oid3) == "retry"
+        assert store.maybe_restore(oid3) is True
+        assert store._restore_strikes == {}
+        client.close()
+    finally:
+        store.destroy()
+
+
+@pytest.mark.chaos
+def test_restore_eio_recovers_via_repull():
+    """Acceptance: a get whose LOCAL restore hits injected EIO (every
+    read faulted) recovers by re-pulling the object from another holder
+    node — no ObjectLostError ever surfaces to the caller."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+
+    _chaos_env(9901, {"disk": {"restore_read": 1.0}})
+    cluster = None
+    try:
+        cluster = Cluster(head_node_args=dict(
+            num_cpus=2, _num_initial_workers=1,
+            object_store_memory=16 << 20))
+        cluster.add_node(num_cpus=1, resources={"pin": 1})
+        import ray_tpu.api as api
+
+        @ray_tpu.remote(resources={"pin": 1}, max_restarts=0)
+        class Holder:
+            def make(self):
+                return np.full(24 << 20, 7, dtype=np.uint8)
+
+        h = Holder.remote()
+        ref = h.make.remote()
+        # first get pulls the object to the head node (both nodes hold it)
+        arr = ray_tpu.get(ref, timeout=120)
+        assert arr[0] == 7 and arr.shape == (24 << 20,)
+        del arr
+        gc.collect()
+        # over-budget (24MB > 16MB): the head's sweep spills it once the
+        # reader lease is released
+        store = api._head.node.store
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not store._spilled:
+            time.sleep(0.25)
+        assert store._spilled, "head store never spilled the big object"
+        # the local restore is doomed (every read EIOs): the get must
+        # come back via a re-pull from the holder node, not error out
+        arr = ray_tpu.get(ref, timeout=120)
+        assert arr[0] == 7 and arr.shape == (24 << 20,)
+        assert store._disk_chaos is not None and store._disk_chaos.stats
+    finally:
+        try:
+            if cluster is not None:
+                cluster.shutdown()
+        finally:
+            _clear_chaos_env()
 
 
 @pytest.mark.chaos

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# chaos_matrix.sh — run the slow chaos soak across N seeds and print the
+# failing seed header, so a red soak is one-command reproducible:
+#
+#   tools/chaos_matrix.sh            # default 3 seeds (1101 2202 3303)
+#   tools/chaos_matrix.sh 5          # 5 seeds: 1101, 2202, ... 5505
+#   tools/chaos_matrix.sh 1101 9907  # explicit seed list
+#
+# Each seed runs the full soak (300 tasks + 120 actor calls under kills,
+# drops, dups, delays, a controller kill -9, a scheduled
+# controller<->node partition, and spill-path disk faults). On failure
+# the replay line (RAY_TPU_CHAOS_SEED=<seed> ...) is printed and the
+# script exits non-zero after finishing the remaining seeds.
+set -u
+
+cd "$(dirname "$0")/.."
+
+seeds=()
+if [ "$#" -eq 0 ]; then
+    seeds=(1101 2202 3303)
+elif [ "$#" -eq 1 ] && [ "$1" -lt 100 ] 2>/dev/null; then
+    for i in $(seq 1 "$1"); do
+        seeds+=($((i * 1101)))
+    done
+else
+    seeds=("$@")
+fi
+
+failed=()
+for seed in "${seeds[@]}"; do
+    echo "=== chaos soak: seed=$seed ==="
+    # the soak parametrizes its seed list from this env var at
+    # collection time (see tests/core/test_chaos.py)
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/core/test_chaos.py::test_chaos_soak" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== seed=$seed PASSED ==="
+    else
+        echo "=== seed=$seed FAILED ==="
+        failed+=("$seed")
+    fi
+done
+
+if [ "${#failed[@]}" -gt 0 ]; then
+    echo
+    echo "FAILING SEEDS: ${failed[*]}"
+    for seed in "${failed[@]}"; do
+        echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$seed python -m pytest" \
+             "tests/core/test_chaos.py::test_chaos_soak -q"
+    done
+    exit 1
+fi
+echo "all ${#seeds[@]} seeds passed"
