@@ -13,6 +13,7 @@ is a jitted GSPMD program.
 
 from ray_tpu._version import __version__
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.streaming import ObjectRefGenerator
 from ray_tpu.actor import ActorClass, ActorHandle, ActorMethod
 from ray_tpu.api import (
     init,
@@ -44,6 +45,7 @@ from ray_tpu.exceptions import (
     GetTimeoutError,
     RpcTimeoutError,
     DeliveryFailedError,
+    StreamCancelledError,
 )
 from ray_tpu.runtime_context import RuntimeContext
 
@@ -70,6 +72,7 @@ __all__ = [
     "get_runtime_context",
     "timeline",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorClass",
     "ActorHandle",
     "ActorMethod",
@@ -85,4 +88,5 @@ __all__ = [
     "GetTimeoutError",
     "RpcTimeoutError",
     "DeliveryFailedError",
+    "StreamCancelledError",
 ]

@@ -48,8 +48,8 @@ class ActorClass:
         self._descriptor: Optional[FunctionDescriptor] = None
         self._exported_sessions = set()
         self._is_async = any(
-            inspect.iscoroutinefunction(v) for v in vars(cls).values()
-            if callable(v))
+            inspect.iscoroutinefunction(v) or inspect.isasyncgenfunction(v)
+            for v in vars(cls).values() if callable(v))
 
     def __call__(self, *a, **kw):
         raise TypeError(
@@ -128,19 +128,24 @@ class ActorClass:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1,
+                 backpressure: int = 0):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._backpressure = backpressure
 
     def options(self, **opts) -> "ActorMethod":
         m = ActorMethod(self._handle, self._name,
-                        opts.get("num_returns", self._num_returns))
+                        opts.get("num_returns", self._num_returns),
+                        int(opts.get("generator_backpressure_num_objects")
+                            or self._backpressure))
         return m
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(
-            self._name, args, kwargs, self._num_returns)
+            self._name, args, kwargs, self._num_returns,
+            self._backpressure)
 
     def bind(self, *args, **kwargs):
         from ray_tpu.dag import ClassMethodNode
@@ -164,22 +169,28 @@ class ActorHandle:
             raise AttributeError(name)
         return ActorMethod(self, name)
 
-    def _submit_method(self, name: str, args, kwargs, num_returns: int):
+    def _submit_method(self, name: str, args, kwargs, num_returns,
+                       backpressure: int = 0):
         w = global_worker()
         args_blob, arg_refs, _ = w.serialize_args(args, kwargs)
         self._seq += 1
+        from ray_tpu.core.task_spec import STREAMING_RETURNS
+        streaming = num_returns == "streaming"
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(self._actor_id),
             job_id=w.job_id,
             function=FunctionDescriptor("", name, ""),
             args_blob=args_blob,
             arg_refs=[(i, oid) for i, oid in arg_refs],
-            num_returns=num_returns,
+            num_returns=STREAMING_RETURNS if streaming else num_returns,
             actor_id=self._actor_id,
             sequence_number=self._seq,
             max_retries=self._max_task_retries,
             name=f"{self._class_name}.{name}",
+            backpressure=backpressure,
         )
+        if streaming:
+            return w.submit_streaming_task(spec)
         refs = w.submit_task(spec)
         return refs[0] if num_returns == 1 else refs
 

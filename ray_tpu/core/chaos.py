@@ -89,9 +89,13 @@ PROTECTED_TYPES = frozenset({"REG", "REGR", "BYE", "RPL", "ERR", "RCN"})
 #: TASK_ASSIGN, TASK_DONE) instead of a hand-picked safe subset.
 #: Request/reply types (SUB, KVO, ...) still need an explicit per-type
 #: entry: their drop surfaces as the caller's RpcTimeoutError, which is
-#: a worse failure mode to inject by default.
+#: a worse failure mode to inject by default. SIT/SEF/SCR are the
+#: streaming-generator item/EOF/credit reports — covered by the same
+#: ack/retransmit layer, so dropping them must still deliver every
+#: yielded item exactly once, in order.
 DEFAULT_DROPPABLE = frozenset({"RES", "PUT", "PNG", "HBT",
-                               "DSP", "ACL", "ASG", "DON"})
+                               "DSP", "ACL", "ASG", "DON",
+                               "SIT", "SEF", "SCR"})
 
 
 @dataclass
@@ -100,15 +104,38 @@ class ChaosConfig:
     message-type name (``"RES"``, ``"PUT"``, ... or ``"*"``) to a
     probability and override the scalar ``*_prob`` defaults.
 
-    ``partitions`` is the scheduled sever matrix: a list of
-    ``{"start": s, "end": s, "a": side, "b": side}`` windows (seconds
-    from injector creation) where a side is one of ``"controller"``,
-    ``"node"``, ``"driver"``, ``"worker"`` or ``"*"``. A window cuts
-    every message, both directions, on links whose (sender role, target
-    class) match — see :meth:`ChaosInjector._partitioned`. Driver and
-    worker targets are indistinguishable at the sender (both are opaque
-    28-byte DEALER identities), so either name matches any non-node
-    peer; node identities are recognized by their ``b"N"`` prefix.
+    ``partitions`` is the scheduled sever matrix: a list of windows
+    (seconds from injector creation) in one of two forms:
+
+    - ``{"start": s, "end": s, "a": side, "b": side}`` — cuts every
+      message, BOTH directions, on links whose (sender, target) match
+      either orientation;
+    - ``{"start": s, "end": s, "src": side, "dst": side}`` — an
+      **asymmetric one-way window**: only messages FROM a matching
+      sender TO a matching target are cut (the reverse direction flows
+      normally — the classic half-open link real networks produce).
+
+    A *side* is a role class (``"controller"``, ``"node"``,
+    ``"driver"``, ``"worker"``, ``"peer"``, ``"*"``) or a **concrete
+    identity**: ``"id:<hexprefix>"`` matches the process's own wire
+    identity (sender side) or the target identity (receiver side) by
+    hex prefix — so partitions can be keyed to specific node ids
+    (:func:`node_identity` renders a NodeID's wire identity) or worker
+    ids, not just role classes. Role classes remain coarse: driver and
+    worker targets are indistinguishable at the sender (both are
+    opaque 28-byte DEALER identities), so either name matches any
+    non-node peer; node identities are recognized by their ``b"N"``
+    prefix.
+
+    ``latency`` injects **slow links** (not cut links): a list of
+    ``{"start": s, "end": s, "src"/"dst" | "a"/"b": side, "prob": p,
+    "dist": "uniform"|"exp"|"lognormal", ...params}`` windows; every
+    matching message is held for a delay drawn from the distribution
+    (``uniform``: ``lo``/``hi``; ``exp``: ``mean``; ``lognormal``:
+    ``mu``/``sigma``, in seconds). Draws come from an independent
+    seeded stream, so adding latency shifts no drop/dup decisions.
+    This is how streaming backpressure is soaked under skew — a slow
+    consumer link, not a dead one.
 
     ``disk``/``disk_fault_prob`` drive the spill-path disk faults
     (ops: ``"spill_write"`` -> EIO/ENOSPC, ``"restore_read"`` ->
@@ -123,6 +150,7 @@ class ChaosConfig:
     dup: Dict[str, float] = field(default_factory=dict)
     delay: Dict[str, float] = field(default_factory=dict)
     partitions: List[Dict] = field(default_factory=list)
+    latency: List[Dict] = field(default_factory=list)
     disk_fault_prob: float = 0.0      # over all spill-path disk ops
     disk: Dict[str, float] = field(default_factory=dict)
 
@@ -164,6 +192,7 @@ class ChaosConfig:
                 "delay_range_s": list(self.delay_range_s),
                 "drop": self.drop, "dup": self.dup, "delay": self.delay,
                 "partitions": self.partitions,
+                "latency": self.latency,
                 "disk_fault_prob": self.disk_fault_prob,
                 "disk": self.disk,
             }),
@@ -228,11 +257,18 @@ class ChaosInjector:
     entry point the transports call; it returns the (possibly empty)
     list of ``(delay_s, payload)`` copies to actually ship."""
 
-    def __init__(self, config: ChaosConfig, stream: str):
+    def __init__(self, config: ChaosConfig, stream: str,
+                 self_id: Optional[str] = None):
         self.config = config
         self.stream = stream
         self.role = stream.split(":", 1)[0]
+        #: this process's wire identity (hex), for concrete-id partition
+        #: and latency-link matching (``"id:<hexprefix>"`` sides)
+        self.self_id = self_id or ""
         self._rng = random.Random(f"{config.seed}:{stream}")
+        #: independent stream for latency-link draws: enabling slow
+        #: links must not shift the drop/dup/delay decision sequence
+        self._lat_rng = random.Random(f"{config.seed}:{stream}:latency")
         self._lock = threading.Lock()
         #: scheduled-partition clock origin: windows are seconds from
         #: injector creation (process start for spawned processes)
@@ -265,8 +301,11 @@ class ChaosInjector:
                 self._severed.discard(peer)
 
     # -------------------------------------------------- partitions
-    @staticmethod
-    def _side_matches_role(side: str, role: str) -> bool:
+    def _side_matches_role(self, side: str, role: str) -> bool:
+        if side.startswith("id:"):
+            # concrete identity: match this process's own wire id
+            return bool(self.self_id) and \
+                self.self_id.startswith(side[3:].lower())
         return side == "*" or side == role or \
             (side in ("driver", "worker", "peer")
              and role in ("driver", "worker"))
@@ -279,10 +318,31 @@ class ChaosInjector:
             return "node"
         return "peer"  # worker or driver: indistinguishable identities
 
-    @classmethod
-    def _side_matches_target(cls, side: str, tclass: str) -> bool:
+    @staticmethod
+    def _side_matches_target(side: str, tclass: str,
+                             target: Optional[bytes] = None) -> bool:
+        if side.startswith("id:"):
+            # concrete identity: match the wire target by hex prefix
+            return target is not None and \
+                target.hex().startswith(side[3:].lower())
         return side == "*" or side == tclass or \
             (side in ("driver", "worker", "peer") and tclass == "peer")
+
+    def _link_matches(self, p: Dict, target: Optional[bytes],
+                      tclass: str) -> bool:
+        """One window against one (this process -> target) link.
+        ``src``/``dst`` windows are ASYMMETRIC: only the named
+        direction is affected (this process must match ``src`` as the
+        sender). ``a``/``b`` windows match either orientation."""
+        if "src" in p or "dst" in p:
+            return self._side_matches_role(p.get("src", "*"), self.role) \
+                and self._side_matches_target(p.get("dst", "*"), tclass,
+                                              target)
+        a, b = p.get("a", "*"), p.get("b", "*")
+        return (self._side_matches_role(a, self.role)
+                and self._side_matches_target(b, tclass, target)) or \
+               (self._side_matches_role(b, self.role)
+                and self._side_matches_target(a, tclass, target))
 
     def _partitioned(self, target: Optional[bytes], now: float) -> bool:
         """True when a scheduled partition window currently severs the
@@ -294,13 +354,41 @@ class ChaosInjector:
         for p in self.config.partitions:
             if not (p.get("start", 0.0) <= t < p.get("end", float("inf"))):
                 continue
-            a, b = p.get("a", "*"), p.get("b", "*")
-            if (self._side_matches_role(a, self.role)
-                    and self._side_matches_target(b, tclass)) or \
-               (self._side_matches_role(b, self.role)
-                    and self._side_matches_target(a, tclass)):
+            if self._link_matches(p, target, tclass):
                 return True
         return False
+
+    def _link_delay(self, target: Optional[bytes], now: float) -> float:
+        """Latency-distribution injection: extra delay for this message
+        from matching slow-link windows (``ChaosConfig.latency``).
+        Draws come from the dedicated ``:latency`` stream."""
+        if not self.config.latency:
+            return 0.0
+        t = now - self._t0
+        tclass = self._target_class(target)
+        total = 0.0
+        for p in self.config.latency:
+            if not (p.get("start", 0.0) <= t < p.get("end", float("inf"))):
+                continue
+            if not self._link_matches(p, target, tclass):
+                continue
+            with self._lock:
+                if self._lat_rng.random() >= p.get("prob", 1.0):
+                    continue
+                dist = p.get("dist", "uniform")
+                if dist == "exp":
+                    d = self._lat_rng.expovariate(
+                        1.0 / max(1e-6, float(p.get("mean", 0.05))))
+                elif dist == "lognormal":
+                    d = self._lat_rng.lognormvariate(
+                        float(p.get("mu", -3.5)),
+                        float(p.get("sigma", 0.5)))
+                else:
+                    lo = float(p.get("lo", 0.01))
+                    hi = float(p.get("hi", max(0.05, lo)))
+                    d = lo + self._lat_rng.random() * (hi - lo)
+            total += min(d, float(p.get("cap", 5.0)))
+        return total
 
     # -------------------------------------------------------------- plan
     def plan_send(self, target: Optional[bytes], mtype: bytes,
@@ -311,14 +399,30 @@ class ChaosInjector:
         = duplicated. Injectable dict payloads are stamped with a wire
         sequence number for receiver-side dedup."""
         name = mtype.decode("ascii", "replace")
+        if isinstance(payload, dict) and \
+                payload.pop("__chaos_delayed__", None):
+            # second pass of a message we already delayed: it was
+            # decided once — ship it now. Without this, always-on
+            # latency links (prob 1.0) would re-delay on every re-entry
+            # and the message would never reach the wire.
+            self.stats[("delayed_ship", name)] += 1
+            return [(0.0, payload)]
+        now = time.monotonic()
         # scheduled partitions cut EVERYTHING on the link, protected
         # types included — a real partition doesn't read headers
-        if self.config.partitions and \
-                self._partitioned(target, time.monotonic()):
+        if self.config.partitions and self._partitioned(target, now):
             self.stats[("partition", name)] += 1
             return []
+        # slow links delay EVERYTHING too (a congested path doesn't
+        # read headers either), protected types included — unlike a cut
+        # this is always recoverable by waiting
+        link_delay = self._link_delay(target, now)
+        if link_delay > 0.0:
+            self.stats[("latency", name)] += 1
         if name in PROTECTED_TYPES:
-            return [(0.0, payload)]
+            if link_delay > 0.0 and isinstance(payload, dict):
+                payload = dict(payload, __chaos_delayed__=True)
+            return [(link_delay, payload)]
         cfg = self.config
         with self._lock:
             if self._severed and (target in self._severed):
@@ -340,7 +444,14 @@ class ChaosInjector:
             if r_delay < cfg.delay_p(name) else 0.0
         if delay > 0.0:
             self.stats[("delay", name)] += 1
-        out = [(delay, payload)]
+        delay += link_delay
+        delayed = payload
+        if delay > 0.0 and isinstance(payload, dict):
+            # delayed copies re-enter the transport's send path via a
+            # timer; the marker makes the second pass ship-only (the
+            # immediate dup below stays unmarked — it never re-enters)
+            delayed = dict(payload, __chaos_delayed__=True)
+        out = [(delay, delayed)]
         if isinstance(payload, dict) and r_dup < cfg.dup_p(name):
             # the copy carries the SAME wire seq: receivers must drop it
             self.stats[("dup", name)] += 1
@@ -348,26 +459,41 @@ class ChaosInjector:
         return out
 
 
-def maybe_injector(role: str) -> Optional[ChaosInjector]:
+def node_identity(node_id_b: bytes) -> bytes:
+    """A node manager's wire identity for a given NodeID binary — lets
+    tests key partition/latency matrices to concrete nodes
+    (``"id:" + node_identity(nid).hex()``)."""
+    return b"N" + node_id_b[:27]
+
+
+def maybe_injector(role: str,
+                   self_id: Optional[bytes] = None
+                   ) -> Optional[ChaosInjector]:
     """The per-process activation hook: returns an injector when chaos
     env vars are set, else ``None`` (the common case — callers keep a
-    ``None`` handle and skip every chaos branch)."""
+    ``None`` handle and skip every chaos branch). ``self_id`` is the
+    process's wire identity, for concrete-id (``"id:<hexprefix>"``)
+    partition/latency matching."""
     cfg = ChaosConfig.from_env()
     if cfg is None:
         return None
     sid = os.environ.get(ENV_STREAM_ID, "")
     stream = f"{role}:{sid}" if sid else role
-    inj = ChaosInjector(cfg, stream)
+    inj = ChaosInjector(cfg, stream,
+                        self_id=self_id.hex() if self_id else None)
     logger.warning("chaos: fault injection ACTIVE (seed=%d stream=%s)",
                    cfg.seed, stream)
     return inj
 
 
 def check_dedup(dedup: Optional[SeqDeduper], payload: Any) -> bool:
-    """Receiver-side hook: pops the wire seq stamp and returns True when
-    the payload is a duplicate that must be discarded."""
+    """Receiver-side hook: pops the wire seq stamp (and the delayed-ship
+    marker, for transports whose parked sends go straight to the wire)
+    and returns True when the payload is a duplicate that must be
+    discarded."""
     if dedup is None or not isinstance(payload, dict):
         return False
+    payload.pop("__chaos_delayed__", None)
     key = payload.pop("__wseq__", None)
     return key is not None and dedup.seen(key)
 

@@ -158,6 +158,15 @@ class Config:
     #: retransmit backoff rounds; bounds delay, never hangs.
     actor_reorder_wait_s: float = 10.0
 
+    # --- streaming generators (core ObjectRefGenerator; reference:
+    # num_returns="streaming" + _generator_backpressure_num_objects) ---
+    #: Consumer-paced credit window: a generator task pauses after this
+    #: many yielded-but-unconsumed items until STREAM_CREDIT reports
+    #: consumption (bounds the object store footprint of a fast
+    #: producer). <= 0 disables backpressure. Per-call override via
+    #: ``options(generator_backpressure_num_objects=...)``.
+    generator_backpressure_num_objects: int = 64
+
     # --- retries / fault tolerance hardening ---
     #: Lease/reconnect retry backoff: exponential with full jitter,
     #: base * 2^attempt capped at the cap (reference retry shape; the

@@ -190,7 +190,7 @@ class NodeManager:
         self._spawn_init_lock = threading.Lock()
         self._spawn_count = 0
         # seeded fault injection (chaos.py): None in production
-        self._chaos = CH.maybe_injector("node")
+        self._chaos = CH.maybe_injector("node", self_id=self.identity)
         self._chaos_dedup = CH.SeqDeduper() if self._chaos is not None \
             else None
         #: chaos-delayed direct sends (timer threads) and reliable-layer

@@ -56,6 +56,16 @@ CANCEL_QUEUED = b"CQD"       # ->worker direct {task_id, force}
 NOTIFY_BLOCKED = b"NBK"      # worker->controller {task_id}
 NOTIFY_UNBLOCKED = b"NUB"    # worker->controller {}
 TASK_HANDBACK = b"HBK"       # worker->controller {specs: [...]}
+# streaming generator tasks (reference: num_returns="streaming" +
+# ReportGeneratorItemReturns, task_manager.cc — each yielded item is its
+# own object, eagerly reported to the owner while the task still runs)
+STREAM_ITEM = b"SIT"         # worker->owner DIRECT {task_id, index, meta,
+                             # worker}: one yielded item's result meta
+STREAM_EOF = b"SEF"          # worker->owner DIRECT {task_id, count,
+                             # worker, error?}: the stream is complete
+STREAM_CREDIT = b"SCR"       # owner->worker DIRECT {task_id, consumed}:
+                             # cumulative consumer progress — opens the
+                             # producer's backpressure window
 # objects
 PUT_OBJECT = b"PUT"          # seal notification {object_id, node_id, size, owner}
 FREE_OBJECT = b"FRE"         # controller->node {object_id}

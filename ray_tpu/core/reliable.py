@@ -66,6 +66,14 @@ RELIABLE_TYPES = frozenset({
     b"CAC",   # CREATE_ACTOR   driver -> controller
     b"PUT",   # PUT_OBJECT     owner/node -> controller
     b"RES",   # TASK_RESULT    worker -> owner / controller -> owner
+    b"SIT",   # STREAM_ITEM    worker -> owner (direct): a lost item
+              # report would leave a permanent gap in the stream
+    b"SEF",   # STREAM_EOF     worker -> owner (direct): loss would hang
+              # the consumer's final next() forever
+    b"SCR",   # STREAM_CREDIT  owner -> worker (direct): credits are
+              # cumulative so a lost one is healed by the next — but the
+              # LAST credit has no successor, and its loss would wedge
+              # the producer at the backpressure window for good
 })
 
 #: payload key carrying ``(sender tag, seq)``; popped before handlers

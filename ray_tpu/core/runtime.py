@@ -70,7 +70,8 @@ class Runtime:
 
         # seeded fault injection (chaos.py): None in production — every
         # hook below is a single attribute check when disabled
-        self._chaos = CH.maybe_injector(kind)
+        self._chaos = CH.maybe_injector(kind,
+                                        self_id=self.worker_id.binary())
         self._chaos_dedup = CH.SeqDeduper() if self._chaos is not None \
             else None
         # lease/reconnect retry backoff: exponential with full jitter
@@ -161,6 +162,14 @@ class Runtime:
         # object_id(bytes) -> result meta {"inline"|"node_id"/"size"|"error"}
         self._meta: Dict[bytes, dict] = {}
         self._meta_lock = threading.Lock()
+        #: streaming generator tasks we own (task_id bytes -> StreamState);
+        #: entries are routing state only — dropped at close / terminal
+        #: failure / full consumption (core/streaming.py)
+        self._streams: Dict[bytes, Any] = {}
+        self._streams_lock = threading.Lock()
+        #: worker-side hook (WorkerExecutor): STREAM_CREDIT consumption
+        #: reports for generator tasks executing in this process
+        self.stream_credit_handler: Optional[Callable[[dict], None]] = None
         self._completion_cbs: Dict[bytes, List[Callable]] = {}
         self._pending_locations: Dict[bytes, float] = {}  # object -> probe ts
 
@@ -598,6 +607,13 @@ class Runtime:
             self._on_reconnect(m.get("gen"))
         elif mtype == P.FETCH_OBJECT:
             self._on_fetch_object(m)
+        elif mtype == P.STREAM_ITEM:
+            self._on_stream_item(m)
+        elif mtype == P.STREAM_EOF:
+            self._on_stream_eof(m)
+        elif mtype == P.STREAM_CREDIT:
+            if self.stream_credit_handler is not None:
+                self.stream_credit_handler(m)
         elif mtype == P.TMPL_MISS:
             self._on_tmpl_miss(m)
         elif mtype == P.PROFILE_SELF:
@@ -1002,6 +1018,19 @@ class Runtime:
             known = known or done_spec is not None
             self._unpin_task_args(done_spec)
             self._on_direct_task_result(m["task_id"])
+            st = self._stream_for(m["task_id"])
+            if st is not None and m.get("error") is not None:
+                # terminal failure of a streaming task (retries
+                # exhausted / actor dead / cancelled): no more item
+                # reports or replays are coming — fail the stream so
+                # blocked consumers raise the typed error instead of
+                # hanging on an index that will never arrive
+                try:
+                    st.fail(P.loads(m["error"]))
+                except Exception:
+                    from ray_tpu.exceptions import RayTpuError
+                    st.fail(RayTpuError("streaming task failed"))
+                self._drop_stream(m["task_id"])
         err = m.get("error")
         rc = self.reference_counter
         via_controller = m.get("via_controller")
@@ -1076,6 +1105,88 @@ class Runtime:
             oid = ObjectID(b)
             # materialize lazily at get(); but wake any waiter now
             self.memory_store.put(oid, _MetaReady(r))
+
+    # ------------------------------------------------- streaming generators
+    def submit_streaming_task(self, spec: TaskSpec):
+        """Submit a ``num_returns="streaming"`` task and return the
+        caller-side :class:`ObjectRefGenerator` (reference:
+        ``CoreWorker::SubmitTask`` with ``returns_dynamically``). The
+        stream record is registered BEFORE submission so the first
+        ``STREAM_ITEM`` cannot race it."""
+        from ray_tpu.core.streaming import ObjectRefGenerator, StreamState
+        tid_b = spec.task_id.binary()
+        state = StreamState(self, tid_b)
+        with self._streams_lock:
+            self._streams[tid_b] = state
+        self.submit_task(spec)
+        return ObjectRefGenerator(state)
+
+    def _stream_for(self, tid_b: Optional[bytes]):
+        if tid_b is None:
+            return None
+        with self._streams_lock:
+            return self._streams.get(tid_b)
+
+    def _drop_stream(self, tid_b: bytes) -> None:
+        with self._streams_lock:
+            self._streams.pop(tid_b, None)
+
+    def _on_stream_item(self, m: dict) -> None:
+        st = self._stream_for(m.get("task_id"))
+        meta = m["meta"]
+        if st is None:
+            # not (or no longer) a stream we track: a lineage replay
+            # re-reporting items whose stream was fully consumed, or a
+            # borrower process. Seed the meta so parked gets resolve;
+            # no stream bookkeeping, no ref minting.
+            b = meta["object_id"]
+            with self._meta_lock:
+                self._meta[b] = meta
+            self.memory_store.put(ObjectID(b), _MetaReady(meta), force=True)
+            return
+        st.on_item(m["index"], meta, m.get("worker"))
+
+    def _on_stream_eof(self, m: dict) -> None:
+        st = self._stream_for(m.get("task_id"))
+        if st is not None:
+            st.on_eof(m["count"], m.get("worker"))
+
+    def _stream_send_credit(self, tid_b: bytes, consumed: int,
+                            producer: Optional[bytes]) -> None:
+        """Consumer progress report: cumulative, so loss-tolerant and
+        idempotent; opens the producer's backpressure window."""
+        if producer is None or self._stopped.is_set():
+            return
+        self._send_direct(producer, P.STREAM_CREDIT,
+                          {"task_id": tid_b, "consumed": consumed})
+
+    def _stream_finished(self, tid_b: bytes) -> None:
+        """StreamState hook: the consumer reached EOF — drop the routing
+        record (late lineage replays fall back to plain meta seeding)."""
+        self._drop_stream(tid_b)
+
+    def _close_stream(self, state) -> None:
+        """Early consumer termination: drop buffered item refs, cancel
+        the producer, forget the stream."""
+        tid_b = state.task_id_b
+        already_done = state.eof_index is not None and state.error is None \
+            and not state.items
+        refs = state.close()
+        self._drop_stream(tid_b)
+        # dropping the buffered refs is what frees unconsumed items —
+        # each was +1'd at report time; the consumer never took them
+        del refs
+        with self._inflight_lock:
+            self._inflight_specs.pop(tid_b, None)
+        if not already_done and not self._stopped.is_set():
+            # cancel the producer (it may still be yielding into the
+            # backpressure window); route like any task cancel
+            try:
+                ref = ObjectRef(ObjectID.for_task_return(TaskID(tid_b), 1),
+                                self.worker_id, _register=False)
+                self.cancel(ref, force=False)
+            except Exception:
+                logger.exception("stream cancel failed")
 
     @staticmethod
     def _find_weakref_targets(value, depth: int = 3) -> list:
@@ -1851,7 +1962,7 @@ class Runtime:
             return {"spec": spec}
         key = (spec.function, spec.name, spec.num_returns,
                spec.max_retries, spec.retry_exceptions,
-               spec.concurrency_group)
+               spec.concurrency_group, spec.backpressure)
         tmpls = st["tmpls"]
         tid = tmpls.get(key)
         me = self.worker_id.binary()
@@ -1985,6 +2096,15 @@ class Runtime:
         so tasks parked on these result objects fail fast with the
         actor's error instead of waiting on an object that will never
         exist (error propagation through the object graph)."""
+        if spec.is_streaming:
+            # streaming call: there are no static return objects — the
+            # stream itself is the future to fail
+            st = self._stream_for(spec.task_id.binary())
+            if st is not None:
+                st.fail(err)
+                self._drop_stream(spec.task_id.binary())
+            self._unpin_task_args(spec)
+            return
         blob = P.dumps(err)
         results = []
         untracked = self.reference_counter._untracked

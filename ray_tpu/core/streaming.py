@@ -1,0 +1,305 @@
+"""Streaming generator tasks: the caller side of ``num_returns="streaming"``.
+
+Equivalent of the reference's ``ObjectRefGenerator``
+(``python/ray/_raylet.pyx`` ``ObjectRefGenerator`` / ``StreamingObjectRefGenerator``
+backed by ``TaskManager::ObjectRefStream``, ``task_manager.h``): a worker
+executing a generator (or async-generator) task eagerly stores each
+yielded item as its own object — ``ObjectID.for_task_return(task_id, i)``
+— and reports it with a ``STREAM_ITEM`` control message the moment it
+exists; ``STREAM_EOF`` closes the stream. Both ride the reliable-delivery
+layer (``core/reliable.py``), so item reports are exactly-once-effect and
+the per-index bookkeeping here only has to absorb *reordering* (a
+retransmitted item can land after younger ones) and *replay* (lineage
+re-execution after a mid-stream worker death re-reports from index 1).
+
+The owner-side :class:`StreamState` is the analog of the reference's
+``ObjectRefStream``: it buffers minted item refs by index, hands them to
+the consumer strictly in yield order, tracks EOF, and reports cumulative
+consumption back to the producer (``STREAM_CREDIT``) so a fast producer
+blocks at the backpressure window instead of flooding the object store
+(reference: ``_generator_backpressure_num_objects``).
+
+Reference counting is per item: every reported item registers one local
+ref owned by the stream; ``__next__`` transfers that ref to the consumer,
+so consumed items are freed independently of the stream and of each
+other. ``close()`` (or GC of an abandoned generator) drops the buffered
+refs and cancels the producer task, so early termination leaks neither
+objects nor a running generator.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, TYPE_CHECKING
+
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ray_tpu.core.runtime import Runtime
+
+
+class StreamState:
+    """Owner-side record of one in-flight streaming task (reference:
+    ``TaskManager::ObjectRefStream``). Created BEFORE the task is
+    submitted so the earliest ``STREAM_ITEM`` cannot race it."""
+
+    def __init__(self, runtime: "Runtime", task_id_b: bytes):
+        self.runtime = runtime
+        self.task_id_b = task_id_b
+        self.cond = threading.Condition()
+        #: minted-but-unconsumed item refs, keyed by 1-based yield index
+        self.items: Dict[int, ObjectRef] = {}
+        #: next index to hand to the consumer (== 1 + items consumed)
+        self.next_index = 1
+        #: total item count, set by STREAM_EOF (error item included)
+        self.eof_index: Optional[int] = None
+        #: stream-level failure (actor died, delivery gave up, task
+        #: failed terminally with retries exhausted) — raised at next()
+        self.error: Optional[BaseException] = None
+        #: identity of the latest reporting worker (credits go here;
+        #: a lineage replay moves it to the replaying worker)
+        self.producer: Optional[bytes] = None
+        self.closed = False
+        #: highest index ever reported (replay/reorder dedup)
+        self.received_max = 0
+
+    # ------------------------------------------------------- report side
+    def on_item(self, index: int, meta: dict, producer: Optional[bytes]
+                ) -> None:
+        """Pump-thread: one item report arrived. Seeds the owner's meta
+        table (so a plain ``get`` on the ref resolves) and mints the
+        stream-owned ref — exactly once per index, however many times a
+        retransmit or lineage replay re-reports it."""
+        rt = self.runtime
+        b = meta["object_id"]
+        drop_now = False
+        with self.cond:
+            if producer is not None:
+                self.producer = producer
+            already_consumed = index < self.next_index
+            # "never minted" == not consumed and not buffered. This must
+            # NOT be a high-water-mark test: a chaos-delayed item can
+            # arrive AFTER its younger siblings, and treating it as a
+            # duplicate would leave a permanent gap the consumer hangs on.
+            first_sighting = not already_consumed \
+                and index not in self.items
+            self.received_max = max(self.received_max, index)
+        if not first_sighting and not already_consumed:
+            # buffered duplicate: meta already seeded, ref already minted
+            return
+        inline_local = rt._owner_local and meta.get("inline") is not None \
+            and meta.get("error") is None
+        oid = ObjectID(b)
+        if first_sighting:
+            rc = rt.reference_counter
+            if inline_local:
+                # owner-local item: no controller entry, no deltas —
+                # suppression must precede the ref's +1 (mirror of put())
+                rc.mark_untracked(oid)
+            ref = ObjectRef(oid, rt.worker_id, _register=False)
+            rc.add_local_reference(ref)
+            ref._registered = True
+        with rt._meta_lock:
+            rt._meta[b] = meta
+            if inline_local:
+                rt._local_objects[b] = None
+        from ray_tpu.core.runtime import _MetaReady
+        rt.memory_store.put(oid, _MetaReady(meta), force=True)
+        if not first_sighting:
+            # replay of a consumed index (lineage re-execution): meta
+            # refreshed. Re-send the cumulative credit to the NEW
+            # producer — its window opens from zero, and the consumer
+            # will never re-consume these indices, so without this a
+            # replay with window <= consumed deadlocks at the window.
+            with self.cond:
+                consumed = self.next_index - 1
+                producer = self.producer
+            rt._stream_send_credit(self.task_id_b, consumed, producer)
+            return
+        with self.cond:
+            if self.closed:
+                drop_now = True  # late item on a cancelled stream
+            else:
+                self.items[index] = ref
+                self.cond.notify_all()
+        if drop_now:
+            # the +1/-1 pair nets to a 0-delta for tracked items, so the
+            # controller still learns the object lived and died
+            del ref
+
+    def on_eof(self, count: int, producer: Optional[bytes]) -> None:
+        with self.cond:
+            if producer is not None:
+                self.producer = producer
+            # first EOF wins: a replayed generator cancelled early (or
+            # a duplicate execution) must not shrink the stream
+            if self.eof_index is None:
+                self.eof_index = count
+            self.cond.notify_all()
+
+    def fail(self, err: BaseException) -> None:
+        """Terminal task failure with no more replays coming: every
+        blocked and future ``next()`` raises ``err``."""
+        with self.cond:
+            if self.error is None:
+                self.error = err
+            self.cond.notify_all()
+
+    # ------------------------------------------------------ consumer side
+    def _done_locked(self) -> bool:
+        return self.eof_index is not None and self.next_index > self.eof_index
+
+    def next_ref(self, timeout: Optional[float] = None) -> ObjectRef:
+        """Block until the next in-order item is available and transfer
+        its ref to the caller. Raises ``StopIteration`` at EOF, the
+        stream error on terminal failure, ``GetTimeoutError`` on
+        timeout."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self.cond:
+            while True:
+                if self.closed:
+                    from ray_tpu.exceptions import StreamCancelledError
+                    raise StreamCancelledError(TaskID(self.task_id_b))
+                ref = self.items.pop(self.next_index, None)
+                if ref is not None:
+                    self.next_index += 1
+                    consumed = self.next_index - 1
+                    producer = self.producer
+                    break
+                if self._done_locked():
+                    # fully consumed: the runtime can forget the routing
+                    # record (late lineage replays seed metas without it)
+                    self.runtime._stream_finished(self.task_id_b)
+                    raise StopIteration
+                if self.error is not None:
+                    raise self.error
+                remaining = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    from ray_tpu.exceptions import GetTimeoutError
+                    raise GetTimeoutError(
+                        f"no stream item within {timeout}s")
+                self.cond.wait(0.2 if remaining is None
+                               else min(0.2, remaining))
+        self.runtime._stream_send_credit(self.task_id_b, consumed, producer)
+        return ref
+
+    def next_ready(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the next item is ready (or the stream is done /
+        failed) WITHOUT consuming it. Returns True when ``next_ref``
+        would return immediately, False on timeout."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self.cond:
+            while True:
+                if self.closed or self.next_index in self.items \
+                        or self._done_locked() or self.error is not None:
+                    return True
+                remaining = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self.cond.wait(0.2 if remaining is None
+                               else min(0.2, remaining))
+
+    def close(self) -> list:
+        """Mark closed and strip the buffered refs out (the runtime
+        drops them and cancels the producer). Idempotent."""
+        with self.cond:
+            if self.closed:
+                return []
+            self.closed = True
+            refs = list(self.items.values())
+            self.items.clear()
+            self.cond.notify_all()
+            return refs
+
+    def finished(self) -> bool:
+        with self.cond:
+            return self.closed or self.error is not None \
+                or self._done_locked()
+
+
+class ObjectRefGenerator:
+    """Caller-facing handle of a streaming task (reference:
+    ``ObjectRefGenerator``, python/ray/_raylet.pyx). Iterating yields
+    ``ObjectRef``s in the producer's yield order; ``ray_tpu.get`` each
+    to materialize (a mid-stream exception is delivered as the failing
+    item — its ``get`` raises). Supports sync and async iteration,
+    next-ready waiting, and early termination via ``close()``."""
+
+    def __init__(self, state: StreamState):
+        self._state = state
+
+    # -------------------------------------------------------------- sync
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._state.next_ref()
+
+    def next_ref(self, timeout: Optional[float] = None) -> ObjectRef:
+        """``__next__`` with a timeout (``GetTimeoutError`` on expiry)."""
+        return self._state.next_ref(timeout)
+
+    def next_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the next item is available (or the stream has
+        ended) without consuming it; False on timeout."""
+        return self._state.next_ready(timeout)
+
+    # ------------------------------------------------------------- async
+    def __aiter__(self) -> "ObjectRefGenerator":
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        import asyncio
+        loop = asyncio.get_event_loop()
+        sentinel = object()
+
+        def pull():
+            try:
+                return self._state.next_ref()
+            except StopIteration:
+                # StopIteration must not cross the executor future (it
+                # would be swallowed into a RuntimeError inside the
+                # coroutine machinery)
+                return sentinel
+
+        out = await loop.run_in_executor(None, pull)
+        if out is sentinel:
+            raise StopAsyncIteration
+        return out
+
+    # ---------------------------------------------------------- control
+    def task_id(self) -> TaskID:
+        return TaskID(self._state.task_id_b)
+
+    def is_finished(self) -> bool:
+        """True when the stream can yield nothing further (EOF reached
+        and consumed, terminally failed, or cancelled)."""
+        return self._state.finished()
+
+    def close(self) -> None:
+        """Early termination: cancel the producer task and drop every
+        buffered (unconsumed) item ref. Safe to call repeatedly."""
+        self._state.runtime._close_stream(self._state)
+
+    cancel = close
+
+    def __del__(self):
+        try:
+            if not self._state.finished():
+                self.close()
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        raise TypeError(
+            "ObjectRefGenerator is not serializable: it is owned by the "
+            "submitting process (pass the consumed values, or the item "
+            "ObjectRefs, instead)")
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({TaskID(self._state.task_id_b).hex()[:16]})"

@@ -24,6 +24,13 @@ from ray_tpu.core.ids import (
 )
 
 
+#: ``num_returns`` sentinel for streaming generator tasks (the API-level
+#: ``num_returns="streaming"``): return objects are minted dynamically,
+#: one per yielded item, and reported via STREAM_ITEM while the task
+#: runs (reference: TaskSpec returns_dynamically / num_streaming_returns)
+STREAMING_RETURNS = -1
+
+
 @dataclass(frozen=True, slots=True)
 class FunctionDescriptor:
     """Stable key for a remote function / actor class."""
@@ -112,12 +119,21 @@ class TaskSpec:
     actor_name: str = ""
     namespace: str = ""
     is_async_actor: bool = False
+    #: streaming-only: per-call backpressure window override
+    #: (0 = use config.generator_backpressure_num_objects; <0 = off)
+    backpressure: int = 0
 
     @property
     def is_actor_task(self) -> bool:
         return self.actor_id is not None and not self.is_actor_creation
 
+    @property
+    def is_streaming(self) -> bool:
+        return self.num_returns == STREAMING_RETURNS
+
     def return_ids(self) -> List[ObjectID]:
+        # streaming tasks (num_returns == STREAMING_RETURNS == -1) have
+        # no static returns: the empty range is load-bearing
         return [ObjectID.for_task_return(self.task_id, i + 1)
                 for i in range(self.num_returns)]
 
@@ -136,7 +152,7 @@ class TaskSpec:
             self.hold_resources, self.max_restarts,
             self.max_task_retries, self.max_concurrency,
             self.max_pending_calls, self.actor_name, self.namespace,
-            self.is_async_actor))
+            self.is_async_actor, self.backpressure))
 
 
 def _spec_from_wire(*fields) -> "TaskSpec":

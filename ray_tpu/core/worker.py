@@ -150,6 +150,12 @@ class WorkerExecutor:
         #: learned wire bytes of the canonical ((), {}) args blob —
         #: lets _resolve_args skip deserializing no-arg fan-out calls
         self._empty_args_blob: Optional[bytes] = None
+        #: streaming backpressure: task_id -> cumulative items the
+        #: consumer reported consumed (STREAM_CREDIT); producers block
+        #: on the condition when produced - consumed hits the window
+        self._stream_cond = threading.Condition()
+        self._stream_consumed: Dict[bytes, int] = {}
+        runtime.stream_credit_handler = self._on_stream_credit
         self._rm = None  # cached runtime metrics handle
         self._block_depth = 0  # main thread blocked in ray.get inside task
         #: serializes the pump thread's dispatch-vs-blocked decision against
@@ -444,6 +450,7 @@ class WorkerExecutor:
         retriable = True
         results = []
         values: Optional[list] = None
+        stream_metas: Optional[list] = None
         restore_env = None
         try:
             if tid_b in self._cancelled:
@@ -459,13 +466,19 @@ class WorkerExecutor:
                 spec, m.get("inline_args") or {}, m.get("arg_errors") or {})
             if spec.is_actor_creation:
                 values = [self._create_actor_instance(spec, args, kwargs)]
+            elif spec.is_streaming:
+                # streaming generator task: items are stored and
+                # reported eagerly inside; `values` stays empty and the
+                # trimmed item metas become the TASK_DONE results
+                stream_metas = self._run_streaming(spec, args, kwargs)
+                values = []
             elif spec.is_actor_task:
                 values = self._run_actor_method(spec, args, kwargs)
             else:
                 fn = self._load_function(spec.function.key())
                 out = fn(*args, **kwargs)
                 values = list(out) if spec.num_returns > 1 else [out]
-            if len(values) != spec.num_returns:
+            if not spec.is_streaming and len(values) != spec.num_returns:
                 raise ValueError(
                     f"task returned {len(values)} values, expected "
                     f"{spec.num_returns}")
@@ -506,6 +519,13 @@ class WorkerExecutor:
                     results = []
                     break
                 results.append(meta)
+            if stream_metas is not None:
+                # streamed items were stored and owner-reported in-band;
+                # TASK_DONE ships the trimmed metas so the controller
+                # records shm locations + lineage (inline items stay
+                # owner-local — the owner got their bytes via
+                # STREAM_ITEM, the controller only needs existence)
+                results = stream_metas
         if error_blob is not None:
             results = [{"object_id": oid.binary()}
                        for oid in spec.return_ids()]
@@ -523,10 +543,14 @@ class WorkerExecutor:
         driver_leased = bool(m.get("driver_leased"))
         if direct_ok:
             # shallow-copy the metas: TASK_DONE carries the same list,
-            # and a same-process owner stores these dicts directly
+            # and a same-process owner stores these dicts directly.
+            # Streaming tasks ship NO result metas here: the owner's
+            # authoritative per-item metas arrived via STREAM_ITEM, and
+            # the trimmed TASK_DONE copies must not overwrite them.
             result_msg = (owner_b, P.TASK_RESULT, {
                 "task_id": tid_b,
-                "results": [dict(r, error=error_blob) for r in results],
+                "results": [] if spec.is_streaming else
+                [dict(r, error=error_blob) for r in results],
                 "error": error_blob,
                 "actor_id": spec.actor_id.binary() if spec.is_actor_task
                 else None,
@@ -566,6 +590,9 @@ class WorkerExecutor:
             # on every actor call would tax the hot path
             "is_actor_task": spec.is_actor_task,
         }
+        if stream_metas is not None:
+            done["streaming"] = True
+            done["stream_count"] = len(stream_metas)
         if m.get("driver_leased"):
             # direct driver-leased dispatch: tell the controller to skip
             # worker/lease bookkeeping; retriable errors ship the spec so
@@ -671,9 +698,214 @@ class WorkerExecutor:
                 out = asyncio.new_event_loop().run_until_complete(out)
         return list(out) if spec.num_returns > 1 else [out]
 
-    def _delayed_exit(self):
-        time.sleep(0.2)
-        os._exit(0)
+    # ------------------------------------------------ streaming generators
+    def _on_stream_credit(self, m: dict) -> None:
+        """Pump-thread: the consumer reported cumulative consumption —
+        open the producer's backpressure window. Credits are monotonic;
+        stale/reordered ones are ignored."""
+        with self._stream_cond:
+            tid = m.get("task_id")
+            cur = self._stream_consumed.get(tid)
+            if cur is not None and m.get("consumed", 0) > cur:
+                self._stream_consumed[tid] = m["consumed"]
+                self._stream_cond.notify_all()
+
+    def _stream_wait_window(self, tid_b: bytes, produced: int,
+                            window: int) -> None:
+        """Block until the consumer's credit opens the window (produced
+        - consumed < window). Interruptible: ray.cancel (SIGINT on the
+        main thread, the cancel flag elsewhere) and executor shutdown
+        break the wait — a producer must never outlive its consumer's
+        interest.
+
+        A credit wait is an open-ended remote wait, exactly like a
+        ray.get inside a task: the blocked-worker protocol applies
+        (NOTIFY_BLOCKED + pipeline handback), or a slow consumer would
+        wedge every task queued behind this one on the serial thread
+        and pin a cpu the cluster could use."""
+
+        def open_locked() -> bool:
+            return produced - self._stream_consumed.get(tid_b, 0) < window
+
+        with self._stream_cond:
+            if open_locked():
+                return  # fast path: no protocol round-trip
+        token = self.runtime._enter_blocked()
+        try:
+            with self._stream_cond:
+                while not open_locked():
+                    if tid_b in self._cancelled or self._stop or \
+                            self.runtime._stopped.is_set():
+                        raise TaskCancelledError(TaskID(tid_b))
+                    self._stream_cond.wait(0.1)
+        finally:
+            self.runtime._exit_blocked(token)
+
+    def _agen_iter(self, agen):
+        """Bridge an async generator to a sync iterator: on an async
+        actor, items are pulled through the actor's event loop (user
+        code may await shared state there); elsewhere a private loop
+        drives it. The finally runs on close() too (cancelled stream):
+        the source's aclose() must fire promptly so its own finally
+        blocks (e.g. the serve replica's ongoing-count decrement) run,
+        instead of waiting for some distant GC."""
+        if self._async_loop is not None:
+            try:
+                while True:
+                    try:
+                        fut = asyncio.run_coroutine_threadsafe(
+                            agen.__anext__(), self._async_loop)
+                        yield fut.result()
+                    except StopAsyncIteration:
+                        return
+            finally:
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        agen.aclose(), self._async_loop).result(5.0)
+                except Exception:
+                    pass
+        else:
+            loop = asyncio.new_event_loop()
+            try:
+                while True:
+                    try:
+                        yield loop.run_until_complete(agen.__anext__())
+                    except StopAsyncIteration:
+                        return
+            finally:
+                try:
+                    loop.run_until_complete(agen.aclose())
+                except Exception:
+                    pass
+                loop.close()
+
+    def _make_stream_iterator(self, spec: TaskSpec, args, kwargs):
+        """Invoke the task body and normalize its result to a sync
+        iterator of yielded items."""
+        import inspect
+        if spec.is_actor_task:
+            if self.actor_instance is None:
+                from ray_tpu.exceptions import ActorDiedError
+                raise ActorDiedError(spec.actor_id,
+                                     "no instance in this worker")
+            method = getattr(self.actor_instance, spec.function.qualname)
+            out = method(*args, **kwargs)
+        else:
+            fn = self._load_function(spec.function.key())
+            out = fn(*args, **kwargs)
+        if inspect.iscoroutine(out):
+            # an async (non-generator) method returning a generator:
+            # resolve it first. inspect, not asyncio: the asyncio
+            # predicate also matches plain generators (legacy
+            # generator-coroutines), which must stream as-is.
+            if self._async_loop is not None and \
+                    threading.current_thread().name != "actor-asyncio":
+                out = asyncio.run_coroutine_threadsafe(
+                    out, self._async_loop).result()
+            else:
+                out = asyncio.new_event_loop().run_until_complete(out)
+        if inspect.isasyncgen(out):
+            return self._agen_iter(out)
+        if inspect.isgenerator(out) or hasattr(out, "__iter__"):
+            return iter(out)
+        raise TypeError(
+            f"num_returns='streaming' requires "
+            f"{spec.name or spec.function.qualname!r} to return a "
+            f"generator, got {type(out).__name__}")
+
+    def _run_streaming(self, spec: TaskSpec, args, kwargs) -> list:
+        """Execute a generator task: eagerly store each yielded item as
+        its own object and report it (STREAM_ITEM, reliable) the moment
+        it exists; STREAM_EOF closes the stream (reference:
+        ``ReportGeneratorItemReturns``, core_worker.cc). Consumer-paced:
+        blocks at the backpressure window until credits arrive. Returns
+        the trimmed item metas for TASK_DONE (controller records shm
+        locations + lineage off them).
+
+        Error semantics: a mid-stream exception is delivered AS the
+        failing item (typed, ordered) followed by EOF — unless the task
+        may retry (retry_exceptions + retries budgeted), in which case
+        nothing terminal is emitted and the replay re-reports the
+        stream from index 1 (the owner dedups)."""
+        from ray_tpu.core.ids import ObjectID as _OID
+        rt = self.runtime
+        tid_b = spec.task_id.binary()
+        owner_b = spec.owner.binary() if spec.owner else None
+        me = rt.worker_id.binary()
+        window = spec.backpressure or getattr(
+            rt.config, "generator_backpressure_num_objects", 64)
+        with self._stream_cond:
+            self._stream_consumed.setdefault(tid_b, 0)
+        metas = []
+        produced = 0
+        it = None
+
+        def send_item(index: int, meta: dict) -> None:
+            if owner_b:
+                rt._send_direct(owner_b, P.STREAM_ITEM, {
+                    "task_id": tid_b, "index": index, "meta": meta,
+                    "worker": me})
+
+        def send_eof(count: int) -> None:
+            if owner_b:
+                rt._send_direct(owner_b, P.STREAM_EOF, {
+                    "task_id": tid_b, "count": count, "worker": me})
+
+        try:
+            it = self._make_stream_iterator(spec, args, kwargs)
+            while True:
+                if window > 0:
+                    self._stream_wait_window(tid_b, produced, window)
+                if tid_b in self._cancelled:
+                    raise TaskCancelledError(spec.task_id)
+                try:
+                    value = next(it)
+                except StopIteration:
+                    break
+                produced += 1
+                oid = _OID.for_task_return(spec.task_id, produced)
+                meta = rt._store_value(oid, value, notify=True)
+                metas.append(
+                    meta if meta.get("node_id") is not None
+                    else {"object_id": meta["object_id"],
+                          "size": meta.get("size", 0)})
+                send_item(produced, meta)
+        except (KeyboardInterrupt, TaskCancelledError):
+            # cancelled (usually by the consumer closing the stream):
+            # EOF for any straggler consumer, then the normal cancel
+            # reporting path
+            send_eof(produced)
+            raise
+        except BaseException as e:  # noqa: BLE001
+            if spec.retry_exceptions and spec.max_retries != 0:
+                # a retry may replay the stream cleanly — emit nothing
+                # terminal (the owner dedups the replayed prefix)
+                raise
+            # typed mid-stream exception delivered as the failing item
+            produced += 1
+            oid = _OID.for_task_return(spec.task_id, produced)
+            err = e if isinstance(e, TaskError) else \
+                TaskError.from_exception(
+                    spec.name or spec.function.qualname, e)
+            item_meta = {"object_id": oid.binary(), "error": P.dumps(err)}
+            rt.seed_meta(oid.binary(), item_meta)
+            send_item(produced, item_meta)
+            send_eof(produced)
+            raise err
+        finally:
+            with self._stream_cond:
+                self._stream_consumed.pop(tid_b, None)
+            # close the (possibly abandoned) generator NOW: its finally
+            # blocks — and for async gens the bridged aclose() — must
+            # not wait for GC (a cancelled serve stream would otherwise
+            # leak the replica's ongoing-count until collection)
+            if it is not None:
+                try:
+                    it.close()
+                except Exception:
+                    pass
+        send_eof(produced)
+        return metas
 
     @staticmethod
     def _apply_runtime_env(env: dict):

@@ -177,6 +177,23 @@ class DeliveryFailedError(RayTpuError):
                 (self.mtype, self.target, self.attempts, self.elapsed_s))
 
 
+class StreamCancelledError(RayTpuError):
+    """An ``ObjectRefGenerator`` was iterated after ``close()``/``cancel()``.
+
+    Early consumer termination cancels the producer task and drops the
+    stream's buffered item refs; further iteration is a caller bug and
+    surfaces as this typed error rather than a hang on items that will
+    never arrive.
+    """
+
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"stream of task {task_id} was cancelled")
+
+    def __reduce__(self):
+        return (StreamCancelledError, (self.task_id,))
+
+
 class ObjectStoreFullError(RayTpuError):
     """Shared-memory store is full and eviction/spill could not make room."""
 

@@ -43,6 +43,7 @@ _DEFAULT_OPTS = dict(
     max_retries=3, retry_exceptions=False, name=None,
     scheduling_strategy=None, runtime_env=None, memory=None,
     placement_group=None, placement_group_bundle_index=-1,
+    generator_backpressure_num_objects=None,
 )
 
 
@@ -144,22 +145,32 @@ class RemoteFunction:
             cache = (opts, resources_from_opts(opts),
                      make_scheduling_strategy(opts))
             self._opts_cache = cache
+        num_returns = opts["num_returns"]
+        # num_returns="streaming": a generator task — items become their
+        # own objects, reported while the task runs; the call returns an
+        # ObjectRefGenerator (reference: ray.remote num_returns model)
+        streaming = num_returns == "streaming"
+        from ray_tpu.core.task_spec import STREAMING_RETURNS
         spec = TaskSpec(
             task_id=w.next_task_id(),
             job_id=w.job_id,
             function=descriptor,
             args_blob=args_blob,
             arg_refs=[(i, oid) for i, oid in arg_refs],
-            num_returns=opts["num_returns"],
+            num_returns=STREAMING_RETURNS if streaming else num_returns,
             resources=dict(cache[1]),
             scheduling_strategy=cache[2],
             max_retries=opts["max_retries"],
             retry_exceptions=bool(opts["retry_exceptions"]),
             name=opts.get("name") or self.__name__,
             runtime_env=_prepare_env(w, opts.get("runtime_env")),
+            backpressure=int(
+                opts.get("generator_backpressure_num_objects") or 0),
         )
+        if streaming:
+            return w.submit_streaming_task(spec)
         refs = w.submit_task(spec)
-        return refs[0] if opts["num_returns"] == 1 else refs
+        return refs[0] if num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):
         """DAG API entry (reference: python/ray/dag/function_node.py)."""

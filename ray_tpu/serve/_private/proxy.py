@@ -11,7 +11,9 @@ modes per route (flags from ``ServeController.get_routes_info``):
   handle, JSON the result (back-compat with round-3 clients).
 - **streaming** — deployments whose ``__call__`` is a (async) generator
   stream chunks to the client as they are produced, via
-  ``DeploymentResponseGenerator`` (consumer-paced pulls).
+  ``DeploymentResponseGenerator`` over a core ``ObjectRefGenerator``
+  (items pushed as produced, consumer-paced by the core backpressure
+  window, delivery covered by the reliable-transport guarantees).
 - **asgi** — ``@serve.ingress`` deployments: the whole request ships to
   the replica, the ASGI app's send() events stream back and are written
   to the socket incrementally (FastAPI StreamingResponse works
@@ -256,7 +258,9 @@ class HTTPProxy:
 
         try:
             gen = await loop.run_in_executor(self._pool, start)
-            gen.batch_size = 1   # stream tokens as produced, not in 8s
+            # core streaming: tokens arrive as the replica produces
+            # them (STREAM_ITEM push), so each next() returns the next
+            # token without a polling round-trip
             it = iter(gen)
             first = await loop.run_in_executor(
                 self._pool, next, it, _END)
@@ -291,7 +295,6 @@ class HTTPProxy:
 
         try:
             gen = await loop.run_in_executor(self._pool, start)
-            gen.batch_size = 1   # ASGI events flush incrementally
             it = iter(gen)
             first = await loop.run_in_executor(
                 self._pool, next, it, _END)

@@ -10,8 +10,7 @@ from __future__ import annotations
 
 import contextvars
 import inspect
-import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict
 
 #: Request-scoped metadata (reference: serve.context._serve_request_context);
 #: read by ``serve.get_multiplexed_model_id()`` inside user code.
@@ -33,7 +32,6 @@ class Replica:
         self.replica_id = replica_id
         self._num_ongoing = 0
         self._num_total = 0
-        self._streams: Dict[str, Any] = {}  # stream_id -> live iterator
         if isinstance(func_or_class, type):
             self._instance = func_or_class(*init_args, **init_kwargs)
         elif callable(func_or_class):
@@ -70,71 +68,54 @@ class Replica:
             _request_context.reset(token)
 
     # -- streaming (reference: RayServeHandle options(stream=True) →
-    # DeploymentResponseGenerator; consumer-paced chunk pulls here) --
-    async def start_stream(self, stream_id: str, ctx: dict,
-                           method_name: str, *args, **kwargs) -> None:
+    # DeploymentResponseGenerator): the handle calls this with
+    # num_returns="streaming", so each yielded item becomes its own
+    # core object, eagerly reported and consumer-paced by the core
+    # backpressure window — there is no replica-held live-generator
+    # table and no next_chunks polling protocol anymore. Early consumer
+    # termination cancels this task; the finally/close path restores
+    # the ongoing-count used for load balancing.
+    async def handle_request_stream(self, ctx: dict, method_name: str,
+                                    *args, **kwargs):
         self._num_ongoing += 1
         self._num_total += 1
-        token = _request_context.set(ctx or {})
         try:
-            method = getattr(self._instance, method_name)
-            out = method(*args, **kwargs)
-            if inspect.iscoroutine(out):
-                out = await out
-        except BaseException:
-            self._num_ongoing -= 1
-            raise
+            token = _request_context.set(ctx or {})
+            try:
+                method = getattr(self._instance, method_name)
+                out = method(*args, **kwargs)
+                if inspect.iscoroutine(out):
+                    out = await out
+            finally:
+                _request_context.reset(token)
+            if not (inspect.isgenerator(out) or inspect.isasyncgen(out)
+                    or hasattr(out, "__iter__")):
+                raise TypeError(
+                    f"options(stream=True) requires {method_name!r} to "
+                    f"return a generator, got {type(out).__name__}")
+            is_async = inspect.isasyncgen(out)
+            it = out if is_async else iter(out)
+            while True:
+                # the request context must be visible to the generator
+                # BODY, which only runs inside this pull — and each pull
+                # of an async generator runs in a fresh task context, so
+                # a one-shot set at creation would not stick
+                token = _request_context.set(ctx or {})
+                try:
+                    if is_async:
+                        try:
+                            item = await it.__anext__()
+                        except StopAsyncIteration:
+                            break
+                    else:
+                        try:
+                            item = next(it)
+                        except StopIteration:
+                            break
+                finally:
+                    _request_context.reset(token)
+                yield item
         finally:
-            _request_context.reset(token)
-        if not (inspect.isgenerator(out) or inspect.isasyncgen(out)
-                or hasattr(out, "__iter__")):
-            self._num_ongoing -= 1
-            raise TypeError(
-                f"options(stream=True) requires {method_name!r} to return "
-                f"a generator, got {type(out).__name__}")
-        it = out if inspect.isasyncgen(out) else iter(out)
-        # keep the ctx with the iterator: a generator body only runs at
-        # next(), so the request context must be set around each pull,
-        # not around creation
-        self._streams[stream_id] = (it, ctx or {})
-
-    async def next_chunks(self, stream_id: str, max_n: int = 8):
-        """Pull up to max_n items; returns (items, done)."""
-        ent = self._streams.get(stream_id)
-        if ent is None:
-            return [], True
-        it, ctx = ent
-        items = []
-        done = False
-        token = _request_context.set(ctx)
-        try:
-            if inspect.isasyncgen(it):
-                for _ in range(max_n):
-                    try:
-                        items.append(await it.__anext__())
-                    except StopAsyncIteration:
-                        done = True
-                        break
-            else:
-                for _ in range(max_n):
-                    try:
-                        items.append(next(it))
-                    except StopIteration:
-                        done = True
-                        break
-        except BaseException:
-            self._streams.pop(stream_id, None)
-            self._num_ongoing -= 1
-            raise
-        finally:
-            _request_context.reset(token)
-        if done:
-            self._streams.pop(stream_id, None)
-            self._num_ongoing -= 1
-        return items, done
-
-    async def cancel_stream(self, stream_id: str) -> None:
-        if self._streams.pop(stream_id, None) is not None:
             self._num_ongoing -= 1
 
     def num_ongoing_requests(self) -> int:

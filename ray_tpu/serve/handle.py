@@ -11,7 +11,6 @@ from the controller when its version bumps (simplified LongPollHost).
 from __future__ import annotations
 
 import random
-import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -47,62 +46,67 @@ def _is_actor_death(e: BaseException) -> bool:
 
 
 class DeploymentResponseGenerator:
-    """Consumer-paced streaming response (reference:
-    ``handle.py:DeploymentResponseGenerator`` for
-    ``options(stream=True)``): the replica holds the live generator;
-    chunks are pulled in small batches as the consumer iterates. An
-    abandoned generator cancels itself on GC so the replica's live
-    stream (and its ongoing-count) is not leaked."""
+    """Streaming response of ``options(stream=True)`` (reference:
+    ``handle.py:DeploymentResponseGenerator``): a thin value-yielding
+    view over a core :class:`~ray_tpu.ObjectRefGenerator` — the replica
+    executes the method as a streaming generator task, each item is its
+    own object reported as produced, and the core credit window paces
+    the producer. Iterating yields materialized values; ``cancel()``
+    (or GC of an abandoned generator) cancels the replica-side task and
+    frees unconsumed items. A replica death before the first item
+    re-routes once, like unary ``DeploymentResponse``."""
 
-    def __init__(self, replica, stream_id: str, start_ref, router, rkey):
-        self._replica = replica
-        self._stream_id = stream_id
-        self._start_ref = start_ref  # raises here if the method blew up
+    def __init__(self, gen, router=None, rkey=None, retry=None):
+        self._gen = gen          # core ObjectRefGenerator
         self._router = router
         self._rkey = rkey
-        self._buf: List[Any] = []
+        self._retry = retry      # () -> DeploymentResponseGenerator
+        self._started = False
         self._done = False
-        #: items pulled per replica round-trip. 8 amortizes RPCs for
-        #: throughput consumers; latency-sensitive consumers (the HTTP
-        #: proxy streaming tokens) set 1 so a slow producer's first
-        #: item isn't held hostage to its eighth.
-        self.batch_size = 8
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        if self._start_ref is not None:
-            try:
-                ray_tpu.get(self._start_ref)
-            except BaseException:
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._finish()
+            raise
+        except Exception as e:
+            if not self._started and self._retry is not None \
+                    and _is_actor_death(e):
+                # membership was stale and the replica is gone: resync
+                # and re-route this stream once
+                retry, self._retry = self._retry, None
                 self._finish()
-                raise
-            self._start_ref = None
-        while not self._buf:
-            if self._done:
-                raise StopIteration
-            try:
-                items, done = ray_tpu.get(
-                    self._replica.next_chunks.remote(
-                        self._stream_id, self.batch_size))
-            except BaseException:
-                self._finish()
-                raise
-            self._buf.extend(items)
-            if done:
-                self._finish()
-        return self._buf.pop(0)
+                fresh = retry()
+                self._gen = fresh._gen
+                self._router = fresh._router
+                self._rkey = fresh._rkey
+                self._done = False
+                return next(self)
+            self._finish()
+            raise
+        self._started = True
+        try:
+            return ray_tpu.get(ref)
+        except BaseException:
+            # a mid-stream exception is delivered as the failing item:
+            # the stream is over — release the router's stream count
+            self._finish()
+            raise
 
     def _finish(self) -> None:
         if not self._done:
             self._done = True
-            self._router.stream_finished(self._rkey)
+            if self._router is not None:
+                self._router.stream_finished(self._rkey)
 
     def cancel(self) -> None:
         if not self._done:
             self._finish()
-            self._replica.cancel_stream.remote(self._stream_id)
+            self._gen.close()
 
     def __del__(self):
         try:
@@ -232,13 +236,22 @@ class DeploymentHandle:
                   for k, v in kwargs.items()}
         replica, rkey = r.pick(self._model_id)
         if self._stream:
-            stream_id = uuid.uuid4().hex
+            # core streaming generator task: the replica method's items
+            # arrive as first-class objects with backpressure and the
+            # runtime's delivery/fault guarantees — no replica-held
+            # generator state, no chunk polling
             ctx = {"multiplexed_model_id": self._model_id or ""}
-            start = replica.start_stream.remote(
-                stream_id, ctx, method, *args, **kwargs)
+            gen = replica.handle_request_stream.options(
+                num_returns="streaming").remote(
+                    ctx, method, *args, **kwargs)
             r.stream_started(rkey)
+
+            def retry_on_dead_replica():
+                r.refresh(force=True)
+                return self._route(method, args, kwargs)
+
             return DeploymentResponseGenerator(
-                replica, stream_id, start, r, rkey)
+                gen, r, rkey, retry=retry_on_dead_replica)
         if self._model_id is not None:
             ctx = {"multiplexed_model_id": self._model_id}
             ref = replica.handle_request_ctx.remote(

@@ -5,10 +5,11 @@ FastAPI/ASGI app into a deployment class) and
 ``_private/http_util.py`` (``ASGIReceiveProxy`` / response streaming).
 TPU-native shape: the proxy ships a picklable request snapshot to the
 replica; the replica runs the ASGI app and streams its send() events
-back through the ordinary deployment streaming channel
-(``Replica.start_stream``/``next_chunks``), so FastAPI
-``StreamingResponse`` bodies flow to the HTTP client chunk by chunk
-without the proxy ever importing the user's app.
+back through the ordinary deployment streaming channel (a core
+streaming generator task — ``Replica.handle_request_stream`` with
+``num_returns="streaming"``), so FastAPI ``StreamingResponse`` bodies
+flow to the HTTP client chunk by chunk without the proxy ever
+importing the user's app.
 """
 
 from __future__ import annotations

@@ -168,14 +168,23 @@ CHAOS_MIX = {"drop_prob": 0.02, "dup_prob": 0.05, "delay_prob": 0.05,
              "delay_range_s": [0.001, 0.05]}
 
 #: the soak mix: >=5% drops across the widened droppable set
-#: (TASK_DISPATCH/ACTOR_CALL/TASK_ASSIGN/TASK_DONE included), one
-#: scheduled 2s controller<->node partition that heals mid-run, and
-#: seeded disk faults on the spill path (EIO/ENOSPC on spill writes,
+#: (TASK_DISPATCH/ACTOR_CALL/TASK_ASSIGN/TASK_DONE and the streaming
+#: STREAM_ITEM/STREAM_EOF/STREAM_CREDIT reports included), one
+#: scheduled 2s controller<->node partition that heals mid-run, one
+#: asymmetric one-way worker->peer window (half-open link), a
+#: latency-distribution window (slow worker->peer links, so streaming
+#: backpressure is exercised under skew, not just loss), and seeded
+#: disk faults on the spill path (EIO/ENOSPC on spill writes,
 #: EIO/truncation on restore reads)
 SOAK_MIX = {"drop_prob": 0.05, "dup_prob": 0.05, "delay_prob": 0.05,
             "delay_range_s": [0.001, 0.05],
             "partitions": [{"start": 5.0, "end": 7.0,
-                            "a": "controller", "b": "node"}],
+                            "a": "controller", "b": "node"},
+                           {"start": 9.0, "end": 10.5,
+                            "src": "worker", "dst": "peer"}],
+            "latency": [{"start": 12.0, "end": 18.0, "src": "worker",
+                         "dst": "peer", "dist": "exp", "mean": 0.008,
+                         "cap": 0.08}],
             "disk": {"restore_read": 0.2, "spill_write": 0.15}}
 
 
@@ -223,13 +232,17 @@ def _assert_refcounts_drain(runtime, deadline_s=25.0):
 
 def _run_chaos_workload(seed, n_tasks, n_actor_calls, kills,
                         restart_controller, deadline_s, mix=CHAOS_MIX,
-                        big_objects=0):
+                        big_objects=0, n_streams=0, stream_len=0):
     """Submit a seeded mix of tasks + actor calls while the monkey
     kills workers (and optionally the controller) on a deterministic
     schedule, then check the end-state invariants. ``big_objects`` puts
     that many shm-sized objects under a store budget small enough to
     force spills, so the seeded disk faults on the spill path actually
-    fire; their gets must resolve to the value or a typed error."""
+    fire; their gets must resolve to the value or a typed error.
+    ``n_streams``/``stream_len`` add streaming generator tasks running
+    THROUGH the fault window (dropped/duplicated STREAM_ITEMs, kills,
+    the controller restart): every yielded item must still arrive
+    exactly once, in order."""
     _chaos_env(seed, mix)
     try:
         init_kw = {}
@@ -267,6 +280,20 @@ def _run_chaos_workload(seed, n_tasks, n_actor_calls, kills,
             for k in range(big_objects):
                 big_refs.append(ray_tpu.put(
                     np.full(8 << 20, k % 251, dtype=np.uint8)))
+
+        gens = []
+        if n_streams:
+            @ray_tpu.remote(num_returns="streaming", max_retries=8,
+                            generator_backpressure_num_objects=8)
+            def streamer(n):
+                for i in range(n):
+                    time.sleep(0.002)
+                    yield i
+
+            # started BEFORE the task burst: the streams live through
+            # the kills, the partition windows and the controller
+            # restart below
+            gens = [streamer.remote(stream_len) for _ in range(n_streams)]
         kill_at = sorted(monkey.rng.sample(
             range(10, n_tasks - 5), kills)) if kills else []
         restart_at = n_tasks // 2 if restart_controller else -1
@@ -326,13 +353,44 @@ def _run_chaos_workload(seed, n_tasks, n_actor_calls, kills,
             assert big_ok >= 1, \
                 f"every spilled object was lost: {typed_errors}"
 
+        # ---- invariant: streaming generators deliver every yielded
+        # item exactly once, in order — through >=5% STREAM_ITEM/
+        # STREAM_EOF/STREAM_CREDIT drops, duplicates, latency skew,
+        # worker kills and the controller restart
+        streamed = 0
+        for gi, g in enumerate(gens):
+            vals_g = []
+            while True:
+                remaining = max(10.0, deadline - time.monotonic())
+                try:
+                    sref = g.next_ref(timeout=remaining)
+                except StopIteration:
+                    break
+                except GetTimeoutError:
+                    raise AssertionError(
+                        f"hung stream {gi} at item {len(vals_g)} "
+                        f"(seed={seed}, monkey log={monkey.log})")
+                vals_g.append(ray_tpu.get(sref, timeout=60))
+            assert vals_g == list(range(stream_len)), (
+                f"stream {gi}: items lost/duplicated/reordered under "
+                f"chaos (seed={seed}): got {len(vals_g)} items")
+            streamed += len(vals_g)
+        stats_file = os.environ.get("RAY_TPU_CHAOS_STATS_FILE")
+        if stats_file:
+            # per-seed streamed-item counts for tools/chaos_matrix.sh:
+            # a truncated stream is visible in a red run's report
+            with open(stats_file, "w") as f:
+                json.dump({"seed": seed, "streamed_items": streamed,
+                           "stream_expected": n_streams * stream_len},
+                          f)
+
         # ---- invariant: refcounts drain once the driver drops refs
         # (clear the loop leftovers too: ``r``/``arr`` in this frame
         # would otherwise pin the last ref through the drain check)
-        r = arr = None  # noqa: F841
-        del refs, arefs, vals, big_refs, r, arr
+        r = arr = sref = None  # noqa: F841
+        del refs, arefs, vals, big_refs, gens, r, arr, sref
         _assert_refcounts_drain(global_worker())
-        return observed_pids, ok, typed_errors, monkey
+        return observed_pids, ok, typed_errors, monkey, streamed
     finally:
         try:
             ray_tpu.shutdown()
@@ -344,9 +402,11 @@ def _run_chaos_workload(seed, n_tasks, n_actor_calls, kills,
 def test_chaos_smoke():
     """Tier-1 chaos coverage: seeded drops/dups/delays at every
     transport plus one worker SIGKILL — small enough to stay fast."""
-    observed, ok, errs, _ = _run_chaos_workload(
+    observed, ok, errs, _, streamed = _run_chaos_workload(
         seed=7101, n_tasks=90, n_actor_calls=45, kills=1,
-        restart_controller=False, deadline_s=150.0)
+        restart_controller=False, deadline_s=150.0,
+        n_streams=1, stream_len=40)
+    assert streamed == 40
     # ---- invariant: no leaked worker processes after shutdown
     _assert_workers_reaped(observed)
 
@@ -362,18 +422,23 @@ SOAK_SEEDS = [int(s) for s in os.environ.get(
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", SOAK_SEEDS)
 def test_chaos_soak(seed):
-    """The full soak: >=300 tasks + >=120 actor calls under seeded
-    kills, >=5% drops across the whole critical message set (the
-    retransmit/ack layer recovers them), duplicates and delays, one
+    """The full soak: >=300 tasks + >=120 actor calls + 3 streaming
+    generator tasks (150 items each) under seeded kills, >=5% drops
+    across the whole critical message set — STREAM_ITEM/STREAM_EOF/
+    STREAM_CREDIT included — (the retransmit/ack layer recovers them),
+    duplicates and delays, a latency-distribution window on the
+    worker->peer links (streaming backpressure under skew), one
     controller kill -9 mid-stream, one scheduled 2s controller<->node
-    partition that heals, and spill-path disk-fault injection over
-    forced big-object spills. Replays deterministically per seed."""
-    observed, ok, errs, monkey = _run_chaos_workload(
+    partition plus an asymmetric one-way worker->peer window, and
+    spill-path disk-fault injection over forced big-object spills.
+    Replays deterministically per seed."""
+    observed, ok, errs, monkey, streamed = _run_chaos_workload(
         seed=seed, n_tasks=300, n_actor_calls=120, kills=3,
         restart_controller=True, deadline_s=420.0, mix=SOAK_MIX,
-        big_objects=8)
+        big_objects=8, n_streams=3, stream_len=150)
     assert ("restart_controller",) in monkey.log
     assert sum(1 for e in monkey.log if e[0] == "kill_worker") >= 1
+    assert streamed == 3 * 150
     _assert_workers_reaped(observed)
 
 

@@ -6,11 +6,15 @@
 #   tools/chaos_matrix.sh 5          # 5 seeds: 1101, 2202, ... 5505
 #   tools/chaos_matrix.sh 1101 9907  # explicit seed list
 #
-# Each seed runs the full soak (300 tasks + 120 actor calls under kills,
-# drops, dups, delays, a controller kill -9, a scheduled
-# controller<->node partition, and spill-path disk faults). On failure
-# the replay line (RAY_TPU_CHAOS_SEED=<seed> ...) is printed and the
-# script exits non-zero after finishing the remaining seeds.
+# Each seed runs the full soak (300 tasks + 120 actor calls + 3
+# streaming generator tasks under kills, drops, dups, delays, a
+# latency-skewed worker link, a controller kill -9, scheduled
+# controller<->node and one-way worker->peer partitions, and spill-path
+# disk faults). Per seed the soak writes its streamed-item count to a
+# stats file this script reports, so a truncated stream is visible at a
+# glance in a red run. On failure the replay line
+# (RAY_TPU_CHAOS_SEED=<seed> ...) is printed and the script exits
+# non-zero after finishing the remaining seeds.
 set -u
 
 cd "$(dirname "$0")/.."
@@ -26,12 +30,34 @@ else
     seeds=("$@")
 fi
 
+stats_dir="${TMPDIR:-/tmp}/ray_tpu_chaos_matrix.$$"
+mkdir -p "$stats_dir"
+
+report_streams() {
+    # per-seed streamed-item report: "streamed 450/450 items" (or
+    # "no stream stats" when the soak died before consuming streams)
+    local seed="$1" f="$stats_dir/soak_$1.json"
+    if [ -f "$f" ]; then
+        python - "$f" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+print(f"    seed {d['seed']}: streamed {d['streamed_items']}"
+      f"/{d['stream_expected']} items")
+EOF
+    else
+        echo "    seed $seed: no stream stats (soak died before the" \
+             "stream invariant — truncated stream or earlier failure)"
+    fi
+}
+
 failed=()
 for seed in "${seeds[@]}"; do
     echo "=== chaos soak: seed=$seed ==="
     # the soak parametrizes its seed list from this env var at
-    # collection time (see tests/core/test_chaos.py)
+    # collection time (see tests/core/test_chaos.py); the stats file
+    # carries the per-seed streamed-item count back out
     if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        RAY_TPU_CHAOS_STATS_FILE="$stats_dir/soak_$seed.json" \
         JAX_PLATFORMS=cpu python -m pytest \
         "tests/core/test_chaos.py::test_chaos_soak" \
         -q -p no:cacheprovider -p no:randomly; then
@@ -40,6 +66,7 @@ for seed in "${seeds[@]}"; do
         echo "=== seed=$seed FAILED ==="
         failed+=("$seed")
     fi
+    report_streams "$seed"
 done
 
 if [ "${#failed[@]}" -gt 0 ]; then
@@ -49,6 +76,8 @@ if [ "${#failed[@]}" -gt 0 ]; then
         echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$seed python -m pytest" \
              "tests/core/test_chaos.py::test_chaos_soak -q"
     done
+    rm -rf "$stats_dir"
     exit 1
 fi
+rm -rf "$stats_dir"
 echo "all ${#seeds[@]} seeds passed"
