@@ -93,9 +93,12 @@ PROTECTED_TYPES = frozenset({"REG", "REGR", "BYE", "RPL", "ERR", "RCN"})
 #: streaming-generator item/EOF/credit reports — covered by the same
 #: ack/retransmit layer, so dropping them must still deliver every
 #: yielded item exactly once, in order.
+#: TEV is the flight-recorder flush (core/events.py): reliably
+#: delivered like its peers, and observability loss must never block
+#: task progress — exactly the contract chaos drops exercise.
 DEFAULT_DROPPABLE = frozenset({"RES", "PUT", "PNG", "HBT",
                                "DSP", "ACL", "ASG", "DON",
-                               "SIT", "SEF", "SCR"})
+                               "SIT", "SEF", "SCR", "TEV"})
 
 
 @dataclass

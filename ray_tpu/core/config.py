@@ -187,6 +187,14 @@ class Config:
     task_events_report_interval_ms: int = 1000
     task_events_max_buffer: int = 100_000
     enable_timeline: bool = True
+    #: Flight recorder (core/events.py): per-process bounded event ring
+    #: flushed to the controller as TASK_EVENTS. Disable with
+    #: RAY_TPU_ENABLE_TASK_EVENTS=0 (traces/timeline go dark; the task
+    #: path loses its only per-hop observability).
+    enable_task_events: bool = True
+    #: Ring capacity per process; overflow drops the OLDEST events,
+    #: counted in the runtime_events_dropped_total metric.
+    task_events_ring_size: int = 4096
 
     # --- TPU ---
     #: Name of the countable chip resource (reference:

@@ -29,6 +29,7 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 import zmq
 
 from ray_tpu.core import chaos as CH
+from ray_tpu.core import events as EV
 from ray_tpu.core import protocol as P
 from ray_tpu.core import reliable as RD
 from ray_tpu.core.config import Config
@@ -110,6 +111,14 @@ class Controller:
         self._chaos = CH.maybe_injector("controller")
         self._chaos_dedup = CH.SeqDeduper() if self._chaos is not None \
             else None
+        # flight recorder + aggregation sink (core/events.py): the
+        # controller's own events ingest locally; every other process
+        # flushes TASK_EVENTS batches here. Guarded by _events_lock —
+        # ingest can fire from the reliable layer's retransmit thread.
+        self._events_lock = threading.Lock()
+        self.flight_events: List[dict] = []
+        self.recorder = EV.make_recorder("controller", config,
+                                         send=self._ingest_events)
         # reliable-delivery sublayer: TASK_DISPATCH/TASK_ASSIGN/
         # TASK_RESULT to workers, nodes and owners get ack/retransmit;
         # resends re-enter _send (thread-safe cross-thread marshal)
@@ -117,7 +126,8 @@ class Controller:
             config, lambda t, mt, pl: self._send(t, mt, pl),
             lambda route, pl: self._send(route, P.MSG_ACK, pl),
             rng=self._chaos.rng_for("retransmit")
-            if self._chaos is not None else None, name="controller")
+            if self._chaos is not None else None, name="controller",
+            recorder=self.recorder)
         self.ctx = zmq.Context.instance()
         self.sock = self.ctx.socket(zmq.ROUTER)
         self.sock.setsockopt(zmq.ROUTER_MANDATORY, 0)
@@ -338,6 +348,9 @@ class Controller:
                                          frames[1] if len(frames) > 1 else frames)
             self._flush_outbox()
             self._drain_sends()
+            # latency bound on the controller's OWN flight-recorder
+            # events reaching the aggregation buffer
+            self.recorder.maybe_flush()
         try:
             self.sock.close(0)
             self._wake_recv.close(0)
@@ -1372,6 +1385,9 @@ class Controller:
             self.task_table[tid].update(
                 state="RUNNING", node=t.node_id.hex() if t.node_id else None,
                 started_at=time.time())
+            self.recorder.record_task(
+                EV.DISPATCHED, t.spec.task_id.hex(), t.spec.trace,
+                worker=worker.hex()[:12])
             self._send_dispatch(worker, t)
             aid = t.spec.actor_id.binary()
             info = self.actors.get(aid)
@@ -1401,6 +1417,13 @@ class Controller:
         lease.inflight.add(tid)
         self.task_table[tid].update(state="RUNNING", node=t.node_id.hex(),
                                     started_at=time.time())
+        self.recorder.record_task(
+            EV.LEASED, t.spec.task_id.hex(), t.spec.trace,
+            worker=lease.worker.hex()[:12],
+            queue_s=round(time.monotonic() - t.submitted_at, 6))
+        self.recorder.record_task(
+            EV.DISPATCHED, t.spec.task_id.hex(), t.spec.trace,
+            worker=lease.worker.hex()[:12])
         self._send_dispatch(lease.worker, t)
 
     def _send_dispatch(self, worker: bytes, t: PendingTask) -> None:
@@ -1441,6 +1464,10 @@ class Controller:
 
     def _h_task_done(self, identity: bytes, m: dict) -> None:
         tid = m["task_id"]
+        self.recorder.record_task(
+            EV.FAILED if m.get("error") is not None else EV.FINISHED,
+            TaskID(tid).hex(), m.get("trace"),
+            worker=identity.hex()[:12])
         # Duplicate executions happen (at-least-once resubmission racing
         # a completion already in flight): lease/worker bookkeeping below
         # must still run for WHICHEVER worker executed, but result
@@ -2558,6 +2585,12 @@ class Controller:
             rows = self.scheduler.available_resources()
         elif what == "timeline":
             rows = self.task_events[-m.get("limit", 100_000):]
+        elif what == "task_events":
+            # merged flight-recorder stream: pull the controller's own
+            # buffered events in first so the snapshot is fresh
+            self.recorder.flush()
+            with self._events_lock:
+                rows = self.flight_events[-m.get("limit", 100_000):]
         else:
             rows = []
         return rows
@@ -2606,6 +2639,20 @@ class Controller:
         cap = self.config.task_events_max_buffer
         if len(self.task_events) > cap:
             self.task_events = self.task_events[-cap:]
+
+    def _ingest_events(self, events: List[dict]) -> None:
+        """Append flight-recorder events into the bounded aggregation
+        buffer (thread-safe: remote TEV batches land on the loop
+        thread, the controller's own watermark flushes can fire from
+        the reliable layer's thread)."""
+        with self._events_lock:
+            self.flight_events.extend(events)
+            cap = self.config.task_events_max_buffer
+            if len(self.flight_events) > cap:
+                del self.flight_events[:len(self.flight_events) - cap]
+
+    def _h_task_events(self, identity: bytes, m: dict) -> None:
+        self._ingest_events(m.get("events") or [])
 
     def _h_subscribe(self, identity: bytes, m: dict) -> None:
         self.subs[m["channel"]].add(identity)
@@ -2659,6 +2706,7 @@ class Controller:
         P.TASK_HANDBACK: _h_task_handback,
         P.STATE_QUERY: _h_state_query,
         P.TIMELINE_EVENTS: _h_timeline,
+        P.TASK_EVENTS: _h_task_events,
         P.SUBSCRIBE: _h_subscribe,
         P.PUBSUB: _h_pubsub,
         P.MSG_ACK: _h_msg_ack,

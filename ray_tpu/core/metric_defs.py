@@ -72,6 +72,34 @@ class RuntimeMetrics:
         self.message_batch_size = Histogram(
             "runtime_message_batch_size",
             "Messages coalesced per wire batch")
+        # -- reliable delivery (core/reliable.py hot paths)
+        self.retransmits = Counter(
+            "runtime_reliable_retransmits_total",
+            "Reliable-layer retransmissions", tag_keys=("type",))
+        self.ack_batch_size = Histogram(
+            "runtime_reliable_ack_batch_size",
+            "Wire seqs acknowledged per MSG_ACK message",
+            boundaries=[1, 2, 5, 10, 20, 50, 100, 250])
+        self.ack_rtt = Histogram(
+            "runtime_reliable_ack_rtt_seconds",
+            "Send-to-ack latency of reliably-delivered messages "
+            "(retransmit attempts included)")
+        self.dup_dropped = Counter(
+            "runtime_reliable_dup_dropped_total",
+            "Retransmit duplicates discarded by the receive dedup")
+        self.delivery_failed = Counter(
+            "runtime_reliable_delivery_failed_total",
+            "Messages abandoned at the attempt cap "
+            "(DeliveryFailedError)")
+        # -- streaming generators
+        self.credit_stall_seconds = Counter(
+            "runtime_stream_credit_stall_seconds_total",
+            "Seconds streaming producers spent blocked on the "
+            "backpressure window waiting for STREAM_CREDIT")
+        # -- flight recorder (core/events.py)
+        self.events_dropped = Counter(
+            "runtime_events_dropped_total",
+            "Flight-recorder events dropped at the ring-buffer cap")
         # -- memory / health (reference: memory_manager worker kills)
         self.oom_worker_kills = Counter(
             "runtime_oom_worker_kills_total",

@@ -28,6 +28,7 @@ import zmq
 
 from ray_tpu.core import chaos as CH
 from ray_tpu.core import direct as D
+from ray_tpu.core import events as EV
 from ray_tpu.core import protocol as P
 from ray_tpu.core import reliable as RD
 from ray_tpu.core.config import Config, get_config
@@ -197,13 +198,20 @@ class NodeManager:
         #: direct acks parked here; drained by the message loop (peer
         #: sockets are loop-thread-only)
         self._chaos_delayed: "deque" = deque()
+        # flight recorder (core/events.py): the node's contribution is
+        # transport-health events (retransmits of its PUT announcements,
+        # dedup drops); flushed with the heartbeat
+        self.recorder = EV.make_recorder(
+            f"node:{self.node_id.hex()[:12]}", self.config,
+            send=lambda evs: self._send(P.TASK_EVENTS, {"events": evs}))
         # reliable-delivery sublayer: the node's critical one-way
         # traffic is controller-bound (PUT_OBJECT announcements); it
         # also acks the controller's TASK_ASSIGNs
         self._reliable = RD.maybe_transport(
             self.config, self._reliable_resend, self._reliable_ack,
             rng=self._chaos.rng_for("retransmit")
-            if self._chaos is not None else None, name="node")
+            if self._chaos is not None else None, name="node",
+            recorder=self.recorder)
 
     # ------------------------------------------------------------------ run
     def _register_with_controller(self) -> None:
@@ -794,6 +802,7 @@ class NodeManager:
                 pass
             self._send(P.HEARTBEAT, {
                 "node_id": self.node_id.binary(), "stats": stats})
+            self.recorder.maybe_flush()
 
     # ----------------------------------------------------------- transfers
     # Receiving side drives (reference: pull_manager.h:52 — the puller

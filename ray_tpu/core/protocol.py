@@ -121,7 +121,12 @@ PROFILE_SELF = b"PRF"        # controller->worker {rid, duration_s}:
                              # profiling; reference: reporter agent's
                              # py-spy endpoints)
 PROFILE_RESULT = b"PRR"      # worker->controller {rid, collapsed, ...}
-TIMELINE_EVENTS = b"TLE"     # worker->controller task event batch
+TIMELINE_EVENTS = b"TLE"     # worker->controller span/timeline batch
+TASK_EVENTS = b"TEV"         # any->controller {events: [...]}: flight-
+                             # recorder flush (core/events.py). Rides
+                             # the reliable layer (exactly-once-effect)
+                             # but is fire-and-forget for the producer —
+                             # a flush never blocks task progress.
 PUBSUB = b"PUB"              # {channel, data} fanout
 SUBSCRIBE = b"SSC"           # {channel}
 GENERIC_REPLY = b"RPL"

@@ -74,6 +74,11 @@ RELIABLE_TYPES = frozenset({
               # cumulative so a lost one is healed by the next — but the
               # LAST credit has no successor, and its loss would wedge
               # the producer at the backpressure window for good
+    b"TEV",   # TASK_EVENTS    any -> controller: flight-recorder flush
+              # (core/events.py). Dedup at the controller makes the
+              # merged event stream exactly-once-effect like the
+              # lifecycle messages it describes; the producer side
+              # stays fire-and-forget (a flush never blocks a task)
 })
 
 #: payload key carrying ``(sender tag, seq)``; popped before handlers
@@ -110,7 +115,8 @@ class ReliableTransport:
                  max_attempts: int = 12, ack_delay_s: float = 0.02,
                  types: frozenset = RELIABLE_TYPES,
                  rng=None, on_fail: Optional[Callable] = None,
-                 name: str = "", start_thread: bool = True):
+                 name: str = "", start_thread: bool = True,
+                 recorder=None):
         from ray_tpu.core.chaos import SeqDeduper
         self._resend = resend
         self._send_ack = send_ack
@@ -122,6 +128,11 @@ class ReliableTransport:
         self._rng = rng
         self._on_fail = on_fail
         self.name = name
+        #: flight recorder (core/events.py FlightRecorder) for
+        #: RETRANSMIT / DUP_DROPPED / ACK_RTT / DELIVERY_FAILED events;
+        #: None keeps every hook a single attribute check
+        self.recorder = recorder
+        self._metrics = None  # lazily-bound runtime metric handles
 
         #: unique per process *instance*: distinguishes sender streams at
         #: a receiver and fences stale acks across restarts
@@ -167,6 +178,38 @@ class ReliableTransport:
             self._cond.notify()
         return payload
 
+    def _m(self):
+        """Lazily-bound runtime metric handles (import deferred: unit
+        tests drive bare transports with no runtime around)."""
+        m = self._metrics
+        if m is None:
+            from ray_tpu.core.metric_defs import runtime_metrics
+            base = runtime_metrics()
+            m = self._metrics = (
+                base.retransmits,            # 0: Counter by type
+                base.ack_rtt.bound(),        # 1: Histogram
+                base.dup_dropped.bound(),    # 2: Counter
+                base.delivery_failed.bound(),  # 3: Counter
+                base.ack_batch_size.bound())   # 4: Histogram
+        return m
+
+    @staticmethod
+    def _task_hex(payload) -> Optional[str]:
+        tid = payload.get("task_id") if isinstance(payload, dict) else None
+        return tid.hex() if isinstance(tid, bytes) else tid
+
+    def _note_retransmit(self, mtype: bytes, payload: dict,
+                         attempt: int) -> None:
+        try:
+            kind = mtype.decode("ascii", "replace")
+            self._m()[0].inc(tags={"type": kind})
+            if self.recorder is not None:
+                self.recorder.record("RETRANSMIT", type=kind,
+                                     attempt=attempt,
+                                     task=self._task_hex(payload))
+        except Exception:
+            pass
+
     def _delay(self, attempt: int) -> float:
         # "equal" jitter keeps a floor of half the window: a retransmit
         # fired before the receiver's batched ack can possibly return is
@@ -178,14 +221,35 @@ class ReliableTransport:
         """Handle an incoming ``MSG_ACK``: drop acked seqs from the ring.
         Acks stamped with another instance's tag (pre-restart traffic)
         are ignored."""
+        now = time.monotonic()
+        acked = []
         with self._cond:
             for tag, ranges in m.get("acks", ()):
                 if tag != self.tag:
                     continue
                 for lo, hi in ranges:
                     for seq in range(lo, hi + 1):
-                        if self._ring.pop(seq, None) is not None:
+                        e = self._ring.pop(seq, None)
+                        if e is not None:
                             self.stats["acked"] += 1
+                            acked.append(e)
+        for e in acked:
+            # send-to-ack latency (retransmit attempts included): the
+            # per-message delivery-health signal
+            try:
+                rtt = now - e["born"]
+                self._m()[1].observe(rtt)
+                if e["attempts"] > 0 and self.recorder is not None:
+                    # only retransmitted messages are interesting enough
+                    # to keep as events — a healthy ack would flood the
+                    # ring with one event per message
+                    self.recorder.record(
+                        "ACK_RTT", rtt_s=round(rtt, 6),
+                        attempts=e["attempts"],
+                        type=e["mtype"].decode("ascii", "replace"),
+                        task=self._task_hex(e["payload"]))
+            except Exception:
+                pass
 
     def drop_target(self, target: Any) -> int:
         """Peer-death notice: stop retransmitting to ``target`` (the
@@ -224,6 +288,13 @@ class ReliableTransport:
             self._cond.notify()
         if self._dedup.seen(key):
             self.stats["dup_dropped"] += 1
+            try:
+                self._m()[2].inc()
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "DUP_DROPPED", task=self._task_hex(payload))
+            except Exception:
+                pass
             return True
         return False
 
@@ -254,6 +325,13 @@ class ReliableTransport:
                 self.stats["acks_sent"] += 1
             except Exception:
                 logger.exception("%s: ack send failed", self.name)
+                continue
+            try:
+                self._m()[4].observe(sum(
+                    hi - lo + 1 for _, ranges in payload["acks"]
+                    for lo, hi in ranges))
+            except Exception:
+                pass
 
     def _collect_due_locked(self, now: float):
         resends, failures = [], []
@@ -271,8 +349,17 @@ class ReliableTransport:
                     elapsed_s=now - e["born"]))
                 continue
             e["due"] = now + self._delay(e["attempts"])
-            resends.append((e["target"], e["mtype"], e["payload"]))
+            resends.append((e["target"], e["mtype"], e["payload"],
+                            e["attempts"]))
         return resends, failures
+
+    def _note_failure(self, err) -> None:
+        try:
+            self._m()[3].inc()
+            if self.recorder is not None:
+                self.recorder.record("DELIVERY_FAILED", error=str(err))
+        except Exception:
+            pass
 
     def _next_wake_locked(self, now: float) -> Optional[float]:
         wake = None
@@ -298,8 +385,9 @@ class ReliableTransport:
                         now >= self._ack_first_at + self._ack_delay:
                     acks = self._take_acks_locked()
             self._ship_acks(acks)
-            for target, mtype, payload in resends:
+            for target, mtype, payload, attempt in resends:
                 self.stats["retransmit"] += 1
+                self._note_retransmit(mtype, payload, attempt)
                 try:
                     self._resend(target, mtype, payload)
                 except Exception:
@@ -307,6 +395,7 @@ class ReliableTransport:
                                      self.name, mtype)
             for err in failures:
                 self.stats["delivery_failed"] += 1
+                self._note_failure(err)
                 if len(self.failures) < 256:
                     self.failures.append(err)
                 logger.error("%s: %s", self.name, err)
@@ -326,11 +415,13 @@ class ReliableTransport:
             resends, failures = self._collect_due_locked(now)
             acks = self._take_acks_locked()
         self._ship_acks(acks)
-        for target, mtype, payload in resends:
+        for target, mtype, payload, attempt in resends:
             self.stats["retransmit"] += 1
+            self._note_retransmit(mtype, payload, attempt)
             self._resend(target, mtype, payload)
         for err in failures:
             self.stats["delivery_failed"] += 1
+            self._note_failure(err)
             if len(self.failures) < 256:
                 self.failures.append(err)
             if self._on_fail is not None:
@@ -345,7 +436,8 @@ class ReliableTransport:
 
 
 def maybe_transport(config, resend, send_ack, *, rng=None,
-                    on_fail=None, name: str = "") -> Optional[ReliableTransport]:
+                    on_fail=None, name: str = "",
+                    recorder=None) -> Optional[ReliableTransport]:
     """Build the process's transport from config; None when the layer is
     disabled (``RAY_TPU_RELIABLE_DELIVERY=0``) so every hook stays a
     single attribute check."""
@@ -357,4 +449,4 @@ def maybe_transport(config, resend, send_ack, *, rng=None,
         cap_s=getattr(config, "retransmit_cap_s", 5.0),
         max_attempts=getattr(config, "retransmit_max_attempts", 12),
         ack_delay_s=getattr(config, "ack_flush_delay_s", 0.02),
-        rng=rng, on_fail=on_fail, name=name)
+        rng=rng, on_fail=on_fail, name=name, recorder=recorder)

@@ -25,6 +25,7 @@ import zmq
 
 from ray_tpu.core import chaos as CH
 from ray_tpu.core import direct as D
+from ray_tpu.core import events as EV
 from ray_tpu.core import protocol as P
 from ray_tpu.core import reliable as RD
 from ray_tpu.core.config import Config, get_config
@@ -68,6 +69,14 @@ class Runtime:
         self.job_id = JobID.from_int(0)
         self.config: Config = get_config()
 
+        # flight recorder (core/events.py): bounded per-process event
+        # ring, flushed to the controller as TASK_EVENTS. Created
+        # before the reliable layer so transport events are captured
+        # from the first message.
+        self.recorder = EV.make_recorder(
+            f"{kind}:{self.worker_id.hex()[:12]}", self.config,
+            send=self._send_events)
+
         # seeded fault injection (chaos.py): None in production — every
         # hook below is a single attribute check when disabled
         self._chaos = CH.maybe_injector(kind,
@@ -93,7 +102,8 @@ class Runtime:
         self._reliable = RD.maybe_transport(
             self.config, self._reliable_resend, self._reliable_ack,
             rng=self._chaos.rng_for("retransmit")
-            if self._chaos is not None else None, name=kind)
+            if self._chaos is not None else None, name=kind,
+            recorder=self.recorder)
 
         self.memory_store = InProcessStore()
         self.reference_counter = ReferenceCounter(self._flush_ref_deltas)
@@ -273,6 +283,7 @@ class Runtime:
                 self.reference_counter.flush()
             except Exception:
                 pass
+            self.recorder.maybe_flush()
 
     @property
     def current_task_id(self) -> TaskID:
@@ -308,6 +319,14 @@ class Runtime:
 
     def _send(self, mtype: bytes, payload: Any) -> None:
         self._out_q.put((None, mtype, payload))
+
+    def _send_events(self, evs: List[dict]) -> None:
+        """Flight-recorder flush hook: fire-and-forget enqueue (the
+        reliable layer gives the batch exactly-once-effect at the
+        controller; the recorder's bounded ring means a dead link can
+        never grow memory or block a task)."""
+        if not self._stopped.is_set():
+            self._send(P.TASK_EVENTS, {"events": evs})
 
     def _send_direct(self, target: bytes, mtype: bytes, payload: Any) -> None:
         """Queue a message for a peer's direct channel (``target`` is the
@@ -715,6 +734,7 @@ class Runtime:
         self._release_all_leases()
         self.reference_counter.flush()
         self.flush_timeline()
+        self.recorder.flush()
         self._stopped.set()
         if self._reliable is not None:
             self._reliable.stop()
@@ -1115,7 +1135,10 @@ class Runtime:
         ``STREAM_ITEM`` cannot race it."""
         from ray_tpu.core.streaming import ObjectRefGenerator, StreamState
         tid_b = spec.task_id.binary()
+        if spec.trace is None:
+            spec.trace = EV.child_trace(spec.task_id.hex())
         state = StreamState(self, tid_b)
+        state.trace = spec.trace  # STREAM_CREDIT carries the link back
         with self._streams_lock:
             self._streams[tid_b] = state
         self.submit_task(spec)
@@ -1152,13 +1175,15 @@ class Runtime:
             st.on_eof(m["count"], m.get("worker"))
 
     def _stream_send_credit(self, tid_b: bytes, consumed: int,
-                            producer: Optional[bytes]) -> None:
+                            producer: Optional[bytes],
+                            trace: Optional[tuple] = None) -> None:
         """Consumer progress report: cumulative, so loss-tolerant and
         idempotent; opens the producer's backpressure window."""
         if producer is None or self._stopped.is_set():
             return
         self._send_direct(producer, P.STREAM_CREDIT,
-                          {"task_id": tid_b, "consumed": consumed})
+                          {"task_id": tid_b, "consumed": consumed,
+                           "trace": trace})
 
     def _stream_finished(self, tid_b: bytes) -> None:
         """StreamState hook: the consumer reached EOF — drop the routing
@@ -1565,6 +1590,11 @@ class Runtime:
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         spec.owner = self.worker_id
+        if spec.trace is None:
+            # causal trace propagation: inherit the submitting thread's
+            # context (a task executing under a propagated trace, or a
+            # tracing.span) — else this task roots a new trace
+            spec.trace = EV.child_trace(spec.task_id.hex())
         # register return refs against OUR counter directly — the
         # ObjectRef ctor's context lookup (global-worker resolve per
         # ref) is measurable on the fan-out hot path
@@ -1626,6 +1656,9 @@ class Runtime:
                         spec.arg_metas = metas
                 self._send(P.SUBMIT_TASK, {"spec": spec})
         self._record_event(spec, "submitted")
+        self.recorder.record_task(
+            EV.SUBMITTED, spec.task_id.hex(), spec.trace,
+            name=spec.name or spec.function.qualname)
         return refs
 
     # ---------------------------------------------- direct normal tasks
@@ -1675,9 +1708,16 @@ class Runtime:
                     self._request_leases(self._lease_want_locked())
                 return took
             self._direct_tids[spec.task_id.binary()] = w
+        self._dispatch_direct(w, spec)
+        return True
+
+    def _dispatch_direct(self, w: bytes, spec: TaskSpec) -> None:
+        """Peer-to-peer dispatch onto a leased worker (one site for the
+        DISPATCHED flight-recorder event)."""
+        self.recorder.record_task(EV.DISPATCHED, spec.task_id.hex(),
+                                  spec.trace, worker=w.hex()[:12])
         self._send_direct(w, P.TASK_DISPATCH,
                           {"spec": spec, "driver_leased": True})
-        return True
 
     def _pick_leased_worker_locked(self) -> Optional[bytes]:
         depth = self.config.dispatch_pipeline_depth
@@ -1771,8 +1811,7 @@ class Runtime:
                     while self._direct_backlog:
                         spill.append(self._pop_backlog_locked())
             for w, spec in sends:
-                self._send_direct(w, P.TASK_DISPATCH,
-                                  {"spec": spec, "driver_leased": True})
+                self._dispatch_direct(w, spec)
             for spec in spill:
                 if self._owner_local:
                     # spilling to the controller path: returns become
@@ -1794,8 +1833,7 @@ class Runtime:
                 self._lease_backoff.reset()
             sends = self._drain_backlog_locked()
         for w, spec in sends:
-            self._send_direct(w, P.TASK_DISPATCH,
-                              {"spec": spec, "driver_leased": True})
+            self._dispatch_direct(w, spec)
 
     def _on_direct_task_result(self, tid_b: bytes) -> None:
         send = None
@@ -1814,8 +1852,7 @@ class Runtime:
                     self._direct_tids[spec.task_id.binary()] = nxt
                     send = (nxt, spec)
         if send is not None:
-            self._send_direct(send[0], P.TASK_DISPATCH,
-                              {"spec": send[1], "driver_leased": True})
+            self._dispatch_direct(send[0], send[1])
 
     def _on_lease_revoked(self, worker: bytes,
                           dead: bool = True) -> None:
@@ -1974,7 +2011,10 @@ class Runtime:
                 "seq": spec.sequence_number,
                 "args_blob": spec.args_blob,
                 "arg_refs": spec.arg_refs or None,
-                "arg_metas": spec.arg_metas}
+                "arg_metas": spec.arg_metas,
+                # the template's trace is the FIRST call's — each
+                # compact call must carry its own causal link
+                "trace": spec.trace}
 
     def _resolve_actor(self, aid: bytes) -> None:
         hexid = ActorID(aid).hex()
@@ -2127,6 +2167,7 @@ class Runtime:
         try:
             self._send(P.TASK_DONE, {
                 "task_id": spec.task_id.binary(),
+                "trace": spec.trace,
                 "results": results,
                 "error": blob,
                 "retriable": False,
@@ -2143,6 +2184,11 @@ class Runtime:
 
     def create_actor(self, spec: TaskSpec) -> None:
         spec.owner = self.worker_id
+        if spec.trace is None:
+            spec.trace = EV.child_trace(spec.task_id.hex())
+        self.recorder.record_task(
+            EV.SUBMITTED, spec.task_id.hex(), spec.trace,
+            name=spec.name or spec.function.qualname, actor=True)
         self.request(P.CREATE_ACTOR, {"spec": spec})
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
@@ -2252,6 +2298,11 @@ class Runtime:
             "tid": threading.get_ident() % 1_000_000, "args": args})
         if len(self._timeline_buf) >= 512:
             self.flush_timeline()
+
+    def flush_events(self) -> None:
+        """Push buffered flight-recorder events to the controller now
+        (state queries call this so fresh local events are visible)."""
+        self.recorder.flush()
 
     def flush_timeline(self) -> None:
         if not self._timeline_buf:

@@ -63,6 +63,10 @@ class StreamState:
         self.closed = False
         #: highest index ever reported (replay/reorder dedup)
         self.received_max = 0
+        #: causal trace of the producing task (core/events.py):
+        #: ``(trace_id, parent_span)`` — rides STREAM_CREDIT so every
+        #: control hop of the stream carries the link
+        self.trace: Optional[tuple] = None
 
     # ------------------------------------------------------- report side
     def on_item(self, index: int, meta: dict, producer: Optional[bytes]
@@ -115,7 +119,8 @@ class StreamState:
             with self.cond:
                 consumed = self.next_index - 1
                 producer = self.producer
-            rt._stream_send_credit(self.task_id_b, consumed, producer)
+            rt._stream_send_credit(self.task_id_b, consumed, producer,
+                                   self.trace)
             return
         with self.cond:
             if self.closed:
@@ -183,7 +188,8 @@ class StreamState:
                         f"no stream item within {timeout}s")
                 self.cond.wait(0.2 if remaining is None
                                else min(0.2, remaining))
-        self.runtime._stream_send_credit(self.task_id_b, consumed, producer)
+        self.runtime._stream_send_credit(self.task_id_b, consumed,
+                                         producer, self.trace)
         return ref
 
     def next_ready(self, timeout: Optional[float] = None) -> bool:

@@ -122,6 +122,12 @@ class TaskSpec:
     #: streaming-only: per-call backpressure window override
     #: (0 = use config.generator_backpressure_num_objects; <0 = off)
     backpressure: int = 0
+    #: causal trace propagation (core/events.py): ``(trace_id,
+    #: parent_span)`` hex pair stamped at submission; the task's own
+    #: span id is derived from its task id. Rides every spec-carrying
+    #: control message (DSP/ASG/ACL/CAC) so the flight recorder links
+    #: parent -> child across processes.
+    trace: Optional[Tuple[str, Optional[str]]] = None
 
     @property
     def is_actor_task(self) -> bool:
@@ -152,7 +158,7 @@ class TaskSpec:
             self.hold_resources, self.max_restarts,
             self.max_task_retries, self.max_concurrency,
             self.max_pending_calls, self.actor_name, self.namespace,
-            self.is_async_actor, self.backpressure))
+            self.is_async_actor, self.backpressure, self.trace))
 
 
 def _spec_from_wire(*fields) -> "TaskSpec":

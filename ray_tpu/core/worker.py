@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+from ray_tpu.core import events as EV
 from ray_tpu.core import protocol as P
 from ray_tpu.core.global_state import set_global_worker
 from ray_tpu.core.ids import NodeID, ObjectID, TaskID, WorkerID
@@ -157,6 +158,7 @@ class WorkerExecutor:
         self._stream_consumed: Dict[bytes, int] = {}
         runtime.stream_credit_handler = self._on_stream_credit
         self._rm = None  # cached runtime metrics handle
+        self._stall_metric = None  # cached credit-stall counter handle
         self._block_depth = 0  # main thread blocked in ray.get inside task
         #: serializes the pump thread's dispatch-vs-blocked decision against
         #: on_block's queue drain (without it a dispatch passing the depth
@@ -278,6 +280,7 @@ class WorkerExecutor:
                 spec.arg_refs = m.get("arg_refs") or []
                 spec.arg_metas = m.get("arg_metas")
                 spec.sequence_number = m.get("seq", -1)
+                spec.trace = m.get("trace")
                 m = dict(m, spec=spec)
         spec: TaskSpec = m["spec"]
         if not spec.is_actor_task and not spec.is_actor_creation:
@@ -360,6 +363,9 @@ class WorkerExecutor:
             except queue.Empty:
                 if self.runtime._stopped.is_set():
                     break
+                # idle: ship any buffered flight-recorder events (e.g.
+                # retransmit events from the reliable layer's thread)
+                self.runtime.recorder.maybe_flush()
                 if ran_since_gc:
                     # idle collection: zero-copy arg values that ended up
                     # in reference cycles hold reader leases on their shm
@@ -383,6 +389,7 @@ class WorkerExecutor:
                     err = P.dumps(TaskCancelledError(spec.task_id))
                     self.runtime._send(P.TASK_DONE, {
                         "task_id": spec.task_id.binary(),
+                        "trace": spec.trace,
                         "results": [{"object_id": oid.binary()}
                                     for oid in spec.return_ids()],
                         "error": err, "retriable": False,
@@ -445,6 +452,17 @@ class WorkerExecutor:
         on_main = threading.get_ident() == self._main_ident
         if on_main:
             self._current_tid = tid_b
+        # install the propagated trace context on THIS thread: tasks
+        # this task submits become its causal children, and every
+        # lifecycle event below carries the same trace id
+        tid_hex = spec.task_id.hex()
+        trace_id, span_id, parent_span = EV.task_trace(
+            tid_hex, getattr(spec, "trace", None))
+        trace_tok = EV.set_context(trace_id, span_id)
+        rec = self.runtime.recorder
+        rec.record(EV.RUNNING, task=tid_hex, trace=trace_id,
+                   span=span_id, parent=parent_span,
+                   name=spec.name or spec.function.qualname)
         start = time.time()
         error_blob = None
         retriable = True
@@ -464,20 +482,25 @@ class WorkerExecutor:
                 restore_env = self._apply_runtime_env(spec.runtime_env)
             args, kwargs = self._resolve_args(
                 spec, m.get("inline_args") or {}, m.get("arg_errors") or {})
-            if spec.is_actor_creation:
-                values = [self._create_actor_instance(spec, args, kwargs)]
-            elif spec.is_streaming:
-                # streaming generator task: items are stored and
-                # reported eagerly inside; `values` stays empty and the
-                # trimmed item metas become the TASK_DONE results
-                stream_metas = self._run_streaming(spec, args, kwargs)
-                values = []
-            elif spec.is_actor_task:
-                values = self._run_actor_method(spec, args, kwargs)
-            else:
-                fn = self._load_function(spec.function.key())
-                out = fn(*args, **kwargs)
-                values = list(out) if spec.num_returns > 1 else [out]
+            from ray_tpu.util.tracing import task_execution_span
+            with task_execution_span(
+                    spec.name or spec.function.qualname,
+                    getattr(spec, "trace", None)):
+                if spec.is_actor_creation:
+                    values = [self._create_actor_instance(
+                        spec, args, kwargs)]
+                elif spec.is_streaming:
+                    # streaming generator task: items are stored and
+                    # reported eagerly inside; `values` stays empty and
+                    # the trimmed item metas become the TASK_DONE results
+                    stream_metas = self._run_streaming(spec, args, kwargs)
+                    values = []
+                elif spec.is_actor_task:
+                    values = self._run_actor_method(spec, args, kwargs)
+                else:
+                    fn = self._load_function(spec.function.key())
+                    out = fn(*args, **kwargs)
+                    values = list(out) if spec.num_returns > 1 else [out]
             if not spec.is_streaming and len(values) != spec.num_returns:
                 raise ValueError(
                     f"task returned {len(values)} values, expected "
@@ -503,6 +526,7 @@ class WorkerExecutor:
         if on_main:
             self._current_tid = None
         self._cancelled.pop(tid_b, None)
+        EV.restore(trace_tok)
         if restore_env is not None:
             try:
                 restore_env()
@@ -549,6 +573,7 @@ class WorkerExecutor:
             # the trimmed TASK_DONE copies must not overwrite them.
             result_msg = (owner_b, P.TASK_RESULT, {
                 "task_id": tid_b,
+                "trace": spec.trace,
                 "results": [] if spec.is_streaming else
                 [dict(r, error=error_blob) for r in results],
                 "error": error_blob,
@@ -581,6 +606,7 @@ class WorkerExecutor:
                             for r in results]
         done = {
             "task_id": tid_b,
+            "trace": spec.trace,
             "results": done_results,
             "error": error_blob,
             "retriable": retriable,
@@ -635,6 +661,12 @@ class WorkerExecutor:
         self.runtime.record_span(
             spec.name or spec.function.qualname, start, time.time() - start,
             task_id=spec.task_id.hex())
+        rec.record(EV.FAILED if error_blob is not None else EV.FINISHED,
+                   task=tid_hex, trace=trace_id, span=span_id,
+                   parent=parent_span,
+                   name=spec.name or spec.function.qualname,
+                   dur_s=round(time.time() - start, 6))
+        rec.maybe_flush()
         self.runtime.current_task_id = self.runtime._driver_task_id
 
     async def _execute_async(self, m: dict) -> None:
@@ -731,6 +763,7 @@ class WorkerExecutor:
             if open_locked():
                 return  # fast path: no protocol round-trip
         token = self.runtime._enter_blocked()
+        stall_t0 = time.monotonic()
         try:
             with self._stream_cond:
                 while not open_locked():
@@ -740,6 +773,23 @@ class WorkerExecutor:
                     self._stream_cond.wait(0.1)
         finally:
             self.runtime._exit_blocked(token)
+            stalled = time.monotonic() - stall_t0
+            # producer blocked on the backpressure window: the signal
+            # Podracer-style overlap tuning needs (a persistently
+            # stalled producer means the consumer is the bottleneck)
+            try:
+                rm = self._stall_metric
+                if rm is None:
+                    from ray_tpu.core.metric_defs import runtime_metrics
+                    rm = self._stall_metric = \
+                        runtime_metrics().credit_stall_seconds.bound()
+                if stalled > 0:
+                    rm.inc(stalled)
+            except Exception:
+                pass
+            self.runtime.recorder.record(
+                EV.CREDIT_STALL, task=tid_b.hex(),
+                seconds=round(stalled, 6), produced=produced)
 
     def _agen_iter(self, agen):
         """Bridge an async generator to a sync iterator: on an async
@@ -840,16 +890,25 @@ class WorkerExecutor:
         produced = 0
         it = None
 
+        tid_hex = spec.task_id.hex()
+        trace_id, span_id, parent_span = EV.task_trace(
+            tid_hex, getattr(spec, "trace", None))
+
         def send_item(index: int, meta: dict) -> None:
+            rt.recorder.record(EV.YIELDED, task=tid_hex, trace=trace_id,
+                               span=span_id, parent=parent_span,
+                               index=index)
             if owner_b:
                 rt._send_direct(owner_b, P.STREAM_ITEM, {
                     "task_id": tid_b, "index": index, "meta": meta,
-                    "worker": me})
+                    "worker": me, "trace": spec.trace})
+            rt.recorder.maybe_flush()
 
         def send_eof(count: int) -> None:
             if owner_b:
                 rt._send_direct(owner_b, P.STREAM_EOF, {
-                    "task_id": tid_b, "count": count, "worker": me})
+                    "task_id": tid_b, "count": count, "worker": me,
+                    "trace": spec.trace})
 
         try:
             it = self._make_stream_iterator(spec, args, kwargs)

@@ -158,6 +158,34 @@ def _make_handler(head: DashboardHead):
                     self._json({"rows": head.state(what, limit)})
                 elif path == "/api/timeline":
                     self._json(head.state("timeline", 100_000))
+                elif path == "/api/v0/events":
+                    # merged flight-recorder stream (core/events.py);
+                    # ?task=<hex> narrows to one task, ?ev=<EVENT>
+                    # to one event type
+                    from urllib.parse import parse_qs
+                    q = parse_qs(parsed.query)
+                    rows = head.state("task_events", 100_000)
+                    task = (q.get("task") or [None])[0]
+                    if task:
+                        rows = [r for r in rows if r.get("task") == task]
+                    ev = (q.get("ev") or [None])[0]
+                    if ev:
+                        rows = [r for r in rows if r.get("ev") == ev]
+                    try:
+                        limit = int(q.get("limit", ["100000"])[0])
+                    except ValueError:
+                        self._json({"error": "limit must be an int"},
+                                   400)
+                        return
+                    self._json({"rows": rows[-limit:]})
+                elif path == "/timeline":
+                    # Perfetto/Chrome-trace JSON of the flight-recorder
+                    # stream: load it at https://ui.perfetto.dev or
+                    # chrome://tracing (one track per process, flow
+                    # arrows along trace ids)
+                    from ray_tpu.core.events import build_chrome_trace
+                    self._json(build_chrome_trace(
+                        head.state("task_events", 100_000)))
                 elif path == "/api/jobs":
                     self._json(head.job_manager.list_jobs())
                 elif path == "/api/version":

@@ -35,7 +35,10 @@ INDEX_HTML = """<!doctype html>
 <h1>ray-tpu dashboard <span id="version" class="muted"></span></h1>
 <div id="summary"></div>
 <p><a class="button" href="/api/timeline" download="timeline.json">
-  Download task timeline (Chrome trace)</a></p>
+  Download task timeline (Chrome trace)</a>
+<a class="button" href="/timeline" download="perfetto_trace.json">
+  Download flight-recorder trace (Perfetto)</a>
+<a class="button" href="/api/v0/events">Flight-recorder events (JSON)</a></p>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Worker processes</h2><table id="procs"></table>
 <h2>Jobs</h2><table id="jobs"></table>

@@ -67,6 +67,89 @@ def list_workers(filters=None, limit: int = 1000, **kw) -> List[dict]:
     return out
 
 
+def list_task_events(task_id: Optional[str] = None, filters=None,
+                     limit: int = 100_000) -> List[dict]:
+    """Merged flight-recorder event stream (core/events.py), oldest
+    first. ``task_id`` (hex) narrows to one task's causal timeline;
+    ``filters`` apply the standard ``(key, op, value)`` predicates
+    (keys: ``ev``, ``proc``, ``trace``, ``span``, ...)."""
+    w = global_worker()
+    # ship this process's buffered events first so the snapshot
+    # includes what the caller just did
+    try:
+        w.flush_events()
+    except Exception:
+        pass
+    rows = w.state_query("task_events")
+    if not isinstance(rows, list):
+        return rows
+    if task_id is not None:
+        rows = [r for r in rows if r.get("task") == task_id]
+    for key, op, value in (filters or []):
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"Unsupported predicate {op!r}")
+    return rows[-limit:]
+
+
+def summarize_task_latency() -> Dict[str, Any]:
+    """Per-task-name latency summary from the flight recorder:
+    scheduling delay (SUBMITTED→RUNNING) and execution time
+    (RUNNING→FINISHED/FAILED), with count / mean / max in seconds —
+    the per-stage signal overlap tuning needs (cf. Podracer /
+    MindSpeed RL: rollout→train dataflows are tuned by stage latency,
+    not end-to-end wall time)."""
+    events = list_task_events()
+    per_task: Dict[str, Dict[str, float]] = {}
+    names: Dict[str, str] = {}
+    for e in events:
+        t = e.get("task")
+        if t is None:
+            continue
+        slot = per_task.setdefault(t, {})
+        ev = e.get("ev")
+        if ev in ("SUBMITTED", "RUNNING", "FINISHED", "FAILED"):
+            # first sighting wins for SUBMITTED/RUNNING (replays keep
+            # the original submit), last wins for the terminal event
+            if ev in ("FINISHED", "FAILED") or ev not in slot:
+                slot[ev] = e.get("ts", 0.0)
+        if e.get("name"):
+            names[t] = e["name"]
+
+    def agg(samples: List[float]) -> Dict[str, float]:
+        return {"count": len(samples),
+                "mean_s": sum(samples) / len(samples),
+                "max_s": max(samples)}
+
+    sched: Dict[str, List[float]] = {}
+    execd: Dict[str, List[float]] = {}
+    failed: Counter = Counter()
+    for t, slot in per_task.items():
+        name = names.get(t, "?")
+        if "SUBMITTED" in slot and "RUNNING" in slot:
+            sched.setdefault(name, []).append(
+                max(0.0, slot["RUNNING"] - slot["SUBMITTED"]))
+        end = slot.get("FINISHED", slot.get("FAILED"))
+        if end is not None and "RUNNING" in slot:
+            execd.setdefault(name, []).append(
+                max(0.0, end - slot["RUNNING"]))
+        if "FAILED" in slot:
+            failed[name] += 1
+    out: Dict[str, Any] = {}
+    for name in sorted(set(sched) | set(execd)):
+        out[name] = {}
+        if name in sched:
+            out[name]["scheduling"] = agg(sched[name])
+        if name in execd:
+            out[name]["execution"] = agg(execd[name])
+        if failed.get(name):
+            out[name]["failed"] = failed[name]
+    return out
+
+
 def summarize_tasks() -> Dict[str, Any]:
     by_state: Counter = Counter()
     by_name: Dict[str, Counter] = {}

@@ -45,6 +45,11 @@ def pytest_runtest_makereport(item, call):
             cfg = os.environ.get("RAY_TPU_CHAOS_CONFIG")
             if cfg:
                 line += f" RAY_TPU_CHAOS_CONFIG='{cfg}'"
+            postmortem = os.environ.get("RAY_TPU_CHAOS_POSTMORTEM_FILE")
+            if postmortem:
+                line += ("\nflight-recorder postmortem: "
+                         f"{postmortem} (render with: python "
+                         f"tools/timeline.py --input {postmortem})")
             rep.sections.append(("chaos seed", line))
 
 

@@ -393,9 +393,35 @@ def _run_chaos_workload(seed, n_tasks, n_actor_calls, kills,
         return observed_pids, ok, typed_errors, monkey, streamed
     finally:
         try:
-            ray_tpu.shutdown()
+            _dump_postmortem(seed)
         finally:
-            _clear_chaos_env()
+            try:
+                ray_tpu.shutdown()
+            finally:
+                _clear_chaos_env()
+
+
+def _dump_postmortem(seed) -> None:
+    """Flight-recorder postmortem: dump the controller's merged event
+    buffer next to the seed's stats file so a red soak is diagnosable
+    from the causal timeline, not just logs (tools/chaos_matrix.sh sets
+    the env var; tools/timeline.py renders the dump as a Perfetto
+    trace)."""
+    path = os.environ.get("RAY_TPU_CHAOS_POSTMORTEM_FILE")
+    if not path:
+        return
+    try:
+        from ray_tpu.util.state import list_task_events
+        events = list_task_events()
+        with open(path, "w") as f:
+            json.dump({"seed": seed, "events": events}, f)
+    except Exception as e:  # the workload may have died pre-init
+        try:
+            with open(path, "w") as f:
+                json.dump({"seed": seed, "events": [],
+                           "error": f"postmortem dump failed: {e}"}, f)
+        except Exception:
+            pass
 
 
 @pytest.mark.chaos

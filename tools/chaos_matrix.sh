@@ -32,6 +32,10 @@ fi
 
 stats_dir="${TMPDIR:-/tmp}/ray_tpu_chaos_matrix.$$"
 mkdir -p "$stats_dir"
+# flight-recorder postmortems live OUTSIDE the per-run stats dir so a
+# failing seed's merged event buffer survives the cleanup below
+postmortem_dir="${TMPDIR:-/tmp}/ray_tpu_chaos_postmortems"
+mkdir -p "$postmortem_dir"
 
 report_streams() {
     # per-seed streamed-item report: "streamed 450/450 items" (or
@@ -58,10 +62,12 @@ for seed in "${seeds[@]}"; do
     # carries the per-seed streamed-item count back out
     if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
         RAY_TPU_CHAOS_STATS_FILE="$stats_dir/soak_$seed.json" \
+        RAY_TPU_CHAOS_POSTMORTEM_FILE="$postmortem_dir/postmortem_$seed.json" \
         JAX_PLATFORMS=cpu python -m pytest \
         "tests/core/test_chaos.py::test_chaos_soak" \
         -q -p no:cacheprovider -p no:randomly; then
         echo "=== seed=$seed PASSED ==="
+        rm -f "$postmortem_dir/postmortem_$seed.json"
     else
         echo "=== seed=$seed FAILED ==="
         failed+=("$seed")
@@ -75,6 +81,16 @@ if [ "${#failed[@]}" -gt 0 ]; then
     for seed in "${failed[@]}"; do
         echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$seed python -m pytest" \
              "tests/core/test_chaos.py::test_chaos_soak -q"
+        # merged flight-recorder buffer dumped at teardown: the causal
+        # event timeline of the failing seed, renderable as a Perfetto
+        # trace (tools/timeline.py --input <file>)
+        pm="$postmortem_dir/postmortem_$seed.json"
+        if [ -f "$pm" ]; then
+            echo "  flight recorder: $pm" \
+                 "(python tools/timeline.py --input $pm)"
+        else
+            echo "  flight recorder: no postmortem (died before dump)"
+        fi
     done
     rm -rf "$stats_dir"
     exit 1

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""timeline.py — export the flight recorder as a Perfetto trace.
+
+Renders the merged task-event stream (``ray_tpu/core/events.py``) as
+Chrome-trace/Perfetto JSON: one track per process, an ``X`` slice per
+task execution attempt (replays show as repeated slices on different
+tracks), instants for YIELDED / RETRANSMIT / CREDIT_STALL / ... and
+flow arrows following each task's span id from its SUBMITTED site to
+every execution — so one trace id can be followed visually across
+processes. Open the output at https://ui.perfetto.dev or
+chrome://tracing.
+
+Usage:
+
+  # from a live cluster this process is connected to (ray_tpu.init
+  # already called, or RAY_TPU_SESSION_DIR pointing at one):
+  python tools/timeline.py -o /tmp/trace.json
+
+  # from a dashboard address (no driver needed):
+  python tools/timeline.py --dashboard http://127.0.0.1:8265 -o out.json
+
+  # from an event dump (e.g. a chaos postmortem file):
+  python tools/timeline.py --input postmortem_1101.json -o out.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ray_tpu.core.events import build_chrome_trace  # noqa: E402
+
+
+def _events_from_input(path: str) -> List[dict]:
+    """Accepts a bare event list, an ``{"events": [...]}`` /
+    ``{"rows": [...]}`` wrapper, or a chaos postmortem dump."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return data
+    for key in ("events", "rows"):
+        if isinstance(data.get(key), list):
+            return data[key]
+    raise SystemExit(f"{path}: no event list found "
+                     "(expected a list, or an 'events'/'rows' key)")
+
+
+def _events_from_dashboard(address: str) -> List[dict]:
+    import urllib.request
+    url = address.rstrip("/") + "/api/v0/events"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read())["rows"]
+
+
+def _events_from_cluster() -> List[dict]:
+    from ray_tpu.util.state import list_task_events
+    return list_task_events()
+
+
+def export_timeline(events: List[dict], filename: str) -> str:
+    trace = build_chrome_trace(events)
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return filename
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="export the task-event flight recorder as "
+        "Perfetto/Chrome-trace JSON")
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--input", help="JSON event dump (list, or "
+                     "{'events'|'rows': [...]}; e.g. a chaos "
+                     "postmortem file)")
+    src.add_argument("--dashboard", help="dashboard address "
+                     "(http://host:port) to fetch /api/v0/events from")
+    ap.add_argument("-o", "--output",
+                    default=f"/tmp/ray_tpu/perfetto_{int(time.time())}"
+                    ".json")
+    args = ap.parse_args(argv)
+
+    if args.input:
+        events = _events_from_input(args.input)
+    elif args.dashboard:
+        events = _events_from_dashboard(args.dashboard)
+    else:
+        events = _events_from_cluster()
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.output)) or ".",
+                exist_ok=True)
+    export_timeline(events, args.output)
+    procs = set()
+    for e in events:
+        if isinstance(e, dict):
+            procs.add(e.get("proc"))
+    print(f"wrote {args.output}: {len(events)} events across "
+          f"{len(procs)} processes "
+          f"(open at https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
