@@ -19,8 +19,11 @@ from ray_tpu.models.transformer import (
     TransformerConfig,
     Transformer,
     lm_loss,
+    hidden_states,
     init_params,
     logical_axes,
+    REMAT_POLICIES,
+    remat_policy_fn,
 )
 from ray_tpu.models.registry import get_config, register_config, MODEL_CONFIGS
 from ray_tpu.models.training import (
@@ -33,8 +36,11 @@ __all__ = [
     "TransformerConfig",
     "Transformer",
     "lm_loss",
+    "hidden_states",
     "init_params",
     "logical_axes",
+    "REMAT_POLICIES",
+    "remat_policy_fn",
     "get_config",
     "register_config",
     "MODEL_CONFIGS",

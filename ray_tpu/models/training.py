@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import optax
 
 from ray_tpu.models.transformer import (
     TransformerConfig, init_params, logical_axes, lm_loss)
@@ -47,7 +48,6 @@ class TrainStepBundle:
 
 
 def _default_optimizer(learning_rate: float, weight_decay: float):
-    import optax
     return optax.chain(
         optax.clip_by_global_norm(1.0),
         optax.adamw(learning_rate, b1=0.9, b2=0.95, eps=1e-8,
@@ -60,14 +60,26 @@ def make_train_step(config: TransformerConfig, mesh,
                     optimizer=None,
                     learning_rate: float = 1e-5,
                     weight_decay: float = 0.0,
-                    donate_state: bool = True) -> TrainStepBundle:
+                    donate_state: bool = True,
+                    remat_policy: Optional[str] = None,
+                    ce_chunk_size: Optional[int] = None) -> TrainStepBundle:
     """Build sharded init + train-step functions over ``mesh``.
 
     The optimizer state inherits each parameter's sharding (ZeRO-style
     optimizer sharding falls out of FSDP rules for free — Adam moments are
     param-shaped pytree leaves).
+
+    ``remat_policy`` / ``ce_chunk_size`` override the config's
+    rematerialization policy and fused-CE chunking for this train step
+    without touching the caller's config (the compute-path knobs a
+    trainer wants to sweep without redefining the model).
     """
     rules = rules if rules is not None else FSDP_RULES
+    if remat_policy is not None:
+        config = dataclasses.replace(config, remat=None,
+                                     remat_policy=remat_policy)
+    if ce_chunk_size is not None:
+        config = dataclasses.replace(config, ce_chunk_size=ce_chunk_size)
     if optimizer is None:
         optimizer = _default_optimizer(learning_rate, weight_decay)
 
@@ -122,7 +134,6 @@ def make_train_step(config: TransformerConfig, mesh,
             loss_fn, has_aux=True)(state["params"])
         updates, new_opt = optimizer.update(
             grads, state["opt_state"], state["params"])
-        import optax
         new_params = optax.apply_updates(state["params"], updates)
         new_state = {"params": new_params, "opt_state": new_opt,
                      "step": state["step"] + 1}

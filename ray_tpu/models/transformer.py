@@ -21,6 +21,16 @@ Design (TPU-first, not a port):
 - master params live in f32; ``config.dtype`` (bf16 on TPU) is the
   compute dtype, cast at use sites so the MXU sees bf16 while layernorm
   statistics and the softmax stay f32 (ops layer contract).
+- rematerialization is a named policy (``remat_policy``), not a bool:
+  ``"dots"`` (default) saves projection/MLP matmul outputs and the
+  attention output (``checkpoint_name``) while recomputing elementwise
+  work and attention internals in the backward; ``"full"``/``"none"``
+  are the old all-or-nothing extremes; ``"offload"`` parks block inputs
+  in pinned host memory.
+- the LM loss never materializes the full ``[b, s, vocab]`` logits
+  tensor: ``ops.fused_lm_head_loss`` projects + reduces in sequence
+  chunks of ``ce_chunk_size`` tokens (``ce_chunk_size=0`` restores the
+  materialized-logits reference path).
 """
 
 from __future__ import annotations
@@ -31,6 +41,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops import (
     apply_rotary,
@@ -40,7 +51,10 @@ from ray_tpu.ops import (
     rms_norm,
     rotary_table,
     cross_entropy_loss,
+    fused_lm_head_loss,
 )
+
+REMAT_POLICIES = ("full", "none", "dots", "dots_all", "offload")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,10 +71,15 @@ class TransformerConfig:
     rope_base: float = 10000.0
     block_style: str = "gptj"               # "gptj" | "llama"
     dtype: Any = jnp.bfloat16                # compute dtype
-    remat: bool = True
+    # Legacy bool (True -> "full", False -> "none"); None defers to
+    # remat_policy. Kept so existing configs keep their exact behavior.
+    remat: Optional[bool] = None
+    remat_policy: str = "dots"               # see REMAT_POLICIES
+    # Fused LM-head loss: tokens per CE chunk (0 = materialized logits).
+    ce_chunk_size: int = 512
     attn_impl: str = "auto"                  # ops.multihead_attention impl
-    attn_block_q: int = 512
-    attn_block_k: int = 512
+    attn_block_q: int = 0                    # 0 = chip-aware default
+    attn_block_k: int = 0
     # MoE (0 = dense): every layer's MLP becomes n_experts experts with
     # Switch top-1 routing, weights sharded on the ep mesh axis
     n_experts: int = 0
@@ -70,6 +89,13 @@ class TransformerConfig:
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads
+
+    @property
+    def resolved_remat_policy(self) -> str:
+        """Effective remat policy, honoring the legacy ``remat`` bool."""
+        if self.remat is not None:
+            return "full" if self.remat else "none"
+        return self.remat_policy
 
     @property
     def num_params(self) -> int:
@@ -233,6 +259,38 @@ def logical_axes(config: TransformerConfig) -> Dict:
     }
 
 
+# ---------------------------------------------------------------- remat
+def remat_policy_fn(name: str):
+    """Map a policy name to a ``jax.checkpoint`` saveable policy.
+
+    Returns ``None`` for "full" (save nothing — recompute everything);
+    "none" (don't checkpoint at all) is the caller's branch. "dots" saves
+    matmul outputs WITHOUT batch dims (qkv/out projections, MLP matmuls —
+    weight-stationary dots worth keeping) plus the named attention output,
+    so neither the flash kernel nor the O(s²) reference attention is
+    re-run in the backward; the quadratic score matrices (dots WITH batch
+    dims) are still recomputed. "dots_all" additionally saves those.
+    "offload" parks block inputs in pinned host memory and saves the
+    attention output on device.
+    """
+    cp = jax.checkpoint_policies
+    save_attn = cp.save_only_these_names("attn_out")
+    if name == "full":
+        return None
+    if name == "dots":
+        return cp.save_from_both_policies(
+            cp.dots_with_no_batch_dims_saveable, save_attn)
+    if name == "dots_all":
+        return cp.save_from_both_policies(cp.dots_saveable, save_attn)
+    if name == "offload":
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=["attn_out"],
+            names_which_can_be_offloaded=["block_in"],
+            offload_src="device", offload_dst="pinned_host")
+    raise ValueError(
+        f"unknown remat policy {name!r}; have {REMAT_POLICIES}")
+
+
 # --------------------------------------------------------------- forward
 def _attention(c: TransformerConfig, q, k, v, mesh, rules):
     """Dispatch attention: ring over the sp axis when it's nontrivial,
@@ -241,9 +299,10 @@ def _attention(c: TransformerConfig, q, k, v, mesh, rules):
     if mesh is not None and sp_axis is not None and sp_axis in mesh.shape \
             and mesh.shape[sp_axis] > 1:
         from jax.sharding import PartitionSpec as P
+        from ray_tpu.util.jax_compat import shard_map
         batch_axes = rules.get("batch")
         spec = P(batch_axes, sp_axis, None, None)
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(ring_attention, axis_name=sp_axis,
                               causal=True),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
@@ -272,6 +331,7 @@ def _attn_sublayer(c, h, lp, sin, cos, layout, mesh, rules):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     att = _attention(c, q, k, v, mesh, rules)
+    att = checkpoint_name(att, "attn_out")
     return jnp.einsum("bshd,hde->bse", att,
                       lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
 
@@ -294,6 +354,7 @@ def _mlp_sublayer(c, h, lp):
 
 
 def _gptj_block(c, x, lp, sin, cos, mesh, rules):
+    x = checkpoint_name(x, "block_in")
     h = layer_norm(x, lp["ln_scale"], lp["ln_bias"])
     att = _attn_sublayer(c, h, lp, sin, cos, "gptj", mesh, rules)
     mlp, aux = _mlp_sublayer(c, h, lp)
@@ -302,6 +363,7 @@ def _gptj_block(c, x, lp, sin, cos, mesh, rules):
 
 def _llama_block(c, x, lp, sin, cos, mesh, rules):
     dt = c.dtype
+    x = checkpoint_name(x, "block_in")
     h = rms_norm(x, lp["attn_norm"])
     att = _attn_sublayer(c, h, lp, sin, cos, "neox", mesh, rules)
     x = x + att.astype(x.dtype)
@@ -310,14 +372,13 @@ def _llama_block(c, x, lp, sin, cos, mesh, rules):
     return x + mlp.astype(x.dtype), aux
 
 
-def apply(config: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
-          mesh=None, rules=None, return_moe_aux: bool = False):
-    """Forward pass: (batch, seq) int32 -> (batch, seq, vocab) logits.
+def hidden_states(config: TransformerConfig, params: Dict,
+                  input_ids: jnp.ndarray, mesh=None, rules=None):
+    """Embed -> blocks -> final norm: (b, s) int32 -> ((b, s, e), moe_aux).
 
-    Always returns logits; with ``return_moe_aux=True`` returns
-    ``(logits, moe_aux_loss)`` (0.0 for dense configs). ``mesh``/``rules``
-    enable in-graph sharding constraints and ring attention; both
-    optional (single-device path needs neither).
+    The shared trunk under both :func:`apply` (which adds the LM-head
+    projection) and :func:`lm_loss` (which fuses the projection into the
+    chunked loss so full logits never materialize).
     """
     c = config
     x = jnp.take(params["embed"], input_ids, axis=0).astype(c.dtype)
@@ -329,8 +390,9 @@ def apply(config: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     block = _gptj_block if c.block_style == "gptj" else _llama_block
     body = functools.partial(block, c, sin=sin, cos=cos,
                              mesh=mesh, rules=rules)
-    if c.remat:
-        body = jax.checkpoint(body)
+    policy = c.resolved_remat_policy
+    if policy != "none":
+        body = jax.checkpoint(body, policy=remat_policy_fn(policy))
 
     def scan_fn(carry, lp):
         out, aux = body(carry, lp)
@@ -344,32 +406,67 @@ def apply(config: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     fn = params["final_norm"]
     if c.block_style == "llama":
         x = rms_norm(x, fn["scale"])
-        logits = jnp.dot(x.astype(c.dtype),
-                         params["lm_head"]["w"].astype(c.dtype))
     else:
         x = layer_norm(x, fn["scale"], fn["bias"])
-        logits = jnp.dot(x.astype(c.dtype),
-                         params["lm_head"]["w"].astype(c.dtype))
+    return x, (jnp.sum(layer_aux) if c.n_experts else 0.0)
+
+
+def apply(config: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
+          mesh=None, rules=None, return_moe_aux: bool = False):
+    """Forward pass: (batch, seq) int32 -> (batch, seq, vocab) logits.
+
+    Always returns logits; with ``return_moe_aux=True`` returns
+    ``(logits, moe_aux_loss)`` (0.0 for dense configs). ``mesh``/``rules``
+    enable in-graph sharding constraints and ring attention; both
+    optional (single-device path needs neither).
+    """
+    c = config
+    x, moe_aux = hidden_states(c, params, input_ids, mesh=mesh, rules=rules)
+    logits = jnp.dot(x.astype(c.dtype),
+                     params["lm_head"]["w"].astype(c.dtype))
+    if c.block_style != "llama":
         logits = logits + params["lm_head"]["b"].astype(c.dtype)
     if return_moe_aux:
-        return logits, jnp.sum(layer_aux) if c.n_experts else 0.0
+        return logits, moe_aux
     return logits
 
 
 def lm_loss(config: TransformerConfig, params: Dict, batch: Dict,
             mesh=None, rules=None) -> Tuple[jnp.ndarray, Dict]:
     """Next-token LM loss. batch: {"input_ids": (b,s) int32,
-    "loss_mask": optional (b,s)}. Returns (loss, aux)."""
+    "loss_mask": optional (b,s)}. Returns (loss, aux).
+
+    With ``config.ce_chunk_size > 0`` (default) the LM-head projection is
+    fused into the chunked cross entropy (``ops.fused_lm_head_loss``) —
+    the full float32 logits tensor is never resident. ``ce_chunk_size=0``
+    restores the materialized-logits reference path.
+    """
+    c = config
     ids = batch["input_ids"]
-    logits, moe_aux = apply(config, params, ids, mesh=mesh, rules=rules,
-                            return_moe_aux=True)
     labels = ids[:, 1:]
     mask = batch.get("loss_mask")
     mask = mask[:, 1:] if mask is not None else None
-    loss, n = cross_entropy_loss(logits[:, :-1], labels, mask=mask)
+    # Chunking scans over the sequence axis; when that axis is SHARDED
+    # (sp > 1, the ring-attention meshes) per-chunk slicing would force
+    # the partitioner to regather every chunk — keep materialized logits
+    # there, fuse everywhere else.
+    sp_axis = rules.get("sequence") if rules else None
+    seq_sharded = (mesh is not None and sp_axis is not None
+                   and sp_axis in mesh.shape and mesh.shape[sp_axis] > 1)
+    if c.ce_chunk_size and not seq_sharded:
+        x, moe_aux = hidden_states(c, params, ids, mesh=mesh, rules=rules)
+        head = params["lm_head"]
+        loss, n = fused_lm_head_loss(
+            x.astype(c.dtype)[:, :-1], head["w"], labels,
+            head_bias=head.get("b"), mask=mask,
+            chunk_size=c.ce_chunk_size)
+    else:
+        logits, moe_aux = apply(c, params, ids, mesh=mesh, rules=rules,
+                                return_moe_aux=True)
+        loss, n = cross_entropy_loss(logits[:, :-1], labels, mask=mask)
     aux = {"n_tokens": n}
-    if config.n_experts:
-        loss = loss + config.moe_aux_weight * moe_aux
+    if c.n_experts:
+        loss = loss + c.moe_aux_weight * moe_aux
         aux["moe_aux"] = moe_aux
     return loss, aux
 

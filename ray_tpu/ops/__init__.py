@@ -17,9 +17,10 @@ one tuned implementation.
 from ray_tpu.ops.norms import rms_norm, layer_norm
 from ray_tpu.ops.rotary import rotary_table, apply_rotary
 from ray_tpu.ops.attention import multihead_attention, attention_reference
-from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.flash_attention import (
+    flash_attention, default_flash_blocks, autotune_flash_blocks)
 from ray_tpu.ops.ring_attention import ring_attention
-from ray_tpu.ops.cross_entropy import cross_entropy_loss
+from ray_tpu.ops.cross_entropy import cross_entropy_loss, fused_lm_head_loss
 
 __all__ = [
     "rms_norm",
@@ -29,6 +30,9 @@ __all__ = [
     "multihead_attention",
     "attention_reference",
     "flash_attention",
+    "default_flash_blocks",
+    "autotune_flash_blocks",
     "ring_attention",
     "cross_entropy_loss",
+    "fused_lm_head_loss",
 ]

@@ -14,7 +14,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.flash_attention import (
+    default_flash_blocks, flash_attention)
 
 _NEG_INF = -1e30
 
@@ -40,12 +41,12 @@ def attention_reference(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                       ).astype(q.dtype)
 
 
-def _can_use_flash(q, k, block: int) -> bool:
+def _can_use_flash(q, k, block_q: int, block_k: int) -> bool:
     b, sq, h, d = q.shape
     sk = k.shape[1]
     if d % 128 != 0:
         return False
-    bq, bk = min(block, sq), min(block, sk)
+    bq, bk = min(block_q, sq), min(block_k, sk)
     return sq % bq == 0 and sk % bk == 0
 
 
@@ -54,18 +55,26 @@ def multihead_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                         sm_scale: Optional[float] = None,
                         mask: Optional[jnp.ndarray] = None,
                         impl: str = "auto",
-                        block_q: int = 512,
-                        block_k: int = 512,
+                        block_q: Optional[int] = None,
+                        block_k: Optional[int] = None,
                         interpret: bool = False) -> jnp.ndarray:
     """Attention over (batch, seq, heads, head_dim).
 
     ``impl``: "auto" | "flash" | "reference". Arbitrary ``mask`` forces the
     reference path (the flash kernel handles only the causal structure).
+    ``block_q``/``block_k`` of ``None`` (or 0) resolve to chip-aware
+    defaults (``flash_attention.default_flash_blocks``).
     """
+    if not block_q or not block_k:
+        dq_, dk_ = default_flash_blocks(
+            q.shape[1], k.shape[1], q.shape[-1],
+            chip="cpu" if interpret else None)
+        block_q = block_q or dq_
+        block_k = block_k or dk_
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         use_flash = (mask is None and (on_tpu or interpret)
-                     and _can_use_flash(q, k, block_q))
+                     and _can_use_flash(q, k, block_q, block_k))
         impl = "flash" if use_flash else "reference"
     if impl == "reference" or mask is not None:
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale,

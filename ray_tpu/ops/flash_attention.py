@@ -8,9 +8,17 @@ log-sum-exp is emitted so the backward pass can recompute P exactly.
 
 Backward: Pallas dq/dk/dv kernels (default) — dk/dv accumulate in VMEM
 across a q scan, dq across a k scan, both recomputing P from the saved
-log-sum-exp (Dao et al., Algorithm 4). The earlier `lax.scan` XLA
-formulation remains available (``backward="xla"``) as the numerical
-cross-check.
+log-sum-exp (Dao et al., Algorithm 4). The softmax-Jacobian diagonal
+``delta = rowsum(dO·O)`` is precomputed ONCE by a small fused Pallas
+kernel and fed to both passes, so neither rematerializes the f32
+``dO·O`` product. The earlier `lax.scan` XLA formulation remains
+available (``backward="xla"``) as the numerical cross-check.
+
+Block sizes: callers may pass explicit ``block_q``/``block_k``; leaving
+them ``None`` picks chip-aware defaults (:func:`default_flash_blocks`,
+keyed on ``parallel.mesh.chip_spec``), and
+:func:`autotune_flash_blocks` times a small candidate grid once and
+caches the winner per ``(chip, seq, head_dim)``.
 
 Layout convention at this layer: (batch, num_heads, seq, head_dim).
 Use :func:`ray_tpu.ops.attention.multihead_attention` for the (B, S, H, D)
@@ -156,6 +164,38 @@ def _flash_fwd(cfg: _Cfg, q, k, v):
     return o, (q, k, v, o, lse)
 
 
+def _delta_kernel(o_ref, do_ref, delta_ref, *, bq: int):
+    """delta = rowsum(dO * O) in f32, blocked over q — the backward's
+    softmax-Jacobian diagonal, shaped like the LSE so both ride the same
+    block spec in the dq and dk/dv kernels."""
+    o = o_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    delta_ref[0, 0] = jnp.sum(o * do, axis=-1).reshape(1, bq)
+
+
+def _delta_pallas(cfg: _Cfg, o, do):
+    b, h, sq, d = o.shape
+    bq = min(cfg.block_q, sq)
+    nq = sq // bq
+    compiler_params = None
+    if pltpu is not None and not cfg.interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel"))
+    return pl.pallas_call(
+        functools.partial(_delta_kernel, bq=bq),
+        grid=(b, h, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, sq), jnp.float32),
+        compiler_params=compiler_params,
+        interpret=cfg.interpret,
+    )(o, do)
+
+
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, dk_s, dv_s, *, cfg: _Cfg, offset: int):
     """Grid (b, h, k_blocks, q_blocks), q innermost: dk/dv accumulators
@@ -264,10 +304,7 @@ def _bwd_pallas(cfg: _Cfg, q, k, v, o, lse, do):
     cfg = dataclasses.replace(cfg, block_q=bq, block_k=bk)
     nq, nk = sq // bq, sk // bk
     offset = sk - sq
-    # softmax-Jacobian diagonal, rowsum(dO * O) — cheap elementwise in
-    # XLA, shaped like the LSE so both ride the same block spec
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)[:, :, None, :]               # (b,h,1,sq)
+    delta = _delta_pallas(cfg, o, do)                     # (b,h,1,sq)
     lse4 = lse[:, :, None, :]                             # (b,h,1,sq)
 
     compiler_params = None
@@ -369,20 +406,140 @@ def _flash_bwd(cfg: _Cfg, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# --------------------------------------------------- block-size selection
+def default_flash_blocks(seq_q: int, seq_k: int, head_dim: int,
+                         chip: Optional[str] = None) -> Tuple[int, int]:
+    """Chip-aware default (block_q, block_k).
+
+    Keyed on ``parallel.mesh.chip_spec``: wider k blocks at long sequence
+    amortize the per-block softmax bookkeeping against the MXU matmuls;
+    large head dims shrink both blocks to keep the f32 S/P tiles plus the
+    (block, head_dim) operands inside VMEM.
+    """
+    if chip is None:
+        try:
+            from ray_tpu.parallel.mesh import chip_spec
+            chip = chip_spec().name
+        except Exception:  # jax backend not initializable — be safe
+            chip = "cpu"
+    if chip == "cpu":
+        bq, bk = 256, 256
+    elif head_dim >= 256:
+        bq, bk = 256, 512
+    elif seq_k >= 2048:
+        bq, bk = 512, 1024
+    else:
+        bq, bk = 512, 512
+    bq, bk = min(bq, seq_q), min(bk, seq_k)
+    # Blocks must tile the sequence; fall back to the largest divisor.
+    while seq_q % bq:
+        bq //= 2
+    while seq_k % bk:
+        bk //= 2
+    return max(bq, 1), max(bk, 1)
+
+
+# Winner cache: (chip, seq, head_dim, causal) -> (block_q, block_k).
+_AUTOTUNE_CACHE: dict = {}
+
+_AUTOTUNE_CANDIDATES = (
+    (256, 256), (256, 512), (512, 512), (512, 1024),
+    (1024, 512), (1024, 1024),
+)
+
+
+def _flash_block_timer(batch, heads, seq, head_dim, causal, dtype,
+                       iters: int, include_backward: bool):
+    """Build a timer(block_q, block_k) -> seconds for autotuning."""
+    import time
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (batch, heads, seq, head_dim)
+    q, k, v = (jax.random.normal(kk, shape, dtype) for kk in ks)
+
+    def timer(bq: int, bk: int) -> float:
+        def f(q, k, v):
+            o = flash_attention(q, k, v, causal=causal,
+                                block_q=bq, block_k=bk)
+            return jnp.sum(o.astype(jnp.float32))
+        fn = jax.jit(jax.grad(f, argnums=(0, 1, 2))) \
+            if include_backward else jax.jit(f)
+        r = fn(q, k, v)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(q, k, v)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / iters
+
+    return timer
+
+
+def autotune_flash_blocks(seq: int, head_dim: int, *,
+                          batch: int = 1, heads: int = 8,
+                          causal: bool = True,
+                          dtype=jnp.bfloat16,
+                          candidates=None,
+                          iters: int = 5,
+                          include_backward: bool = True,
+                          timer=None,
+                          chip: Optional[str] = None) -> Tuple[int, int]:
+    """One-shot block-size autotune: time a small candidate grid and cache
+    the winner per ``(chip, seq, head_dim, causal)``.
+
+    Off-TPU (and without an injected ``timer``) this returns the
+    chip-aware default without running anything. ``timer`` is injectable
+    for tests: a callable ``(block_q, block_k) -> seconds``.
+    """
+    if chip is None:
+        try:
+            from ray_tpu.parallel.mesh import chip_spec
+            chip = chip_spec().name
+        except Exception:
+            chip = "cpu"
+    key = (chip, int(seq), int(head_dim), bool(causal))
+    if key in _AUTOTUNE_CACHE:
+        return _AUTOTUNE_CACHE[key]
+
+    default = default_flash_blocks(seq, seq, head_dim, chip=chip)
+    cands = [c for c in (candidates or _AUTOTUNE_CANDIDATES)
+             if seq % min(c[0], seq) == 0 and seq % min(c[1], seq) == 0]
+    if default not in cands:
+        cands.insert(0, default)
+    if timer is None:
+        if jax.default_backend() != "tpu" or len(cands) <= 1:
+            _AUTOTUNE_CACHE[key] = default
+            return default
+        timer = _flash_block_timer(batch, heads, seq, head_dim, causal,
+                                   dtype, iters, include_backward)
+    best, best_t = default, float("inf")
+    for bq, bk in cands:
+        try:
+            t = timer(min(bq, seq), min(bk, seq))
+        except Exception:  # a candidate may not fit VMEM — skip it
+            continue
+        if t < best_t:
+            best, best_t = (min(bq, seq), min(bk, seq)), t
+    _AUTOTUNE_CACHE[key] = best
+    return best
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = False,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 512,
-                    block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: bool = False,
                     backward: str = "pallas") -> jnp.ndarray:
     """Flash attention over (batch, heads, seq, head_dim) arrays.
 
-    Requires seq divisible by the (clamped) block sizes. ``interpret=True``
-    runs the Pallas kernels in interpreter mode (CPU tests).
-    ``backward`` selects the VJP implementation: "pallas" (VMEM-blocked
-    dq/dk/dv kernels recomputing P from the saved LSE) or "xla"
-    (the lax.scan formulation, kept for parity checks).
+    Requires seq divisible by the (clamped) block sizes; ``block_q`` /
+    ``block_k`` left as ``None`` (or 0) pick chip-aware defaults
+    (:func:`default_flash_blocks`). ``interpret=True`` runs the Pallas
+    kernels in interpreter mode (CPU tests). ``backward`` selects the VJP
+    implementation: "pallas" (VMEM-blocked dq/dk/dv kernels recomputing P
+    from the saved LSE) or "xla" (the lax.scan formulation, kept for
+    parity checks).
     """
     d = q.shape[-1]
     if sm_scale is None:
@@ -390,6 +547,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     if backward not in ("pallas", "xla"):
         raise ValueError(f"backward must be 'pallas' or 'xla', "
                          f"got {backward!r}")
+    if not block_q or not block_k:
+        dq_, dk_ = default_flash_blocks(q.shape[2], k.shape[2], d,
+                                        chip="cpu" if interpret else None)
+        block_q = block_q or dq_
+        block_k = block_k or dk_
     cfg = _Cfg(causal=causal, sm_scale=float(sm_scale),
                block_q=block_q, block_k=block_k, interpret=interpret,
                bwd=backward)

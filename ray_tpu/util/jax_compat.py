@@ -1,0 +1,29 @@
+"""Compatibility shims across supported JAX versions.
+
+The numerics layer targets current JAX (``jax.shard_map`` with
+``check_vma``); older still-deployed versions only ship
+``jax.experimental.shard_map.shard_map`` with the ``check_rep`` spelling
+of the same flag. Routing every call site through :func:`shard_map`
+keeps one code path working on both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None) -> Any:
+    """``jax.shard_map`` where available, else the experimental one
+    (``check_vma`` mapped to its old ``check_rep`` name). ``None`` leaves
+    the library default."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -1,0 +1,268 @@
+"""Numerical-parity suite for the compute-path optimizations.
+
+Three families, all fp32 on CPU so the comparisons are tight:
+
+- chunked fused LM-head CE vs. the reference materialized-logits CE:
+  loss AND grads (x / head weights / bias / mask), including z-loss and
+  masked positions, uneven chunk boundaries (padding path), and the
+  model-level ``lm_loss`` wiring on both block styles;
+- every remat policy produces identical loss/grads to ``"full"`` (remat
+  changes scheduling, never math);
+- flash-attention block-size selection: chip-aware defaults tile the
+  sequence, the autotune cache works, and autotuned block configs
+  produce the same output as the defaults.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import get_config, lm_loss
+from ray_tpu.models.transformer import REMAT_POLICIES, remat_policy_fn
+from ray_tpu.ops import (
+    attention_reference,
+    autotune_flash_blocks,
+    cross_entropy_loss,
+    default_flash_blocks,
+    flash_attention,
+    fused_lm_head_loss,
+)
+from ray_tpu.ops.flash_attention import _AUTOTUNE_CACHE
+
+
+# ------------------------------------------------------- fused CE parity
+def _ce_inputs(key, b=2, s=13, e=32, v=97):
+    ks = jax.random.split(key, 5)
+    x = jax.random.normal(ks[0], (b, s, e), jnp.float32)
+    w = 0.1 * jax.random.normal(ks[1], (e, v), jnp.float32)
+    bias = 0.1 * jax.random.normal(ks[2], (v,), jnp.float32)
+    labels = jax.random.randint(ks[3], (b, s), 0, v)
+    mask = (jax.random.uniform(ks[4], (b, s)) > 0.3).astype(jnp.float32)
+    return x, w, bias, labels, mask
+
+
+@pytest.mark.parametrize("z_loss", [0.0, 1e-3])
+@pytest.mark.parametrize("chunk", [5, 13, 64])   # uneven, exact, single
+def test_fused_ce_matches_reference(z_loss, chunk):
+    x, w, bias, labels, mask = _ce_inputs(jax.random.PRNGKey(0))
+
+    def ref(x, w, bias, mask):
+        logits = jnp.dot(x, w) + bias
+        return cross_entropy_loss(logits, labels, mask=mask,
+                                  z_loss_coeff=z_loss)[0]
+
+    def fused(x, w, bias, mask):
+        return fused_lm_head_loss(x, w, labels, head_bias=bias, mask=mask,
+                                  z_loss_coeff=z_loss,
+                                  chunk_size=chunk)[0]
+
+    np.testing.assert_allclose(np.asarray(jax.jit(fused)(x, w, bias, mask)),
+                               np.asarray(ref(x, w, bias, mask)),
+                               rtol=1e-6, atol=1e-6)
+    g_ref = jax.grad(ref, argnums=(0, 1, 2, 3))(x, w, bias, mask)
+    g_fus = jax.jit(jax.grad(fused, argnums=(0, 1, 2, 3)))(x, w, bias, mask)
+    for name, a, b in zip("xwbm", g_ref, g_fus):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_fused_ce_n_tokens_and_no_bias():
+    x, w, _, labels, mask = _ce_inputs(jax.random.PRNGKey(1))
+    loss_f, n_f = fused_lm_head_loss(x, w, labels, mask=mask, chunk_size=4)
+    loss_r, n_r = cross_entropy_loss(jnp.dot(x, w), labels, mask=mask)
+    assert float(n_f) == float(n_r)
+    np.testing.assert_allclose(float(loss_f), float(loss_r), rtol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["gptj-tiny", "llama2-tiny"])
+def test_lm_loss_fused_matches_materialized(name):
+    """Model-level wiring: ce_chunk_size>0 (fused, with chunk padding)
+    vs ce_chunk_size=0 (reference logits path) — loss and param grads."""
+    cfg = get_config(name)
+    from ray_tpu.models import Transformer
+    params = Transformer(cfg).init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                             cfg.vocab_size)
+    mask = (jax.random.uniform(jax.random.PRNGKey(2), (2, 16)) > 0.2
+            ).astype(jnp.float32)
+    batch = {"input_ids": ids, "loss_mask": mask}
+
+    def loss_with(chunk, p):
+        c = dataclasses.replace(cfg, ce_chunk_size=chunk)
+        return lm_loss(c, p, batch)[0]
+
+    # chunk 7 over s'=15 exercises the padded final chunk
+    l_ref, g_ref = jax.value_and_grad(
+        functools.partial(loss_with, 0))(params)
+    l_fus, g_fus = jax.jit(jax.value_and_grad(
+        functools.partial(loss_with, 7)))(params)
+    np.testing.assert_allclose(float(l_fus), float(l_ref), rtol=1e-6)
+    for pa, pb in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_fus)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_fused_ce_is_moe_compatible():
+    cfg = get_config("moe-tiny")
+    from ray_tpu.models import Transformer
+    params = Transformer(cfg).init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                             cfg.vocab_size)
+    for chunk in (0, 8):
+        c = dataclasses.replace(cfg, ce_chunk_size=chunk)
+        loss, aux = lm_loss(c, params, {"input_ids": ids})
+        assert np.isfinite(float(loss))
+        assert "moe_aux" in aux
+
+
+# ------------------------------------------------------ remat policy parity
+def _policy_loss_and_grads(cfg, params, batch, policy):
+    c = dataclasses.replace(cfg, remat=None, remat_policy=policy)
+    return jax.jit(jax.value_and_grad(
+        lambda p: lm_loss(c, p, batch)[0]))(params)
+
+
+@pytest.mark.parametrize("policy",
+                         [p for p in REMAT_POLICIES
+                          if p not in ("full", "offload")])
+def test_remat_policies_match_full(policy):
+    cfg = get_config("gptj-tiny")
+    from ray_tpu.models import Transformer
+    params = Transformer(cfg).init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                             cfg.vocab_size)
+    batch = {"input_ids": ids}
+    l_full, g_full = _policy_loss_and_grads(cfg, params, batch, "full")
+    l_p, g_p = _policy_loss_and_grads(cfg, params, batch, policy)
+    np.testing.assert_allclose(float(l_p), float(l_full), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_remat_offload_policy():
+    """Host-offload policy: parity with "full" where the platform
+    supports pinned_host transfers; skip (not fail) where it doesn't."""
+    cfg = get_config("gptj-tiny")
+    from ray_tpu.models import Transformer
+    params = Transformer(cfg).init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                             cfg.vocab_size)
+    batch = {"input_ids": ids}
+    l_full, g_full = _policy_loss_and_grads(cfg, params, batch, "full")
+    try:
+        l_o, g_o = _policy_loss_and_grads(cfg, params, batch, "offload")
+    except Exception as e:  # noqa: BLE001 — backend without host memories
+        pytest.skip(f"pinned_host offload unsupported here: {e}")
+    np.testing.assert_allclose(float(l_o), float(l_full), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_o)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_legacy_remat_bool_still_resolves():
+    cfg = get_config("gptj-tiny")           # remat=False in registry
+    assert cfg.resolved_remat_policy == "none"
+    assert dataclasses.replace(cfg, remat=True) \
+        .resolved_remat_policy == "full"
+    assert dataclasses.replace(cfg, remat=None) \
+        .resolved_remat_policy == cfg.remat_policy
+
+
+def test_remat_policy_fn_rejects_unknown():
+    with pytest.raises(ValueError):
+        remat_policy_fn("bogus")
+
+
+# --------------------------------------------------- flash block selection
+def test_default_flash_blocks_tile_the_sequence():
+    for chip in ("cpu", "v4", "v5e", "v5p", "v6e"):
+        for seq in (128, 1024, 4096, 96):     # 96: non-power-of-two
+            for d in (64, 128, 256):
+                bq, bk = default_flash_blocks(seq, seq, d, chip=chip)
+                assert bq >= 1 and bk >= 1
+                assert seq % bq == 0 and seq % bk == 0, (chip, seq, d)
+
+
+def test_autotune_picks_winner_and_caches():
+    _AUTOTUNE_CACHE.clear()
+    calls = []
+
+    def timer(bq, bk):
+        calls.append((bq, bk))
+        return 1.0 if (bq, bk) != (256, 512) else 0.5
+
+    best = autotune_flash_blocks(1024, 128, timer=timer, chip="v5e")
+    assert best == (256, 512)
+    assert len(calls) >= 2
+    # cached: same key returns without timing
+    n = len(calls)
+    again = autotune_flash_blocks(1024, 128, timer=timer, chip="v5e")
+    assert again == best and len(calls) == n
+    _AUTOTUNE_CACHE.clear()
+
+
+def test_autotune_off_tpu_returns_chip_default():
+    _AUTOTUNE_CACHE.clear()
+    assert autotune_flash_blocks(1024, 128, chip="cpu") \
+        == default_flash_blocks(1024, 1024, 128, chip="cpu")
+    _AUTOTUNE_CACHE.clear()
+
+
+def test_autotune_survives_failing_candidate():
+    _AUTOTUNE_CACHE.clear()
+
+    def timer(bq, bk):
+        if (bq, bk) == (256, 256):
+            raise RuntimeError("vmem oom")
+        return float(bq * bk)
+
+    best = autotune_flash_blocks(
+        256, 128, timer=timer, chip="v5e",
+        candidates=((256, 256), (128, 128), (128, 256)))
+    assert best == (128, 128)
+    _AUTOTUNE_CACHE.clear()
+
+
+@pytest.mark.parametrize("blocks", [(64, 64), (64, 128), (128, 64)])
+def test_flash_output_invariant_to_blocks(blocks):
+    """An autotuned block config must be a pure scheduling choice: the
+    kernel output matches the default-block output and the reference."""
+    key = jax.random.PRNGKey(3)
+    ks = jax.random.split(key, 3)
+    q, k, v = (jax.random.normal(kk, (2, 128, 4, 64), jnp.float32)
+               for kk in ks)
+    ref = attention_reference(q, k, v, causal=True)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    base = flash_attention(qt, kt, vt, causal=True, block_q=128,
+                           block_k=128, interpret=True)
+    tuned = flash_attention(qt, kt, vt, causal=True, block_q=blocks[0],
+                            block_k=blocks[1], interpret=True)
+    np.testing.assert_allclose(np.asarray(tuned), np.asarray(base),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(jnp.swapaxes(tuned, 1, 2)),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bwd_delta_kernel_grads_match_xla():
+    """The fused delta-precompute feeds the Pallas dq/dk/dv kernels;
+    their grads must still match the lax.scan XLA backward."""
+    key = jax.random.PRNGKey(4)
+    ks = jax.random.split(key, 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 128, 128), jnp.float32)
+               for kk in ks)
+
+    def loss(mode):
+        def f(q, k, v):
+            o = flash_attention(q, k, v, causal=True, block_q=64,
+                                block_k=64, interpret=True, backward=mode)
+            return jnp.sum(o ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(loss("pallas"), loss("xla")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)

@@ -71,7 +71,8 @@ def test_dispatcher_reference_on_cpu():
 
 def test_ring_attention_matches_reference(cpu_mesh_devices):
     from jax.sharding import Mesh, PartitionSpec as P
-    shard_map = jax.shard_map
+
+    from ray_tpu.util.jax_compat import shard_map
 
     mesh = Mesh(np.asarray(cpu_mesh_devices).reshape(8), ("sp",))
     b, s, h, d = 2, 64, 2, 8
@@ -92,7 +93,8 @@ def test_ring_attention_matches_reference(cpu_mesh_devices):
 
 def test_ring_attention_grads(cpu_mesh_devices):
     from jax.sharding import Mesh, PartitionSpec as P
-    shard_map = jax.shard_map
+
+    from ray_tpu.util.jax_compat import shard_map
 
     mesh = Mesh(np.asarray(cpu_mesh_devices).reshape(8), ("sp",))
     b, s, h, d = 1, 32, 2, 8

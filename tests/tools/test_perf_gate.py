@@ -1,0 +1,87 @@
+"""Perf-gate smoke tests: the gate script must parse the checked-in
+BENCH_r*.json baselines and apply its tolerance correctly. No TPU (or
+fresh benchmark run) required — this validates the gate logic itself."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from tools.perf_gate import (  # noqa: E402
+    compare, extract_metrics, latest_baseline, parse_bench_record)
+
+pytestmark = pytest.mark.perf
+
+
+def test_gate_parses_all_checked_in_baselines():
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert paths, "no checked-in baselines"
+    for p in paths:
+        with open(p) as f:
+            rec = parse_bench_record(json.load(f))
+        m = extract_metrics(rec)
+        assert m["seq1024"] > 0, p
+
+
+def test_latest_baseline_is_highest_revision():
+    path, rec = latest_baseline(REPO)
+    revs = sorted(int(p.rsplit("_r", 1)[1].split(".")[0])
+                  for p in glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert path.endswith(f"BENCH_r{revs[-1]:02d}.json") \
+        or path.endswith(f"BENCH_r{revs[-1]}.json")
+    assert rec["value"] > 0
+
+
+def test_self_compare_passes_and_regression_fails():
+    _, base = latest_baseline(REPO)
+    ok, _ = compare(base, base, tolerance=2.0)
+    assert ok
+    regressed = dict(base, value=base["value"] - 3.0)
+    ok, msgs = compare(regressed, base, tolerance=2.0)
+    assert not ok and any(m.startswith("FAIL") for m in msgs)
+    # within tolerance: a 1-point dip passes the default gate
+    dipped = dict(base, value=base["value"] - 1.0)
+    ok, _ = compare(dipped, base, tolerance=2.0)
+    assert ok
+
+
+def test_missing_seq4096_is_skipped_not_failed():
+    _, base = latest_baseline(REPO)
+    fresh = {"metric": base["metric"], "value": base["value"],
+             "detail": {}}                       # CPU-style record
+    ok, msgs = compare(fresh, base, tolerance=2.0)
+    assert ok
+    assert any("skipped" in m for m in msgs)
+
+
+def test_driver_wrapper_and_tail_parsing():
+    rec = {"metric": "m", "value": 10.0, "detail": {}}
+    assert parse_bench_record({"parsed": rec})["value"] == 10.0
+    tail = "warning: noise\n" + json.dumps(rec) + "\n"
+    assert parse_bench_record({"rc": 0, "tail": tail})["value"] == 10.0
+    with pytest.raises(ValueError):
+        parse_bench_record({"rc": 0, "tail": "no json here"})
+
+
+def test_cli_end_to_end(tmp_path):
+    path, base = latest_baseline(REPO)
+    gate = os.path.join(REPO, "tools", "perf_gate.py")
+    r = subprocess.run([sys.executable, gate, "--fresh", path],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
+
+    bad = dict(base, value=base["value"] - 5.0)
+    f = tmp_path / "fresh.json"
+    f.write_text(json.dumps(bad))
+    r = subprocess.run([sys.executable, gate, "--fresh", str(f)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "FAIL" in r.stdout

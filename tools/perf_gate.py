@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Perf regression gate: compare a fresh ``bench.py`` JSON against the
+latest checked-in ``BENCH_r*.json`` baseline.
+
+A fresh measurement regressing the headline (seq-1024) MFU — or the
+seq-4096 MFU, when both records carry one — by more than ``--tolerance``
+MFU points (default 2.0) fails the gate with exit code 1.
+
+Usage:
+    python tools/perf_gate.py --fresh out.json          # compare a file
+    python tools/perf_gate.py --fresh -                 # read stdin
+    python tools/perf_gate.py --run                     # run bench.py now
+    python tools/perf_gate.py --fresh out.json --tolerance 1.0
+
+Accepted input shapes (both for ``--fresh`` and the baselines):
+- a raw bench line: ``{"metric": ..., "value": ..., "detail": {...}}``
+- a driver wrapper: ``{"cmd": ..., "rc": 0, "parsed": {<bench line>}}``
+  (falls back to parsing the last JSON-looking line of ``"tail"``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_TOLERANCE = 2.0          # MFU points
+
+
+def parse_bench_record(obj: dict) -> dict:
+    """Normalize a bench blob (raw line or driver wrapper) to the raw
+    bench record with "metric"/"value"/"detail" keys."""
+    if "metric" in obj and "value" in obj:
+        return obj
+    parsed = obj.get("parsed")
+    if isinstance(parsed, dict) and "value" in parsed:
+        return parsed
+    tail = obj.get("tail", "")
+    for line in reversed(tail.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "value" in rec:
+                return rec
+    raise ValueError("no bench record found in JSON blob")
+
+
+def extract_metrics(rec: dict) -> dict:
+    """{"seq1024": mfu, "seq4096": mfu|None} from a bench record."""
+    detail = rec.get("detail") or {}
+    seq4k = detail.get("seq4096") or {}
+    out = {"seq1024": float(rec["value"]),
+           "seq4096": None}
+    if isinstance(seq4k, dict) and "mfu_pct" in seq4k:
+        out["seq4096"] = float(seq4k["mfu_pct"])
+    return out
+
+
+def latest_baseline(root: str = REPO_ROOT) -> Tuple[str, dict]:
+    """Find the highest-numbered BENCH_r*.json and parse it."""
+    paths = glob.glob(os.path.join(root, "BENCH_r*.json"))
+    if not paths:
+        raise FileNotFoundError(f"no BENCH_r*.json baselines under {root}")
+
+    def rev(p: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    path = max(paths, key=rev)
+    with open(path) as f:
+        return path, parse_bench_record(json.load(f))
+
+
+def compare(fresh: dict, baseline: dict,
+            tolerance: float = DEFAULT_TOLERANCE):
+    """Return (ok, messages). Regression beyond ``tolerance`` MFU points
+    on any metric both records carry fails; missing metrics are skipped
+    (a CPU smoke run has no seq4096)."""
+    fm, bm = extract_metrics(fresh), extract_metrics(baseline)
+    ok, msgs = True, []
+    for name in ("seq1024", "seq4096"):
+        f, b = fm.get(name), bm.get(name)
+        if f is None or b is None:
+            msgs.append(f"{name}: skipped (missing in "
+                        f"{'fresh' if f is None else 'baseline'})")
+            continue
+        delta = f - b
+        line = f"{name}: fresh {f:.2f} vs baseline {b:.2f} " \
+               f"({delta:+.2f} MFU pts, tolerance -{tolerance:.2f})"
+        if delta < -tolerance:
+            ok = False
+            msgs.append("FAIL " + line)
+        else:
+            msgs.append("ok   " + line)
+    return ok, msgs
+
+
+def _load_fresh(args) -> dict:
+    if args.run:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+            capture_output=True, text=True, timeout=3600)
+        if out.returncode != 0:
+            raise RuntimeError(f"bench.py failed rc={out.returncode}:\n"
+                               f"{out.stderr[-2000:]}")
+        for line in reversed(out.stdout.strip().splitlines()):
+            if line.strip().startswith("{"):
+                return parse_bench_record(json.loads(line))
+        raise ValueError("bench.py printed no JSON line")
+    if args.fresh == "-":
+        return parse_bench_record(json.load(sys.stdin))
+    with open(args.fresh) as f:
+        return parse_bench_record(json.load(f))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--fresh", help="fresh bench JSON path ('-' = stdin)")
+    src.add_argument("--run", action="store_true",
+                     help="run bench.py and gate its output")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: latest BENCH_r*.json)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="allowed MFU-point regression (default 2.0)")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root to search for baselines")
+    args = ap.parse_args(argv)
+
+    try:
+        fresh = _load_fresh(args)
+        if args.baseline:
+            base_path = args.baseline
+            with open(base_path) as f:
+                baseline = parse_bench_record(json.load(f))
+        else:
+            base_path, baseline = latest_baseline(args.root)
+    except (OSError, ValueError, KeyError, RuntimeError) as e:
+        print(f"perf_gate: error: {e}", file=sys.stderr)
+        return 2
+
+    ok, msgs = compare(fresh, baseline, args.tolerance)
+    print(f"perf_gate: baseline {os.path.basename(str(base_path))}")
+    for m in msgs:
+        print(f"perf_gate: {m}")
+    print(f"perf_gate: {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
