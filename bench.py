@@ -21,7 +21,9 @@ jit split run once), and — when more than one device is visible — an
 FSDP train-step MFU over all local devices (the MULTICHIP metric).
 
 Env overrides: RAY_TPU_BENCH_REMAT (comma list of policies to try, e.g.
-"dots,full"), RAY_TPU_BENCH_CE_CHUNK (fused-CE chunk size; 0 = unfused).
+"dots,full"), RAY_TPU_BENCH_CE_CHUNK (fused-CE chunk size; 0 = unfused),
+RAY_TPU_BENCH_MC_VARIANTS (comma list restricting the multichip
+grad-transport/weight-update matrix, e.g. "fp32_replicated,int8_sharded").
 """
 
 from __future__ import annotations
@@ -46,11 +48,15 @@ def _sync(state, metrics):
 
 
 def _measure_mfu(cfg, batch: int, seq: int, steps: int, warmup: int,
-                 devices=None, phase_split: bool = False) -> dict:
+                 devices=None, phase_split: bool = False,
+                 grad_transport: str = "fp32",
+                 shard_weight_update: bool = False) -> dict:
     """Train-step MFU of one config at one sequence length.
 
     ``devices``: None = first local device; a list enables the FSDP
     multichip measurement (mesh fsdp=len(devices)).
+    ``grad_transport`` / ``shard_weight_update`` select the gradient
+    communication path (see ``models.training.make_train_step``).
     """
     import jax
     import jax.numpy as jnp
@@ -61,7 +67,9 @@ def _measure_mfu(cfg, batch: int, seq: int, steps: int, warmup: int,
     n_dev = len(devices)
     spec = MeshSpec(fsdp=n_dev) if n_dev > 1 else MeshSpec()
     mesh = build_mesh(spec, devices)
-    bundle = make_train_step(cfg, mesh, learning_rate=1e-4)
+    bundle = make_train_step(cfg, mesh, learning_rate=1e-4,
+                             grad_transport=grad_transport,
+                             shard_weight_update=shard_weight_update)
     state = bundle.init(seed=0)
     ids = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
                              cfg.vocab_size)
@@ -87,6 +95,7 @@ def _measure_mfu(cfg, batch: int, seq: int, steps: int, warmup: int,
     mfu_pct = 100.0 * achieved / (chip_spec().bf16_flops * n_dev)
     out = {"mfu_pct": round(mfu_pct, 2),
            "tokens_per_s": round(tokens_per_s, 1),
+           "step_ms": round(dt / steps * 1e3, 2),
            "loss": final_loss,
            "compile_s": round(compile_s, 2)}
     if phase_split:
@@ -243,16 +252,9 @@ def main() -> None:
             detail["flash_bwd_4k"] = {"error": str(e)[:120]}
 
     if len(jax.devices()) > 1:
-        # FSDP train-step MFU over all local devices (MULTICHIP metric):
-        # same per-device token load as the headline measurement.
-        try:
-            n = len(jax.devices())
-            mc = _measure_mfu(cfg, batch * n, seq, max(steps // 2, 2),
-                              warmup, devices=jax.devices())
-            mc["n_devices"] = n
-            detail["multichip"] = mc
-        except Exception as e:  # noqa: BLE001
-            detail["multichip"] = {"error": str(e)[:120]}
+        detail["multichip"] = _measure_multichip(
+            cfg, batch, seq, max(steps // 2, 2), warmup,
+            single_tokens_per_s=head["tokens_per_s"])
 
     print(json.dumps({
         "metric": "gptj_train_mfu_single_chip",
@@ -261,6 +263,61 @@ def main() -> None:
         "vs_baseline": round(mfu_pct / BASELINE_MFU_PCT, 3),
         "detail": detail,
     }))
+
+
+MULTICHIP_VARIANTS = (("fp32", False), ("int8", False),
+                      ("fp32", True), ("int8", True))
+
+
+def _measure_multichip(cfg, batch: int, seq: int, steps: int, warmup: int,
+                       single_tokens_per_s: float) -> dict:
+    """FSDP train-step MFU over all local devices (MULTICHIP metric),
+    measured for the gradient-transport x weight-update matrix:
+    fp32 vs int8 grad transport, replicated vs cross-replica-sharded
+    weight update. Same per-device token load as the headline.
+
+    Each variant carries a comm/compute split: compute is the
+    single-chip step time at the same per-device load (from the headline
+    measurement), comm is the multichip step-time excess over it —
+    attributable, since the only thing the multichip step adds is the
+    gradient/param communication the variant is designed to shrink.
+
+    Env override: RAY_TPU_BENCH_MC_VARIANTS (comma list like
+    "fp32_replicated,int8_sharded") restricts the matrix.
+    """
+    import jax
+
+    n = len(jax.devices())
+    single_step_ms = batch * seq / single_tokens_per_s * 1e3
+    want = os.environ.get("RAY_TPU_BENCH_MC_VARIANTS")
+    want = {v.strip() for v in want.split(",")} if want else None
+    variants = {}
+    for gt, swu in MULTICHIP_VARIANTS:
+        name = f"{gt}_{'sharded' if swu else 'replicated'}"
+        if want is not None and name not in want:
+            continue
+        try:
+            v = _measure_mfu(cfg, batch * n, seq, steps, warmup,
+                             devices=jax.devices(), grad_transport=gt,
+                             shard_weight_update=swu)
+            v["comm_split_ms"] = {
+                "compute_ms": round(single_step_ms, 2),
+                "comm_ms": round(max(v["step_ms"] - single_step_ms, 0.0),
+                                 2)}
+        except Exception as e:  # noqa: BLE001
+            v = {"error": str(e)[:120]}
+        variants[name] = v
+    ok = {k: v for k, v in variants.items() if "mfu_pct" in v}
+    if not ok:
+        return {"n_devices": n, "variants": variants,
+                "error": "no multichip variant succeeded"}
+    # Headline multichip fields stay the fp32 replicated baseline (the
+    # pre-existing metric shape); the matrix rides in "variants".
+    mc = dict(ok.get("fp32_replicated") or next(iter(ok.values())))
+    mc["n_devices"] = n
+    mc["best_variant"] = max(ok, key=lambda k: ok[k]["mfu_pct"])
+    mc["variants"] = variants
+    return mc
 
 
 def _flash_bwd_compare(jax, jnp, seq: int = 4096) -> dict:

@@ -22,8 +22,11 @@ import optax
 
 from ray_tpu.models.transformer import (
     TransformerConfig, init_params, logical_axes, lm_loss)
+from ray_tpu.parallel.quantization import DEFAULT_BLOCK_SIZE, fake_quant
 from ray_tpu.parallel.sharding import (
     ShardingRules, FSDP_RULES, shard_params, batch_sharding, replicated)
+
+GRAD_TRANSPORTS = ("fp32", "int8")
 
 
 @dataclasses.dataclass
@@ -36,6 +39,8 @@ class TrainStepBundle:
     step_fn: Callable[[Dict, Dict], Tuple[Dict, Dict]]  # (state, batch)
     state_shardings: Dict
     batch_spec: Any
+    grad_transport: str = "fp32"
+    shard_weight_update: bool = False
 
     def init(self, seed: int = 0) -> Dict:
         return self.init_fn(jax.random.PRNGKey(seed))
@@ -62,7 +67,11 @@ def make_train_step(config: TransformerConfig, mesh,
                     weight_decay: float = 0.0,
                     donate_state: bool = True,
                     remat_policy: Optional[str] = None,
-                    ce_chunk_size: Optional[int] = None) -> TrainStepBundle:
+                    ce_chunk_size: Optional[int] = None,
+                    grad_transport: str = "fp32",
+                    shard_weight_update: bool = False,
+                    quant_block_size: int = DEFAULT_BLOCK_SIZE,
+                    quant_stochastic: bool = False) -> TrainStepBundle:
     """Build sharded init + train-step functions over ``mesh``.
 
     The optimizer state inherits each parameter's sharding (ZeRO-style
@@ -73,7 +82,33 @@ def make_train_step(config: TransformerConfig, mesh,
     rematerialization policy and fused-CE chunking for this train step
     without touching the caller's config (the compute-path knobs a
     trainer wants to sweep without redefining the model).
+
+    Communication-path knobs (the gradient byte path from loss to
+    weight):
+
+    - ``grad_transport``: ``"fp32"`` (exact) or ``"int8"`` — gradients
+      cross the reduction wire int8 blockwise-quantized (per-block f32
+      scales, f32 accumulators; EQuARX, arXiv:2506.17615). Inside one
+      SPMD program the reduction itself is compiled by XLA, so the knob
+      injects the transport's quantization error via
+      ``quantization.fake_quant`` on each gradient leaf — numerically
+      the requantize leg of the quantized all-reduce; the eager
+      ``collective.quantized_allreduce`` carries real int8 payloads.
+      ``quant_block_size`` / ``quant_stochastic`` tune the wire format
+      (stochastic rounding makes the quantizer unbiased, keyed per step
+      and leaf).
+    - ``shard_weight_update``: reduce-scatter gradients over the data
+      axes (dp×fsdp), have each replica update only its 1/N flat
+      optimizer shard, then all-gather fresh params
+      (arXiv:2004.13336). Optimizer state lives in the flat sharded
+      layout (1/N per replica even for leaves the rule table
+      replicates); ``state["params"]`` keeps its normal layout, so
+      eval/checkpoint paths are unchanged. Flat shards are padded to
+      whole quant blocks so both transports share one state treedef.
     """
+    if grad_transport not in GRAD_TRANSPORTS:
+        raise ValueError(f"grad_transport must be one of "
+                         f"{GRAD_TRANSPORTS}, got {grad_transport!r}")
     rules = rules if rules is not None else FSDP_RULES
     if remat_policy is not None:
         config = dataclasses.replace(config, remat=None,
@@ -88,9 +123,42 @@ def make_train_step(config: TransformerConfig, mesh,
     batch_sh = batch_sharding(mesh, rules, ("batch", "sequence"))
     rep = replicated(mesh)
 
+    # Cross-replica sharded weight update: gradients and master-param
+    # working copies are flattened to 1-D, padded to n_shards * k quant
+    # blocks, and sharded over the data axes. A sharding constraint to
+    # ``flat_sh`` on a freshly reduced gradient compiles to the
+    # reduce-scatter; the constraint back to the parameter's compute
+    # sharding on the updated leaf compiles to the all-gather.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    update_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape[a] > 1)
+    n_shards = 1
+    for a in update_axes:
+        n_shards *= mesh.shape[a]
+    flat_sh = NamedSharding(mesh, P(update_axes) if update_axes else P())
+
+    def _flat_len(n: int) -> int:
+        chunk = -(-n // n_shards)
+        chunk = -(-chunk // quant_block_size) * quant_block_size
+        return chunk * n_shards
+
+    def _flatten_leaf(x):
+        flat = x.reshape(-1)
+        return jnp.pad(flat, (0, _flat_len(x.size) - x.size))
+
+    def _flatten_tree(tree, constrain_to=None):
+        def one(x):
+            f = _flatten_leaf(x)
+            if constrain_to is not None:
+                f = jax.lax.with_sharding_constraint(f, constrain_to)
+            return f
+        return jax.tree.map(one, tree)
+
     def init_raw(key):
         params = init_params(config, key)
-        opt_state = optimizer.init(params)
+        if shard_weight_update:
+            opt_state = optimizer.init(_flatten_tree(params))
+        else:
+            opt_state = optimizer.init(params)
         return {"params": params, "opt_state": opt_state,
                 "step": jnp.zeros((), jnp.int32)}
 
@@ -115,8 +183,14 @@ def make_train_step(config: TransformerConfig, mesh,
         except Exception:
             return False
 
+    opt_leaf_sh_tree = param_sh_tree
+    if shard_weight_update:
+        # Flat layout: every optimizer leaf (moments etc.) is a 1-D
+        # shard over the data axes, 1/N resident per replica.
+        opt_leaf_sh_tree = jax.tree.unflatten(
+            params_treedef, [flat_sh] * len(flat_params))
     opt_sh = jax.tree.map(
-        lambda sub: param_sh_tree if is_param_tree(sub) else rep,
+        lambda sub: opt_leaf_sh_tree if is_param_tree(sub) else rep,
         state_shapes["opt_state"], is_leaf=is_param_tree)
 
     state_sh = {
@@ -127,14 +201,43 @@ def make_train_step(config: TransformerConfig, mesh,
 
     init_fn = jax.jit(init_raw, out_shardings=state_sh)
 
+    def _quantize_grads(grads, step):
+        """int8 transport: each gradient leaf picks up one wire leg's
+        blockwise quantization error (per-step, per-leaf keys when
+        stochastic rounding is on)."""
+        base = jax.random.fold_in(jax.random.PRNGKey(0x5eed), step) \
+            if quant_stochastic else None
+        leaves, treedef = jax.tree.flatten(grads)
+        out = []
+        for i, g in enumerate(leaves):
+            key = jax.random.fold_in(base, i) if quant_stochastic else None
+            out.append(fake_quant(g, quant_block_size,
+                                  quant_stochastic, key))
+        return jax.tree.unflatten(treedef, out)
+
     def step_raw(state, batch):
         def loss_fn(p):
             return lm_loss(config, p, batch, mesh=mesh, rules=rules)
         (loss, aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state["params"])
-        updates, new_opt = optimizer.update(
-            grads, state["opt_state"], state["params"])
-        new_params = optax.apply_updates(state["params"], updates)
+        if grad_transport == "int8":
+            grads = _quantize_grads(grads, state["step"])
+        if shard_weight_update:
+            # Reduce-scatter grads to flat 1/N shards, update only the
+            # local optimizer shard, all-gather fresh params (the
+            # constraint back to the param sharding via out_shardings).
+            gflat = _flatten_tree(grads, constrain_to=flat_sh)
+            pflat = _flatten_tree(state["params"], constrain_to=flat_sh)
+            updates, new_opt = optimizer.update(
+                gflat, state["opt_state"], pflat)
+            new_pflat = optax.apply_updates(pflat, updates)
+            new_params = jax.tree.map(
+                lambda p, f: f[:p.size].reshape(p.shape),
+                state["params"], new_pflat)
+        else:
+            updates, new_opt = optimizer.update(
+                grads, state["opt_state"], state["params"])
+            new_params = optax.apply_updates(state["params"], updates)
         new_state = {"params": new_params, "opt_state": new_opt,
                      "step": state["step"] + 1}
         metrics = {"loss": loss, "n_tokens": aux["n_tokens"],
@@ -151,7 +254,9 @@ def make_train_step(config: TransformerConfig, mesh,
 
     return TrainStepBundle(config=config, mesh=mesh, rules=rules,
                            init_fn=init_fn, step_fn=step_fn,
-                           state_shardings=state_sh, batch_spec=batch_sh)
+                           state_shardings=state_sh, batch_spec=batch_sh,
+                           grad_transport=grad_transport,
+                           shard_weight_update=shard_weight_update)
 
 
 def make_eval_step(config: TransformerConfig, mesh,

@@ -21,6 +21,7 @@ from ray_tpu.parallel.sharding import (
     constrain,
 )
 from ray_tpu.parallel import collective
+from ray_tpu.parallel import quantization
 
 __all__ = [
     "MeshSpec",
@@ -34,4 +35,5 @@ __all__ = [
     "batch_sharding",
     "constrain",
     "collective",
+    "quantization",
 ]

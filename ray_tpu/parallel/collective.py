@@ -19,6 +19,12 @@ Backends:
   reference). Rendezvous mirrors the reference's ``NCCLUniqueIDStore``
   named actor (``collective_group/nccl_collective_group.py:28-68``) using
   the internal KV instead.
+
+``quantized_allreduce`` / ``quantized_reducescatter`` are the int8
+blockwise-quantized variants (EQuARX, arXiv:2506.17615): local shards are
+quantized against per-block f32 scales, reduced in f32 accumulators, the
+reduced chunks requantized for the gather leg, and dequantized at the
+edge. Wire format in ``parallel.quantization``.
 """
 
 from __future__ import annotations
@@ -122,6 +128,51 @@ def _build_stub(mesh, op: str, **kw):
         return jax.jit(shard_map(
             f, mesh=mesh, in_specs=P(axes), out_specs=P(),
             check_vma=False))
+    if op in ("quantized_allreduce", "quantized_reducescatter"):
+        # Two-leg quantized reduction (EQuARX, arXiv:2506.17615): each
+        # rank int8-quantizes its local payload (send side), partial sums
+        # accumulate in f32 via psum_scatter, the reduced chunk is
+        # REquantized for the gather leg — so the all-gather moves int8
+        # values + per-block f32 scales, not f32 tensors — and the edge
+        # dequantizes. Chunk boundaries are rounded up to whole quant
+        # blocks so no block ever straddles two ranks' chunks.
+        import jax.numpy as jnp
+        from ray_tpu.parallel import quantization as qz
+
+        world = int(mesh.devices.size)
+        block = int(kw.get("block_size") or qz.DEFAULT_BLOCK_SIZE)
+        sr = bool(kw.get("stochastic_rounding", False))
+
+        def f(x, seed):
+            local = x[0]
+            n = local.size
+            chunk = qz._padded_len(-(-n // world), block)
+            padded = jnp.pad(local.astype(jnp.float32).reshape(-1),
+                             (0, chunk * world - n))
+            key = None
+            if sr:
+                idx = 0
+                for a in axes:
+                    idx = idx * mesh.shape[a] + jax.lax.axis_index(a)
+                key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+                key = jax.random.fold_in(key, idx)
+            q, s = qz.quantize_int8(padded, block, sr, key)
+            sent = qz.dequantize_int8(q, s)                # f32 accum leg
+            mine = jax.lax.psum_scatter(sent.reshape(world, chunk), axes,
+                                        scatter_dimension=0, tiled=False)
+            q2, s2 = qz.quantize_int8(mine, block)          # gather leg
+            qg = jax.lax.all_gather(q2, axes, axis=0, tiled=False)
+            sg = jax.lax.all_gather(s2, axes, axis=0, tiled=False)
+            full = (qg.astype(jnp.float32) * sg[..., None]).reshape(-1)
+            if reduce_op == "mean":
+                full = full / world
+            out = full[:n].reshape(local.shape)
+            if op == "quantized_reducescatter":
+                return jnp.stack(jnp.split(out, world, axis=0))
+            return out
+        return jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(axes), P()), out_specs=P(),
+            check_vma=False))
     raise ValueError(f"unknown collective {op}")
 
 
@@ -194,8 +245,64 @@ def allgather(tensor, group_name: str = "default"):
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
     g = get_group(group_name)
+    if g.backend == "host":
+        return _host_reducescatter(g, tensor, op)
     return g._stub("reducescatter", tensor.shape, tensor.dtype,
                    reduce_op=op)(tensor)
+
+
+def _check_quant_op(op: str) -> None:
+    if op not in ("sum", "mean"):
+        raise ValueError(
+            f"quantized collectives support op='sum'/'mean', got {op!r} "
+            "(max/min don't survive blockwise requantization)")
+
+
+def quantized_allreduce(tensor, group_name: str = "default",
+                        op: str = "sum",
+                        block_size: Optional[int] = None,
+                        stochastic_rounding: bool = False):
+    """All-reduce with int8 blockwise-quantized transport: quantize local
+    shards, reduce in f32 accumulators, requantize for the gather leg,
+    dequantize at the edge. Same calling convention as :func:`allreduce`;
+    the result carries the quantization error of both wire legs (bounded
+    by half a quantization step per leg per block — see
+    ``parallel.quantization``)."""
+    _check_quant_op(op)
+    g = get_group(group_name)
+    if g.backend == "host":
+        return _host_quantized_allreduce(g, tensor, op, block_size)
+    seed = g.next_seq("q_ar") if stochastic_rounding else 0
+    stub = g._stub("quantized_allreduce", tensor.shape, tensor.dtype,
+                   reduce_op=op, block_size=block_size,
+                   stochastic_rounding=stochastic_rounding)
+    return stub(tensor, np.uint32(seed))
+
+
+def quantized_reducescatter(tensor, group_name: str = "default",
+                            op: str = "sum",
+                            block_size: Optional[int] = None,
+                            stochastic_rounding: bool = False):
+    """Reduce-scatter with int8-quantized transport; same calling
+    convention (and chunking) as :func:`reducescatter`."""
+    _check_quant_op(op)
+    g = get_group(group_name)
+    if g.backend == "host":
+        summed = _host_quantized_allreduce(g, tensor, op, block_size)
+        if tensor.shape[0] % g.world_size:
+            raise ValueError(
+                f"reducescatter dim 0 ({tensor.shape[0]}) not divisible "
+                f"by world size {g.world_size}")
+        return np.split(summed, g.world_size, axis=0)[g.rank]
+    if tensor.shape[1] % g.world_size:
+        raise ValueError(
+            f"reducescatter chunk dim ({tensor.shape[1]}) not divisible "
+            f"by world size {g.world_size}")
+    seed = g.next_seq("q_rs") if stochastic_rounding else 0
+    stub = g._stub("quantized_reducescatter", tensor.shape, tensor.dtype,
+                   reduce_op=op, block_size=block_size,
+                   stochastic_rounding=stochastic_rounding)
+    return stub(tensor, np.uint32(seed))
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
@@ -334,6 +441,55 @@ def _host_allgather(g: Group, tensor):
     _kv_put(key, _dumps(arr))
     return [_loads(_kv_wait(_key(g, f"ag/{seq}/{r}")))
             for r in range(g.world_size)]
+
+
+def _host_reducescatter(g: Group, tensor, op: str):
+    """Host-backend reduce-scatter: every rank contributes its local
+    tensor and takes home the ``rank``-th dim-0 chunk of the elementwise
+    reduction. Symmetric (every rank writes and reads each seq), so the
+    lag-2 GC argument holds exactly as for allreduce/allgather."""
+    arr = np.asarray(tensor)
+    if arr.shape[0] % g.world_size:
+        raise ValueError(
+            f"reducescatter dim 0 ({arr.shape[0]}) not divisible by "
+            f"world size {g.world_size}")
+    seq = g.next_seq("rs")
+    key = _key(g, f"rs/{seq}/{g.rank}")
+    _gc_symmetric(g, "rs", seq, key)
+    _kv_put(key, _dumps(arr))
+    parts = [_loads(_kv_wait(_key(g, f"rs/{seq}/{r}")))
+             for r in range(g.world_size)]
+    stack = np.stack(parts)
+    out = {"sum": stack.sum(0), "mean": stack.mean(0),
+           "max": stack.max(0), "min": stack.min(0)}[op]
+    return np.split(out, g.world_size, axis=0)[g.rank]
+
+
+def _host_quantized_allreduce(g: Group, tensor, op: str,
+                              block_size: Optional[int]):
+    """Host-backend quantized all-reduce: each rank publishes int8 block
+    values + f32 scales (the actual KV wire bytes shrink ~4x vs the f32
+    payload of ``_host_allreduce``); readers dequantize into f32
+    accumulators. Single-leg — there is no separate gather hop to
+    requantize on the KV-store topology."""
+    from ray_tpu.parallel import quantization as qz
+
+    block = int(block_size or qz.DEFAULT_BLOCK_SIZE)
+    arr = np.asarray(tensor)
+    q, s = qz.quantize_int8_np(arr, block)
+    seq = g.next_seq("qar")
+    qkey = _key(g, f"qar/{seq}/q/{g.rank}")
+    skey = _key(g, f"qar/{seq}/s/{g.rank}")
+    _gc_symmetric(g, "qar.q", seq, qkey)
+    _gc_symmetric(g, "qar.s", seq, skey)
+    _kv_put(qkey, _dumps(q))
+    _kv_put(skey, _dumps(s))
+    out = np.zeros(arr.shape, np.float32)
+    for r in range(g.world_size):
+        rq = _loads(_kv_wait(_key(g, f"qar/{seq}/q/{r}")))
+        rs = _loads(_kv_wait(_key(g, f"qar/{seq}/s/{r}")))
+        out += qz.dequantize_int8_np(rq, rs, arr.shape)
+    return out / g.world_size if op == "mean" else out
 
 
 def _host_broadcast(g: Group, tensor, src_rank: int):

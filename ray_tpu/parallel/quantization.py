@@ -1,0 +1,116 @@
+"""Int8 blockwise quantization for collective transport.
+
+The wire format used by the quantized collectives (EQuARX-style,
+arXiv:2506.17615): a tensor is flattened, padded to a whole number of
+``block_size``-element blocks, and each block is symmetrically quantized
+to int8 against its own f32 scale (``amax / 127``). On the wire a block
+costs ``block_size`` bytes of payload plus 4 bytes of scale, so transport
+shrinks ~4x vs f32 (``compression_ratio`` below gives the exact number).
+
+Rounding is round-to-nearest by default; ``stochastic_rounding=True``
+makes the quantizer unbiased (``E[dequant(quant(x))] = x``) at the cost
+of higher per-element variance — the standard choice for gradient
+transport, where bias compounds across steps but zero-mean noise averages
+out across the reduction.
+
+Everything here is jittable and shard_map-safe (pure ``jnp``); the
+``*_np`` twins are the plain-NumPy reference used by the host-backend
+collectives and the parity tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+DEFAULT_BLOCK_SIZE = 256
+_QMAX = 127.0
+
+
+def _padded_len(n: int, block_size: int) -> int:
+    return -(-n // block_size) * block_size
+
+
+def quantize_int8(x, block_size: int = DEFAULT_BLOCK_SIZE,
+                  stochastic_rounding: bool = False,
+                  key=None) -> Tuple:
+    """Quantize ``x`` to ``(values int8 [nblocks, block_size],
+    scales f32 [nblocks])``.
+
+    Blocks are taken over the row-major flattening of ``x``; the final
+    block is zero-padded (an all-zero block quantizes exactly, so padding
+    never perturbs the scales). ``stochastic_rounding`` requires ``key``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    flat = jnp.asarray(x).astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    flat = jnp.pad(flat, (0, _padded_len(n, block_size) - n))
+    blocks = flat.reshape(-1, block_size)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    scaled = blocks / scales[:, None]
+    if stochastic_rounding:
+        if key is None:
+            raise ValueError("stochastic_rounding requires a PRNG key")
+        # floor(x + u), u ~ U[0,1): E[q] == x exactly.
+        q = jnp.floor(scaled + jax.random.uniform(key, scaled.shape))
+    else:
+        q = jnp.round(scaled)
+    return jnp.clip(q, -_QMAX, _QMAX).astype(jnp.int8), scales
+
+
+def dequantize_int8(values, scales, shape=None, dtype=None):
+    """Invert :func:`quantize_int8`. ``shape=None`` returns the padded
+    1-D f32 payload; otherwise the result is sliced and reshaped (and
+    cast to ``dtype`` if given)."""
+    import jax.numpy as jnp
+
+    flat = (values.astype(jnp.float32) * scales[..., None]).reshape(-1)
+    if shape is not None:
+        size = int(np.prod(shape)) if shape else 1
+        flat = flat[:size].reshape(shape)
+    return flat.astype(dtype) if dtype is not None else flat
+
+
+def fake_quant(x, block_size: int = DEFAULT_BLOCK_SIZE,
+               stochastic_rounding: bool = False, key=None):
+    """``dequant(quant(x))`` with ``x``'s shape and dtype — the transport
+    error a tensor picks up crossing one quantized wire leg. Used by the
+    training step to model int8 gradient transport inside one SPMD
+    program (where the reduction itself is compiled by XLA and the
+    pre-reduction per-rank payloads aren't addressable)."""
+    q, s = quantize_int8(x, block_size, stochastic_rounding, key)
+    return dequantize_int8(q, s, x.shape, x.dtype)
+
+
+def compression_ratio(numel: int,
+                      block_size: int = DEFAULT_BLOCK_SIZE) -> float:
+    """f32 bytes over int8-wire bytes for a ``numel`` tensor: payload is
+    1 byte/elem (after padding) + 4 bytes of scale per block."""
+    nblocks = -(-numel // block_size)
+    return (4.0 * numel) / (nblocks * block_size + 4.0 * nblocks)
+
+
+# ------------------------------------------------- NumPy reference twins
+def quantize_int8_np(x: np.ndarray, block_size: int = DEFAULT_BLOCK_SIZE
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    flat = np.asarray(x, dtype=np.float32).reshape(-1)
+    n = flat.shape[0]
+    flat = np.pad(flat, (0, _padded_len(n, block_size) - n))
+    blocks = flat.reshape(-1, block_size)
+    amax = np.max(np.abs(blocks), axis=1)
+    scales = np.where(amax > 0, amax / _QMAX, 1.0).astype(np.float32)
+    q = np.clip(np.round(blocks / scales[:, None]), -_QMAX, _QMAX)
+    return q.astype(np.int8), scales
+
+
+def dequantize_int8_np(values: np.ndarray, scales: np.ndarray,
+                       shape=None, dtype=None) -> np.ndarray:
+    flat = (values.astype(np.float32) * scales[..., None]).reshape(-1)
+    if shape is not None:
+        size = int(np.prod(shape)) if shape else 1
+        flat = flat[:size].reshape(shape)
+    return flat.astype(dtype) if dtype is not None else flat

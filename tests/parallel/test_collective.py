@@ -39,6 +39,54 @@ def test_xla_allgather_reducescatter(cpu_mesh_devices):
     np.testing.assert_allclose(np.asarray(rs), np.full((8, 1), 8.0))
 
 
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_quantized_allreduce_parity_across_world_sizes(
+        cpu_mesh_devices, world):
+    import jax.numpy as jnp
+    col.init_collective_group(world, 0, "xla", f"q{world}")
+    rng = np.random.RandomState(world)
+    # 35 elems with block 16: uneven block edges inside uneven chunks
+    stacked = jnp.asarray(rng.randn(world, 5, 7).astype(np.float32))
+    ref = np.asarray(col.allreduce(stacked, f"q{world}"))
+    out = np.asarray(col.quantized_allreduce(stacked, f"q{world}",
+                                             block_size=16))
+    assert out.shape == ref.shape
+    # two quantized legs: send-side error sums over ranks, requantize
+    # error is one half-step of the reduced tensor's block scale
+    tol = (world + np.abs(ref).max()) / 254 + 1e-5
+    np.testing.assert_allclose(out, ref, atol=2 * tol)
+    mean = np.asarray(col.quantized_allreduce(stacked, f"q{world}",
+                                              op="mean", block_size=16))
+    np.testing.assert_allclose(mean, ref / world, atol=2 * tol / world)
+
+
+def test_quantized_allreduce_stochastic_and_op_validation(
+        cpu_mesh_devices):
+    import jax.numpy as jnp
+    col.init_collective_group(4, 0, "xla", "qs")
+    stacked = jnp.asarray(
+        np.random.RandomState(7).randn(4, 65).astype(np.float32))
+    ref = np.asarray(col.allreduce(stacked, "qs"))
+    out = np.asarray(col.quantized_allreduce(
+        stacked, "qs", block_size=32, stochastic_rounding=True))
+    np.testing.assert_allclose(out, ref, atol=0.2)
+    with pytest.raises(ValueError):
+        col.quantized_allreduce(stacked, "qs", op="max")
+
+
+def test_quantized_reducescatter_parity(cpu_mesh_devices):
+    import jax.numpy as jnp
+    col.init_collective_group(8, 0, "xla", "qrs")
+    rng = np.random.RandomState(3)
+    y = jnp.asarray(rng.randn(8, 8, 6).astype(np.float32))
+    ref = np.asarray(col.reducescatter(y, "qrs"))
+    out = np.asarray(col.quantized_reducescatter(y, "qrs", block_size=16))
+    assert out.shape == ref.shape == (8, 1, 6)
+    np.testing.assert_allclose(out, ref, atol=0.15)
+    with pytest.raises(ValueError):   # chunk dim not divisible by world
+        col.quantized_reducescatter(jnp.ones((8, 3, 2)), "qrs")
+
+
 def test_host_backend_across_actors(ray_start_regular):
     @ray_tpu.remote
     class Rank:
@@ -67,6 +115,52 @@ def test_host_backend_across_actors(ray_start_regular):
     outs = ray_tpu.get([a.do_broadcast.remote() for a in actors], timeout=120)
     for out in outs:
         np.testing.assert_allclose(out, np.zeros((2,)))
+
+
+def test_host_reducescatter_across_actors(ray_start_regular):
+    """Regression: host-backend groups used to fall through to the xla
+    stub on reducescatter (unlike allreduce/allgather) and die building
+    a device mesh for the actor's world."""
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            from ray_tpu.parallel import collective
+            collective.init_collective_group(world, rank, backend="host",
+                                             group_name="rsg")
+            self.rank = rank
+
+        def do_reducescatter(self):
+            from ray_tpu.parallel import collective
+            # twice: exercises the lag-2 GC path on the "rs" kind
+            collective.reducescatter(
+                np.full((4, 3), float(self.rank + 1)), "rsg")
+            return collective.reducescatter(
+                np.full((4, 3), float(self.rank + 1)), "rsg")
+
+        def do_quantized_allreduce(self):
+            from ray_tpu.parallel import collective
+            return collective.quantized_allreduce(
+                np.full((5,), float(self.rank + 1)), "rsg", block_size=4)
+
+    world = 2
+    actors = [Rank.remote(r, world) for r in range(world)]
+    outs = ray_tpu.get([a.do_reducescatter.remote() for a in actors],
+                       timeout=180)
+    # sum is all-3s (4,3); rank r takes dim-0 chunk r
+    for r, out in enumerate(outs):
+        assert out.shape == (2, 3)
+        np.testing.assert_allclose(out, np.full((2, 3), 3.0))
+    outs = ray_tpu.get([a.do_quantized_allreduce.remote() for a in actors],
+                       timeout=120)
+    for out in outs:
+        np.testing.assert_allclose(out, np.full((5,), 3.0), atol=0.05)
+
+
+def test_host_reducescatter_rejects_indivisible():
+    # shape[0]=3 not divisible by world 2: must raise before any KV I/O
+    g = col.Group("rs-bad", 2, 0, "host")
+    with pytest.raises(ValueError):
+        col._host_reducescatter(g, np.ones((3, 2)), "sum")
 
 
 def test_declarative_group_creation(ray_start_regular):

@@ -1,0 +1,72 @@
+"""Int8 blockwise quantization: roundtrip error bounds, uneven block
+edges, stochastic-rounding unbiasedness, wire-format accounting."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.parallel import quantization as qz
+
+
+def test_roundtrip_error_bounded_per_block():
+    rng = np.random.RandomState(0)
+    x = rng.randn(7, 33).astype(np.float32) * 3.0   # 231 elems, block 64
+    q, s = qz.quantize_int8(x, block_size=64)
+    out = np.asarray(qz.dequantize_int8(q, s, x.shape, np.float32))
+    err = np.abs(out - x).reshape(-1)
+    # round-to-nearest: error <= scale/2 elementwise, per block
+    bound = np.repeat(np.asarray(s), 64)[: x.size] / 2 + 1e-7
+    assert (err <= bound).all()
+    assert np.asarray(q).dtype == np.int8
+    assert np.asarray(s).shape == (4,)              # ceil(231/64) blocks
+
+
+def test_uneven_edges_shapes_and_padding():
+    x = np.arange(10, dtype=np.float32)             # 10 elems, block 8
+    q, s = qz.quantize_int8(x, block_size=8)
+    assert np.asarray(q).shape == (2, 8)
+    out = np.asarray(qz.dequantize_int8(q, s, x.shape))
+    assert out.shape == (10,)
+    np.testing.assert_allclose(out, x, atol=9.0 / 254 + 1e-6)
+    # exact zeros stay exact (all-pad block has scale 1, values 0)
+    z = np.zeros((3, 5), np.float32)
+    qz_, sz = qz.quantize_int8(z, block_size=64)
+    np.testing.assert_array_equal(
+        np.asarray(qz.dequantize_int8(qz_, sz, z.shape)), z)
+
+
+def test_numpy_reference_matches_jax():
+    rng = np.random.RandomState(1)
+    x = rng.randn(100).astype(np.float32)
+    qj, sj = qz.quantize_int8(x, block_size=32)
+    qn, sn = qz.quantize_int8_np(x, block_size=32)
+    np.testing.assert_array_equal(np.asarray(qj), qn)
+    np.testing.assert_allclose(np.asarray(sj), sn, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(qz.dequantize_int8(qj, sj, x.shape)),
+        qz.dequantize_int8_np(qn, sn, x.shape), rtol=1e-6)
+
+
+def test_stochastic_rounding_is_unbiased():
+    import jax
+    # values sitting strictly between grid points: deterministic rounding
+    # is maximally biased here; stochastic rounding averages to x.
+    x = np.full((64,), 0.305, np.float32)           # 30.5 grid units:
+    x[0] = 1.27                                     # pins scale to 0.01
+    acc = np.zeros_like(x)
+    n = 200
+    for i in range(n):
+        acc += np.asarray(qz.fake_quant(
+            x, block_size=64, stochastic_rounding=True,
+            key=jax.random.PRNGKey(i)))
+    scale = 1.27 / 127
+    assert np.abs(acc / n - x).max() < 0.2 * scale
+    with pytest.raises(ValueError):
+        qz.quantize_int8(x, stochastic_rounding=True)   # key required
+
+
+def test_compression_ratio_math():
+    # 1024 elems in 256-blocks: 4096 f32 bytes vs 1024 + 4*4 wire bytes
+    assert qz.compression_ratio(1024, 256) == pytest.approx(
+        4096 / (1024 + 16))
+    # padding waste shows up for tiny tensors
+    assert qz.compression_ratio(1, 256) == pytest.approx(4 / 260)

@@ -1,0 +1,46 @@
+"""Perf smoke tests for bench.py's multichip grad-path variants: the
+measurement harness itself (not fresh perf numbers — no TPU needed)."""
+
+import dataclasses
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+pytestmark = pytest.mark.perf
+
+
+def _tiny_cfg():
+    from ray_tpu.models import get_config
+    return dataclasses.replace(
+        get_config("gptj-tiny"), d_model=32, n_layers=1, n_heads=2,
+        head_dim=16, d_ff=64, vocab_size=128, max_seq_len=32)
+
+
+def test_measure_mfu_emits_step_time_and_variant_fields():
+    r = bench._measure_mfu(_tiny_cfg(), batch=4, seq=32, steps=2,
+                           warmup=1, grad_transport="int8",
+                           shard_weight_update=True)
+    assert r["mfu_pct"] > 0 and r["step_ms"] > 0
+    assert r["loss"] == r["loss"]          # finite
+
+
+def test_measure_multichip_matrix_and_comm_split(cpu_mesh_devices,
+                                                 monkeypatch):
+    # restrict to one cheap variant; the full matrix runs in bench.py
+    monkeypatch.setenv("RAY_TPU_BENCH_MC_VARIANTS",
+                       "int8_sharded,nonexistent")
+    mc = bench._measure_multichip(_tiny_cfg(), batch=1, seq=32, steps=2,
+                                  warmup=1, single_tokens_per_s=1e4)
+    assert mc["n_devices"] == len(cpu_mesh_devices)
+    assert set(mc["variants"]) == {"int8_sharded"}
+    v = mc["variants"]["int8_sharded"]
+    split = v["comm_split_ms"]
+    assert split["compute_ms"] > 0 and split["comm_ms"] >= 0
+    assert mc["best_variant"] == "int8_sharded"

@@ -15,9 +15,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 sys.path.insert(0, REPO)
 
 from tools.perf_gate import (  # noqa: E402
-    compare, extract_metrics, latest_baseline, parse_bench_record)
+    compare, extract_metrics, extract_multichip_metrics, latest_baseline,
+    parse_bench_record, record_backend)
 
 pytestmark = pytest.mark.perf
+
+
+def _mc_record(fp32=1.0, int8=1.2, backend="cpu"):
+    variants = {"fp32_replicated": {"mfu_pct": fp32},
+                "int8_sharded": {"mfu_pct": int8},
+                "broken": {"error": "boom"}}
+    return {"metric": "gptj_train_mfu_single_chip", "value": 10.0,
+            "detail": {"backend": backend,
+                       "multichip": {"mfu_pct": fp32, "n_devices": 8,
+                                     "variants": variants}}}
 
 
 def test_gate_parses_all_checked_in_baselines():
@@ -68,6 +79,80 @@ def test_driver_wrapper_and_tail_parsing():
     assert parse_bench_record({"rc": 0, "tail": tail})["value"] == 10.0
     with pytest.raises(ValueError):
         parse_bench_record({"rc": 0, "tail": "no json here"})
+
+
+def test_extract_multichip_metrics_variants_and_gaps():
+    m = extract_multichip_metrics(_mc_record())
+    assert m["multichip"] == 1.0
+    assert m["multichip/fp32_replicated"] == 1.0
+    assert m["multichip/int8_sharded"] == 1.2
+    assert m["multichip/broken"] is None            # errored variant
+    # wrapper-era record with no multichip section: everything skips
+    empty = extract_multichip_metrics({"metric": "m", "value": 1.0,
+                                       "detail": {}})
+    assert empty["multichip"] is None
+
+
+def test_multichip_compare_gates_per_variant():
+    base = _mc_record(fp32=1.0, int8=1.2)
+    ok, _ = compare(base, base, tolerance=2.0, metric="multichip")
+    assert ok
+    regressed = _mc_record(fp32=1.0, int8=1.2)
+    regressed["detail"]["multichip"]["variants"]["int8_sharded"] = {
+        "mfu_pct": 1.2 - 3.0}
+    ok, msgs = compare(regressed, base, tolerance=2.0, metric="multichip")
+    assert not ok
+    assert any(m.startswith("FAIL multichip/int8_sharded") for m in msgs)
+    # a baseline without the variant matrix never fails new variants
+    old = {"metric": "m", "value": 1.0,
+           "detail": {"multichip": {"mfu_pct": 1.0}}}
+    ok, msgs = compare(base, old, tolerance=2.0, metric="multichip")
+    assert ok and any("skipped" in m for m in msgs)
+
+
+def test_latest_baseline_prefers_matching_backend(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"metric": "m", "value": 40.0, "detail": {"backend": "tpu"}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"metric": "m", "value": 0.2, "detail": {"backend": "cpu"}}))
+    path, rec = latest_baseline(str(tmp_path), prefer_backend="tpu")
+    assert path.endswith("r01.json") and rec["value"] == 40.0
+    # no preference (or no match): highest revision wins
+    path, rec = latest_baseline(str(tmp_path))
+    assert path.endswith("r02.json")
+    path, _ = latest_baseline(str(tmp_path), prefer_backend="axon")
+    assert path.endswith("r02.json")
+
+
+def test_multichip_gate_skips_on_wrapper_only_baselines(tmp_path):
+    # the pre-r06 MULTICHIP records are driver wrappers with no bench
+    # JSON in the tail: bootstrap must pass, not error
+    from tools.perf_gate import main as gate_main
+    (tmp_path / "MULTICHIP_r01.json").write_text(json.dumps(
+        {"n_devices": 8, "rc": 0, "ok": True, "tail": "WARNING: noise\n"}))
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(_mc_record()))
+    rc = gate_main(["--fresh", str(fresh), "--metric", "multichip",
+                    "--root", str(tmp_path)])
+    assert rc == 0
+
+
+def test_multichip_cli_self_compare():
+    path = os.path.join(REPO, "MULTICHIP_r06.json")
+    gate = os.path.join(REPO, "tools", "perf_gate.py")
+    r = subprocess.run(
+        [sys.executable, gate, "--fresh", path, "--metric", "multichip",
+         "--root", REPO],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
+    assert "multichip/int8_sharded" in r.stdout
+    with open(path) as f:
+        rec = parse_bench_record(json.load(f))
+    assert record_backend(rec) == "cpu"
+    m = extract_multichip_metrics(rec)
+    # acceptance: int8+sharded >= the fp32 replicated baseline
+    assert m["multichip/int8_sharded"] >= m["multichip/fp32_replicated"]
 
 
 def test_cli_end_to_end(tmp_path):

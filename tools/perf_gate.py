@@ -1,15 +1,30 @@
 #!/usr/bin/env python
 """Perf regression gate: compare a fresh ``bench.py`` JSON against the
-latest checked-in ``BENCH_r*.json`` baseline.
+latest checked-in baseline series.
 
-A fresh measurement regressing the headline (seq-1024) MFU — or the
-seq-4096 MFU, when both records carry one — by more than ``--tolerance``
-MFU points (default 2.0) fails the gate with exit code 1.
+Two gated series (``--metric``):
+
+- ``bench`` (default) — the single-chip headline: a fresh measurement
+  regressing the seq-1024 MFU — or the seq-4096 MFU, when both records
+  carry one — by more than ``--tolerance`` MFU points (default 2.0)
+  fails with exit code 1. Baselines: ``BENCH_r*.json``.
+- ``multichip`` — the all-local-devices FSDP MFU (``detail.multichip``),
+  gated per grad-transport/weight-update variant (``fp32_replicated``,
+  ``int8_sharded``, …) plus the headline multichip MFU. Baselines:
+  ``MULTICHIP_r*.json``. Early MULTICHIP records are driver wrappers
+  with no bench JSON in their tail; if no baseline in the series parses,
+  the gate reports "no parseable baseline" and passes (exit 0) rather
+  than failing bootstrap.
+
+Baselines are matched to the fresh record's backend (``detail.backend``:
+"tpu"/"cpu") when possible, so a CPU smoke record checked in between TPU
+rounds never becomes the TPU series' comparison point.
 
 Usage:
     python tools/perf_gate.py --fresh out.json          # compare a file
     python tools/perf_gate.py --fresh -                 # read stdin
     python tools/perf_gate.py --run                     # run bench.py now
+    python tools/perf_gate.py --fresh out.json --metric multichip
     python tools/perf_gate.py --fresh out.json --tolerance 1.0
 
 Accepted input shapes (both for ``--fresh`` and the baselines):
@@ -31,6 +46,8 @@ from typing import Optional, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_TOLERANCE = 2.0          # MFU points
+BASELINE_GLOBS = {"bench": "BENCH_r*.json",
+                  "multichip": "MULTICHIP_r*.json"}
 
 
 def parse_bench_record(obj: dict) -> dict:
@@ -54,6 +71,11 @@ def parse_bench_record(obj: dict) -> dict:
     raise ValueError("no bench record found in JSON blob")
 
 
+def record_backend(rec: dict) -> Optional[str]:
+    detail = rec.get("detail") or {}
+    return detail.get("backend")
+
+
 def extract_metrics(rec: dict) -> dict:
     """{"seq1024": mfu, "seq4096": mfu|None} from a bench record."""
     detail = rec.get("detail") or {}
@@ -65,29 +87,70 @@ def extract_metrics(rec: dict) -> dict:
     return out
 
 
-def latest_baseline(root: str = REPO_ROOT) -> Tuple[str, dict]:
-    """Find the highest-numbered BENCH_r*.json and parse it."""
-    paths = glob.glob(os.path.join(root, "BENCH_r*.json"))
+def extract_multichip_metrics(rec: dict) -> dict:
+    """Multichip MFUs from a bench record: the headline multichip MFU
+    plus one entry per grad-transport/weight-update variant. Keys absent
+    from a record (old baselines predate the variant matrix) are simply
+    skipped by the comparison."""
+    detail = rec.get("detail") or {}
+    mc = detail.get("multichip") or {}
+    out = {"multichip": None}
+    if isinstance(mc, dict) and "mfu_pct" in mc:
+        out["multichip"] = float(mc["mfu_pct"])
+    for name, v in (mc.get("variants") or {}).items():
+        out[f"multichip/{name}"] = (
+            float(v["mfu_pct"])
+            if isinstance(v, dict) and "mfu_pct" in v else None)
+    return out
+
+
+EXTRACTORS = {"bench": extract_metrics,
+              "multichip": extract_multichip_metrics}
+
+
+def latest_baseline(root: str = REPO_ROOT, metric: str = "bench",
+                    prefer_backend: Optional[str] = None
+                    ) -> Tuple[str, dict]:
+    """Find the highest-numbered parseable baseline for ``metric``,
+    preferring (when ``prefer_backend`` is given) the highest-numbered
+    record measured on the same backend as the fresh run."""
+    pattern = BASELINE_GLOBS[metric]
+    paths = glob.glob(os.path.join(root, pattern))
     if not paths:
-        raise FileNotFoundError(f"no BENCH_r*.json baselines under {root}")
+        raise FileNotFoundError(f"no {pattern} baselines under {root}")
 
     def rev(p: str) -> int:
-        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        m = re.search(r"_r(\d+)\.json$", os.path.basename(p))
         return int(m.group(1)) if m else -1
 
-    path = max(paths, key=rev)
-    with open(path) as f:
-        return path, parse_bench_record(json.load(f))
+    parseable = []
+    for path in sorted(paths, key=rev, reverse=True):
+        try:
+            with open(path) as f:
+                rec = parse_bench_record(json.load(f))
+        except (OSError, ValueError, json.JSONDecodeError):
+            continue
+        parseable.append((path, rec))
+    if not parseable:
+        raise ValueError(
+            f"no parseable baseline in {pattern} under {root}")
+    if prefer_backend is not None:
+        for path, rec in parseable:
+            if record_backend(rec) == prefer_backend:
+                return path, rec
+    return parseable[0]
 
 
 def compare(fresh: dict, baseline: dict,
-            tolerance: float = DEFAULT_TOLERANCE):
+            tolerance: float = DEFAULT_TOLERANCE, metric: str = "bench"):
     """Return (ok, messages). Regression beyond ``tolerance`` MFU points
     on any metric both records carry fails; missing metrics are skipped
-    (a CPU smoke run has no seq4096)."""
-    fm, bm = extract_metrics(fresh), extract_metrics(baseline)
+    (a CPU smoke run has no seq4096; an old multichip baseline has no
+    variant matrix)."""
+    extract = EXTRACTORS[metric]
+    fm, bm = extract(fresh), extract(baseline)
     ok, msgs = True, []
-    for name in ("seq1024", "seq4096"):
+    for name in sorted(set(fm) | set(bm)):
         f, b = fm.get(name), bm.get(name)
         if f is None or b is None:
             msgs.append(f"{name}: skipped (missing in "
@@ -123,13 +186,26 @@ def _load_fresh(args) -> dict:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="Gate the multichip series with: "
+               "python tools/perf_gate.py --fresh out.json "
+               "--metric multichip")
     src = ap.add_mutually_exclusive_group(required=True)
     src.add_argument("--fresh", help="fresh bench JSON path ('-' = stdin)")
     src.add_argument("--run", action="store_true",
                      help="run bench.py and gate its output")
+    ap.add_argument("--metric", choices=sorted(BASELINE_GLOBS),
+                    default="bench",
+                    help="which series to gate: 'bench' = single-chip "
+                         "seq1024/seq4096 MFU vs BENCH_r*.json; "
+                         "'multichip' = all-devices FSDP MFU (per "
+                         "grad-transport/weight-update variant) vs "
+                         "MULTICHIP_r*.json (default: bench)")
     ap.add_argument("--baseline", default=None,
-                    help="baseline JSON (default: latest BENCH_r*.json)")
+                    help="baseline JSON (default: latest parseable "
+                         "baseline for --metric, preferring the fresh "
+                         "record's backend)")
     ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
                     help="allowed MFU-point regression (default 2.0)")
     ap.add_argument("--root", default=REPO_ROOT,
@@ -138,18 +214,36 @@ def main(argv=None) -> int:
 
     try:
         fresh = _load_fresh(args)
+    except (OSError, ValueError, KeyError, RuntimeError,
+            json.JSONDecodeError) as e:
+        print(f"perf_gate: error: {e}", file=sys.stderr)
+        return 2
+
+    try:
         if args.baseline:
             base_path = args.baseline
             with open(base_path) as f:
                 baseline = parse_bench_record(json.load(f))
         else:
-            base_path, baseline = latest_baseline(args.root)
-    except (OSError, ValueError, KeyError, RuntimeError) as e:
+            base_path, baseline = latest_baseline(
+                args.root, args.metric,
+                prefer_backend=record_backend(fresh))
+    except ValueError as e:
+        if args.metric == "multichip" and not args.baseline:
+            # Bootstrap: the early MULTICHIP records are driver wrappers
+            # with no bench JSON — nothing to gate against yet.
+            print(f"perf_gate: {e}")
+            print("perf_gate: PASS (no parseable multichip baseline)")
+            return 0
+        print(f"perf_gate: error: {e}", file=sys.stderr)
+        return 2
+    except (OSError, KeyError, RuntimeError, FileNotFoundError) as e:
         print(f"perf_gate: error: {e}", file=sys.stderr)
         return 2
 
-    ok, msgs = compare(fresh, baseline, args.tolerance)
-    print(f"perf_gate: baseline {os.path.basename(str(base_path))}")
+    ok, msgs = compare(fresh, baseline, args.tolerance, args.metric)
+    print(f"perf_gate: metric {args.metric}, baseline "
+          f"{os.path.basename(str(base_path))}")
     for m in msgs:
         print(f"perf_gate: {m}")
     print(f"perf_gate: {'PASS' if ok else 'FAIL'}")
