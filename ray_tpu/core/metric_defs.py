@@ -96,6 +96,26 @@ class RuntimeMetrics:
             "runtime_stream_credit_stall_seconds_total",
             "Seconds streaming producers spent blocked on the "
             "backpressure window waiting for STREAM_CREDIT")
+        # -- serve LLM engine (serve/llm_engine.py): per-replica
+        # scheduler signals — the queue-latency/occupancy family the
+        # autoscaler consumes (ROADMAP item 1)
+        self.serve_queue_depth = Gauge(
+            "serve_engine_queue_depth",
+            "Requests waiting for a decode slot on this replica")
+        self.serve_batch_occupancy = Histogram(
+            "serve_engine_batch_occupancy",
+            "Active decode slots per batched decode step",
+            boundaries=[1, 2, 4, 8, 16, 32, 64])
+        self.serve_ttft = Histogram(
+            "serve_engine_ttft_seconds",
+            "Submit-to-first-token latency (chunked prefill included)",
+            boundaries=[0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10])
+        self.serve_tokens = Counter(
+            "serve_engine_tokens_total",
+            "Tokens generated by this replica's engine")
+        self.serve_tokens_per_s = Gauge(
+            "serve_engine_tokens_per_s",
+            "Engine decode throughput since start")
         # -- flight recorder (core/events.py)
         self.events_dropped = Counter(
             "runtime_events_dropped_total",

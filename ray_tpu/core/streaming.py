@@ -67,6 +67,16 @@ class StreamState:
         #: ``(trace_id, parent_span)`` — rides STREAM_CREDIT so every
         #: control hop of the stream carries the link
         self.trace: Optional[tuple] = None
+        #: credit batching: cumulative credits are idempotent, so the
+        #: consumer only reports every ``credit_batch`` items — EXCEPT
+        #: when its buffer just drained (the producer may be blocked at
+        #: the window; an unsent credit there would deadlock). Halves of
+        #: small windows flush eagerly; 1 restores per-item credits.
+        self.credit_batch = max(
+            1, min(8, getattr(runtime.config,
+                              "generator_backpressure_num_objects",
+                              64) // 2))
+        self.last_credit = 0
 
     # ------------------------------------------------------- report side
     def on_item(self, index: int, meta: dict, producer: Optional[bytes]
@@ -119,6 +129,7 @@ class StreamState:
             with self.cond:
                 consumed = self.next_index - 1
                 producer = self.producer
+                self.last_credit = consumed
             rt._stream_send_credit(self.task_id_b, consumed, producer,
                                    self.trace)
             return
@@ -172,6 +183,14 @@ class StreamState:
                     self.next_index += 1
                     consumed = self.next_index - 1
                     producer = self.producer
+                    # batched credits: flush when the buffer drained
+                    # (producer may be window-blocked) or every
+                    # credit_batch items; skipped credits are covered
+                    # by the next flush (cumulative).
+                    send_credit = (not self.items) or \
+                        consumed - self.last_credit >= self.credit_batch
+                    if send_credit:
+                        self.last_credit = consumed
                     break
                 if self._done_locked():
                     # fully consumed: the runtime can forget the routing
@@ -188,8 +207,9 @@ class StreamState:
                         f"no stream item within {timeout}s")
                 self.cond.wait(0.2 if remaining is None
                                else min(0.2, remaining))
-        self.runtime._stream_send_credit(self.task_id_b, consumed,
-                                         producer, self.trace)
+        if send_credit:
+            self.runtime._stream_send_credit(self.task_id_b, consumed,
+                                             producer, self.trace)
         return ref
 
     def next_ready(self, timeout: Optional[float] = None) -> bool:

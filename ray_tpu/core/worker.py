@@ -670,6 +670,10 @@ class WorkerExecutor:
         self.runtime.current_task_id = self.runtime._driver_task_id
 
     async def _execute_async(self, m: dict) -> None:
+        # None = the loop's default executor, which actor setup replaced
+        # with a max_concurrency-sized pool (the asyncio default would
+        # cap concurrency at min(32, cpus+4) and deadlock against user
+        # run_in_executor work — see _create_actor_instance).
         async with self._async_sema:
             await asyncio.get_event_loop().run_in_executor(
                 None, lambda: self._execute_async_inner(m))
@@ -692,6 +696,22 @@ class WorkerExecutor:
             self._thread_pool = ThreadPoolExecutor(spec.max_concurrency)
         if spec.is_async_actor:
             self._async_loop = asyncio.new_event_loop()
+            # Dedicated executor installed as the loop's default.
+            # asyncio's built-in default executor is min(32, cpus+4)
+            # threads — on small hosts that silently caps actor
+            # concurrency below max_concurrency, and DEADLOCKS when
+            # user code shares the default executor: a streaming call
+            # occupies one thread for its whole life, and the user
+            # coroutine's own run_in_executor work queues behind
+            # further calls that are waiting for those same threads.
+            # Sized 2x + margin so every admitted call (semaphore caps
+            # them at max_concurrency) can nest one run_in_executor of
+            # its own without exhausting the pool.
+            from concurrent.futures import ThreadPoolExecutor
+            self._async_pool = ThreadPoolExecutor(
+                2 * max(2, spec.max_concurrency) + 2,
+                thread_name_prefix="actor-async-exec")
+            self._async_loop.set_default_executor(self._async_pool)
             t = threading.Thread(target=self._async_loop.run_forever,
                                  name="actor-asyncio", daemon=True)
             t.start()

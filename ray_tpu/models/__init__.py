@@ -21,6 +21,9 @@ from ray_tpu.models.transformer import (
     lm_loss,
     hidden_states,
     init_params,
+    init_kv_cache,
+    prefill,
+    decode_step,
     logical_axes,
     REMAT_POLICIES,
     remat_policy_fn,
@@ -38,6 +41,9 @@ __all__ = [
     "lm_loss",
     "hidden_states",
     "init_params",
+    "init_kv_cache",
+    "prefill",
+    "decode_step",
     "logical_axes",
     "REMAT_POLICIES",
     "remat_policy_fn",

@@ -47,6 +47,7 @@ from ray_tpu.ops import (
     apply_rotary,
     layer_norm,
     multihead_attention,
+    paged_attention,
     ring_attention,
     rms_norm,
     rotary_table,
@@ -469,6 +470,154 @@ def lm_loss(config: TransformerConfig, params: Dict, batch: Dict,
         loss = loss + c.moe_aux_weight * moe_aux
         aux["moe_aux"] = moe_aux
     return loss, aux
+
+
+# ------------------------------------------------------- inference (KV)
+# The serving decode path: a paged KV cache ([num_blocks, block_size,
+# kv_heads, head_dim] per layer, block table per sequence) written by
+# chunked prefill and batched single-token decode steps. Both entry
+# points are shape-stable — jit them once at the engine's fixed
+# (batch, chunk, table) shapes and admission never recompiles.
+
+def init_kv_cache(config: TransformerConfig, num_blocks: int,
+                  block_size: int) -> Dict[str, jnp.ndarray]:
+    """Allocate the paged KV cache: ``{"k", "v"}`` of shape
+    ``[n_layers, num_blocks, block_size, kv_heads, head_dim]`` in the
+    compute dtype. Zero-filled; a zero key scores 0 pre-softmax, so
+    reserved/trash blocks are numerically harmless."""
+    c = config
+    shape = (c.n_layers, num_blocks, block_size, c.kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def _paged_attn_sublayer(c, h, lp, sin, cos, layout, kc, vc,
+                         block_tables, positions, write_mask):
+    """Decode-path attention sublayer: project qkv for the new tokens,
+    rotate at their absolute positions, write k/v into the cache blocks,
+    then attend against the (now-updated) paged cache. Returns
+    (attn_out, kc, vc)."""
+    e = h.shape[-1]
+    dt = c.dtype
+
+    def proj(w, n):
+        return jnp.einsum("bse,ehd->bshd", h.astype(dt),
+                          w.reshape(e, n, -1).astype(dt))
+    q = proj(lp["wq"], c.n_heads)
+    k = proj(lp["wk"], c.kv_heads)
+    v = proj(lp["wv"], c.kv_heads)
+    q = apply_rotary(q, sin, cos, positions=positions, layout=layout)
+    k = apply_rotary(k, sin, cos, positions=positions, layout=layout)
+
+    n_blocks, bs = kc.shape[0], kc.shape[1]
+    bid = jnp.take_along_axis(block_tables, positions // bs, axis=1)
+    slot = positions % bs
+    # invalid (padded) chunk positions scatter out of bounds -> dropped
+    bid = jnp.where(write_mask, bid, n_blocks)
+    kc = kc.at[bid, slot].set(k.astype(kc.dtype), mode="drop")
+    vc = vc.at[bid, slot].set(v.astype(vc.dtype), mode="drop")
+
+    att = paged_attention(q, kc, vc, block_tables, positions)
+    out = jnp.einsum("bshd,hde->bse", att,
+                     lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
+    return out, kc, vc
+
+
+def _forward_with_cache(c: TransformerConfig, params: Dict,
+                        ids: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+                        block_tables: jnp.ndarray,
+                        positions: jnp.ndarray,
+                        write_mask: jnp.ndarray):
+    """Shared trunk of :func:`prefill` and :func:`decode_step`:
+    (B, C) token ids at absolute ``positions`` -> (B, C, vocab) logits,
+    writing each layer's k/v into the paged cache as it goes."""
+    if c.n_experts:
+        raise NotImplementedError(
+            "paged decode does not support MoE configs yet")
+    bs = cache["k"].shape[2]
+    window = block_tables.shape[1] * bs
+    sin, cos = rotary_table(
+        window, c.rotary_dim if c.block_style == "gptj" else c.head_dim,
+        c.rope_base)
+    layout = "gptj" if c.block_style == "gptj" else "neox"
+    x = jnp.take(params["embed"], ids, axis=0).astype(c.dtype)
+
+    def gptj_step(x, lp, kc, vc):
+        h = layer_norm(x, lp["ln_scale"], lp["ln_bias"])
+        att, kc, vc = _paged_attn_sublayer(
+            c, h, lp, sin, cos, layout, kc, vc,
+            block_tables, positions, write_mask)
+        mlp, _ = _mlp_sublayer(c, h, lp)
+        return x + (att + mlp).astype(x.dtype), kc, vc
+
+    def llama_step(x, lp, kc, vc):
+        h = rms_norm(x, lp["attn_norm"])
+        att, kc, vc = _paged_attn_sublayer(
+            c, h, lp, sin, cos, layout, kc, vc,
+            block_tables, positions, write_mask)
+        x = x + att.astype(x.dtype)
+        h2 = rms_norm(x, lp["mlp_norm"]).astype(c.dtype)
+        mlp, _ = _mlp_sublayer(c, h2, lp)
+        return x + mlp.astype(x.dtype), kc, vc
+
+    step = gptj_step if c.block_style == "gptj" else llama_step
+
+    def scan_fn(carry, per_layer):
+        lp, kc, vc = per_layer
+        out, kc, vc = step(carry, lp, kc, vc)
+        return out, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_fn, x, (params["layers"], cache["k"], cache["v"]))
+
+    fn = params["final_norm"]
+    if c.block_style == "llama":
+        x = rms_norm(x, fn["scale"])
+    else:
+        x = layer_norm(x, fn["scale"], fn["bias"])
+    logits = jnp.dot(x.astype(c.dtype),
+                     params["lm_head"]["w"].astype(c.dtype))
+    if c.block_style != "llama":
+        logits = logits + params["lm_head"]["b"].astype(c.dtype)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def prefill(config: TransformerConfig, params: Dict, tokens: jnp.ndarray,
+            cache: Dict[str, jnp.ndarray], block_tables: jnp.ndarray,
+            start_pos: jnp.ndarray, lens: jnp.ndarray):
+    """Process one prompt chunk per sequence, writing cache blocks.
+
+    ``tokens``: (B, C) int32 — chunk ``start_pos[b] .. start_pos[b]+
+    lens[b]-1`` of each prompt, zero-padded past ``lens[b]`` (chunked
+    prefill feeds a fixed C per call so the engine never recompiles).
+    Chunk token i attends every cached position ``<= start_pos + i`` —
+    earlier chunks of the same prompt plus the chunk's own causal
+    prefix. Returns ``(logits (B, C, vocab), cache)``; the first
+    generated token comes from ``logits[b, lens[b]-1]`` of the FINAL
+    chunk.
+    """
+    b, chunk = tokens.shape
+    positions = start_pos[:, None] + jnp.arange(chunk, dtype=jnp.int32)
+    write_mask = jnp.arange(chunk, dtype=jnp.int32)[None, :] \
+        < lens[:, None]
+    return _forward_with_cache(config, params, tokens, cache,
+                               block_tables, positions, write_mask)
+
+
+def decode_step(config: TransformerConfig, params: Dict,
+                token_ids: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+                block_tables: jnp.ndarray, seq_lens: jnp.ndarray):
+    """One batched decode step: each sequence's newest token
+    (``token_ids``: (B,) int32, sitting at absolute position
+    ``seq_lens[b]``) is written to its cache block and attends every
+    earlier position — causal by construction. Returns
+    ``(logits (B, vocab), cache)``.
+    """
+    positions = seq_lens[:, None].astype(jnp.int32)
+    write_mask = jnp.ones_like(positions, dtype=bool)
+    logits, cache = _forward_with_cache(
+        config, params, token_ids[:, None], cache,
+        block_tables, positions, write_mask)
+    return logits[:, 0], cache
 
 
 class Transformer:

@@ -16,7 +16,8 @@ one tuned implementation.
 
 from ray_tpu.ops.norms import rms_norm, layer_norm
 from ray_tpu.ops.rotary import rotary_table, apply_rotary
-from ray_tpu.ops.attention import multihead_attention, attention_reference
+from ray_tpu.ops.attention import (
+    multihead_attention, attention_reference, paged_attention)
 from ray_tpu.ops.flash_attention import (
     flash_attention, default_flash_blocks, autotune_flash_blocks)
 from ray_tpu.ops.ring_attention import ring_attention
@@ -29,6 +30,7 @@ __all__ = [
     "apply_rotary",
     "multihead_attention",
     "attention_reference",
+    "paged_attention",
     "flash_attention",
     "default_flash_blocks",
     "autotune_flash_blocks",

@@ -41,6 +41,54 @@ def attention_reference(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                       ).astype(q.dtype)
 
 
+def paged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                    v_cache: jnp.ndarray, block_tables: jnp.ndarray,
+                    q_positions: jnp.ndarray, *,
+                    sm_scale: Optional[float] = None) -> jnp.ndarray:
+    """Attention of new-token queries against a paged KV cache.
+
+    The serving decode/prefill primitive: keys and values live in a pool
+    of fixed-size blocks (``k_cache``/``v_cache`` of shape
+    ``[num_blocks, block_size, kv_heads, head_dim]``); each sequence owns
+    an ordered list of block ids (``block_tables[b, t]`` holds the block
+    storing absolute positions ``t*block_size .. t*block_size+bs-1`` of
+    sequence ``b``). Queries ``q[b, i]`` sit at absolute position
+    ``q_positions[b, i]`` and attend every cached position ``<= q_positions
+    [b, i]`` — causal by construction, so the SAME call serves batched
+    single-token decode (``q`` of shape ``[B, 1, H, D]``) and chunked
+    prefill (``[B, C, H, D]``, the chunk's own keys having been written to
+    the cache first). GQA caches store ``kv_heads < num_heads``; heads are
+    repeated at read time.
+
+    Pure-XLA gather implementation (one ``take`` per sequence over its
+    block table, f32 softmax) — the reference path CPU tests exercise and
+    the TPU baseline until a Pallas paged kernel lands. Work is
+    O(B * C * T * block_size) regardless of true lengths; keep
+    ``block_tables`` sized to the serving window, not the model max.
+    """
+    n_blocks, bs, kvh, d = k_cache.shape
+    b, c, h, _ = q.shape
+    t = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    # Gather each sequence's blocks: [B, T, bs, KVH, D] -> [B, K, KVH, D]
+    k = jnp.take(k_cache, block_tables, axis=0).reshape(b, t * bs, kvh, d)
+    v = jnp.take(v_cache, block_tables, axis=0).reshape(b, t * bs, kvh, d)
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # key slot j of the gathered view holds absolute position j
+    key_pos = jnp.arange(t * bs, dtype=jnp.int32)
+    mask = key_pos[None, None, :] <= q_positions[:, :, None]   # [B, C, K]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    s = jnp.where(mask[:, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
 def _can_use_flash(q, k, block_q: int, block_k: int) -> bool:
     b, sq, h, d = q.shape
     sk = k.shape[1]

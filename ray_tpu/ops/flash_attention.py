@@ -29,7 +29,9 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import json
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -442,6 +444,78 @@ def default_flash_blocks(seq_q: int, seq_k: int, head_dim: int,
 # Winner cache: (chip, seq, head_dim, causal) -> (block_q, block_k).
 _AUTOTUNE_CACHE: dict = {}
 
+# ---- disk persistence: serving replicas must not re-time the candidate
+# grid on every process start. Winners are stored as JSON keyed by
+# "chip|jax_version|seq|head_dim|causal" (the jax version is part of the
+# key because a compiler upgrade can move the optimum) under
+# $RAY_TPU_FLASH_CACHE_DIR (default ~/.cache/ray_tpu). Only TIMED
+# winners persist — chip-default fallbacks cost nothing to recompute.
+_DISK_CACHE_LOADED = False
+
+
+def _autotune_cache_path() -> str:
+    d = os.environ.get("RAY_TPU_FLASH_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "ray_tpu")
+    return os.path.join(d, "flash_autotune.json")
+
+
+def _disk_cache_enabled() -> bool:
+    return os.environ.get("RAY_TPU_FLASH_AUTOTUNE_CACHE", "1") != "0"
+
+
+def _disk_key(key: tuple) -> str:
+    chip, seq, head_dim, causal = key
+    return f"{chip}|{jax.__version__}|{seq}|{head_dim}|{int(causal)}"
+
+
+def _load_disk_cache() -> None:
+    """Merge persisted winners for THIS jax version into the in-memory
+    cache (once per process; misses after that re-time normally)."""
+    global _DISK_CACHE_LOADED
+    if _DISK_CACHE_LOADED or not _disk_cache_enabled():
+        return
+    _DISK_CACHE_LOADED = True
+    try:
+        with open(_autotune_cache_path()) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return
+    ver = jax.__version__
+    for k, v in data.items():
+        parts = k.split("|")
+        if len(parts) != 5 or parts[1] != ver:
+            continue
+        try:
+            key = (parts[0], int(parts[2]), int(parts[3]),
+                   bool(int(parts[4])))
+            _AUTOTUNE_CACHE.setdefault(key, (int(v[0]), int(v[1])))
+        except (TypeError, ValueError, IndexError):
+            continue
+
+
+def _persist_winner(key: tuple, blocks: Tuple[int, int]) -> None:
+    """Write-through one timed winner (read-modify-write + atomic
+    rename; concurrent replicas may race, last writer wins — every
+    intermediate state is a valid cache). Best-effort: a read-only
+    filesystem must not break autotuning."""
+    if not _disk_cache_enabled():
+        return
+    path = _autotune_cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = {}
+        data[_disk_key(key)] = list(blocks)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=0, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
 _AUTOTUNE_CANDIDATES = (
     (256, 256), (256, 512), (512, 512), (512, 1024),
     (1024, 512), (1024, 1024),
@@ -500,6 +574,9 @@ def autotune_flash_blocks(seq: int, head_dim: int, *,
     key = (chip, int(seq), int(head_dim), bool(causal))
     if key in _AUTOTUNE_CACHE:
         return _AUTOTUNE_CACHE[key]
+    _load_disk_cache()   # persisted winners from earlier processes
+    if key in _AUTOTUNE_CACHE:
+        return _AUTOTUNE_CACHE[key]
 
     default = default_flash_blocks(seq, seq, head_dim, chip=chip)
     cands = [c for c in (candidates or _AUTOTUNE_CANDIDATES)
@@ -521,6 +598,7 @@ def autotune_flash_blocks(seq: int, head_dim: int, *,
         if t < best_t:
             best, best_t = (min(bq, seq), min(bk, seq)), t
     _AUTOTUNE_CACHE[key] = best
+    _persist_winner(key, best)   # timed winner: survive process restarts
     return best
 
 

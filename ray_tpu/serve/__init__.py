@@ -24,6 +24,9 @@ from ray_tpu.serve.deployment import (
 from ray_tpu.serve.handle import (
     DeploymentHandle, DeploymentResponse, DeploymentResponseGenerator)
 from ray_tpu.serve._private.replica import get_multiplexed_model_id
+from ray_tpu.serve.llm_engine import (
+    EngineConfig, EngineDeadError, LLMEngine, LLMServer,
+    RequestTooLargeError)
 
 __all__ = [
     "Application",
@@ -32,6 +35,11 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentResponseGenerator",
+    "EngineConfig",
+    "EngineDeadError",
+    "LLMEngine",
+    "LLMServer",
+    "RequestTooLargeError",
     "batch",
     "delete",
     "deployment",

@@ -1,0 +1,631 @@
+"""Continuous-batching LLM inference engine (the "millions of users"
+serving path, ROADMAP item 1).
+
+vLLM-style serving on the repo's own model stack: a paged KV cache in
+device memory (``models.transformer.init_kv_cache``), a fixed array of
+**decode slots** stepped as ONE batched ``decode_step`` call, and
+**chunked prefill** interleaved between decode steps so a new arrival's
+time-to-first-token never stalls in-flight streams for more than one
+``prefill_chunk``'s worth of compute. New requests are admitted into the
+in-flight batch between steps — continuous batching, not static batching:
+a finishing stream frees its slot and blocks for the next queued prompt
+immediately, so the MXU stays at high occupancy under ragged request
+lengths.
+
+Shapes are FIXED at engine construction (``decode_slots`` sequences per
+decode call, ``prefill_chunk`` tokens per prefill call, one block table
+of ``blocks_per_seq`` entries per slot) and both model functions are
+jitted once with donated caches — admission, EOS, and cancellation are
+pure host-side bookkeeping and never recompile.
+
+Memory accounting: one KV block holds ``block_size`` tokens ×
+``2 (k+v) × n_layers × kv_heads × head_dim × dtype_bytes`` bytes; the
+pool is ``num_kv_blocks`` blocks (default: full occupancy — every slot
+can hold ``max_seq_len`` tokens — plus one reserved trash block that
+idle slots' writes land in). Blocks are recycled through a free list on
+EOS/cancel/error.
+
+Integration: :class:`LLMServer` is the deployment-facing wrapper —
+``generate`` is an async generator, so a Serve replica streams tokens
+through the core ``num_returns="streaming"`` machinery and
+``handle.options(stream=True)`` / the HTTP proxy work unchanged;
+consumer ``close()`` lands in :meth:`LLMEngine.cancel`, which frees the
+slot and blocks at the next step boundary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+import functools
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu.exceptions import RayTpuError
+
+
+class EngineDeadError(RayTpuError):
+    """The engine's step loop died; every queued/in-flight request is
+    failed with this (typed — consumers never hang on a dead engine)."""
+
+
+class RequestTooLargeError(RayTpuError):
+    """prompt_len + 1 exceeds the engine's per-request window
+    (``max_seq_len``) — the request can never be admitted."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Knobs of the serving engine (see README "Serving").
+
+    - ``decode_slots``: sequences decoded per batched step — the
+      continuous-batching width and the unit of batch occupancy.
+    - ``kv_block_size``: tokens per KV-cache block (paging granularity;
+      smaller = less internal fragmentation, more gather indices).
+    - ``max_seq_len``: per-request window (prompt + generated tokens);
+      sets ``blocks_per_seq`` and the attention gather width.
+    - ``prefill_chunk``: prompt tokens processed per engine step — the
+      TTFT-vs-inter-token-latency tradeoff knob.
+    - ``num_kv_blocks``: KV pool size; 0 = auto (full occupancy + the
+      reserved trash block idle slots write into).
+    """
+    decode_slots: int = 8
+    kv_block_size: int = 16
+    max_seq_len: int = 256
+    prefill_chunk: int = 32
+    num_kv_blocks: int = 0
+    max_new_tokens: int = 64          # default per-request cap
+    eos_token_id: Optional[int] = None
+
+    @property
+    def blocks_per_seq(self) -> int:
+        return -(-self.max_seq_len // self.kv_block_size)
+
+    @property
+    def resolved_num_blocks(self) -> int:
+        if self.num_kv_blocks:
+            return self.num_kv_blocks
+        return 1 + self.decode_slots * self.blocks_per_seq
+
+    def kv_bytes_per_token(self, model_config) -> int:
+        """KV bytes/token — the HBM-budget side of the block math."""
+        import jax.numpy as jnp
+        c = model_config
+        itemsize = jnp.dtype(c.dtype).itemsize
+        return 2 * c.n_layers * c.kv_heads * c.head_dim * itemsize
+
+
+_DONE = object()          # stream-end sentinel on the request queue
+
+# request lifecycle states
+_QUEUED, _PREFILL, _DECODE, _FINISHED = range(4)
+
+
+class _Request:
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
+                 "out", "state", "slot", "blocks", "prefill_pos",
+                 "seq_len", "generated", "cancelled", "t_submit",
+                 "t_first_token")
+
+    def __init__(self, rid: int, prompt: List[int], max_new_tokens: int,
+                 eos_token_id: Optional[int]):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.out: "queue.Queue" = queue.Queue()
+        self.state = _QUEUED
+        self.slot: Optional[int] = None
+        self.blocks: List[int] = []
+        self.prefill_pos = 0          # prompt tokens already in cache
+        self.seq_len = 0              # cache positions written
+        self.generated = 0            # tokens emitted
+        self.cancelled = False
+        self.t_submit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+
+
+class LLMEngine:
+    """Continuous-batching scheduler over the paged decode path.
+
+    Thread model: one background step thread owns the device state
+    (caches + slot arrays); ``submit``/``cancel`` only touch the queue
+    under a lock and are safe from any thread or event loop. Consumers
+    read per-request ``queue.Queue``s fed by the step thread.
+    """
+
+    def __init__(self, model_config, engine_config: Optional[EngineConfig]
+                 = None, params=None, seed: int = 0,
+                 replica_tag: str = ""):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from ray_tpu.models import (decode_step, init_kv_cache,
+                                    init_params, prefill)
+
+        self.model_config = model_config
+        self.config = engine_config or EngineConfig()
+        self.replica_tag = replica_tag
+        ec = self.config
+        if ec.prefill_chunk < 1 or ec.decode_slots < 1:
+            raise ValueError("prefill_chunk and decode_slots must be >= 1")
+
+        self._params = params if params is not None \
+            else init_params(model_config, jax.random.PRNGKey(seed))
+        self._cache = init_kv_cache(model_config, ec.resolved_num_blocks,
+                                    ec.kv_block_size)
+
+        S, T = ec.decode_slots, ec.blocks_per_seq
+        self._np = np
+        self._jnp = jnp
+        # Host-side slot arrays. Block-table row 0s point idle slots at
+        # the reserved trash block, so their (masked-garbage) decode
+        # writes never touch a live sequence's blocks.
+        self._block_tables = np.zeros((S, T), np.int32)
+        self._seq_lens = np.zeros((S,), np.int32)
+        self._last_tok = np.zeros((S,), np.int32)
+        self._slots: List[Optional[_Request]] = [None] * S
+        self._free_slots = list(range(S))
+        self._free_blocks = collections.deque(
+            range(1, ec.resolved_num_blocks))    # block 0 = trash
+
+        # jit once at the fixed shapes; caches are donated so XLA
+        # updates them in place step over step.
+        def _prefill_fn(params, tokens, cache, bt, start, lens):
+            logits, cache = prefill(model_config, params, tokens, cache,
+                                    bt, start, lens)
+            last = jnp.take_along_axis(
+                logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+            return jnp.argmax(last, axis=-1).astype(jnp.int32), cache
+
+        def _decode_fn(params, toks, cache, bt, seq_lens):
+            logits, cache = decode_step(model_config, params, toks,
+                                        cache, bt, seq_lens)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._jit_prefill = jax.jit(_prefill_fn, donate_argnums=(2,))
+        self._jit_decode = jax.jit(_decode_fn, donate_argnums=(2,))
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._pending: "collections.deque[_Request]" = collections.deque()
+        self._prefilling: "collections.deque[_Request]" = \
+            collections.deque()
+        self._rid = 0
+        self._stop = False
+        self._dead: Optional[BaseException] = None
+
+        # -- stats / metrics -------------------------------------------
+        self._tokens_total = 0
+        self._decode_steps = 0
+        self._prefill_chunks = 0
+        self._occupancy: Dict[int, int] = collections.defaultdict(int)
+        self._t_start = time.monotonic()
+        self._last_stats_emit = 0.0
+        self._metrics = self._recorder = None
+        try:
+            from ray_tpu.core.metric_defs import runtime_metrics
+            self._metrics = runtime_metrics()
+        except Exception:
+            pass
+        try:
+            from ray_tpu.core.global_state import try_global_worker
+            w = try_global_worker()
+            self._recorder = getattr(w, "recorder", None)
+        except Exception:
+            pass
+
+        # Engine-owned executor for consumer-side queue polls: sharing
+        # the actor event loop's default executor would let stream
+        # polls and whole actor calls starve each other under load.
+        from concurrent.futures import ThreadPoolExecutor
+        self._poll_pool = ThreadPoolExecutor(
+            2 * ec.decode_slots + 4, thread_name_prefix="llm-engine-poll")
+
+        self._thread = threading.Thread(
+            target=self._run, name="llm-engine-step", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------- public API
+    def submit(self, prompt_ids: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None) -> _Request:
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise ValueError("empty prompt")
+        ec = self.config
+        if len(prompt) + 1 > ec.max_seq_len:
+            raise RequestTooLargeError(
+                f"prompt of {len(prompt)} tokens + 1 exceeds the engine "
+                f"window max_seq_len={ec.max_seq_len}")
+        mnt = max_new_tokens if max_new_tokens is not None \
+            else ec.max_new_tokens
+        eos = eos_token_id if eos_token_id is not None else ec.eos_token_id
+        with self._work:
+            if self._dead is not None:
+                raise EngineDeadError(
+                    f"engine step loop died: {self._dead!r}")
+            self._rid += 1
+            req = _Request(self._rid, prompt, max(1, int(mnt)), eos)
+            self._pending.append(req)
+            self._work.notify_all()
+        return req
+
+    def cancel(self, req: _Request) -> None:
+        """Mark a request cancelled; the step thread frees its slot and
+        blocks at the next step boundary (the generator ``close()``
+        path lands here)."""
+        with self._work:
+            req.cancelled = True
+            self._work.notify_all()
+
+    async def generate(self, prompt_ids: Sequence[int],
+                       max_new_tokens: Optional[int] = None,
+                       eos_token_id: Optional[int] = None):
+        """Async token stream for one request. Raises typed errors
+        (``EngineDeadError`` / ``RequestTooLargeError``) instead of
+        hanging; early ``aclose()`` cancels the request and frees its
+        slot + blocks."""
+        req = self.submit(prompt_ids, max_new_tokens, eos_token_id)
+        loop = asyncio.get_running_loop()
+        get = functools.partial(req.out.get, timeout=0.2)
+        try:
+            while True:
+                try:
+                    item = await loop.run_in_executor(self._poll_pool, get)
+                except queue.Empty:
+                    if self._dead is not None:
+                        raise EngineDeadError(
+                            f"engine step loop died: {self._dead!r}")
+                    continue
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self.cancel(req)
+
+    def generate_sync(self, prompt_ids: Sequence[int],
+                      max_new_tokens: Optional[int] = None,
+                      eos_token_id: Optional[int] = None,
+                      timeout_s: float = 120.0):
+        """Blocking token stream (tests / direct embedding)."""
+        req = self.submit(prompt_ids, max_new_tokens, eos_token_id)
+        deadline = time.monotonic() + timeout_s
+        try:
+            while True:
+                try:
+                    item = req.out.get(timeout=0.2)
+                except queue.Empty:
+                    if self._dead is not None:
+                        raise EngineDeadError(
+                            f"engine step loop died: {self._dead!r}")
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("generate_sync timed out")
+                    continue
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self.cancel(req)
+
+    def stats(self) -> Dict[str, Any]:
+        """Scheduler counters (the autoscaling signal surface): queue
+        depth, batch occupancy histogram, tokens/s, leak-check views of
+        the slot/block free lists."""
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t_start, 1e-9)
+            return {
+                "queue_depth": len(self._pending),
+                "prefilling": len(self._prefilling),
+                "active_slots": sum(1 for r in self._slots
+                                    if r is not None),
+                "free_slots": len(self._free_slots),
+                "free_blocks": len(self._free_blocks),
+                "total_blocks": self.config.resolved_num_blocks - 1,
+                "tokens_total": self._tokens_total,
+                "tokens_per_s": round(self._tokens_total / elapsed, 2),
+                "decode_steps": self._decode_steps,
+                "prefill_chunks": self._prefill_chunks,
+                "occupancy_hist": dict(self._occupancy),
+                "dead": repr(self._dead) if self._dead else None,
+            }
+
+    def shutdown(self) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        self._thread.join(timeout=10)
+        self._poll_pool.shutdown(wait=False)
+
+    # -------------------------------------------------------- step loop
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._work:
+                    while not self._stop and not self._has_work_locked():
+                        self._work.wait(timeout=0.5)
+                    if self._stop:
+                        break
+                self._step()
+        except BaseException as e:  # noqa: BLE001 — fail typed, never hang
+            self._on_dead(e)
+
+    def _has_work_locked(self) -> bool:
+        return bool(self._pending) or bool(self._prefilling) \
+            or any(r is not None for r in self._slots)
+
+    def _on_dead(self, e: BaseException) -> None:
+        with self._work:
+            self._dead = e
+            reqs = [r for r in self._slots if r is not None]
+            reqs += list(self._prefilling) + list(self._pending)
+            self._pending.clear()
+            self._prefilling.clear()
+        err = EngineDeadError(f"engine step loop died: {e!r}")
+        err.__cause__ = e
+        for r in set(reqs):
+            r.out.put(err)
+
+    # one engine step: reap -> admit -> one prefill chunk -> one decode
+    def _step(self) -> None:
+        self._reap_cancelled()
+        self._admit()
+        self._prefill_one_chunk()
+        self._decode_once()
+        self._emit_stats()
+
+    def _reap_cancelled(self) -> None:
+        with self._lock:
+            for req in list(self._prefilling):
+                if req.cancelled:
+                    self._prefilling.remove(req)
+                    self._release_locked(req)
+            for req in list(self._pending):
+                if req.cancelled:
+                    self._pending.remove(req)
+                    req.out.put(_DONE)
+            for req in self._slots:
+                if req is not None and req.cancelled:
+                    self._release_locked(req)
+
+    def _admit(self) -> None:
+        ec = self.config
+        while True:
+            with self._lock:
+                if not self._pending or not self._free_slots:
+                    return
+                req = self._pending[0]
+                need = -(-min(len(req.prompt) + req.max_new_tokens,
+                              ec.max_seq_len) // ec.kv_block_size)
+                if need > len(self._free_blocks):
+                    # full occupancy: WAIT for blocks (shapes are fixed;
+                    # admission pressure never grows the compiled batch)
+                    return
+                self._pending.popleft()
+                req.slot = self._free_slots.pop()
+                req.blocks = [self._free_blocks.popleft()
+                              for _ in range(need)]
+                self._block_tables[req.slot, :] = 0
+                self._block_tables[req.slot, :need] = req.blocks
+                self._seq_lens[req.slot] = 0
+                req.state = _PREFILL
+                self._slots[req.slot] = req
+                self._prefilling.append(req)
+
+    def _prefill_one_chunk(self) -> None:
+        with self._lock:
+            req = self._prefilling[0] if self._prefilling else None
+        if req is None:
+            return
+        np, jnp = self._np, self._jnp
+        ec = self.config
+        C = ec.prefill_chunk
+        start = req.prefill_pos
+        n = min(C, len(req.prompt) - start)
+        chunk = np.zeros((1, C), np.int32)
+        chunk[0, :n] = req.prompt[start:start + n]
+        tok, self._cache = self._jit_prefill(
+            self._params, jnp.asarray(chunk), self._cache,
+            jnp.asarray(self._block_tables[req.slot:req.slot + 1]),
+            jnp.full((1,), start, jnp.int32),
+            jnp.full((1,), n, jnp.int32))
+        req.prefill_pos += n
+        self._prefill_chunks += 1
+        if req.prefill_pos < len(req.prompt):
+            return
+        # prompt fully cached: the final chunk's last logits give the
+        # first generated token — TTFT stops here
+        first = int(tok[0])
+        req.seq_len = len(req.prompt)
+        req.t_first_token = time.monotonic()
+        self._record_ttft(req)
+        with self._lock:
+            self._prefilling.popleft()
+            if req.cancelled:
+                self._release_locked(req)
+                return
+            if req.eos_token_id is not None and first == req.eos_token_id:
+                self._release_locked(req)
+                return
+            req.generated = 1
+            req.out.put(first)
+            self._tokens_total += 1
+            if req.generated >= req.max_new_tokens:
+                self._release_locked(req)
+                return
+            req.state = _DECODE
+            self._last_tok[req.slot] = first
+            self._seq_lens[req.slot] = req.seq_len
+
+    def _decode_once(self) -> None:
+        with self._lock:
+            active = [r for r in self._slots
+                      if r is not None and r.state == _DECODE]
+            if not active:
+                return
+            self._decode_steps += 1
+            self._occupancy[len(active)] += 1
+            if self._metrics is not None:
+                try:
+                    self._metrics.serve_batch_occupancy.observe(
+                        len(active))
+                except Exception:
+                    pass
+            toks = self._last_tok.copy()
+            lens = self._seq_lens.copy()
+            bt = self._block_tables.copy()
+        jnp = self._jnp
+        out, self._cache = self._jit_decode(
+            self._params, jnp.asarray(toks), self._cache,
+            jnp.asarray(bt), jnp.asarray(lens))
+        out = self._np.asarray(out)
+        with self._lock:
+            for req in active:
+                if req.cancelled or self._slots[req.slot] is not req:
+                    continue
+                tok = int(out[req.slot])
+                req.seq_len += 1           # the token we just wrote
+                self._seq_lens[req.slot] = req.seq_len
+                if req.eos_token_id is not None \
+                        and tok == req.eos_token_id:
+                    self._release_locked(req)
+                    continue
+                req.generated += 1
+                req.out.put(tok)
+                self._tokens_total += 1
+                if req.generated >= req.max_new_tokens \
+                        or req.seq_len + 1 >= self.config.max_seq_len:
+                    self._release_locked(req)
+                else:
+                    self._last_tok[req.slot] = tok
+
+    def _release_locked(self, req: _Request,
+                        err: Optional[BaseException] = None) -> None:
+        """Return a request's slot + blocks to the free lists and close
+        its stream (call with self._lock held)."""
+        if req.slot is not None and self._slots[req.slot] is req:
+            self._slots[req.slot] = None
+            self._block_tables[req.slot, :] = 0
+            self._seq_lens[req.slot] = 0
+            self._last_tok[req.slot] = 0
+            self._free_slots.append(req.slot)
+            self._free_blocks.extend(req.blocks)
+            req.blocks = []
+            req.slot = None
+        req.state = _FINISHED
+        req.out.put(err if err is not None else _DONE)
+        self._work.notify_all()
+
+    # ------------------------------------------------ metrics / events
+    def _record_ttft(self, req: _Request) -> None:
+        ttft = req.t_first_token - req.t_submit
+        if self._metrics is not None:
+            try:
+                self._metrics.serve_ttft.observe(ttft)
+                self._metrics.serve_tokens.inc()
+            except Exception:
+                pass
+        if self._recorder is not None:
+            try:
+                self._recorder.record(
+                    "ENGINE_TTFT", replica=self.replica_tag,
+                    rid=req.rid, ttft_s=round(ttft, 6),
+                    prompt_len=len(req.prompt))
+            except Exception:
+                pass
+
+    def _emit_stats(self, interval_s: float = 0.5) -> None:
+        now = time.monotonic()
+        if now - self._last_stats_emit < interval_s:
+            return
+        self._last_stats_emit = now
+        s = self.stats()
+        if self._metrics is not None:
+            try:
+                self._metrics.serve_queue_depth.set(s["queue_depth"])
+                self._metrics.serve_tokens_per_s.set(s["tokens_per_s"])
+            except Exception:
+                pass
+        if self._recorder is not None:
+            try:
+                self._recorder.record(
+                    "ENGINE_STATS", replica=self.replica_tag,
+                    queue_depth=s["queue_depth"],
+                    active=s["active_slots"],
+                    tokens_per_s=s["tokens_per_s"],
+                    free_blocks=s["free_blocks"])
+                self._recorder.maybe_flush()
+            except Exception:
+                pass
+
+
+def _resolve_dtype(name):
+    import jax.numpy as jnp
+    if not isinstance(name, str):
+        return name
+    return {"float32": jnp.float32, "f32": jnp.float32,
+            "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+            "float16": jnp.float16}[name]
+
+
+class LLMServer:
+    """Deployment-facing engine wrapper. Construct with plain dicts so
+    the deployment graph ships cheaply to the replica actor::
+
+        app = serve.deployment(LLMServer).bind(
+            model={"d_model": 256, "n_layers": 4, ...},
+            engine={"decode_slots": 8, "kv_block_size": 16})
+        h = serve.run(app)
+        for tok in h.options(stream=True).generate.remote([1, 2, 3]):
+            ...
+
+    ``generate`` is an async generator, so each token rides the core
+    streaming-generator machinery (per-item objects, backpressure,
+    typed failure on replica death).
+    """
+
+    def __init__(self, model: Optional[Dict[str, Any]] = None,
+                 engine: Optional[Dict[str, Any]] = None,
+                 seed: int = 0):
+        from ray_tpu.models import TransformerConfig
+        model = dict(model or {})
+        if "dtype" in model:
+            model["dtype"] = _resolve_dtype(model["dtype"])
+        model.setdefault("dtype", _resolve_dtype("float32"))
+        self.model_config = TransformerConfig(**model)
+        self.engine_config = EngineConfig(**(engine or {}))
+        self.engine = LLMEngine(self.model_config, self.engine_config,
+                                seed=seed,
+                                replica_tag=f"pid:{os.getpid()}")
+
+    async def generate(self, prompt_ids: Sequence[int],
+                       max_new_tokens: Optional[int] = None,
+                       eos_token_id: Optional[int] = None):
+        async for tok in self.engine.generate(
+                prompt_ids, max_new_tokens, eos_token_id):
+            yield tok
+
+    async def __call__(self, prompt_ids: Sequence[int],
+                       max_new_tokens: Optional[int] = None):
+        async for tok in self.engine.generate(prompt_ids,
+                                              max_new_tokens):
+            yield tok
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def kv_block_bytes(self) -> int:
+        ec, mc = self.engine_config, self.model_config
+        return ec.kv_block_size * ec.kv_bytes_per_token(mc)
+
+    def check_health(self) -> None:
+        if self.engine._dead is not None:
+            raise EngineDeadError(repr(self.engine._dead))

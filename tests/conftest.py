@@ -10,6 +10,15 @@ XLA_FLAGS must be set before the first backend init.
 
 import os
 import sys
+import tempfile
+
+# Isolate the flash-autotune disk cache (ops/flash_attention.py): a
+# winner persisted by one test run must not short-circuit the next
+# run's autotune tests. Workers inherit the env, so they share the
+# same per-run scratch dir.
+os.environ.setdefault(
+    "RAY_TPU_FLASH_CACHE_DIR",
+    tempfile.mkdtemp(prefix="ray-tpu-flash-cache-"))
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -228,6 +228,74 @@ def test_autotune_survives_failing_candidate():
     _AUTOTUNE_CACHE.clear()
 
 
+def test_autotune_winner_persists_across_processes(tmp_path,
+                                                   monkeypatch):
+    """A TIMED winner is written to disk keyed by (chip, jax version,
+    seq, head_dim, causal); a fresh process (simulated: in-memory cache
+    cleared, load flag reset) gets it back WITHOUT re-timing."""
+    import json
+
+    import importlib
+
+    import jax as _jax
+
+    # the module, not the identically-named function ray_tpu.ops
+    # re-exports over it
+    fa = importlib.import_module("ray_tpu.ops.flash_attention")
+
+    monkeypatch.setenv("RAY_TPU_FLASH_CACHE_DIR", str(tmp_path))
+    _AUTOTUNE_CACHE.clear()
+    monkeypatch.setattr(fa, "_DISK_CACHE_LOADED", False)
+    calls = []
+
+    def timer(bq, bk):
+        calls.append((bq, bk))
+        return 1.0 if (bq, bk) != (512, 512) else 0.1
+
+    best = autotune_flash_blocks(2048, 128, timer=timer, chip="v5e")
+    assert best == (512, 512) and calls
+    path = tmp_path / "flash_autotune.json"
+    data = json.loads(path.read_text())
+    key = f"v5e|{_jax.__version__}|2048|128|1"
+    assert data[key] == [512, 512]
+
+    # "new process": memory cache gone, disk cache not yet loaded
+    _AUTOTUNE_CACHE.clear()
+    monkeypatch.setattr(fa, "_DISK_CACHE_LOADED", False)
+    n = len(calls)
+    again = autotune_flash_blocks(2048, 128, timer=timer, chip="v5e")
+    assert again == (512, 512)
+    assert len(calls) == n, "disk-cached winner was re-timed"
+
+    # entries from another jax version are ignored (recompute), and a
+    # corrupt file never breaks autotuning
+    _AUTOTUNE_CACHE.clear()
+    monkeypatch.setattr(fa, "_DISK_CACHE_LOADED", False)
+    path.write_text(json.dumps({f"v5e|other-ver|2048|128|1": [256, 256]}))
+    assert autotune_flash_blocks(2048, 128, timer=timer, chip="v5e") \
+        == (512, 512)
+    _AUTOTUNE_CACHE.clear()
+    monkeypatch.setattr(fa, "_DISK_CACHE_LOADED", False)
+    path.write_text("{corrupt")
+    assert autotune_flash_blocks(2048, 128, timer=timer, chip="v5e") \
+        == (512, 512)
+    _AUTOTUNE_CACHE.clear()
+
+
+def test_autotune_default_path_not_persisted(tmp_path, monkeypatch):
+    """Off-TPU default fallbacks (nothing was timed) must not litter
+    the disk cache — they cost nothing to recompute."""
+    import importlib
+    fa = importlib.import_module("ray_tpu.ops.flash_attention")
+
+    monkeypatch.setenv("RAY_TPU_FLASH_CACHE_DIR", str(tmp_path))
+    _AUTOTUNE_CACHE.clear()
+    monkeypatch.setattr(fa, "_DISK_CACHE_LOADED", False)
+    autotune_flash_blocks(1024, 128, chip="cpu")
+    assert not (tmp_path / "flash_autotune.json").exists()
+    _AUTOTUNE_CACHE.clear()
+
+
 @pytest.mark.parametrize("blocks", [(64, 64), (64, 128), (128, 64)])
 def test_flash_output_invariant_to_blocks(blocks):
     """An autotuned block config must be a pure scheduling choice: the

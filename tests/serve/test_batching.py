@@ -1,0 +1,91 @@
+"""Regression tests for @serve.batch queue scoping.
+
+The decorator used to close over ONE ``_BatchQueue`` shared by every
+instance of the deployment class: a mixed batch executed against
+``batch[0][0]`` (whichever instance submitted first), silently running
+other instances' requests through the wrong replica's state, and the
+flusher task was pinned to the first caller's event loop forever."""
+
+import asyncio
+
+import pytest
+
+from ray_tpu.serve.batching import batch
+
+
+class Tagged:
+    def __init__(self, tag):
+        self.tag = tag
+
+    @batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+    async def run(self, items):
+        # results carry the *executing* instance's tag: cross-instance
+        # batch mixing becomes visible as a wrong tag in the result
+        return [(self.tag, i) for i in items]
+
+
+def test_instances_do_not_share_queues():
+    a, b = Tagged("a"), Tagged("b")
+
+    async def main():
+        outs = await asyncio.gather(
+            *[a.run(i) for i in range(5)],
+            *[b.run(i) for i in range(5)])
+        return outs
+
+    outs = asyncio.run(main())
+    assert outs[:5] == [("a", i) for i in range(5)]
+    assert outs[5:] == [("b", i) for i in range(5)]
+
+
+def test_batching_still_batches():
+    calls = []
+
+    class Sizes:
+        @batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        async def run(self, items):
+            calls.append(len(items))
+            return items
+
+    s = Sizes()
+
+    async def main():
+        return await asyncio.gather(*[s.run(i) for i in range(8)])
+
+    assert asyncio.run(main()) == list(range(8))
+    assert max(calls) > 1, f"no batching happened: {calls}"
+
+
+def test_new_event_loop_gets_fresh_flusher():
+    """The old _ensure pinned the FIRST caller's loop: an instance used
+    from a later loop (restarted async actor) submitted into a queue
+    whose flusher task lived on a dead loop — and wedged forever."""
+    inst = Tagged("x")
+
+    async def one(i):
+        return await asyncio.wait_for(inst.run(i), timeout=10)
+
+    assert asyncio.run(one(1)) == ("x", 1)     # loop 1 (now closed)
+    assert asyncio.run(one(2)) == ("x", 2)     # fresh loop must work
+
+
+def test_two_loops_interleaved_threads():
+    """Two instances driven from two different threads/loops at once."""
+    import threading
+
+    a, b = Tagged("a"), Tagged("b")
+    out = {}
+
+    def drive(name, inst):
+        async def main():
+            return await asyncio.gather(*[inst.run(i) for i in range(4)])
+        out[name] = asyncio.run(main())
+
+    ts = [threading.Thread(target=drive, args=("a", a)),
+          threading.Thread(target=drive, args=("b", b))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert out["a"] == [("a", i) for i in range(4)]
+    assert out["b"] == [("b", i) for i in range(4)]

@@ -1,0 +1,51 @@
+"""bench_serve.py smoke: the serving benchmark must run end-to-end on
+the CPU backend (tiny workload) and emit a record the serve perf gate
+can parse — the CI guard that keeps the SERVE metric producible."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+pytestmark = [pytest.mark.serve_llm]
+
+
+def test_bench_serve_smoke_subprocess():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RAY_TPU_JAX_PLATFORM="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_serve.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    line = [ln for ln in r.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "serve_tokens_per_s_chip"
+    assert rec["value"] > 0
+    d = rec["detail"]
+    assert d["backend"] == "cpu"
+    for mode in ("continuous", "serial"):
+        assert d[mode]["errors"] == [], d[mode]
+        assert d[mode]["requests_done"] == d["requests"]
+        assert d[mode]["ttft_ms"]["p50"] is not None
+    # the record feeds the gate
+    from tools.perf_gate import extract_serve_metrics, parse_bench_record
+    m = extract_serve_metrics(parse_bench_record(rec))
+    assert m["serve_tokens_per_s_chip"] == rec["value"]
+
+
+def test_workload_is_seeded_and_stable():
+    from bench_serve import make_workload
+    a = make_workload(12, 4, seed=7, mean_interarrival_s=0.01)
+    b = make_workload(12, 4, seed=7, mean_interarrival_s=0.01)
+    assert a == b
+    c = make_workload(12, 4, seed=8, mean_interarrival_s=0.01)
+    assert a != c
+    assert all(r["client"] < 4 for r in a)

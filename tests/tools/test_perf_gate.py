@@ -15,8 +15,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 sys.path.insert(0, REPO)
 
 from tools.perf_gate import (  # noqa: E402
-    compare, extract_metrics, extract_multichip_metrics, latest_baseline,
-    parse_bench_record, record_backend)
+    compare, extract_metrics, extract_multichip_metrics,
+    extract_serve_metrics, latest_baseline, parse_bench_record,
+    record_backend)
 
 pytestmark = pytest.mark.perf
 
@@ -153,6 +154,84 @@ def test_multichip_cli_self_compare():
     m = extract_multichip_metrics(rec)
     # acceptance: int8+sharded >= the fp32 replicated baseline
     assert m["multichip/int8_sharded"] >= m["multichip/fp32_replicated"]
+
+
+# --------------------------------------------------------- serve series
+def _serve_record(tps=1000.0, vs_serial=3.5, backend="cpu"):
+    return {"metric": "serve_tokens_per_s_chip", "value": tps,
+            "unit": "tokens/s/chip", "vs_serial": vs_serial,
+            "detail": {"backend": backend}}
+
+
+def test_serve_gate_parses_checked_in_baseline():
+    paths = sorted(glob.glob(os.path.join(REPO, "SERVE_r*.json")))
+    assert paths, "no checked-in SERVE baselines"
+    for p in paths:
+        with open(p) as f:
+            rec = parse_bench_record(json.load(f))
+        m = extract_serve_metrics(rec)
+        assert m["serve_tokens_per_s_chip"] > 0, p
+        # the engine's headline claim: continuous batching >= 3x the
+        # serial per-request decode throughput at the bench's client
+        # count (acceptance criterion, locked in by the record)
+        assert m["serve_vs_serial"] >= 3.0, p
+
+
+def test_serve_compare_is_relative():
+    base = _serve_record(tps=1000.0)
+    ok, _ = compare(_serve_record(tps=900.0), base, metric="serve")
+    assert ok            # -10% inside the default 15% window
+    ok, msgs = compare(_serve_record(tps=800.0), base, metric="serve")
+    assert not ok        # -20% fails
+    assert any("%" in m and "FAIL" in m for m in msgs)
+    # explicit tolerance is percent for serve
+    ok, _ = compare(_serve_record(tps=800.0), base, tolerance=25.0,
+                    metric="serve")
+    assert ok
+
+
+def test_serve_missing_vs_serial_skipped():
+    base = _serve_record()
+    fresh = _serve_record()
+    fresh.pop("vs_serial")
+    ok, msgs = compare(fresh, base, metric="serve")
+    assert ok
+    assert any("serve_vs_serial: skipped" in m for m in msgs)
+
+
+def test_serve_cli_self_compare_and_bootstrap(tmp_path):
+    gate = os.path.join(REPO, "tools", "perf_gate.py")
+    path = sorted(glob.glob(os.path.join(REPO, "SERVE_r*.json")))[-1]
+    r = subprocess.run(
+        [sys.executable, gate, "--fresh", path, "--metric", "serve",
+         "--root", REPO],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
+    # bootstrap: an empty series passes rather than failing (matches
+    # the multichip gate's behavior)
+    f = tmp_path / "fresh.json"
+    f.write_text(json.dumps(_serve_record()))
+    r = subprocess.run(
+        [sys.executable, gate, "--fresh", str(f), "--metric", "serve",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no parseable serve baseline" in r.stdout \
+        or "PASS" in r.stdout
+
+
+def test_serve_baseline_backend_matching(tmp_path):
+    (tmp_path / "SERVE_r01.json").write_text(
+        json.dumps(_serve_record(tps=5000.0, backend="tpu")))
+    (tmp_path / "SERVE_r02.json").write_text(
+        json.dumps(_serve_record(tps=900.0, backend="cpu")))
+    # a fresh TPU record compares against the TPU baseline even though
+    # a newer CPU smoke record exists
+    path, rec = latest_baseline(str(tmp_path), "serve",
+                                prefer_backend="tpu")
+    assert path.endswith("SERVE_r01.json")
+    assert rec["value"] == 5000.0
 
 
 def test_cli_end_to_end(tmp_path):

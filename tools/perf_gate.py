@@ -2,7 +2,7 @@
 """Perf regression gate: compare a fresh ``bench.py`` JSON against the
 latest checked-in baseline series.
 
-Two gated series (``--metric``):
+Three gated series (``--metric``):
 
 - ``bench`` (default) — the single-chip headline: a fresh measurement
   regressing the seq-1024 MFU — or the seq-4096 MFU, when both records
@@ -15,6 +15,11 @@ Two gated series (``--metric``):
   with no bench JSON in their tail; if no baseline in the series parses,
   the gate reports "no parseable baseline" and passes (exit 0) rather
   than failing bootstrap.
+- ``serve`` — the continuous-batching serving headline from
+  ``bench_serve.py`` (tokens/s/chip), gated RELATIVELY: a fresh record
+  more than ``--tolerance`` PERCENT below baseline (default 15%) fails.
+  Baselines: ``SERVE_r*.json``; like ``multichip``, an empty/unparseable
+  series bootstrap-passes.
 
 Baselines are matched to the fresh record's backend (``detail.backend``:
 "tpu"/"cpu") when possible, so a CPU smoke record checked in between TPU
@@ -45,9 +50,16 @@ import sys
 from typing import Optional, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_TOLERANCE = 2.0          # MFU points
+DEFAULT_TOLERANCE = 2.0          # MFU points (bench/multichip)
 BASELINE_GLOBS = {"bench": "BENCH_r*.json",
-                  "multichip": "MULTICHIP_r*.json"}
+                  "multichip": "MULTICHIP_r*.json",
+                  "serve": "SERVE_r*.json"}
+#: metrics compared RELATIVELY (tolerance is an allowed % drop, not
+#: absolute points — tokens/s scales with the chip, MFU doesn't)
+RELATIVE_METRICS = {"serve"}
+DEFAULT_TOLERANCES = {"bench": 2.0, "multichip": 2.0, "serve": 15.0}
+#: series whose early records may predate any parseable baseline
+BOOTSTRAP_METRICS = {"multichip", "serve"}
 
 
 def parse_bench_record(obj: dict) -> dict:
@@ -104,8 +116,19 @@ def extract_multichip_metrics(rec: dict) -> dict:
     return out
 
 
+def extract_serve_metrics(rec: dict) -> dict:
+    """The serving headline (tokens/s/chip) plus the batching speedup
+    when the record carries one (older records without it are skipped
+    by the comparison)."""
+    out = {"serve_tokens_per_s_chip": float(rec["value"])}
+    vs = rec.get("vs_serial")
+    out["serve_vs_serial"] = float(vs) if vs is not None else None
+    return out
+
+
 EXTRACTORS = {"bench": extract_metrics,
-              "multichip": extract_multichip_metrics}
+              "multichip": extract_multichip_metrics,
+              "serve": extract_serve_metrics}
 
 
 def latest_baseline(root: str = REPO_ROOT, metric: str = "bench",
@@ -142,11 +165,15 @@ def latest_baseline(root: str = REPO_ROOT, metric: str = "bench",
 
 
 def compare(fresh: dict, baseline: dict,
-            tolerance: float = DEFAULT_TOLERANCE, metric: str = "bench"):
-    """Return (ok, messages). Regression beyond ``tolerance`` MFU points
-    on any metric both records carry fails; missing metrics are skipped
-    (a CPU smoke run has no seq4096; an old multichip baseline has no
-    variant matrix)."""
+            tolerance: Optional[float] = None, metric: str = "bench"):
+    """Return (ok, messages). Regression beyond ``tolerance`` on any
+    metric both records carry fails; missing metrics are skipped (a CPU
+    smoke run has no seq4096; an old multichip baseline has no variant
+    matrix). Absolute MFU points for bench/multichip, percent-of-
+    baseline for the RELATIVE_METRICS series."""
+    if tolerance is None:
+        tolerance = DEFAULT_TOLERANCES[metric]
+    relative = metric in RELATIVE_METRICS
     extract = EXTRACTORS[metric]
     fm, bm = extract(fresh), extract(baseline)
     ok, msgs = True, []
@@ -156,9 +183,14 @@ def compare(fresh: dict, baseline: dict,
             msgs.append(f"{name}: skipped (missing in "
                         f"{'fresh' if f is None else 'baseline'})")
             continue
-        delta = f - b
-        line = f"{name}: fresh {f:.2f} vs baseline {b:.2f} " \
-               f"({delta:+.2f} MFU pts, tolerance -{tolerance:.2f})"
+        if relative:
+            delta = (f - b) / b * 100.0 if b else 0.0
+            line = f"{name}: fresh {f:.2f} vs baseline {b:.2f} " \
+                   f"({delta:+.1f}%, tolerance -{tolerance:.1f}%)"
+        else:
+            delta = f - b
+            line = f"{name}: fresh {f:.2f} vs baseline {b:.2f} " \
+                   f"({delta:+.2f} MFU pts, tolerance -{tolerance:.2f})"
         if delta < -tolerance:
             ok = False
             msgs.append("FAIL " + line)
@@ -201,13 +233,17 @@ def main(argv=None) -> int:
                          "seq1024/seq4096 MFU vs BENCH_r*.json; "
                          "'multichip' = all-devices FSDP MFU (per "
                          "grad-transport/weight-update variant) vs "
-                         "MULTICHIP_r*.json (default: bench)")
+                         "MULTICHIP_r*.json; 'serve' = bench_serve.py "
+                         "tokens/s/chip vs SERVE_r*.json, relative "
+                         "tolerance in percent (default: bench)")
     ap.add_argument("--baseline", default=None,
                     help="baseline JSON (default: latest parseable "
                          "baseline for --metric, preferring the fresh "
                          "record's backend)")
-    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
-                    help="allowed MFU-point regression (default 2.0)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="allowed regression: MFU points for "
+                         "bench/multichip (default 2.0), percent of "
+                         "baseline for serve (default 15)")
     ap.add_argument("--root", default=REPO_ROOT,
                     help="repo root to search for baselines")
     args = ap.parse_args(argv)
@@ -228,12 +264,14 @@ def main(argv=None) -> int:
             base_path, baseline = latest_baseline(
                 args.root, args.metric,
                 prefer_backend=record_backend(fresh))
-    except ValueError as e:
-        if args.metric == "multichip" and not args.baseline:
-            # Bootstrap: the early MULTICHIP records are driver wrappers
-            # with no bench JSON — nothing to gate against yet.
+    except (ValueError, FileNotFoundError) as e:
+        if args.metric in BOOTSTRAP_METRICS and not args.baseline:
+            # Bootstrap: a series may predate any parseable baseline
+            # (early MULTICHIP records are driver wrappers with no
+            # bench JSON; a fresh SERVE series has no records at all).
             print(f"perf_gate: {e}")
-            print("perf_gate: PASS (no parseable multichip baseline)")
+            print(f"perf_gate: PASS (no parseable {args.metric} "
+                  f"baseline)")
             return 0
         print(f"perf_gate: error: {e}", file=sys.stderr)
         return 2
