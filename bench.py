@@ -24,6 +24,11 @@ Env overrides: RAY_TPU_BENCH_REMAT (comma list of policies to try, e.g.
 "dots,full"), RAY_TPU_BENCH_CE_CHUNK (fused-CE chunk size; 0 = unfused),
 RAY_TPU_BENCH_MC_VARIANTS (comma list restricting the multichip
 grad-transport/weight-update matrix, e.g. "fp32_replicated,int8_sharded").
+
+`python bench.py --pipeline [--smoke]` runs the PIPELINE metric instead:
+MPMD actor pipeline (1F1B, streamed activations) vs serial actors vs
+single-program SPMD GPipe — tokens/s, measured + analytic bubble
+fractions, and MPMD-vs-single-program loss parity. See pipeline_main.
 """
 
 from __future__ import annotations
@@ -265,6 +270,181 @@ def main() -> None:
     }))
 
 
+# ------------------------------------------------------------ PIPELINE
+# `python bench.py --pipeline` measures the PIPELINE metric: the
+# 2-stage MPMD actor pipeline (parallel/mpmd_pipeline.py) driven by the
+# 1F1B scheduler vs (a) the same actors driven serially with no overlap
+# and (b) the single-program SPMD GPipe (ops/pipeline.py) at equal
+# microbatches on local devices. Reports tokens/s, the MEASURED bubble
+# fraction of both actor modes, the ANALYTIC GPipe bubble
+# (S-1)/(M+S-1) next to them, and the forward/loss parity of the MPMD
+# split against the single-program model. Gated by
+# `tools/perf_gate.py --metric pipeline` (PIPELINE_r*.json).
+
+
+def _pipeline_config(on_tpu: bool, smoke: bool):
+    import jax.numpy as jnp
+    from ray_tpu.models import TransformerConfig
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=1024, n_layers=8, n_heads=8,
+            head_dim=128, d_ff=4096, max_seq_len=1024, rotary_dim=64,
+            block_style="gptj", ce_chunk_size=512)
+        return cfg, 8, 1024, 4, 2, 6   # batch, seq, microbatches, S, steps
+    cfg = TransformerConfig(
+        vocab_size=1024, d_model=128, n_layers=4, n_heads=4,
+        head_dim=32, d_ff=512, max_seq_len=256, rotary_dim=16,
+        block_style="gptj", dtype=jnp.float32, remat=False,
+        ce_chunk_size=128)
+    if smoke:
+        return cfg, 4, 64, 2, 2, 2
+    return cfg, 8, 128, 4, 2, 4
+
+
+def _measure_mpmd(pipe, batch_d, steps: int) -> dict:
+    """Steady-state tokens/s + measured bubble of an MPMDPipeline
+    (first step is the compile step, excluded)."""
+    res = pipe.step(batch_d)          # compile
+    t0 = time.perf_counter()
+    bubbles = []
+    for _ in range(steps):
+        res = pipe.step(batch_d)
+        bubbles.append(res.bubble_fraction)
+    dt = time.perf_counter() - t0
+    b, s = batch_d["input_ids"].shape
+    return {"tokens_per_s": round(b * s * steps / dt, 1),
+            "step_ms": round(dt / steps * 1e3, 2),
+            "bubble_fraction": round(sum(bubbles) / len(bubbles), 4),
+            "loss": res.loss,
+            "stage_busy_ms": [round(st["busy_s"] * 1e3, 2)
+                              for st in res.stage_stats]}
+
+
+def _measure_spmd_gpipe(cfg, batch: int, seq: int, n_microbatches: int,
+                        n_stages: int, steps: int) -> dict:
+    """The single-program GPipe comparison: embed + pipeline_apply over
+    a pp mesh + fused head loss, fwd+bwd via value_and_grad — same
+    model, same microbatches, one shared compile."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from ray_tpu.models.transformer import (
+        init_params, run_layers, stage_layer_ranges, stage_loss,
+        _final_norm)
+    from ray_tpu.ops.pipeline import pipeline_apply, stack_stage_params
+
+    devices = jax.devices()[:n_stages]
+    if len(devices) < n_stages:
+        return {"error": f"needs {n_stages} local devices"}
+    mesh = Mesh(np.array(devices), ("pp",))
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ranges = stage_layer_ranges(cfg.n_layers, n_stages)
+    stacked = stack_stage_params([
+        jax.tree.map(lambda a: a[lo:hi], params["layers"])
+        for lo, hi in ranges])
+
+    def stage_fn(lp, x):
+        return run_layers(cfg, lp, x)[0].astype(x.dtype)
+
+    def loss_fn(p, ids, mask):
+        x = jnp.take(p["embed"], ids, axis=0).astype(cfg.dtype)
+        x = pipeline_apply(stage_fn, p["stacked"], x, mesh,
+                           n_microbatches)
+        x = _final_norm(cfg, p, x)
+        tail = {"lm_head": p["lm_head"]}
+        return stage_loss(cfg, tail, x, ids, mask)[0]
+
+    p = {"embed": params["embed"], "stacked": stacked,
+         "final_norm": params["final_norm"],
+         "lm_head": params["lm_head"]}
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                             cfg.vocab_size)
+    mask = jnp.ones((batch, seq), jnp.float32)
+    loss, grads = step(p, ids, mask)
+    jax.block_until_ready(grads)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, grads = step(p, ids, mask)
+    jax.block_until_ready(grads)
+    dt = time.perf_counter() - t0
+    return {"tokens_per_s": round(batch * seq * steps / dt, 1),
+            "step_ms": round(dt / steps * 1e3, 2),
+            "loss": float(loss)}
+
+
+def pipeline_main(smoke: bool = False) -> None:
+    # the SPMD comparison needs >= 2 local devices; on CPU force the
+    # virtual split BEFORE jax initializes its backend
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("RAY_TPU_JAX_PLATFORM",
+                          os.environ.get("JAX_PLATFORMS", ""))
+
+    import numpy as np
+
+    import jax
+    import ray_tpu
+    from ray_tpu.models.transformer import init_params, lm_loss
+    from ray_tpu.parallel.mpmd_pipeline import (
+        MPMDPipeline, analytic_gpipe_bubble)
+    from ray_tpu.parallel.mesh import chip_spec
+    from ray_tpu.util.state import list_task_events
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg, batch, seq, M, S, steps = _pipeline_config(on_tpu, smoke)
+    ids = np.array(jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size))
+    batch_d = {"input_ids": ids,
+               "loss_mask": np.ones((batch, seq), np.float32)}
+
+    ray_tpu.init(num_cpus=max(2 * S + 2, 6),
+                 _num_initial_workers=S + 1)
+    try:
+        pipe = MPMDPipeline(cfg, n_stages=S, n_microbatches=M, seed=0)
+        mpmd = _measure_mpmd(pipe, batch_d, steps)
+        serial = MPMDPipeline(cfg, n_stages=S, n_microbatches=M,
+                              seed=0, serial=True)
+        ser = _measure_mpmd(serial, batch_d, max(steps // 2, 1))
+        # forward/loss parity vs the single-program model (exact same
+        # seed -> bit-identical weights; must agree to <= 1e-5)
+        ref_loss = float(lm_loss(
+            cfg, init_params(cfg, jax.random.PRNGKey(0)), batch_d)[0])
+        parity = abs(ref_loss - mpmd["loss"])
+        spmd = _measure_spmd_gpipe(cfg, batch, seq, M, S, steps)
+        ticks = len(list_task_events(filters=[("ev", "=", "STAGE_TICK")]))
+    finally:
+        ray_tpu.shutdown()
+
+    detail = {
+        "backend": jax.default_backend(),
+        "chip": chip_spec().name,
+        "n_stages": S,
+        "n_microbatches": M,
+        "model_params": cfg.num_params,
+        "mpmd_1f1b": mpmd,
+        "serial": ser,
+        "spmd_gpipe": spmd,
+        "analytic_gpipe_bubble": round(analytic_gpipe_bubble(S, M), 4),
+        "loss_parity_abs": round(parity, 9),
+        "single_program_loss": ref_loss,
+        "stage_tick_events": ticks,
+    }
+    print(json.dumps({
+        "metric": "pipeline_tokens_per_s",
+        "value": mpmd["tokens_per_s"],
+        "unit": "tok/s",
+        "vs_serial": round(mpmd["tokens_per_s"]
+                           / max(ser["tokens_per_s"], 1e-9), 3),
+        "detail": detail,
+    }))
+
+
 MULTICHIP_VARIANTS = (("fp32", False), ("int8", False),
                       ("fp32", True), ("int8", True))
 
@@ -353,4 +533,8 @@ def _flash_bwd_compare(jax, jnp, seq: int = 4096) -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if "--pipeline" in sys.argv:
+        pipeline_main(smoke="--smoke" in sys.argv)
+    else:
+        main()

@@ -13,7 +13,7 @@ is a jitted GSPMD program.
 
 from ray_tpu._version import __version__
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.streaming import ObjectRefGenerator
+from ray_tpu.core.streaming import ObjectRefGenerator, wait_any
 from ray_tpu.actor import ActorClass, ActorHandle, ActorMethod
 from ray_tpu.api import (
     init,
@@ -73,6 +73,7 @@ __all__ = [
     "timeline",
     "ObjectRef",
     "ObjectRefGenerator",
+    "wait_any",
     "ActorClass",
     "ActorHandle",
     "ActorMethod",

@@ -43,6 +43,10 @@ Event taxonomy (the ``ev`` field):
 ``CREDIT_STALL``   streaming producer blocked on the backpressure
                    window for ``seconds``
 ``DELIVERY_FAILED``reliable layer gave up on a message (typed error)
+``STAGE_TICK``     MPMD pipeline stage interval: ``phase`` forward/
+                   backward/idle with ``stage``/``mb``/``dur_s`` —
+                   rendered as duration slices, so the Perfetto
+                   timeline IS the pipeline-bubble visualization
 =================  =====================================================
 """
 
@@ -67,6 +71,7 @@ DUP_DROPPED = "DUP_DROPPED"
 ACK_RTT = "ACK_RTT"
 CREDIT_STALL = "CREDIT_STALL"
 DELIVERY_FAILED = "DELIVERY_FAILED"
+STAGE_TICK = "STAGE_TICK"
 
 #: lifecycle events a task timeline is built from (exporter slice pairs)
 LIFECYCLE = (SUBMITTED, LEASED, DISPATCHED, RUNNING, YIELDED,
@@ -353,7 +358,11 @@ def build_chrome_trace(events: List[dict]) -> dict:
                 "ph": "i", "s": "t", "ts": start.get("ts", 0.0) * 1e6,
                 "pid": pid_for(proc), "tid": 0, "args": base_args})
 
-    # transport / untasked events land on their process track
+    # transport / untasked events land on their process track. Events
+    # carrying a duration (STAGE_TICK forward/backward/idle intervals)
+    # render as X slices ending at their record timestamp — laid side
+    # by side per process they ARE the pipeline schedule, and the gaps
+    # plus the phase="idle" slices are the measured bubbles.
     for e in events:
         if not isinstance(e, dict) or "ev" not in e:
             continue
@@ -361,6 +370,20 @@ def build_chrome_trace(events: List[dict]) -> dict:
             continue
         args = {k: v for k, v in e.items()
                 if k not in ("ev", "ts", "proc", "pid")}
+        dur_s = e.get("dur_s")
+        if isinstance(dur_s, (int, float)) and dur_s > 0:
+            name = e["ev"]
+            if e.get("phase"):
+                name = f"{name}:{e['phase']}"
+                if e.get("mb") is not None:
+                    name += f"[{e['mb']}]"
+            trace_events.append({
+                "name": name, "cat": "stage", "ph": "X",
+                "ts": (e.get("ts", 0.0) - dur_s) * 1e6,
+                "dur": max(1.0, dur_s * 1e6),
+                "pid": pid_for(e.get("proc", "?")), "tid": 0,
+                "args": args})
+            continue
         trace_events.append({
             "name": e["ev"], "cat": "transport", "ph": "i", "s": "t",
             "ts": e.get("ts", 0.0) * 1e6,

@@ -16,6 +16,7 @@ buffers 8-byte aligned, each prefixed by u64 length.
 
 from __future__ import annotations
 
+import io
 import pickle
 import struct
 import threading
@@ -114,8 +115,19 @@ class SerializationContext:
                 # so any STACK_GLOBAL against __main__ (its module name
                 # appears literally in the stream) also falls back to
                 # cloudpickle's by-value treatment.
-                meta = pickle.dumps(
-                    value, protocol=5, buffer_callback=buffers.append)
+                # The pickler carries a scoped dispatch-table entry for
+                # device arrays: any jax.Array ANYWHERE in the value
+                # (streamed pipeline activations, (loss, aux) tuples)
+                # ships as a raw out-of-band buffer instead of riding
+                # the pickle stream in-band.
+                sink = io.BytesIO()
+                p = pickle.Pickler(sink, protocol=5,
+                                   buffer_callback=buffers.append)
+                dt = _device_array_dispatch()
+                if dt is not None:
+                    p.dispatch_table = dt
+                p.dump(value)
+                meta = sink.getvalue()
                 if b"__main__" in meta:
                     raise pickle.PicklingError("__main__ global")
             except (pickle.PicklingError, pickle.PickleError, TypeError,
@@ -200,6 +212,73 @@ def _pre_serialize(value):
         return _OOBBytes(bytes, value)
     if type(value) is bytearray and len(value) > _OOB_BYTES_THRESHOLD:
         return _OOBBytes(bytearray, value)
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(value, jax.Array):
+        import numpy as np
+        return np.asarray(value)
+    return value
+
+
+# ---- device-array serialization fast path ----------------------------
+# A jax.Array nested anywhere inside a value (a streamed pipeline
+# activation tuple, an actor-call argument tree) used to ride jax's own
+# __reduce__ THROUGH the pickle stream: a full in-band copy of the
+# payload, then a second copy out at load. The scoped dispatch-table
+# entry below turns any device array into (dtype, shape, PickleBuffer):
+# the host view goes out-of-band — one memcpy into shm at write — and
+# reconstructs as a zero-copy ``np.frombuffer`` view at read. Scoped to
+# the object-store pickler (NOT copyreg-global) so user pickling
+# semantics elsewhere are untouched.
+
+_jax_dispatch: Optional[dict] = None
+
+
+def _device_array_dispatch() -> Optional[dict]:
+    global _jax_dispatch
+    if _jax_dispatch is not None:
+        return _jax_dispatch or None
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None  # keep probing until jax shows up in the process
+    try:
+        from jax._src.array import ArrayImpl as _concrete
+    except Exception:  # pragma: no cover - layout drift across versions
+        _concrete = type(jax.numpy.zeros((), jax.numpy.float32))
+    _jax_dispatch = {_concrete: _reduce_device_array}
+    return _jax_dispatch
+
+
+def _reduce_device_array(a):
+    import numpy as np
+    host = np.asarray(a)
+    if host.nbytes < _OOB_BYTES_THRESHOLD:
+        return (np.array, (host,))
+    if not host.flags["C_CONTIGUOUS"]:
+        host = np.ascontiguousarray(host)
+    # ship as raw bytes: extension dtypes (bfloat16, float8_*) refuse
+    # the buffer protocol, a uint8 view never does
+    return (_restore_ndarray,
+            (pickle.PickleBuffer(host.view(np.uint8)),
+             host.dtype.name, host.shape))
+
+
+def _restore_ndarray(buf, dtype_name: str, shape):
+    import numpy as np
+    try:
+        dtype = np.dtype(dtype_name)
+    except TypeError:
+        # extension dtypes (bfloat16, float8_*) register via ml_dtypes
+        import ml_dtypes
+        dtype = np.dtype(getattr(ml_dtypes, dtype_name))
+    return np.frombuffer(buf, dtype=np.uint8).view(dtype).reshape(shape)
+
+
+def to_host(value):
+    """Eagerly move a top-level device array to host numpy (no-op for
+    anything else). The streaming worker calls this at yield time so
+    the device fetch happens outside the store/report critical path."""
     import sys
     jax = sys.modules.get("jax")
     if jax is not None and isinstance(value, jax.Array):

@@ -30,7 +30,7 @@ objects nor a running generator.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, TYPE_CHECKING
+from typing import Dict, List, Optional, Sequence, Tuple, TYPE_CHECKING
 
 from ray_tpu.core.ids import ObjectID, TaskID
 from ray_tpu.core.object_ref import ObjectRef
@@ -77,6 +77,24 @@ class StreamState:
                               "generator_backpressure_num_objects",
                               64) // 2))
         self.last_credit = 0
+        #: wait_any subscribers: Events set on every readiness edge
+        #: (item buffered, EOF, failure, close)
+        self._waiters: List[threading.Event] = []
+
+    def _wake_waiters_locked(self) -> None:
+        for ev in self._waiters:
+            ev.set()
+
+    def add_waiter(self, ev: threading.Event) -> None:
+        with self.cond:
+            self._waiters.append(ev)
+
+    def remove_waiter(self, ev: threading.Event) -> None:
+        with self.cond:
+            try:
+                self._waiters.remove(ev)
+            except ValueError:
+                pass
 
     # ------------------------------------------------------- report side
     def on_item(self, index: int, meta: dict, producer: Optional[bytes]
@@ -139,6 +157,7 @@ class StreamState:
             else:
                 self.items[index] = ref
                 self.cond.notify_all()
+                self._wake_waiters_locked()
         if drop_now:
             # the +1/-1 pair nets to a 0-delta for tracked items, so the
             # controller still learns the object lived and died
@@ -153,6 +172,7 @@ class StreamState:
             if self.eof_index is None:
                 self.eof_index = count
             self.cond.notify_all()
+            self._wake_waiters_locked()
 
     def fail(self, err: BaseException) -> None:
         """Terminal task failure with no more replays coming: every
@@ -161,6 +181,7 @@ class StreamState:
             if self.error is None:
                 self.error = err
             self.cond.notify_all()
+            self._wake_waiters_locked()
 
     # ------------------------------------------------------ consumer side
     def _done_locked(self) -> bool:
@@ -240,6 +261,7 @@ class StreamState:
             refs = list(self.items.values())
             self.items.clear()
             self.cond.notify_all()
+            self._wake_waiters_locked()
             return refs
 
     def finished(self) -> bool:
@@ -329,3 +351,47 @@ class ObjectRefGenerator:
 
     def __repr__(self):
         return f"ObjectRefGenerator({TaskID(self._state.task_id_b).hex()[:16]})"
+
+
+def wait_any(generators: Sequence[ObjectRefGenerator],
+             timeout: Optional[float] = None, num_returns: int = 1
+             ) -> Tuple[List[ObjectRefGenerator],
+                        List[ObjectRefGenerator]]:
+    """Block until at least ``num_returns`` of ``generators`` are
+    *actionable* — their next ``next_ref()`` would return (an in-order
+    item is buffered) or terminate immediately (EOF fully consumed,
+    terminal failure, cancelled). Returns ``(ready, not_ready)`` in the
+    input order, like ``ray_tpu.wait`` for plain refs; on timeout the
+    ready list may be shorter than ``num_returns`` (possibly empty).
+
+    Event-driven, not polling: every stream wakes a shared Event on its
+    readiness edges (item report, EOF, failure, close), so a fan-in
+    consumer — e.g. the MPMD 1F1B scheduler draining one stream per
+    pipeline stage — reacts at delivery latency regardless of how many
+    streams it watches.
+    """
+    gens = list(generators)
+    if not gens:
+        return [], []
+    num_returns = max(1, min(num_returns, len(gens)))
+    import time as _time
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    ev = threading.Event()
+    for g in gens:
+        g._state.add_waiter(ev)
+    try:
+        while True:
+            ready = [g for g in gens if g._state.next_ready(timeout=0)]
+            if len(ready) >= num_returns:
+                break
+            remaining = None if deadline is None \
+                else deadline - _time.monotonic()
+            if remaining is not None and remaining <= 0:
+                break
+            ev.wait(0.2 if remaining is None else min(0.2, remaining))
+            ev.clear()
+    finally:
+        for g in gens:
+            g._state.remove_waiter(ev)
+    ready_ids = {id(g) for g in ready}
+    return ready, [g for g in gens if id(g) not in ready_ids]

@@ -914,10 +914,12 @@ class WorkerExecutor:
         trace_id, span_id, parent_span = EV.task_trace(
             tid_hex, getattr(spec, "trace", None))
 
-        def send_item(index: int, meta: dict) -> None:
+        def send_item(index: int, meta: dict,
+                      nbytes: Optional[int] = None) -> None:
             rt.recorder.record(EV.YIELDED, task=tid_hex, trace=trace_id,
                                span=span_id, parent=parent_span,
-                               index=index)
+                               index=index,
+                               **({"nbytes": nbytes} if nbytes else {}))
             if owner_b:
                 rt._send_direct(owner_b, P.STREAM_ITEM, {
                     "task_id": tid_b, "index": index, "meta": meta,
@@ -941,6 +943,13 @@ class WorkerExecutor:
                     value = next(it)
                 except StopIteration:
                     break
+                # device-array fast path: fetch device->host NOW, on
+                # the generator's thread, so the store+report path (and
+                # any lock it takes) never blocks on an accelerator
+                # transfer; the serializer then ships the host view
+                # out-of-band instead of through the pickle stream
+                from ray_tpu.core.serialization import to_host
+                value = to_host(value)
                 produced += 1
                 oid = _OID.for_task_return(spec.task_id, produced)
                 meta = rt._store_value(oid, value, notify=True)
@@ -948,7 +957,7 @@ class WorkerExecutor:
                     meta if meta.get("node_id") is not None
                     else {"object_id": meta["object_id"],
                           "size": meta.get("size", 0)})
-                send_item(produced, meta)
+                send_item(produced, meta, meta.get("size"))
         except (KeyboardInterrupt, TaskCancelledError):
             # cancelled (usually by the consumer closing the stream):
             # EOF for any straggler consumer, then the normal cancel

@@ -373,17 +373,15 @@ def _llama_block(c, x, lp, sin, cos, mesh, rules):
     return x + mlp.astype(x.dtype), aux
 
 
-def hidden_states(config: TransformerConfig, params: Dict,
-                  input_ids: jnp.ndarray, mesh=None, rules=None):
-    """Embed -> blocks -> final norm: (b, s) int32 -> ((b, s, e), moe_aux).
-
-    The shared trunk under both :func:`apply` (which adds the LM-head
-    projection) and :func:`lm_loss` (which fuses the projection into the
-    chunked loss so full logits never materialize).
-    """
+def run_layers(config: TransformerConfig, layer_params: Dict,
+               x: jnp.ndarray, mesh=None, rules=None):
+    """Scan the transformer blocks in ``layer_params`` (leaves stacked
+    ``[n, ...]``) over hidden states ``x``: (b, s, e) -> ((b, s, e),
+    moe_aux). The trunk shared by :func:`hidden_states` and the
+    pipeline-stage forward (a stage's trunk is a contiguous slice of
+    the stacked layer leaves — same scan, fewer layers)."""
     c = config
-    x = jnp.take(params["embed"], input_ids, axis=0).astype(c.dtype)
-    seq = input_ids.shape[1]
+    seq = x.shape[1]
     sin, cos = rotary_table(
         seq, c.rotary_dim if c.block_style == "gptj" else c.head_dim,
         c.rope_base)
@@ -402,14 +400,29 @@ def hidden_states(config: TransformerConfig, params: Dict,
             out = constrain(out, mesh, rules, ("batch", "sequence", None))
         return out, aux
 
-    x, layer_aux = jax.lax.scan(scan_fn, x, params["layers"])
-
-    fn = params["final_norm"]
-    if c.block_style == "llama":
-        x = rms_norm(x, fn["scale"])
-    else:
-        x = layer_norm(x, fn["scale"], fn["bias"])
+    x, layer_aux = jax.lax.scan(scan_fn, x, layer_params)
     return x, (jnp.sum(layer_aux) if c.n_experts else 0.0)
+
+
+def _final_norm(config: TransformerConfig, params: Dict, x: jnp.ndarray):
+    fn = params["final_norm"]
+    if config.block_style == "llama":
+        return rms_norm(x, fn["scale"])
+    return layer_norm(x, fn["scale"], fn["bias"])
+
+
+def hidden_states(config: TransformerConfig, params: Dict,
+                  input_ids: jnp.ndarray, mesh=None, rules=None):
+    """Embed -> blocks -> final norm: (b, s) int32 -> ((b, s, e), moe_aux).
+
+    The shared trunk under both :func:`apply` (which adds the LM-head
+    projection) and :func:`lm_loss` (which fuses the projection into the
+    chunked loss so full logits never materialize).
+    """
+    c = config
+    x = jnp.take(params["embed"], input_ids, axis=0).astype(c.dtype)
+    x, moe_aux = run_layers(c, params["layers"], x, mesh=mesh, rules=rules)
+    return _final_norm(c, params, x), moe_aux
 
 
 def apply(config: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
@@ -470,6 +483,90 @@ def lm_loss(config: TransformerConfig, params: Dict, batch: Dict,
         loss = loss + c.moe_aux_weight * moe_aux
         aux["moe_aux"] = moe_aux
     return loss, aux
+
+
+# --------------------------------------------------- pipeline stages
+# MPMD pipeline parallelism (parallel/mpmd_pipeline.py) splits the model
+# into S separately-compiled stage programs: stage 0 owns the embedding
+# plus the first trunk slice, middle stages own trunk slices, the last
+# stage owns its slice plus final norm and LM head (fused into the loss,
+# like lm_loss). Because per-layer weights are STACKED on the leading
+# ``layers`` axis, a stage's parameters are literally ``leaf[lo:hi]`` —
+# no re-initialization, and a stage slice of ``init_params(key)`` is
+# bit-identical to the single-program model's weights.
+
+def stage_layer_ranges(n_layers: int, n_stages: int):
+    """Near-even contiguous ``[lo, hi)`` layer ranges, earlier stages
+    taking the remainder (they also carry the embedding)."""
+    if not 1 <= n_stages <= n_layers:
+        raise ValueError(
+            f"n_stages must be in [1, {n_layers}], got {n_stages}")
+    base, rem = divmod(n_layers, n_stages)
+    ranges, lo = [], 0
+    for s in range(n_stages):
+        hi = lo + base + (1 if s < rem else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    return ranges
+
+
+def stage_slice_params(config: TransformerConfig, params: Dict,
+                       stage: int, n_stages: int) -> Dict:
+    """Slice a full parameter pytree down to one pipeline stage's
+    weights: trunk-range of the stacked layer leaves, plus the
+    embedding (stage 0) / final norm + LM head (last stage)."""
+    if config.n_experts:
+        raise NotImplementedError(
+            "pipeline stage splitting does not support MoE configs "
+            "(the aux loss would need cross-stage wiring)")
+    lo, hi = stage_layer_ranges(config.n_layers, n_stages)[stage]
+    out: Dict = {"layers": jax.tree.map(lambda a: a[lo:hi],
+                                        params["layers"])}
+    if stage == 0:
+        out["embed"] = params["embed"]
+    if stage == n_stages - 1:
+        out["final_norm"] = params["final_norm"]
+        out["lm_head"] = params["lm_head"]
+    return out
+
+
+def stage_forward(config: TransformerConfig, stage: int, n_stages: int,
+                  stage_params: Dict, inp: jnp.ndarray,
+                  mesh=None, rules=None) -> jnp.ndarray:
+    """One stage's forward: stage 0 takes (b, s) int32 token ids and
+    embeds them; later stages take the upstream (b, s, e) activation.
+    The last stage applies the final norm, so its output feeds
+    :func:`stage_loss` (or an LM-head projection) directly."""
+    c = config
+    if stage == 0:
+        x = jnp.take(stage_params["embed"], inp, axis=0).astype(c.dtype)
+    else:
+        x = inp.astype(c.dtype)
+    x, _ = run_layers(c, stage_params["layers"], x, mesh=mesh, rules=rules)
+    if stage == n_stages - 1:
+        x = _final_norm(c, stage_params, x)
+    return x
+
+
+def stage_loss(config: TransformerConfig, stage_params: Dict,
+               h: jnp.ndarray, input_ids: jnp.ndarray,
+               loss_mask: Optional[jnp.ndarray] = None):
+    """Last-stage LM loss from final-norm'd hidden states ``h``: the
+    same fused-projection tail as :func:`lm_loss` (ce_chunk_size > 0)
+    or the materialized-logits reference path. Returns (loss, n)."""
+    c = config
+    labels = input_ids[:, 1:]
+    mask = loss_mask[:, 1:] if loss_mask is not None else None
+    head = stage_params["lm_head"]
+    if c.ce_chunk_size:
+        return fused_lm_head_loss(
+            h.astype(c.dtype)[:, :-1], head["w"], labels,
+            head_bias=head.get("b"), mask=mask,
+            chunk_size=c.ce_chunk_size)
+    logits = jnp.dot(h.astype(c.dtype), head["w"].astype(c.dtype))
+    if c.block_style != "llama":
+        logits = logits + head["b"].astype(c.dtype)
+    return cross_entropy_loss(logits[:, :-1], labels, mask=mask)
 
 
 # ------------------------------------------------------- inference (KV)

@@ -24,6 +24,7 @@ from ray_tpu.parallel import collective
 from ray_tpu.parallel import quantization
 
 __all__ = [
+    "mpmd_pipeline",
     "MeshSpec",
     "build_mesh",
     "local_mesh",
@@ -37,3 +38,12 @@ __all__ = [
     "collective",
     "quantization",
 ]
+
+
+def __getattr__(name):
+    # mpmd_pipeline imports lazily: it pulls in the actor/runtime layer,
+    # which plain sharding users shouldn't pay for at import time
+    if name == "mpmd_pipeline":
+        import importlib
+        return importlib.import_module("ray_tpu.parallel.mpmd_pipeline")
+    raise AttributeError(name)

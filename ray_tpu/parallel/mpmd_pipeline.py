@@ -1,0 +1,598 @@
+"""MPMD pipeline parallelism: actor-hosted stages, streamed activations.
+
+The SPMD pipeline in ``ops/pipeline.py`` compiles every stage into ONE
+jitted GPipe program — one mesh, one compile, the full GPipe bubble.
+This module is the MPMD alternative the task/actor runtime makes
+possible (Scaling Deep Learning Training with MPMD Pipeline
+Parallelism, arXiv:2412.14374; the decoupled-actor split mirrors
+Podracer's sebulba, arXiv:2104.06272):
+
+- each pipeline stage is a :class:`PipelineStage` **actor** pinned to
+  its own device subset, holding its stage parameters
+  (``models.transformer.stage_slice_params`` — a contiguous slice of
+  the stacked layer leaves, bit-identical to the single-program
+  weights) and TWO jitted programs:
+
+  * stage-forward: ``jit(lambda p, x: jax.vjp(stage_fn, p, x))`` —
+    returns the activation AND the vjp closure. ``jax.vjp``'s return
+    is a pytree-registered ``Partial`` whose leaves are the saved
+    residuals, so it crosses the jit boundary as plain arrays;
+  * stage-backward: ``jit(lambda vjp, g: vjp(g))`` — applies a saved
+    vjp to the downstream gradient, REUSING the forward's residuals
+    (no recompute), and emits the upstream input-gradient.
+
+  Per-stage compiles mean per-stage specialization: stages can differ
+  in remat policy, precision, even layer count — the constraint the
+  single shared compile imposed is gone.
+
+- a driver-side **1F1B scheduler** (:class:`MPMDPipeline`) streams
+  per-microbatch activations stage-to-stage: each stage's step is one
+  ``num_returns="streaming"`` actor call whose yields are the per-
+  microbatch outputs, the driver waits on whichever stage produces
+  next (``streaming.wait_any``) and routes the item *ref* — never the
+  bytes — into the downstream stage's mailbox, so stage *k*'s forward
+  on microbatch *i+1* overlaps both the activation transport and
+  stage *k+1*'s forward on microbatch *i*. Transport rides the PR-2/
+  PR-3 reliable+credit layer; activations ship via the device-array
+  out-of-band serialization fast path (``core/serialization.py``).
+
+Every forward/backward/idle interval is recorded as a ``STAGE_TICK``
+flight-recorder event, so the Perfetto ``/timeline`` export doubles as
+the bubble visualization, and :meth:`PipelineStage.step_stats` returns
+the measured busy/idle split the bench turns into a bubble fraction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "one_f_one_b_order",
+    "analytic_gpipe_bubble",
+    "PipelineStage",
+    "MPMDPipeline",
+    "PipelineStepResult",
+]
+
+
+def one_f_one_b_order(stage: int, n_stages: int, n_microbatches: int
+                      ) -> List[Tuple[str, int]]:
+    """The 1F1B schedule as seen by one stage: ``[("F", mb), ...]``.
+
+    Warmup forwards fill the pipe (``n_stages - 1 - stage`` of them —
+    the last stage has none), then the steady state alternates one
+    forward with one backward, then the cooldown drains the remaining
+    backwards. Deterministic per (stage, n_stages, M): the driver and
+    the stage actor both derive it, so stream item *j* of stage *s*
+    IS operation ``order[j]`` — no tags ride the wire.
+    """
+    m = n_microbatches
+    warmup = min(n_stages - 1 - stage, m)
+    order = [("F", i) for i in range(warmup)]
+    b = 0
+    for f in range(warmup, m):
+        order.append(("F", f))
+        order.append(("B", b))
+        b += 1
+    order.extend(("B", i) for i in range(b, m))
+    return order
+
+
+def analytic_gpipe_bubble(n_stages: int, n_microbatches: int) -> float:
+    """The GPipe pipeline-bubble fraction ``(S-1)/(M+S-1)``: the share
+    of each device's timeline spent idle when M microbatches flow
+    through S stages with a full flush between steps. 1F1B has the
+    same bubble in steady state; its win is activation memory."""
+    s, m = n_stages, n_microbatches
+    return (s - 1) / (m + s - 1)
+
+
+def _recorder():
+    """This process's flight recorder (None outside a runtime)."""
+    try:
+        from ray_tpu.core.global_state import try_global_worker
+        w = try_global_worker()
+        return w.recorder if w is not None else None
+    except Exception:
+        return None
+
+
+class PipelineStage:
+    """One pipeline stage, hosted in its own actor process.
+
+    Holds the stage's parameter slice on its pinned device and the two
+    jitted programs (forward-with-vjp, backward-from-saved-residuals).
+    Activations and gradients arrive through mailboxes
+    (:meth:`put_activation` / :meth:`put_grad` / :meth:`put_targets` —
+    tiny actor calls whose object args are pulled worker-to-worker),
+    and one streaming :meth:`run` call per step yields the stage's
+    per-microbatch outputs in its 1F1B order.
+
+    Run with ``max_concurrency >= 2``: ``run`` blocks on mailboxes
+    while the feed calls execute on sibling threads.
+    """
+
+    #: seconds a mailbox take may starve before the stage fails typed
+    #: (a dead neighbor must surface as an error, never a hang)
+    TAKE_TIMEOUT_S = 120.0
+
+    def __init__(self, config, stage: int, n_stages: int, seed: int = 0,
+                 device_index: Optional[int] = None,
+                 remat_policy: Optional[str] = None):
+        import threading
+
+        import jax
+
+        from ray_tpu.models.transformer import (
+            init_params, stage_slice_params)
+
+        if remat_policy is not None:
+            config = dataclasses.replace(config, remat=None,
+                                         remat_policy=remat_policy)
+        self.config = config
+        self.stage = stage
+        self.n_stages = n_stages
+        devices = jax.devices()
+        self.device = devices[(stage if device_index is None
+                               else device_index) % len(devices)]
+        # full init from the shared seed, then slice: the stage weights
+        # are bit-identical to the single-program model's (parity is a
+        # slicing invariant, not a tolerance)
+        params = init_params(config, jax.random.PRNGKey(seed))
+        self.params = jax.device_put(
+            stage_slice_params(config, params, stage, n_stages),
+            self.device)
+        del params
+        self._fwd, self._bwd, self._acc = self._build_programs()
+        self._cond = threading.Condition()
+        self._acts: Dict[int, Any] = {}
+        self._grads_in: Dict[int, Any] = {}
+        self._targets: Dict[int, Any] = {}
+        self._abort = False
+        self._vjps: Dict[int, Any] = {}
+        self.grads = None
+        self._stats = {"busy_s": 0.0, "idle_s": 0.0, "fwd_s": 0.0,
+                       "bwd_s": 0.0, "ops": 0, "span_s": 0.0}
+
+    # ------------------------------------------------------- programs
+    def _build_programs(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import stage_forward, stage_loss
+
+        c, s, n = self.config, self.stage, self.n_stages
+        last = s == n - 1
+
+        if s == 0:
+            # token ids are int32: differentiate wrt params only
+            def fwd(p, x):
+                return jax.vjp(lambda q: stage_forward(c, s, n, q, x), p)
+        elif last:
+            def fwd(p, x, ids, mask):
+                def f(q, xx):
+                    h = stage_forward(c, s, n, q, xx)
+                    return stage_loss(c, q, h, ids, mask)[0]
+                return jax.vjp(f, p, x)
+        else:
+            def fwd(p, x):
+                return jax.vjp(
+                    lambda q, xx: stage_forward(c, s, n, q, xx), p, x)
+
+        # device pinning rides the params: they are committed to
+        # self.device, so jit places every stage program there
+        return (jax.jit(fwd),
+                jax.jit(lambda vjp, g: vjp(g)),
+                jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b)))
+
+    # ------------------------------------------------------- mailboxes
+    def put_activation(self, i: int, x) -> None:
+        with self._cond:
+            self._acts[i] = x
+            self._cond.notify_all()
+
+    def put_grad(self, i: int, g) -> None:
+        with self._cond:
+            self._grads_in[i] = g
+            self._cond.notify_all()
+
+    def put_targets(self, i: int, input_ids, loss_mask=None) -> None:
+        """Last stage only: the labels (and mask) microbatch the loss
+        needs — fed by the driver alongside stage 0's token feed."""
+        with self._cond:
+            self._targets[i] = (input_ids, loss_mask)
+            self._cond.notify_all()
+
+    def abort(self) -> None:
+        """Unblock any pending mailbox take with a typed error (driver
+        cleanup after a neighbor stage died)."""
+        with self._cond:
+            self._abort = True
+            self._cond.notify_all()
+
+    def _take(self, box: Dict[int, Any], i: int):
+        deadline = time.monotonic() + self.TAKE_TIMEOUT_S
+        with self._cond:
+            while i not in box:
+                if self._abort:
+                    raise RuntimeError(
+                        f"stage {self.stage} aborted waiting for "
+                        f"microbatch {i}")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"stage {self.stage} starved waiting for "
+                        f"microbatch {i} (neighbor stage dead?)")
+                self._cond.wait(0.1)
+            return box.pop(i)
+
+    # ------------------------------------------------------------ step
+    def run(self, n_microbatches: int):
+        """One pipeline step as a streaming generator: walks this
+        stage's 1F1B order, blocking on the mailbox each op needs,
+        and yields the op's output as its own stream item — the
+        activation (F, non-last), the (loss, n_tokens) pair (F, last),
+        the upstream input-gradient (B, stage > 0) or the op duration
+        (B, stage 0). Records a ``STAGE_TICK`` span per compute AND
+        per idle interval: the timeline shows the bubbles."""
+        import jax
+
+        rec = _recorder()
+        last = self.stage == self.n_stages - 1
+        self._stats = {"busy_s": 0.0, "idle_s": 0.0, "fwd_s": 0.0,
+                       "bwd_s": 0.0, "ops": 0, "span_s": 0.0}
+        with self._cond:
+            self._abort = False
+        self._vjps.clear()
+        self.grads = None
+        t_start = time.perf_counter()
+        for op, i in one_f_one_b_order(self.stage, self.n_stages,
+                                       n_microbatches):
+            t_wait = time.perf_counter()
+            if op == "F":
+                x = self._take(self._acts, i)
+                tgt = self._take(self._targets, i) if last else None
+            else:
+                g = self._take(self._grads_in, i)
+            idle = time.perf_counter() - t_wait
+            if rec is not None and idle > 1e-4:
+                rec.record("STAGE_TICK", stage=self.stage, mb=i,
+                           phase="idle", dur_s=round(idle, 6))
+            t0 = time.perf_counter()
+            if op == "F":
+                if self.stage == 0:
+                    out, vjp = self._fwd(self.params, x)
+                elif last:
+                    import jax.numpy as jnp
+                    ids, mask = tgt
+                    if mask is None:
+                        mask = jnp.ones_like(ids, dtype=jnp.float32)
+                    loss, vjp = self._fwd(self.params, x, ids, mask)
+                    n = float(jnp.sum(mask[:, 1:]))
+                    out = {"loss": float(loss), "n_tokens": n}
+                else:
+                    out, vjp = self._fwd(self.params, x)
+                if not isinstance(out, dict):
+                    jax.block_until_ready(out)
+                self._vjps[i] = vjp
+            else:
+                parts = self._bwd(self._vjps.pop(i), g)
+                gp = parts[0]
+                out = parts[1] if self.stage > 0 else None
+                self.grads = gp if self.grads is None \
+                    else self._acc(self.grads, gp)
+                if out is not None:
+                    jax.block_until_ready(out)
+                else:
+                    jax.block_until_ready(self.grads)
+            dur = time.perf_counter() - t0
+            st = self._stats
+            st["busy_s"] += dur
+            st["idle_s"] += idle
+            st["fwd_s" if op == "F" else "bwd_s"] += dur
+            st["ops"] += 1
+            if rec is not None:
+                rec.record("STAGE_TICK", stage=self.stage, mb=i,
+                           phase="forward" if op == "F" else "backward",
+                           dur_s=round(dur, 6))
+                rec.maybe_flush()
+            yield out if out is not None else {"dur_s": dur}
+        self._stats["span_s"] = time.perf_counter() - t_start
+
+    # ------------------------------------- serial (unpipelined) path
+    def forward_one(self, i: int, x, input_ids=None, loss_mask=None):
+        """Unary forward for the serial stage-by-stage baseline: same
+        jitted program, no mailbox, one microbatch per call."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        if self.stage == self.n_stages - 1 and self.stage > 0:
+            if loss_mask is None:
+                loss_mask = jnp.ones_like(input_ids, dtype=jnp.float32)
+            out, vjp = self._fwd(self.params, x, input_ids, loss_mask)
+            n = float(jnp.sum(loss_mask[:, 1:]))
+            res: Any = {"loss": float(out), "n_tokens": n}
+        else:
+            out, vjp = self._fwd(self.params, x)
+            jax.block_until_ready(out)
+            res = out
+        self._vjps[i] = vjp
+        self._tick("forward", i, time.perf_counter() - t0)
+        return res
+
+    def backward_one(self, i: int, g):
+        t0 = time.perf_counter()
+        parts = self._bwd(self._vjps.pop(i), g)
+        gp = parts[0]
+        out = parts[1] if self.stage > 0 else None
+        self.grads = gp if self.grads is None else self._acc(self.grads,
+                                                             gp)
+        import jax
+        jax.block_until_ready(out if out is not None else self.grads)
+        self._tick("backward", i, time.perf_counter() - t0)
+        return out
+
+    def _tick(self, phase: str, i: int, dur: float) -> None:
+        st = self._stats
+        st["busy_s"] += dur
+        st[("fwd_s" if phase == "forward" else "bwd_s")] += dur
+        st["ops"] += 1
+        rec = _recorder()
+        if rec is not None:
+            rec.record("STAGE_TICK", stage=self.stage, mb=i, phase=phase,
+                       dur_s=round(dur, 6))
+            rec.maybe_flush()
+
+    def reset_step(self) -> None:
+        """Serial-path step reset (the streaming ``run`` resets
+        itself)."""
+        self._vjps.clear()
+        self.grads = None
+        self._stats = {"busy_s": 0.0, "idle_s": 0.0, "fwd_s": 0.0,
+                       "bwd_s": 0.0, "ops": 0, "span_s": 0.0}
+        self._t_reset = time.perf_counter()
+
+    # ------------------------------------------------------- queries
+    def step_stats(self) -> Dict[str, float]:
+        st = dict(self._stats)
+        if not st["span_s"] and getattr(self, "_t_reset", None):
+            st["span_s"] = time.perf_counter() - self._t_reset
+        st["device"] = str(self.device)
+        st["stage"] = self.stage
+        return st
+
+    def get_grads(self):
+        """Host copy of the accumulated stage-parameter gradients."""
+        import numpy as np
+
+        import jax
+        return jax.tree.map(np.asarray, self.grads)
+
+    def ping(self) -> int:
+        return self.stage
+
+
+@dataclasses.dataclass
+class PipelineStepResult:
+    loss: float
+    n_tokens: float
+    #: per-microbatch (loss, n) pairs in microbatch order
+    microbatch_losses: List[Tuple[float, float]]
+    #: per-stage step_stats dicts
+    stage_stats: List[Dict[str, float]]
+    wall_s: float
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Measured bubble: the mean over stages of the fraction of
+        the step's wall clock each stage spent NOT computing."""
+        if not self.wall_s:
+            return 0.0
+        fr = [1.0 - min(s["busy_s"] / self.wall_s, 1.0)
+              for s in self.stage_stats]
+        return sum(fr) / len(fr)
+
+
+class MPMDPipeline:
+    """Driver-side 1F1B scheduler over :class:`PipelineStage` actors.
+
+    ``step(batch)`` splits the batch into ``n_microbatches`` along the
+    batch axis, feeds stage 0's token microbatches / the last stage's
+    targets and loss seeds, launches one streaming ``run`` per stage,
+    and routes items (by ref) between neighbors as
+    ``streaming.wait_any`` reports them ready. The combined loss is
+    the token-weighted mean of the per-microbatch losses — exactly the
+    single-program ``lm_loss`` of the full batch.
+
+    ``serial=True`` drives the same actors microbatch-by-microbatch
+    with unary calls and full barriers — the no-overlap baseline the
+    measured bubble fraction is compared against.
+    """
+
+    def __init__(self, config, n_stages: int = 2,
+                 n_microbatches: int = 4, seed: int = 0,
+                 serial: bool = False,
+                 step_timeout_s: float = 300.0,
+                 actor_options: Optional[Dict[str, Any]] = None,
+                 remat_policies: Optional[Sequence[Optional[str]]] = None):
+        import ray_tpu
+
+        if n_stages < 2:
+            raise ValueError("MPMDPipeline needs n_stages >= 2 "
+                             "(use the plain train step otherwise)")
+        self.config = config
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.serial = serial
+        self.step_timeout_s = step_timeout_s
+        opts = {"max_concurrency": 4, "max_restarts": 0}
+        opts.update(actor_options or {})
+        cls = ray_tpu.remote(**opts)(PipelineStage)
+        policies = remat_policies or [None] * n_stages
+        self.stages = [
+            cls.remote(config, s, n_stages, seed=seed, device_index=s,
+                       remat_policy=policies[s])
+            for s in range(n_stages)]
+        ray_tpu.get([a.ping.remote() for a in self.stages], timeout=300)
+
+    # ---------------------------------------------------------- steps
+    def _split(self, batch: Dict[str, Any]):
+        import numpy as np
+
+        ids = np.asarray(batch["input_ids"])
+        mask = batch.get("loss_mask")
+        mask = np.asarray(mask) if mask is not None else None
+        m = self.n_microbatches
+        if ids.shape[0] % m:
+            raise ValueError(f"batch {ids.shape[0]} not divisible by "
+                             f"{m} microbatches")
+        ids_mb = np.split(ids, m)
+        mask_mb = np.split(mask, m) if mask is not None else [None] * m
+        # per-microbatch label-token counts — known to the driver
+        # without running the model, so the last stage's backward seeds
+        # (d total / d loss_i = n_i / N) can be fed up front
+        ns = [float(mk[:, 1:].sum()) if mk is not None
+              else float(i.shape[0] * (i.shape[1] - 1))
+              for i, mk in zip(ids_mb, mask_mb)]
+        return ids_mb, mask_mb, ns
+
+    def step(self, batch: Dict[str, Any]) -> PipelineStepResult:
+        return (self._step_serial if self.serial
+                else self._step_1f1b)(batch)
+
+    def _step_1f1b(self, batch: Dict[str, Any]) -> PipelineStepResult:
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu.core import streaming
+
+        S, M = self.n_stages, self.n_microbatches
+        ids_mb, mask_mb, ns = self._split(batch)
+        total_n = sum(ns)
+        t0 = time.perf_counter()
+        hold = []  # keep routed refs alive until the step completes
+        for i in range(M):
+            hold.append(self.stages[0].put_activation.remote(
+                i, ids_mb[i]))
+            last = self.stages[-1]
+            if S > 1:
+                hold.append(last.put_targets.remote(
+                    i, ids_mb[i], mask_mb[i]))
+            # the loss cotangent: scalar n_i / N, feedable up front
+            hold.append(last.put_grad.remote(
+                i, np.float32(ns[i] / total_n)))
+        gens = [a.run.options(num_returns="streaming").remote(M)
+                for a in self.stages]
+        orders = [one_f_one_b_order(s, S, M) for s in range(S)]
+        cursors = [0] * S
+        losses: Dict[int, Tuple[float, float]] = {}
+        by_gen = {id(g): s for s, g in enumerate(gens)}
+        active = list(gens)
+        deadline = time.monotonic() + self.step_timeout_s
+        try:
+            while active:
+                ready, _ = streaming.wait_any(
+                    active, timeout=max(deadline - time.monotonic(), 0.0))
+                if not ready:
+                    raise TimeoutError(
+                        f"pipeline step stalled: no stage produced an "
+                        f"item within {self.step_timeout_s}s")
+                for g in ready:
+                    s = by_gen[id(g)]
+                    try:
+                        ref = g.next_ref(timeout=1.0)
+                    except StopIteration:
+                        active.remove(g)
+                        continue
+                    op, i = orders[s][cursors[s]]
+                    cursors[s] += 1
+                    if op == "F" and s < S - 1:
+                        hold.append(
+                            self.stages[s + 1].put_activation.remote(
+                                i, ref))
+                    elif op == "F":
+                        item = ray_tpu.get(ref, timeout=60)
+                        losses[i] = (item["loss"], item["n_tokens"])
+                    elif op == "B" and s > 0:
+                        hold.append(self.stages[s - 1].put_grad.remote(
+                            i, ref))
+                    hold.append(ref)
+        except BaseException:
+            self._cleanup(gens)
+            raise
+        wall = time.perf_counter() - t0
+        stats = ray_tpu.get(
+            [a.step_stats.remote() for a in self.stages], timeout=60)
+        mb = [losses[i] for i in range(M)]
+        loss = sum(l * n for l, n in mb) / total_n
+        return PipelineStepResult(
+            loss=loss, n_tokens=total_n, microbatch_losses=mb,
+            stage_stats=stats, wall_s=wall)
+
+    def _step_serial(self, batch: Dict[str, Any]) -> PipelineStepResult:
+        """No-overlap baseline: each microbatch walks every stage's
+        forward, then every stage's backward, with a full barrier per
+        call — what pipelining exists to beat."""
+        import numpy as np
+
+        import ray_tpu
+
+        S, M = self.n_stages, self.n_microbatches
+        ids_mb, mask_mb, ns = self._split(batch)
+        total_n = sum(ns)
+        t0 = time.perf_counter()
+        ray_tpu.get([a.reset_step.remote() for a in self.stages],
+                    timeout=60)
+        losses = []
+        for i in range(M):
+            act = ray_tpu.get(
+                self.stages[0].forward_one.remote(i, ids_mb[i]),
+                timeout=self.step_timeout_s)
+            for s in range(1, S):
+                out = self.stages[s].forward_one.remote(
+                    i, act, ids_mb[i], mask_mb[i]) if s == S - 1 else \
+                    self.stages[s].forward_one.remote(i, act)
+                act = ray_tpu.get(out, timeout=self.step_timeout_s)
+            losses.append((act["loss"], act["n_tokens"]))
+            g: Any = np.float32(ns[i] / total_n)
+            for s in range(S - 1, -1, -1):
+                g = ray_tpu.get(self.stages[s].backward_one.remote(i, g),
+                                timeout=self.step_timeout_s)
+        wall = time.perf_counter() - t0
+        stats = ray_tpu.get(
+            [a.step_stats.remote() for a in self.stages], timeout=60)
+        loss = sum(l * n for l, n in losses) / total_n
+        return PipelineStepResult(
+            loss=loss, n_tokens=total_n, microbatch_losses=losses,
+            stage_stats=stats, wall_s=wall)
+
+    # -------------------------------------------------------- cleanup
+    def _cleanup(self, gens) -> None:
+        """Failure path: unblock every stage, then drop all stream
+        state — typed error out, no hang, no leaked stream refs."""
+        for a in self.stages:
+            try:
+                a.abort.remote()
+            except Exception:
+                pass
+        for g in gens:
+            try:
+                g.close()
+            except Exception:
+                pass
+
+    def grads(self, timeout: float = 120.0):
+        """Per-stage accumulated parameter-gradient trees (host)."""
+        import ray_tpu
+        return ray_tpu.get([a.get_grads.remote() for a in self.stages],
+                           timeout=timeout)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        for a in self.stages:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -21,6 +21,35 @@ from ray_tpu.serve._private.replica import Replica
 CONTROLLER_NAME = "SERVE_CONTROLLER_ACTOR"
 
 
+def autoscale_decision(cfg, target_num: int, avg_ongoing: float,
+                       avg_queue_depth: Optional[float] = None,
+                       avg_ttft_s: Optional[float] = None) -> int:
+    """Pure scale policy: the new target replica count for one
+    deployment, given the probed signals (delay gating is the
+    caller's job — this is the decision, testable without a cluster).
+
+    Scale-up fires on ANY pressure signal: ongoing requests above
+    target (the classic queue-depth policy), engine queue depth above
+    ``cfg.target_queue_depth``, or engine TTFT above
+    ``cfg.target_ttft_s`` (each only when configured AND probed —
+    continuous-batching engines admit work immediately, so handle-side
+    ongoing counts understate a deep engine backlog). Scale-down
+    requires ongoing requests below half target AND no engine
+    pressure."""
+    up = avg_ongoing > cfg.target_ongoing_requests
+    engine_pressure = False
+    if cfg.target_queue_depth is not None and avg_queue_depth is not None:
+        engine_pressure |= avg_queue_depth > cfg.target_queue_depth
+    if cfg.target_ttft_s is not None and avg_ttft_s is not None:
+        engine_pressure |= avg_ttft_s > cfg.target_ttft_s
+    if (up or engine_pressure) and target_num < cfg.max_replicas:
+        return target_num + 1
+    if avg_ongoing < cfg.target_ongoing_requests / 2 \
+            and not engine_pressure and target_num > cfg.min_replicas:
+        return target_num - 1
+    return target_num
+
+
 class _DeploymentInfo:
     def __init__(self, deployment, init_args, init_kwargs):
         self.deployment = deployment
@@ -240,14 +269,34 @@ class ServeController:
         except Exception:
             return
         avg = sum(ongoing) / len(ongoing)
+        avg_queue = avg_ttft = None
+        if cfg.target_queue_depth is not None \
+                or cfg.target_ttft_s is not None:
+            # engine-gauge probe (serve_engine_queue_depth / ttft): the
+            # per-replica scheduler counters surfaced by Replica.stats
+            try:
+                stats = ray_tpu.get(
+                    [r.stats.remote() for r in info.replicas], timeout=10)
+            except Exception:
+                stats = []
+            queues = [s["engine"].get("queue_depth") for s in stats
+                      if isinstance(s, dict) and "engine" in s]
+            ttfts = [s["engine"].get("ttft_ewma_s") for s in stats
+                     if isinstance(s, dict) and "engine" in s]
+            queues = [q for q in queues if q is not None]
+            ttfts = [t for t in ttfts if t is not None]
+            if queues:
+                avg_queue = sum(queues) / len(queues)
+            if ttfts:
+                avg_ttft = sum(ttfts) / len(ttfts)
+        new_target = autoscale_decision(cfg, info.target_num, avg,
+                                        avg_queue, avg_ttft)
         now = time.time()
-        if avg > cfg.target_ongoing_requests and \
-                info.target_num < cfg.max_replicas and \
+        if new_target > info.target_num and \
                 now - info._last_scale_up > cfg.upscale_delay_s:
-            info.target_num += 1
+            info.target_num = new_target
             info._last_scale_up = now
-        elif avg < cfg.target_ongoing_requests / 2 and \
-                info.target_num > cfg.min_replicas and \
+        elif new_target < info.target_num and \
                 now - info._last_scale_down > cfg.downscale_delay_s:
-            info.target_num -= 1
+            info.target_num = new_target
             info._last_scale_down = now

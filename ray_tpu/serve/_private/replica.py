@@ -133,6 +133,18 @@ class Replica:
         return True
 
     def stats(self) -> Dict[str, Any]:
-        return {"replica_id": self.replica_id,
-                "ongoing": self._num_ongoing,
-                "total": self._num_total}
+        out = {"replica_id": self.replica_id,
+               "ongoing": self._num_ongoing,
+               "total": self._num_total}
+        # engine-aware deployments (LLMServer & friends) expose their
+        # scheduler counters; surface them for the autoscaler's
+        # engine-gauge scale-up signals (queue depth, TTFT)
+        fn = getattr(self._instance, "stats", None)
+        if callable(fn):
+            try:
+                engine = fn()
+                if isinstance(engine, dict):
+                    out["engine"] = engine
+            except Exception:
+                pass
+        return out

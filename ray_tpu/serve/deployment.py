@@ -16,12 +16,28 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Queue-depth autoscaling (reference ``serve/config.py``)."""
+    """Queue-depth autoscaling (reference ``serve/config.py``).
+
+    Beyond the reference's ongoing-request target, engine-aware
+    deployments (those whose instance exposes ``stats()`` — e.g.
+    ``LLMServer``) can scale up on the per-replica engine gauges: a
+    mean engine queue depth above ``target_queue_depth``, or a mean
+    time-to-first-token above ``target_ttft_s``, triggers the same
+    scale-up path as ongoing-request pressure. Both default to None
+    (off) so plain deployments behave exactly as before; engine
+    pressure also vetoes a downscale (an idle handle count can
+    coexist with a deep engine backlog — continuous batching hides
+    queued work from the ongoing-request signal).
+    """
     min_replicas: int = 1
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 3.0
     downscale_delay_s: float = 10.0
+    #: scale up when mean engine queue depth exceeds this (None = off)
+    target_queue_depth: Optional[float] = None
+    #: scale up when mean engine TTFT (EWMA) exceeds this (None = off)
+    target_ttft_s: Optional[float] = None
 
 
 class Deployment:

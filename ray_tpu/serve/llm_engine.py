@@ -206,6 +206,10 @@ class LLMEngine:
         self._occupancy: Dict[int, int] = collections.defaultdict(int)
         self._t_start = time.monotonic()
         self._last_stats_emit = 0.0
+        # EWMA of recent TTFTs: the autoscaler's latency signal (a
+        # histogram is right for dashboards, wrong for a scale-up
+        # decision that wants "what are users seeing RIGHT NOW")
+        self._ttft_ewma: Optional[float] = None
         self._metrics = self._recorder = None
         try:
             from ray_tpu.core.metric_defs import runtime_metrics
@@ -335,6 +339,8 @@ class LLMEngine:
                 "decode_steps": self._decode_steps,
                 "prefill_chunks": self._prefill_chunks,
                 "occupancy_hist": dict(self._occupancy),
+                "ttft_ewma_s": (round(self._ttft_ewma, 6)
+                                if self._ttft_ewma is not None else None),
                 "dead": repr(self._dead) if self._dead else None,
             }
 
@@ -527,6 +533,8 @@ class LLMEngine:
     # ------------------------------------------------ metrics / events
     def _record_ttft(self, req: _Request) -> None:
         ttft = req.t_first_token - req.t_submit
+        self._ttft_ewma = ttft if self._ttft_ewma is None \
+            else 0.8 * self._ttft_ewma + 0.2 * ttft
         if self._metrics is not None:
             try:
                 self._metrics.serve_ttft.observe(ttft)

@@ -216,3 +216,64 @@ def test_streaming_midstream_worker_kill_lineage_replay(
     assert os.path.exists(marker), "producer never died — test vacuous"
     assert got == list(range(25)), \
         f"stream not replayed exactly-once/in-order after kill: {got}"
+
+
+@pytest.mark.slow
+@pytest.mark.pipeline
+def test_mpmd_pipeline_midstage_kill_fails_typed_no_hang(
+        ray_start_regular):
+    """Chaos regression (MPMD pipeline + fault tolerance): SIGKILL the
+    MIDDLE stage actor mid-step. The driver-side 1F1B scheduler must
+    surface a typed failure — not hang on the dead stage's stream or
+    on a neighbor blocked in its mailbox — and must drop all stream
+    state (no leaked refs), leaving the cluster usable."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.core.global_state import global_worker
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=3, n_heads=2, head_dim=16,
+        d_ff=64, max_seq_len=32, rotary_dim=8, block_style="gptj",
+        dtype=jnp.float32, remat=False, ce_chunk_size=8)
+    batch = {"input_ids": np.zeros((6, 16), np.int32),
+             "loss_mask": np.ones((6, 16), np.float32)}
+    pipe = MPMDPipeline(cfg, n_stages=3, n_microbatches=3, seed=0,
+                        step_timeout_s=60.0)
+    pipe.step(batch)  # compile + one clean step
+
+    # SIGKILL the middle stage shortly after the next step starts
+    killer = threading.Timer(
+        0.05, lambda: ray_tpu.kill(pipe.stages[1], no_restart=True))
+    killer.start()
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as ei:
+        pipe.step(batch)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 90, f"driver hung for {elapsed:.0f}s"
+    assert isinstance(
+        ei.value,
+        (ray_tpu.RayTpuError, TimeoutError)), repr(ei.value)
+    killer.join()
+
+    # no leaked stream refs: the failed step's streams are all dropped
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and global_worker()._streams:
+        time.sleep(0.2)
+    assert not global_worker()._streams, "leaked stream state"
+
+    # the cluster is still healthy: surviving stages answer, and a
+    # fresh task runs
+    assert ray_tpu.get(pipe.stages[0].ping.remote(), timeout=60) == 0
+
+    @ray_tpu.remote
+    def alive():
+        return "ok"
+
+    assert ray_tpu.get(alive.remote(), timeout=60) == "ok"
+    pipe.shutdown()

@@ -396,3 +396,123 @@ def test_stream_midstream_worker_kill_replays_via_lineage(
     assert vals == list(range(30)), \
         "mid-stream worker kill must replay the stream via lineage"
     assert os.path.exists(marker), "the producer never died — test vacuous"
+
+
+# ------------------------------------------- wait_any (multi-stream)
+
+
+def _fake_stream_state():
+    """A StreamState wired to a minimal fake runtime: enough for the
+    consumer-side readiness machinery (items/EOF/failure/close) that
+    wait_any exercises — no cluster, no object store."""
+    import types
+
+    from ray_tpu.core.streaming import StreamState
+    rt = types.SimpleNamespace(config=types.SimpleNamespace())
+    return StreamState(rt, os.urandom(16))
+
+
+def _push_item(st, val=None):
+    """Simulate one in-order item report (the tail of on_item)."""
+    with st.cond:
+        st.received_max += 1
+        st.items[st.received_max] = val
+        st.cond.notify_all()
+        st._wake_waiters_locked()
+
+
+def test_wait_any_staggered_producers_unit():
+    """Three streams fed by staggered producer threads: wait_any
+    returns as soon as the FIRST becomes ready (not after a poll
+    tick), honors num_returns, and reports input order."""
+    import threading
+
+    from ray_tpu.core.streaming import ObjectRefGenerator, wait_any
+
+    states = [_fake_stream_state() for _ in range(3)]
+    gens = [ObjectRefGenerator(s) for s in states]
+
+    # nothing ready yet -> timeout returns ([], all)
+    ready, rest = wait_any(gens, timeout=0.05)
+    assert ready == [] and rest == gens
+
+    delays = {0: 0.30, 1: 0.05, 2: 0.60}
+    for i, st in enumerate(states):
+        threading.Timer(delays[i], _push_item, args=(st,)).start()
+
+    t0 = time.monotonic()
+    ready, rest = wait_any(gens, timeout=10)
+    waited = time.monotonic() - t0
+    assert ready == [gens[1]] and set(rest) == {gens[0], gens[2]}
+    assert waited < 0.25, f"wait_any polled instead of waking: {waited}"
+
+    # num_returns=2: blocks until the second producer lands
+    ready, _ = wait_any(gens, timeout=10, num_returns=2)
+    assert gens[0] in ready and gens[1] in ready
+    ready, _ = wait_any(gens, timeout=10, num_returns=3)
+    assert ready == gens  # input order preserved
+
+
+def test_wait_any_terminal_streams_are_ready():
+    """EOF-consumed, failed, and closed streams are 'actionable' —
+    next_ref would terminate immediately, so wait_any must not block
+    on them."""
+    from ray_tpu.core.streaming import ObjectRefGenerator, wait_any
+
+    eof = _fake_stream_state()
+    eof.on_eof(0, None)            # empty stream, fully consumed
+    failed = _fake_stream_state()
+    failed.fail(RuntimeError("producer died"))
+    closed = _fake_stream_state()
+    closed.close()
+    pending = _fake_stream_state()
+
+    gens = [ObjectRefGenerator(s) for s in (eof, failed, closed,
+                                            pending)]
+    ready, rest = wait_any(gens, timeout=0.2, num_returns=4)
+    assert rest == [gens[3]]
+    assert ready == gens[:3]
+
+    # a failure arriving WHILE blocked wakes the waiter immediately
+    import threading
+    threading.Timer(0.05, pending.fail,
+                    args=(RuntimeError("late"),)).start()
+    t0 = time.monotonic()
+    ready, _ = wait_any([gens[3]], timeout=10)
+    assert ready == [gens[3]]
+    assert time.monotonic() - t0 < 0.25
+
+
+def test_wait_any_empty_and_validation():
+    from ray_tpu.core.streaming import wait_any
+    assert wait_any([], timeout=0.1) == ([], [])
+
+
+def test_wait_any_live_streams(ray_start_regular):
+    """Integration: wait_any across real streaming tasks with
+    staggered producers drains all items from whichever stream is
+    ready, without ever blocking on the slow one."""
+    from ray_tpu.core.streaming import wait_any
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(tag, n, delay):
+        for i in range(n):
+            time.sleep(delay)
+            yield (tag, i)
+
+    gens = [gen.remote("fast", 5, 0.01), gen.remote("slow", 3, 0.4)]
+    got = {"fast": [], "slow": []}
+    active = list(gens)
+    deadline = time.monotonic() + 120
+    while active and time.monotonic() < deadline:
+        ready, _ = wait_any(active, timeout=60)
+        assert ready, "wait_any timed out with streams still active"
+        for g in ready:
+            try:
+                tag, i = ray_tpu.get(g.next_ref(timeout=10))
+            except StopIteration:
+                active.remove(g)
+                continue
+            got[tag].append(i)
+    assert got["fast"] == list(range(5))
+    assert got["slow"] == list(range(3))

@@ -1,0 +1,118 @@
+"""Serve autoscaler scale-up policy (pure decision function).
+
+The per-replica engine gauges (`serve_engine_queue_depth`, TTFT) are
+wired into the controller's scale-up decision: continuous-batching
+engines admit requests immediately, so the handle-side ongoing-request
+count understates a deep engine backlog — the engine signals close
+that gap. These tests exercise ``autoscale_decision`` directly (no
+cluster) plus the stats surfaces it reads.
+"""
+
+import pytest
+
+from ray_tpu.serve._private.controller import autoscale_decision
+from ray_tpu.serve.deployment import AutoscalingConfig
+
+
+def cfg(**kw):
+    base = dict(min_replicas=1, max_replicas=4,
+                target_ongoing_requests=2.0)
+    base.update(kw)
+    return AutoscalingConfig(**base)
+
+
+def test_classic_ongoing_request_policy_unchanged():
+    c = cfg()
+    # above target -> up; below half target -> down; in between -> hold
+    assert autoscale_decision(c, 2, avg_ongoing=3.0) == 3
+    assert autoscale_decision(c, 2, avg_ongoing=0.5) == 1
+    assert autoscale_decision(c, 2, avg_ongoing=1.5) == 2
+    # bounds respected
+    assert autoscale_decision(c, 4, avg_ongoing=10.0) == 4
+    assert autoscale_decision(c, 1, avg_ongoing=0.0) == 1
+
+
+def test_engine_queue_depth_triggers_scale_up():
+    c = cfg(target_queue_depth=4.0)
+    # ongoing looks idle, but the engine backlog is deep -> scale up
+    assert autoscale_decision(c, 1, avg_ongoing=0.0,
+                              avg_queue_depth=9.0) == 2
+    # backlog below target: no pressure
+    assert autoscale_decision(c, 2, avg_ongoing=1.5,
+                              avg_queue_depth=1.0) == 2
+    # unconfigured target ignores the probe entirely
+    assert autoscale_decision(cfg(), 1, avg_ongoing=0.0,
+                              avg_queue_depth=100.0) == 1
+    # configured but unprobed (no engine-aware replicas): no effect
+    assert autoscale_decision(c, 1, avg_ongoing=0.0,
+                              avg_queue_depth=None) == 1
+
+
+def test_engine_ttft_triggers_scale_up():
+    c = cfg(target_ttft_s=0.5)
+    assert autoscale_decision(c, 1, avg_ongoing=0.0,
+                              avg_ttft_s=1.2) == 2
+    assert autoscale_decision(c, 1, avg_ongoing=0.0,
+                              avg_ttft_s=0.1) == 1
+
+
+def test_engine_pressure_vetoes_downscale():
+    c = cfg(target_queue_depth=4.0)
+    # ongoing says "scale down", the engine backlog says "don't"
+    assert autoscale_decision(c, 3, avg_ongoing=0.2,
+                              avg_queue_depth=50.0) == 4
+    c_full = cfg(target_queue_depth=4.0, max_replicas=3)
+    assert autoscale_decision(c_full, 3, avg_ongoing=0.2,
+                              avg_queue_depth=50.0) == 3  # capped, held
+
+
+def test_engine_stats_surfaces():
+    """LLMEngine.stats carries the TTFT EWMA, and the EWMA tracks
+    observations (unit-level: poke the private recorder the way the
+    step loop does)."""
+    pytest.importorskip("jax")
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    class _Req:
+        t_submit = 10.0
+        t_first_token = 10.25
+        rid = "r1"
+        prompt = [1, 2, 3]
+
+    eng = LLMEngine.__new__(LLMEngine)  # no model build: unit surface
+    eng._ttft_ewma = None
+    eng._metrics = None
+    eng._recorder = None
+    eng.replica_tag = "t"
+    eng._record_ttft(_Req())
+    assert eng._ttft_ewma == pytest.approx(0.25)
+    _Req.t_first_token = 10.05
+    eng._record_ttft(_Req())
+    # EWMA: 0.8 * 0.25 + 0.2 * 0.05
+    assert eng._ttft_ewma == pytest.approx(0.21)
+
+
+def test_replica_stats_merges_instance_engine_stats():
+    from ray_tpu.serve._private.replica import Replica
+
+    class Engineish:
+        def __init__(self):
+            pass
+
+        def stats(self):
+            return {"queue_depth": 7, "ttft_ewma_s": 0.4}
+
+    r = Replica.__new__(Replica)
+    r.replica_id = "d#0"
+    r._num_ongoing = 1
+    r._num_total = 5
+    r._instance = Engineish()
+    out = r.stats()
+    assert out["engine"] == {"queue_depth": 7, "ttft_ewma_s": 0.4}
+    assert out["ongoing"] == 1
+
+    class Plain:
+        pass
+
+    r._instance = Plain()
+    assert "engine" not in r.stats()

@@ -1,0 +1,80 @@
+"""PIPELINE bench smoke tests: the `bench.py --pipeline` record shape
+— the SPMD-GPipe comparison row with the analytic bubble fraction
+``(S-1)/(M+S-1)`` reported next to the measured one, so the MPMD-vs-
+SPMD comparison is apples-to-apples — without requiring a fresh run
+(the slow test actually runs the harness end to end)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+pytestmark = [pytest.mark.perf, pytest.mark.pipeline]
+
+
+def test_analytic_bubble_formula():
+    from ray_tpu.parallel.mpmd_pipeline import analytic_gpipe_bubble
+    assert analytic_gpipe_bubble(2, 4) == pytest.approx(0.2)
+    assert analytic_gpipe_bubble(3, 9) == pytest.approx(2 / 11)
+
+
+def test_checked_in_pipeline_record_shape():
+    """The recorded PIPELINE series carries both bubble columns and
+    the per-mode tokens/s rows the gate and README quote."""
+    paths = sorted(p for p in os.listdir(REPO)
+                   if p.startswith("PIPELINE_r") and p.endswith(".json"))
+    assert paths, "no checked-in PIPELINE records"
+    with open(os.path.join(REPO, paths[-1])) as f:
+        rec = json.load(f)
+    d = rec["detail"]
+    from ray_tpu.parallel.mpmd_pipeline import analytic_gpipe_bubble
+    assert d["analytic_gpipe_bubble"] == pytest.approx(
+        analytic_gpipe_bubble(d["n_stages"], d["n_microbatches"]),
+        abs=1e-3)
+    # measured next to analytic, for BOTH actor modes
+    assert 0.0 <= d["mpmd_1f1b"]["bubble_fraction"] <= 1.0
+    assert 0.0 <= d["serial"]["bubble_fraction"] <= 1.0
+    assert d["mpmd_1f1b"]["bubble_fraction"] \
+        < d["serial"]["bubble_fraction"]
+    assert d["spmd_gpipe"]["tokens_per_s"] > 0
+    # acceptance: forward/loss parity with the single-program model
+    assert d["loss_parity_abs"] <= 1e-5
+    assert d["stage_tick_events"] > 0
+    assert rec["vs_serial"] > 0
+
+
+def test_pipeline_config_splits_evenly():
+    from bench import _pipeline_config
+    for on_tpu in (False, True):
+        for smoke in (False, True):
+            cfg, batch, seq, m, s, steps = _pipeline_config(on_tpu,
+                                                            smoke)
+            assert batch % m == 0
+            assert cfg.n_layers % s == 0
+            assert steps >= 1
+
+
+@pytest.mark.slow
+def test_bench_pipeline_smoke_subprocess():
+    """End-to-end: `bench.py --pipeline --smoke` prints one JSON line
+    the pipeline gate accepts."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--pipeline",
+         "--smoke"],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [ln for ln in out.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "pipeline_tokens_per_s"
+    assert rec["value"] > 0
+    from tools.perf_gate import compare
+    ok, msgs = compare(rec, rec, metric="pipeline")
+    assert ok, msgs

@@ -249,3 +249,75 @@ def test_cli_end_to_end(tmp_path):
                        capture_output=True, text=True, timeout=120)
     assert r.returncode == 1
     assert "FAIL" in r.stdout
+
+
+# ------------------------------------------------------ pipeline gate
+
+
+def _pipeline_record(tok=3000.0, spmd=2500.0, bubble=0.22,
+                     backend="cpu"):
+    return {"metric": "pipeline_tokens_per_s", "value": tok,
+            "unit": "tok/s", "vs_serial": 1.1,
+            "detail": {"backend": backend,
+                       "mpmd_1f1b": {"tokens_per_s": tok,
+                                     "bubble_fraction": bubble},
+                       "serial": {"bubble_fraction": 0.55},
+                       "spmd_gpipe": {"tokens_per_s": spmd},
+                       "analytic_gpipe_bubble": 0.2}}
+
+
+def test_pipeline_extractor_and_utilization_inversion():
+    from tools.perf_gate import extract_pipeline_metrics
+    m = extract_pipeline_metrics(_pipeline_record(bubble=0.25))
+    assert m["pipeline_tokens_per_s"] == 3000.0
+    assert m["pipeline/spmd_tokens_per_s"] == 2500.0
+    # bubble is lower-better; the gate compares utilization = 1 - bubble
+    assert m["pipeline/stage_utilization"] == pytest.approx(0.75)
+    # records without the detail blocks skip, not crash
+    m2 = extract_pipeline_metrics({"metric": "x", "value": 1.0})
+    assert m2["pipeline/spmd_tokens_per_s"] is None
+    assert m2["pipeline/stage_utilization"] is None
+
+
+def test_pipeline_gate_relative_tolerance():
+    base = _pipeline_record()
+    ok, _ = compare(_pipeline_record(tok=2700.0), base,
+                    metric="pipeline")  # -10% < 15% tolerance
+    assert ok
+    ok, msgs = compare(_pipeline_record(tok=2000.0), base,
+                       metric="pipeline")  # -33%
+    assert not ok and any("FAIL" in m for m in msgs)
+    # a bubble regression (utilization drop beyond tolerance) fails too
+    ok, msgs = compare(_pipeline_record(bubble=0.60), base,
+                       metric="pipeline")
+    assert not ok, msgs
+
+
+def test_pipeline_gate_against_checked_in_baseline():
+    from tools.perf_gate import extract_pipeline_metrics
+    path, rec = latest_baseline(REPO, metric="pipeline")
+    assert "PIPELINE_r" in os.path.basename(path)
+    m = extract_pipeline_metrics(rec)
+    assert m["pipeline_tokens_per_s"] > 0
+    assert 0.0 < m["pipeline/stage_utilization"] <= 1.0
+    ok, _ = compare(rec, rec, metric="pipeline")
+    assert ok
+    # the checked-in record satisfies the acceptance shape: measured
+    # MPMD bubble beats serial, analytic bubble reported next to it
+    d = rec["detail"]
+    assert d["mpmd_1f1b"]["bubble_fraction"] \
+        < d["serial"]["bubble_fraction"]
+    assert "analytic_gpipe_bubble" in d
+
+
+def test_pipeline_gate_bootstrap_passes_without_baselines(tmp_path):
+    import subprocess
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(_pipeline_record()))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "--fresh", str(fresh), "--metric", "pipeline",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "PASS" in out.stdout

@@ -20,6 +20,11 @@ Three gated series (``--metric``):
   more than ``--tolerance`` PERCENT below baseline (default 15%) fails.
   Baselines: ``SERVE_r*.json``; like ``multichip``, an empty/unparseable
   series bootstrap-passes.
+- ``pipeline`` — the MPMD pipeline headline from ``bench.py
+  --pipeline`` (1F1B tokens/s), plus the SPMD-GPipe tokens/s and the
+  stage utilization (1 − measured bubble fraction, so higher is
+  better) when the records carry them. Gated RELATIVELY like
+  ``serve``; baselines ``PIPELINE_r*.json``, bootstrap-passes.
 
 Baselines are matched to the fresh record's backend (``detail.backend``:
 "tpu"/"cpu") when possible, so a CPU smoke record checked in between TPU
@@ -53,13 +58,15 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_TOLERANCE = 2.0          # MFU points (bench/multichip)
 BASELINE_GLOBS = {"bench": "BENCH_r*.json",
                   "multichip": "MULTICHIP_r*.json",
-                  "serve": "SERVE_r*.json"}
+                  "serve": "SERVE_r*.json",
+                  "pipeline": "PIPELINE_r*.json"}
 #: metrics compared RELATIVELY (tolerance is an allowed % drop, not
 #: absolute points — tokens/s scales with the chip, MFU doesn't)
-RELATIVE_METRICS = {"serve"}
-DEFAULT_TOLERANCES = {"bench": 2.0, "multichip": 2.0, "serve": 15.0}
+RELATIVE_METRICS = {"serve", "pipeline"}
+DEFAULT_TOLERANCES = {"bench": 2.0, "multichip": 2.0, "serve": 15.0,
+                      "pipeline": 15.0}
 #: series whose early records may predate any parseable baseline
-BOOTSTRAP_METRICS = {"multichip", "serve"}
+BOOTSTRAP_METRICS = {"multichip", "serve", "pipeline"}
 
 
 def parse_bench_record(obj: dict) -> dict:
@@ -126,9 +133,29 @@ def extract_serve_metrics(rec: dict) -> dict:
     return out
 
 
+def extract_pipeline_metrics(rec: dict) -> dict:
+    """The MPMD pipeline headline (1F1B tokens/s) plus the SPMD-GPipe
+    tokens/s and the stage utilization (1 − measured bubble fraction —
+    inverted so the shared higher-is-better comparison applies) when
+    the record carries them."""
+    detail = rec.get("detail") or {}
+    out = {"pipeline_tokens_per_s": float(rec["value"]),
+           "pipeline/spmd_tokens_per_s": None,
+           "pipeline/stage_utilization": None}
+    spmd = detail.get("spmd_gpipe") or {}
+    if isinstance(spmd, dict) and "tokens_per_s" in spmd:
+        out["pipeline/spmd_tokens_per_s"] = float(spmd["tokens_per_s"])
+    mpmd = detail.get("mpmd_1f1b") or {}
+    if isinstance(mpmd, dict) and "bubble_fraction" in mpmd:
+        out["pipeline/stage_utilization"] = round(
+            1.0 - float(mpmd["bubble_fraction"]), 4)
+    return out
+
+
 EXTRACTORS = {"bench": extract_metrics,
               "multichip": extract_multichip_metrics,
-              "serve": extract_serve_metrics}
+              "serve": extract_serve_metrics,
+              "pipeline": extract_pipeline_metrics}
 
 
 def latest_baseline(root: str = REPO_ROOT, metric: str = "bench",
@@ -235,7 +262,10 @@ def main(argv=None) -> int:
                          "grad-transport/weight-update variant) vs "
                          "MULTICHIP_r*.json; 'serve' = bench_serve.py "
                          "tokens/s/chip vs SERVE_r*.json, relative "
-                         "tolerance in percent (default: bench)")
+                         "tolerance in percent; 'pipeline' = bench.py "
+                         "--pipeline MPMD tokens/s (+ SPMD tokens/s, "
+                         "stage utilization) vs PIPELINE_r*.json, "
+                         "relative (default: bench)")
     ap.add_argument("--baseline", default=None,
                     help="baseline JSON (default: latest parseable "
                          "baseline for --metric, preferring the fresh "
