@@ -297,6 +297,24 @@ class ObjectRefGenerator:
         ended) without consuming it; False on timeout."""
         return self._state.next_ready(timeout)
 
+    def ready_refs(self, max_items: Optional[int] = None) -> List[ObjectRef]:
+        """Drain every already-buffered in-order item WITHOUT blocking
+        (at most ``max_items``). A fan-in consumer woken by ``wait_any``
+        uses this to take a producer's whole burst in one pass instead
+        of one wakeup per item. Returns possibly-empty; EOF/failure are
+        NOT consumed here — the next ``next_ref()`` surfaces them."""
+        out: List[ObjectRef] = []
+        st = self._state
+        while max_items is None or len(out) < max_items:
+            with st.cond:
+                if st.closed or st.next_index not in st.items:
+                    break
+            try:
+                out.append(st.next_ref(timeout=0))
+            except Exception:
+                break
+        return out
+
     # ------------------------------------------------------------- async
     def __aiter__(self) -> "ObjectRefGenerator":
         return self

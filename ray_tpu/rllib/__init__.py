@@ -28,6 +28,9 @@ from ray_tpu.rllib.offline import (
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.rollout_stream import (
+    RandomEnv, RolloutBlockStream, make_rollout_streams,
+    rollout_stream)
 from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
@@ -72,10 +75,14 @@ __all__ = [
     "PPOConfig",
     "RLModule",
     "RLModuleSpec",
+    "RandomEnv",
+    "RolloutBlockStream",
     "SAC",
     "SACConfig",
     "TD3",
     "TD3Config",
     "WeightedImportanceSampling",
     "compute_gae",
+    "make_rollout_streams",
+    "rollout_stream",
 ]

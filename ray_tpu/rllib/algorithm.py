@@ -87,7 +87,8 @@ class Algorithm(Trainable):
         # Trainable.__init__ rebound self.config to the plain dict;
         # expose the AlgorithmConfig object (reference behavior).
         cfg = self.config = self._algo_config
-        env_creator = _resolve_env_creator(cfg.env, cfg.env_config)
+        env_creator = self._env_creator = _resolve_env_creator(
+            cfg.env, cfg.env_config)
         probe = env_creator()
         self.module_spec = spec_for_spaces(
             probe.observation_space, probe.action_space,
@@ -117,15 +118,23 @@ class Algorithm(Trainable):
         self._cached_weights = None
 
         n_runners = max(1, cfg.num_env_runners)
-        runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
-        self.env_runners = [
-            runner_cls.remote(env_creator, spec,
-                              cfg.num_envs_per_env_runner,
-                              cfg.gamma, getattr(cfg, "lambda_", 0.95),
-                              cfg.seed, i)
-            for i in range(n_runners)]
+        if getattr(cfg, "streaming_rollouts", False):
+            # Rollout producers are per-step generator TASKS
+            # (rollout_stream.py) — deterministic, lineage-replayable.
+            # No long-lived runner actors to keep in sync.
+            self.env_runners = []
+        else:
+            runner_cls = ray_tpu.remote(num_cpus=1)(EnvRunner)
+            self.env_runners = [
+                runner_cls.remote(env_creator, spec,
+                                  cfg.num_envs_per_env_runner,
+                                  cfg.gamma,
+                                  getattr(cfg, "lambda_", 0.95),
+                                  cfg.seed, i)
+                for i in range(n_runners)]
         self._sync_weights()
         self._timesteps = 0
+        self._iterations = 0
         self._return_window: List[float] = []
 
     # Subclass hooks ---------------------------------------------------
@@ -144,6 +153,8 @@ class Algorithm(Trainable):
 
     def step(self) -> Dict[str, Any]:
         cfg = self.config
+        if getattr(cfg, "streaming_rollouts", False):
+            return self._step_streaming()
         per_runner = max(1, cfg.train_batch_size
                          // (len(self.env_runners)
                              * cfg.num_envs_per_env_runner))
@@ -172,6 +183,53 @@ class Algorithm(Trainable):
             "episode_reward_mean": mean_return,
             "num_env_steps_sampled_lifetime": self._timesteps,
             "learner": metrics,
+        }
+
+    def _step_streaming(self) -> Dict[str, Any]:
+        """Streaming rollout→train step: N generator-task runners
+        stream GAE'd rollout blocks straight into the learner's
+        ``iter_batches`` (first epoch trains as blocks arrive; later
+        epochs shuffle the collected batch). The consumer-idle
+        fraction is reported as ``rollout_train_bubble``."""
+        from ray_tpu.rllib.rollout_stream import (
+            RolloutBlockStream, make_rollout_streams)
+        cfg = self.config
+        self._iterations += 1
+        n_runners = max(1, cfg.num_env_runners)
+        per_runner = max(1, cfg.train_batch_size
+                         // (n_runners * cfg.num_envs_per_env_runner))
+        block_steps = min(max(1, cfg.rollout_block_steps), per_runner)
+        n_blocks = max(1, -(-per_runner // block_steps))
+        weights_ref = ray_tpu.put(self.learner_group.get_weights())
+        gens = make_rollout_streams(
+            self._env_creator, self.module_spec, weights_ref,
+            n_runners, n_blocks, block_steps,
+            num_envs=cfg.num_envs_per_env_runner, gamma=cfg.gamma,
+            lambda_=getattr(cfg, "lambda_", 0.95),
+            # fresh trajectories every iteration, deterministic within
+            # one (lineage replay must regenerate identical blocks)
+            seed=cfg.seed + 100_000 * self._iterations)
+        stream = RolloutBlockStream(gens, collect=True)
+        try:
+            metrics = self.learner_group.update_from_stream(
+                stream, minibatch_size=cfg.minibatch_size,
+                num_epochs=cfg.num_epochs)
+        finally:
+            stream.close()
+        sstats = stream.stats()
+        self._timesteps += int(sstats["rows"])
+        self._cached_weights = None
+        self._return_window.extend(stream.episode_returns())
+        self._return_window = self._return_window[-100:]
+        mean_return = (float(np.mean(self._return_window))
+                       if self._return_window else float("nan"))
+        return {
+            "episode_return_mean": mean_return,
+            "episode_reward_mean": mean_return,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "learner": metrics,
+            "rollout_train_bubble": sstats["bubble"],
+            "rollout_stream": sstats,
         }
 
     def train(self) -> Dict[str, Any]:

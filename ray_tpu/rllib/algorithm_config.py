@@ -21,6 +21,13 @@ class AlgorithmConfig:
         self.num_env_runners: int = 0
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
+        #: stream rollout blocks from generator-task runners straight
+        #: into the learner (rollout_stream.py) instead of the
+        #: epoch-barriered sample-then-train step. Lineage-replayable:
+        #: a runner SIGKILLed mid-epoch replays its stream prefix.
+        self.streaming_rollouts: bool = False
+        #: env steps per streamed rollout block (per runner)
+        self.rollout_block_steps: int = 64
         # training
         self.lr: float = 3e-4
         self.gamma: float = 0.99
@@ -46,6 +53,8 @@ class AlgorithmConfig:
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
+                    streaming_rollouts: Optional[bool] = None,
+                    rollout_block_steps: Optional[int] = None,
                     **_ignored) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -53,6 +62,10 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if streaming_rollouts is not None:
+            self.streaming_rollouts = streaming_rollouts
+        if rollout_block_steps is not None:
+            self.rollout_block_steps = rollout_block_steps
         return self
 
     # Reference alias

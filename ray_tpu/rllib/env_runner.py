@@ -165,6 +165,18 @@ class EnvRunner:
             "advantages": flat(adv),
         }
 
+    def sample_blocks(self, num_blocks: int, steps_per_block: int
+                      ) -> "Any":
+        """Generator of ``num_blocks`` consecutive rollout blocks of
+        ``steps_per_block`` env steps each — the producer half of the
+        rollout→train streaming dataflow. Works as a streaming actor
+        call (``runner.sample_blocks.options(num_returns="streaming")
+        .remote(...)``) on a live runner; ``rllib.rollout_stream``
+        wraps the same loop in a deterministic generator TASK when
+        lineage replay of the stream prefix is required."""
+        for _ in range(num_blocks):
+            yield self.sample(steps_per_block)
+
     def sample_segments(self, num_steps: int) -> Dict[str, np.ndarray]:
         """Time-major rollout segments for off-policy correction
         (IMPALA/V-trace needs the [T, B] structure + behavior log-probs

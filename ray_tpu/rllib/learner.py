@@ -152,6 +152,35 @@ class LearnerGroup:
                      for a in self._remote])
         return results[0][1]
 
+    def update_from_stream(self, stream,
+                           minibatch_size: Optional[int] = None,
+                           num_epochs: int = 1
+                           ) -> Dict[str, float]:
+        """Streaming rollout→train epoch (Podracer-style): the FIRST
+        epoch consumes minibatches straight off the rollout stream as
+        blocks arrive (``RolloutBlockStream.iter_batches`` — the
+        learner updates while runners are still sampling, no epoch
+        barrier), collecting the blocks; the remaining ``num_epochs -
+        1`` epochs run the usual shuffled-minibatch passes over the
+        collected full batch. Streamed minibatches drop the ragged
+        tail so every update shares one jitted shape."""
+        stream._collect = True
+        metrics: Dict[str, float] = {}
+        n_updates = 0
+        for mb in stream.iter_batches(minibatch_size, drop_last=True):
+            metrics = self._one_update(mb)
+            n_updates += 1
+        if not stream.blocks:
+            return metrics
+        if num_epochs > 1:
+            batch = stream.full_batch()
+            metrics = self.update_from_batch(
+                batch, minibatch_size=minibatch_size,
+                num_epochs=num_epochs - 1)
+        metrics = dict(metrics)
+        metrics["stream_updates"] = float(n_updates)
+        return metrics
+
     def update_ordered(self, batch: Dict[str, np.ndarray]
                        ) -> Dict[str, float]:
         """One full-batch update with NO shuffling — sequence-structured

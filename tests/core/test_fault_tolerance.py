@@ -277,3 +277,36 @@ def test_mpmd_pipeline_midstage_kill_fails_typed_no_hang(
 
     assert ray_tpu.get(alive.remote(), timeout=60) == "ok"
     pipe.shutdown()
+
+
+@pytest.mark.streaming
+@pytest.mark.data_streaming
+def test_rollout_stream_midepoch_kill_exactly_once(ray_start_regular):
+    """Regression (rollout→train dataflow + fault tolerance): one of N
+    rollout generator TASKS is SIGKILLed mid-epoch after the learner
+    consumed part of its stream. The owner's lineage resubmission
+    replays the stream prefix on a fresh worker (the rollout is
+    deterministic in its args), the per-index dedup absorbs the
+    replayed items, and the consumer sees every rollout block exactly
+    once — no duplicate and no missing (worker, block) uid."""
+    import tempfile
+
+    from ray_tpu.rllib.rl_module import RLModuleSpec
+    from ray_tpu.rllib.rollout_stream import (
+        RandomEnv, RolloutBlockStream, block_uid, make_rollout_streams)
+
+    spec = RLModuleSpec(observation_dim=6, num_actions=3, hiddens=(8,))
+    weights = spec.build().init(__import__("jax").random.PRNGKey(0))
+    marker = tempfile.mktemp()
+    runners, blocks, steps = 2, 4, 6
+    gens = make_rollout_streams(
+        lambda: RandomEnv(6, 3, 10, seed=2), spec, weights,
+        runners, blocks, steps, seed=5,
+        faults={0: {"die_at_block": 2, "marker": marker}})
+    stream = RolloutBlockStream(gens, collect=True)
+    rows = sum(len(b["obs"]) for b, _ in stream.iter_blocks(timeout=240))
+    assert os.path.exists(marker), "runner never died — test vacuous"
+    assert rows == runners * blocks * steps
+    assert sorted(stream.delivered_uids()) == sorted(
+        block_uid(w, b) for w in range(runners) for b in range(blocks)), \
+        "rollout blocks not delivered exactly once after mid-epoch kill"

@@ -1,0 +1,90 @@
+"""DATA bench smoke tests: the `bench.py --data` record shape — the
+stage-overlap fraction reported next to the streaming-vs-staged rows/s
+at equal task counts, the prefetch hit rate, and the rollout→train leg
+with its exactly-once chaos column — without requiring a fresh run
+(the slow test actually runs the harness end to end)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+pytestmark = [pytest.mark.perf, pytest.mark.data_streaming]
+
+
+def test_checked_in_data_record_shape():
+    """The recorded DATA series carries every column the gate and the
+    README quote: streaming beats staged-serial rows/s at equal task
+    counts, the overlap fraction is present, and the rollout→train
+    chaos leg delivered every row exactly once."""
+    paths = sorted(p for p in os.listdir(REPO)
+                   if p.startswith("DATA_r") and p.endswith(".json"))
+    assert paths, "no checked-in DATA records"
+    with open(os.path.join(REPO, paths[-1])) as f:
+        rec = json.load(f)
+    assert rec["metric"] == "data_rows_per_s"
+    d = rec["detail"]
+    # acceptance: streaming >= staged-serial end-to-end rows/s
+    assert d["streaming"]["rows_per_s"] >= d["staged"]["rows_per_s"]
+    assert rec["vs_staged"] >= 1.0
+    assert 0.0 <= d["stage_overlap_fraction"] <= 1.0
+    assert d["stage_overlap_fraction"] > 0.0
+    # exactly-once row totals, both executors
+    assert d["exactly_once_rows"] is True
+    assert d["streaming"]["rows"] == d["rows_expected"]
+    assert d["staged"]["rows"] == d["rows_expected"]
+    assert 0.0 <= d["prefetch"]["hit_rate"] <= 1.0
+    rt = d["rollout_train"]
+    assert rt["chaos"]["runner_killed"] is True
+    assert rt["chaos"]["exactly_once"] is True
+    assert rt["chaos"]["rows_delivered"] == rt["chaos"]["rows_expected"]
+    # measured consumer idle-time reduction vs epoch-barriered rollouts
+    assert rt["consumer_idle_reduction"] > 0.0
+    assert rt["streaming"]["idle_s"] < rt["epoch_barriered"]["idle_s"]
+
+
+def test_data_config_shapes():
+    from bench import _data_config
+    for smoke in (False, True):
+        cfg = _data_config(smoke)
+        assert cfg["n_blocks"] % cfg["pool"] == 0
+        assert cfg["rows_per_block"] > 0
+        # streamed minibatches must tile a block row count so the
+        # drop_last re-chunking never starves an update
+        rows = cfg["runners"] * cfg["r_blocks"] * cfg["r_steps"]
+        assert rows % cfg["minibatch"] == 0
+
+
+@pytest.mark.slow
+def test_bench_data_smoke_subprocess():
+    """End-to-end: `bench.py --data --smoke` prints one JSON line the
+    data gate accepts, with the overlap fraction present, streaming >=
+    staged rows/s, and exactly-once row totals."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--data",
+         "--smoke"],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [ln for ln in out.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "data_rows_per_s"
+    assert rec["value"] > 0
+    d = rec["detail"]
+    assert "stage_overlap_fraction" in d
+    assert d["exactly_once_rows"] is True
+    assert d["rollout_train"]["chaos"]["exactly_once"] is True
+    # streaming >= staged-serial rows/s (small slack: the smoke config
+    # runs seconds-long stages on a loaded CI box)
+    assert d["streaming"]["rows_per_s"] \
+        >= 0.95 * d["staged"]["rows_per_s"], d
+    from tools.perf_gate import compare
+    ok, msgs = compare(rec, rec, metric="data")
+    assert ok, msgs

@@ -321,3 +321,81 @@ def test_pipeline_gate_bootstrap_passes_without_baselines(tmp_path):
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 0, out.stderr
     assert "PASS" in out.stdout
+
+
+# ---------------------------------------------------------------- data
+
+
+def _data_record(rows_per_s=15000.0, overlap=0.3, hit_rate=0.95,
+                 bubble=0.65, backend="cpu"):
+    return {"metric": "data_rows_per_s", "value": rows_per_s,
+            "unit": "rows/s", "vs_staged": 1.4,
+            "detail": {"backend": backend,
+                       "stage_overlap_fraction": overlap,
+                       "prefetch": {"hit_rate": hit_rate},
+                       "rollout_train": {
+                           "streaming": {"bubble": bubble}}}}
+
+
+def test_data_extractor_and_utilization_inversion():
+    from tools.perf_gate import extract_data_metrics
+    m = extract_data_metrics(_data_record())
+    assert m["data_rows_per_s"] == 15000.0
+    assert m["data/stage_overlap"] == 0.3
+    assert m["data/prefetch_hit_rate"] == 0.95
+    # bubble is inverted so the shared higher-is-better rule applies
+    assert m["data/rollout_train_utilization"] == pytest.approx(0.35)
+    # sparse/old records skip the optional columns
+    sparse = {"metric": "data_rows_per_s", "value": 10.0, "detail": {}}
+    ms = extract_data_metrics(sparse)
+    assert ms["data/stage_overlap"] is None
+    assert ms["data/rollout_train_utilization"] is None
+
+
+def test_data_compare_is_relative():
+    base = _data_record(rows_per_s=10000.0)
+    ok, _ = compare(_data_record(rows_per_s=9000.0), base,
+                    metric="data")
+    assert ok  # -10% within the 15% relative default
+    ok, msgs = compare(_data_record(rows_per_s=8000.0), base,
+                       metric="data")
+    assert not ok, msgs  # -20% fails
+    # a worse overlap fraction alone also gates
+    ok, msgs = compare(_data_record(overlap=0.1), base, metric="data")
+    assert not ok, msgs
+
+
+def test_data_gate_against_checked_in_baseline():
+    from tools.perf_gate import extract_data_metrics
+    path, rec = latest_baseline(REPO, metric="data")
+    assert "DATA_r" in os.path.basename(path)
+    m = extract_data_metrics(rec)
+    assert m["data_rows_per_s"] > 0
+    assert 0.0 < m["data/stage_overlap"] <= 1.0
+    assert 0.0 < m["data/rollout_train_utilization"] <= 1.0
+    ok, _ = compare(rec, rec, metric="data")
+    assert ok
+
+
+def test_data_gate_bootstrap_and_backend_matching(tmp_path):
+    import subprocess
+    # bootstrap: no DATA baselines under root -> PASS (exit 0)
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(_data_record()))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "--fresh", str(fresh), "--metric", "data",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "PASS" in out.stdout
+    # backend matching: a CPU smoke record checked in later never
+    # becomes the TPU series' comparison point
+    (tmp_path / "DATA_r01.json").write_text(
+        json.dumps(_data_record(rows_per_s=90000.0, backend="tpu")))
+    (tmp_path / "DATA_r02.json").write_text(
+        json.dumps(_data_record(rows_per_s=1000.0, backend="cpu")))
+    path, rec = latest_baseline(
+        tmp_path, metric="data", prefer_backend="tpu")
+    assert path.endswith("DATA_r01.json")
+    assert record_backend(rec) == "tpu"

@@ -75,10 +75,35 @@ for seed in "${seeds[@]}"; do
     report_streams "$seed"
 done
 
+# ---- data-pipeline soak leg: stream through 2 fused stages under 5%
+# drops (STREAM_ITEM/EOF/CREDIT included) + one producer SIGKILL per
+# seed, exactly-once rows asserted end to end (tests/data/
+# test_streaming_exec.py::test_data_pipeline_chaos_soak)
+for seed in "${seeds[@]}"; do
+    echo "=== data-pipeline soak: seed=$seed ==="
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/data/test_streaming_exec.py::test_data_pipeline_chaos_soak" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== data seed=$seed PASSED ==="
+    else
+        echo "=== data seed=$seed FAILED ==="
+        failed+=("data:$seed")
+    fi
+done
+
 if [ "${#failed[@]}" -gt 0 ]; then
     echo
     echo "FAILING SEEDS: ${failed[*]}"
     for seed in "${failed[@]}"; do
+        case "$seed" in
+        data:*)
+            s="${seed#data:}"
+            echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
+                 "tests/data/test_streaming_exec.py::test_data_pipeline_chaos_soak -q"
+            continue
+            ;;
+        esac
         echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$seed python -m pytest" \
              "tests/core/test_chaos.py::test_chaos_soak -q"
         # merged flight-recorder buffer dumped at teardown: the causal

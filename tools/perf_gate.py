@@ -25,6 +25,12 @@ Three gated series (``--metric``):
   stage utilization (1 − measured bubble fraction, so higher is
   better) when the records carry them. Gated RELATIVELY like
   ``serve``; baselines ``PIPELINE_r*.json``, bootstrap-passes.
+- ``data`` — the streaming data-plane headline from ``bench.py
+  --data`` (end-to-end rows/s through the generator-fed executor),
+  plus the stage-overlap fraction, the prefetch hit rate and the
+  rollout→train consumer utilization (1 − streaming bubble) when the
+  records carry them. Gated RELATIVELY like ``serve``; baselines
+  ``DATA_r*.json``, bootstrap-passes.
 
 Baselines are matched to the fresh record's backend (``detail.backend``:
 "tpu"/"cpu") when possible, so a CPU smoke record checked in between TPU
@@ -59,14 +65,15 @@ DEFAULT_TOLERANCE = 2.0          # MFU points (bench/multichip)
 BASELINE_GLOBS = {"bench": "BENCH_r*.json",
                   "multichip": "MULTICHIP_r*.json",
                   "serve": "SERVE_r*.json",
-                  "pipeline": "PIPELINE_r*.json"}
+                  "pipeline": "PIPELINE_r*.json",
+                  "data": "DATA_r*.json"}
 #: metrics compared RELATIVELY (tolerance is an allowed % drop, not
 #: absolute points — tokens/s scales with the chip, MFU doesn't)
-RELATIVE_METRICS = {"serve", "pipeline"}
+RELATIVE_METRICS = {"serve", "pipeline", "data"}
 DEFAULT_TOLERANCES = {"bench": 2.0, "multichip": 2.0, "serve": 15.0,
-                      "pipeline": 15.0}
+                      "pipeline": 15.0, "data": 15.0}
 #: series whose early records may predate any parseable baseline
-BOOTSTRAP_METRICS = {"multichip", "serve", "pipeline"}
+BOOTSTRAP_METRICS = {"multichip", "serve", "pipeline", "data"}
 
 
 def parse_bench_record(obj: dict) -> dict:
@@ -152,10 +159,35 @@ def extract_pipeline_metrics(rec: dict) -> dict:
     return out
 
 
+def extract_data_metrics(rec: dict) -> dict:
+    """The streaming data-plane headline (end-to-end rows/s) plus the
+    stage-overlap fraction, prefetch hit rate and rollout→train
+    consumer utilization (1 − streaming bubble — inverted so the
+    shared higher-is-better comparison applies) when the record
+    carries them."""
+    detail = rec.get("detail") or {}
+    out = {"data_rows_per_s": float(rec["value"]),
+           "data/stage_overlap": None,
+           "data/prefetch_hit_rate": None,
+           "data/rollout_train_utilization": None}
+    if "stage_overlap_fraction" in detail:
+        out["data/stage_overlap"] = float(
+            detail["stage_overlap_fraction"])
+    pf = detail.get("prefetch") or {}
+    if isinstance(pf, dict) and "hit_rate" in pf:
+        out["data/prefetch_hit_rate"] = float(pf["hit_rate"])
+    rt = (detail.get("rollout_train") or {}).get("streaming") or {}
+    if isinstance(rt, dict) and "bubble" in rt:
+        out["data/rollout_train_utilization"] = round(
+            1.0 - float(rt["bubble"]), 4)
+    return out
+
+
 EXTRACTORS = {"bench": extract_metrics,
               "multichip": extract_multichip_metrics,
               "serve": extract_serve_metrics,
-              "pipeline": extract_pipeline_metrics}
+              "pipeline": extract_pipeline_metrics,
+              "data": extract_data_metrics}
 
 
 def latest_baseline(root: str = REPO_ROOT, metric: str = "bench",
@@ -265,6 +297,9 @@ def main(argv=None) -> int:
                          "tolerance in percent; 'pipeline' = bench.py "
                          "--pipeline MPMD tokens/s (+ SPMD tokens/s, "
                          "stage utilization) vs PIPELINE_r*.json, "
+                         "relative; 'data' = bench.py --data rows/s "
+                         "(+ stage overlap, prefetch hit rate, "
+                         "rollout-train utilization) vs DATA_r*.json, "
                          "relative (default: bench)")
     ap.add_argument("--baseline", default=None,
                     help="baseline JSON (default: latest parseable "
