@@ -283,11 +283,15 @@ def main() -> None:
 # 2-stage MPMD actor pipeline (parallel/mpmd_pipeline.py) driven by the
 # 1F1B scheduler vs (a) the same actors driven serially with no overlap
 # and (b) the single-program SPMD GPipe (ops/pipeline.py) at equal
-# microbatches on local devices. Reports tokens/s, the MEASURED bubble
-# fraction of both actor modes, the ANALYTIC GPipe bubble
-# (S-1)/(M+S-1) next to them, and the forward/loss parity of the MPMD
-# split against the single-program model. Gated by
-# `tools/perf_gate.py --metric pipeline` (PIPELINE_r*.json).
+# microbatches on local devices, plus the TRAIN variant: the full
+# fwd+bwd+fused-per-stage-optimizer pipeline over the interleave
+# matrix v in {1, 2} (virtual stages), with the measured bubble next
+# to the analytic (S-1)/(v*M+S-1) and the make_train_step loss-
+# trajectory parity (<= 1e-5 over 20 steps). Reports tokens/s, the
+# MEASURED bubble fraction of every mode, the ANALYTIC bubbles next to
+# them, and the forward/loss parity of the MPMD split against the
+# single-program model. Gated by `tools/perf_gate.py --metric
+# pipeline` (PIPELINE_r*.json).
 
 
 def _pipeline_config(on_tpu: bool, smoke: bool):
@@ -306,26 +310,109 @@ def _pipeline_config(on_tpu: bool, smoke: bool):
         ce_chunk_size=128)
     if smoke:
         return cfg, 4, 64, 2, 2, 2
-    return cfg, 8, 128, 4, 2, 4
+    return cfg, 8, 128, 4, 2, 8
+
+
+def _pipeline_train_config(on_tpu: bool, smoke: bool):
+    """The train-variant matrix config: deeper than the fwd+bwd leg
+    (8 layers, longer sequences) so a v=2 chunk still carries real
+    compute — interleaving wins exactly when per-chunk compute
+    dominates per-op overhead, which is the TPU regime the CPU record
+    has to approximate. Returns (cfg, batch, seq, M, train_steps)."""
+    import dataclasses as _dc
+
+    cfg, batch, seq, M, S, _ = _pipeline_config(on_tpu, smoke)
+    if smoke:
+        # shared tiny config: the smoke contract is wall-clock (< 60s
+        # on CPU), not bubble ordering
+        return cfg, batch, seq, M, 3
+    if on_tpu:
+        return cfg, batch, seq, M, 19
+    return (_dc.replace(cfg, n_layers=8, max_seq_len=256), 8, 256, 4,
+            19)
 
 
 def _measure_mpmd(pipe, batch_d, steps: int) -> dict:
     """Steady-state tokens/s + measured bubble of an MPMDPipeline
-    (first step is the compile step, excluded)."""
-    res = pipe.step(batch_d)          # compile
-    t0 = time.perf_counter()
-    bubbles = []
+    (first step is the compile step, excluded; per-step timing with
+    the MEDIAN step reported — CPU bench boxes share cores, and one
+    descheduled step would otherwise poison the whole window)."""
+    import statistics
+
+    pipe.step(batch_d)                # compile
+    res = pipe.step(batch_d)          # warm (workers, event rings)
+    dts, bubbles = [], []
     for _ in range(steps):
+        t0 = time.perf_counter()
         res = pipe.step(batch_d)
+        dts.append(time.perf_counter() - t0)
         bubbles.append(res.bubble_fraction)
-    dt = time.perf_counter() - t0
+    med = statistics.median(dts)
     b, s = batch_d["input_ids"].shape
-    return {"tokens_per_s": round(b * s * steps / dt, 1),
-            "step_ms": round(dt / steps * 1e3, 2),
+    return {"tokens_per_s": round(b * s / med, 1),
+            "step_ms": round(med * 1e3, 2),
             "bubble_fraction": round(sum(bubbles) / len(bubbles), 4),
             "loss": res.loss,
             "stage_busy_ms": [round(st["busy_s"] * 1e3, 2)
                               for st in res.stage_stats]}
+
+
+def _measure_train(cfg, batch_d, S: int, M: int, v: int, steps: int,
+                   lr: float = 1e-3) -> dict:
+    """Train-variant measurement at one interleave factor: the full
+    fwd+bwd+fused-per-stage-opt pipeline (grads/params/opt state
+    resident on the stages; the driver only reduces the scalar grad
+    norm). Returns steady-state tokens/s, the measured bubble, the
+    analytic interleaved bubble (S-1)/(v*M+S-1) next to it, and the
+    loss trajectory (entry 0 = the compile step)."""
+    from ray_tpu.parallel.mpmd_pipeline import (
+        MPMDPipeline, analytic_bubble)
+
+    import statistics
+
+    pipe = MPMDPipeline(cfg, n_stages=S, n_microbatches=M, seed=0,
+                        n_virtual=v, train=True, learning_rate=lr)
+    res = pipe.step(batch_d)          # compile
+    losses = [res.loss]
+    dts, bubbles = [], []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        res = pipe.step(batch_d)
+        dts.append(time.perf_counter() - t0)
+        losses.append(res.loss)
+        bubbles.append(res.bubble_fraction)
+    med = statistics.median(dts)
+    pipe.shutdown()
+    b, s = batch_d["input_ids"].shape
+    return {"tokens_per_s": round(b * s / med, 1),
+            "step_ms": round(med * 1e3, 2),
+            "bubble_fraction": round(sum(bubbles) / len(bubbles), 4),
+            "analytic_bubble": round(analytic_bubble(S, M, v), 4),
+            "grad_norm": round(res.grad_norm, 6),
+            "losses": [round(l, 8) for l in losses],
+            "stage_busy_ms": [round(st["busy_s"] * 1e3, 2)
+                              for st in res.stage_stats],
+            "stage_opt_ms": [round(st["opt_s"] * 1e3, 2)
+                             for st in res.stage_stats]}
+
+
+def _train_reference_losses(cfg, batch_d, n: int,
+                            lr: float = 1e-3) -> list:
+    """The single-program make_train_step loss trajectory the pipeline
+    train variants are gated against (<= 1e-5 parity)."""
+    import jax
+
+    from ray_tpu.models.training import make_train_step
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dp=1, fsdp=1), jax.devices()[:1])
+    bundle = make_train_step(cfg, mesh, learning_rate=lr)
+    state = bundle.init(seed=0)
+    out = []
+    for _ in range(n):
+        state, met = bundle.step(state, batch_d)
+        out.append(float(met["loss"]))
+    return out
 
 
 def _measure_spmd_gpipe(cfg, batch: int, seq: int, n_microbatches: int,
@@ -419,12 +506,37 @@ def pipeline_main(smoke: bool = False) -> None:
         serial = MPMDPipeline(cfg, n_stages=S, n_microbatches=M,
                               seed=0, serial=True)
         ser = _measure_mpmd(serial, batch_d, max(steps // 2, 1))
+        pipe.shutdown()
+        serial.shutdown()
         # forward/loss parity vs the single-program model (exact same
         # seed -> bit-identical weights; must agree to <= 1e-5)
         ref_loss = float(lm_loss(
             cfg, init_params(cfg, jax.random.PRNGKey(0)), batch_d)[0])
         parity = abs(ref_loss - mpmd["loss"])
         spmd = _measure_spmd_gpipe(cfg, batch, seq, M, S, steps)
+        # train variant: fwd+bwd+fused per-stage opt over the
+        # interleave matrix v in {1, 2}, plus the make_train_step loss-
+        # trajectory parity (20 steps full, shrunk in smoke)
+        tcfg, tb, tseq, tM, train_steps = _pipeline_train_config(
+            on_tpu, smoke)
+        tids = np.array(jax.random.randint(
+            jax.random.PRNGKey(1), (tb, tseq), 0, tcfg.vocab_size))
+        tbatch = {"input_ids": tids,
+                  "loss_mask": np.ones((tb, tseq), np.float32)}
+        t_train = time.perf_counter()
+        train = {f"v{v}": _measure_train(tcfg, tbatch, S, tM, v,
+                                         train_steps)
+                 for v in (1, 2)}
+        ref_losses = _train_reference_losses(tcfg, tbatch,
+                                             train_steps + 1)
+        train["n_microbatches"] = tM
+        train["model_params"] = tcfg.num_params
+        train["parity_steps"] = train_steps + 1
+        train["loss_parity_train_abs"] = round(max(
+            abs(a - b)
+            for key in ("v1", "v2")
+            for a, b in zip(train[key]["losses"], ref_losses)), 9)
+        train["wall_s"] = round(time.perf_counter() - t_train, 2)
         ticks = len(list_task_events(filters=[("ev", "=", "STAGE_TICK")]))
     finally:
         ray_tpu.shutdown()
@@ -438,6 +550,7 @@ def pipeline_main(smoke: bool = False) -> None:
         "mpmd_1f1b": mpmd,
         "serial": ser,
         "spmd_gpipe": spmd,
+        "train": train,
         "analytic_gpipe_bubble": round(analytic_gpipe_bubble(S, M), 4),
         "loss_parity_abs": round(parity, 9),
         "single_program_loss": ref_loss,

@@ -167,6 +167,15 @@ class Config:
     #: ``options(generator_backpressure_num_objects=...)``.
     generator_backpressure_num_objects: int = 64
 
+    # --- MPMD pipeline (parallel/mpmd_pipeline.py) ---
+    #: Seconds a pipeline stage's mailbox take may starve before the
+    #: stage fails with a typed TimeoutError (a dead neighbor stage
+    #: must surface as an error at the driver, never a hang). Sized
+    #: well above any sane per-microbatch compute; shrink it in tests
+    #: that provoke stalls. Per-pipeline override via
+    #: ``MPMDPipeline(mailbox_deadline_s=...)``.
+    pipeline_mailbox_deadline_s: float = 120.0
+
     # --- retries / fault tolerance hardening ---
     #: Lease/reconnect retry backoff: exponential with full jitter,
     #: base * 2^attempt capped at the cap (reference retry shape; the

@@ -44,9 +44,11 @@ Event taxonomy (the ``ev`` field):
                    window for ``seconds``
 ``DELIVERY_FAILED``reliable layer gave up on a message (typed error)
 ``STAGE_TICK``     MPMD pipeline stage interval: ``phase`` forward/
-                   backward/idle with ``stage``/``mb``/``dur_s`` —
-                   rendered as duration slices, so the Perfetto
-                   timeline IS the pipeline-bubble visualization
+                   backward/opt/idle with ``stage``/``mb``/``dur_s``
+                   and ``vs`` (virtual-stage chunk index) — rendered
+                   as duration slices, so the Perfetto timeline IS
+                   the pipeline-bubble visualization with per-chunk
+                   forward/backward/optimizer occupancy per track
 =================  =====================================================
 """
 
@@ -377,6 +379,10 @@ def build_chrome_trace(events: List[dict]) -> dict:
                 name = f"{name}:{e['phase']}"
                 if e.get("mb") is not None:
                     name += f"[{e['mb']}]"
+                if e.get("vs") is not None:
+                    # virtual-stage (chunk) index: separates the
+                    # interleaved chunks' occupancy on one stage track
+                    name += f"@c{e['vs']}"
             trace_events.append({
                 "name": name, "cat": "stage", "ph": "X",
                 "ts": (e.get("ts", 0.0) - dur_s) * 1e6,

@@ -58,6 +58,11 @@ class RuntimeMetrics:
             "runtime_puts_total", "ray_tpu.put calls")
         self.put_bytes = Counter(
             "runtime_put_bytes_total", "Bytes written by put")
+        self.materialized_bytes = Counter(
+            "runtime_object_bytes_materialized_total",
+            "Bytes of object payloads this process materialized from "
+            "the shm store / remote holders (inbound transfer "
+            "accounting: what ray_tpu.get actually moved here)")
         # -- workers / actors (reference: actors-by-state, worker counts)
         self.workers_alive = Gauge(
             "runtime_workers_alive", "Worker processes registered")

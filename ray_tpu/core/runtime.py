@@ -1313,6 +1313,18 @@ class Runtime:
         if token and self.block_notifier is not None:
             self.block_notifier.on_unblock()
 
+    @staticmethod
+    def _count_materialized(nbytes: int) -> None:
+        """Inbound transfer accounting: bytes of object payload this
+        process pulled in to satisfy a get (the pipeline train-mode
+        tests assert the driver's per-step inbound stays scalar-sized
+        — no grad/param bytes through the driver)."""
+        try:
+            from ray_tpu.core.metric_defs import runtime_metrics
+            runtime_metrics().materialized_bytes.inc(nbytes)
+        except Exception:
+            pass
+
     def _materialize(self, oid: ObjectID, meta: dict):
         if meta.get("error") is not None:
             err = P.loads(meta["error"])
@@ -1322,6 +1334,7 @@ class Runtime:
             value, _ = self.serialization.deserialize_from_view(
                 memoryview(meta["inline"]))
             self.memory_store.put(oid, value, force=True)
+            self._count_materialized(len(meta["inline"]))
             return value
         # shared-memory object
         node_b = meta.get("node_id")
@@ -1341,6 +1354,7 @@ class Runtime:
             if view is not None:
                 value, _, bufs = \
                     self.serialization.deserialize_from_view_tracked(view)
+                self._count_materialized(view.nbytes)
                 self._cache_shm_value(oid, value, bufs)
                 return value
         # remote: ask controller to make it local (or hand us inline
@@ -1363,6 +1377,7 @@ class Runtime:
                 value, _ = self.serialization.deserialize_from_view(
                     memoryview(reply["inline"]))
                 self.memory_store.put(oid, value, force=True)
+                self._count_materialized(len(reply["inline"]))
                 return value
             if self.shm is None:
                 raise RuntimeError(
@@ -1373,6 +1388,7 @@ class Runtime:
             if view is not None:
                 value, _, bufs = \
                     self.serialization.deserialize_from_view_tracked(view)
+                self._count_materialized(view.nbytes)
                 self._cache_shm_value(oid, value, bufs)
                 return value
             time.sleep(0.2 * (attempt + 1))

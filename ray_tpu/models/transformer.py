@@ -530,6 +530,31 @@ def stage_slice_params(config: TransformerConfig, params: Dict,
     return out
 
 
+def merge_stage_params(config: TransformerConfig,
+                       chunk_params: Dict[int, Dict]) -> Dict:
+    """Inverse of :func:`stage_slice_params`: reassemble the canonical
+    single-program parameter pytree from per-chunk slices keyed by
+    global chunk index ``0..K-1`` (``K = len(chunk_params)``). Works on
+    any param-SHAPED tree (Adam moments included), so the pipeline
+    checkpoint merge reuses it for optimizer state."""
+    if not chunk_params:
+        raise ValueError("missing chunks: got an empty chunk set")
+    K = max(chunk_params) + 1
+    missing = [c for c in range(K) if c not in chunk_params]
+    if missing or "final_norm" not in chunk_params[K - 1]:
+        raise ValueError(
+            f"missing chunks: have {sorted(chunk_params)}, need a "
+            f"contiguous 0..K-1 set ending in the final-norm/LM-head "
+            f"chunk")
+    layer_trees = [chunk_params[c]["layers"] for c in range(K)]
+    out: Dict = {"layers": jax.tree.map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *layer_trees)}
+    out["embed"] = chunk_params[0]["embed"]
+    out["final_norm"] = chunk_params[K - 1]["final_norm"]
+    out["lm_head"] = chunk_params[K - 1]["lm_head"]
+    return out
+
+
 def stage_forward(config: TransformerConfig, stage: int, n_stages: int,
                   stage_params: Dict, inp: jnp.ndarray,
                   mesh=None, rules=None) -> jnp.ndarray:

@@ -1,4 +1,5 @@
-"""MPMD pipeline parallelism: actor-hosted stages, streamed activations.
+"""MPMD pipeline parallelism: actor-hosted stages, streamed activations,
+interleaved virtual stages, per-stage fused optimizer step.
 
 The SPMD pipeline in ``ops/pipeline.py`` compiles every stage into ONE
 jitted GPipe program — one mesh, one compile, the full GPipe bubble.
@@ -8,76 +9,195 @@ Parallelism, arXiv:2412.14374; the decoupled-actor split mirrors
 Podracer's sebulba, arXiv:2104.06272):
 
 - each pipeline stage is a :class:`PipelineStage` **actor** pinned to
-  its own device subset, holding its stage parameters
-  (``models.transformer.stage_slice_params`` — a contiguous slice of
-  the stacked layer leaves, bit-identical to the single-program
-  weights) and TWO jitted programs:
+  its own device subset, holding ``n_virtual`` *virtual stage* slices
+  of the model (``models.transformer.stage_slice_params`` over
+  round-robin chunk ids — actor ``s`` hosts global chunks
+  ``s, s+S, s+2S, ...`` of the ``K = S*v`` total, each a contiguous
+  slab of the stacked layer leaves, bit-identical to the
+  single-program weights) and THREE jitted program families:
 
-  * stage-forward: ``jit(lambda p, x: jax.vjp(stage_fn, p, x))`` —
-    returns the activation AND the vjp closure. ``jax.vjp``'s return
-    is a pytree-registered ``Partial`` whose leaves are the saved
-    residuals, so it crosses the jit boundary as plain arrays;
+  * stage-forward (per chunk role): ``jit(lambda p, x:
+    jax.vjp(stage_fn, p, x))`` — returns the activation AND the vjp
+    closure. ``jax.vjp``'s return is a pytree-registered ``Partial``
+    whose leaves are the saved residuals, so it crosses the jit
+    boundary as plain arrays;
   * stage-backward: ``jit(lambda vjp, g: vjp(g))`` — applies a saved
     vjp to the downstream gradient, REUSING the forward's residuals
-    (no recompute), and emits the upstream input-gradient.
+    (no recompute), and emits the upstream input-gradient. Per-chunk
+    parameter gradients accumulate in-actor across microbatches
+    (donated accumulator buffers);
+  * stage-optimizer (``train=True``): one fused jitted program that
+    scales the accumulated grads by the global clip factor, runs the
+    optax update on the stage's param slice, and applies it — params,
+    optimizer state AND grads donated. Optimizer state never leaves
+    the stage; after warmup the only per-step driver traffic is the
+    scalar grad-norm reduction and the loss scalars.
 
-  Per-stage compiles mean per-stage specialization: stages can differ
-  in remat policy, precision, even layer count — the constraint the
-  single shared compile imposed is gone.
+- a driver-side **interleaved 1F1B scheduler** (:class:`MPMDPipeline`)
+  streams per-microbatch activations chunk-to-chunk: each stage's step
+  is one ``num_returns="streaming"`` actor call whose yields are the
+  per-op outputs in the stage's deterministic
+  :func:`one_f_one_b_order`, the driver waits on whichever stage
+  produces next (``streaming.wait_any``) and routes the item *ref* —
+  never the bytes — into the next chunk's mailbox. With ``n_virtual >
+  1`` the warmup/cooldown bubble shrinks by the virtual-stage factor:
+  analytic ``(S-1)/(v*M+S-1)`` vs GPipe's ``(S-1)/(M+S-1)``.
 
-- a driver-side **1F1B scheduler** (:class:`MPMDPipeline`) streams
-  per-microbatch activations stage-to-stage: each stage's step is one
-  ``num_returns="streaming"`` actor call whose yields are the per-
-  microbatch outputs, the driver waits on whichever stage produces
-  next (``streaming.wait_any``) and routes the item *ref* — never the
-  bytes — into the downstream stage's mailbox, so stage *k*'s forward
-  on microbatch *i+1* overlaps both the activation transport and
-  stage *k+1*'s forward on microbatch *i*. Transport rides the PR-2/
-  PR-3 reliable+credit layer; activations ship via the device-array
-  out-of-band serialization fast path (``core/serialization.py``).
+Every forward/backward/opt/idle interval is recorded as a
+``STAGE_TICK`` flight-recorder event labelled with its phase and
+virtual-stage (chunk) index, so the Perfetto ``/timeline`` export
+doubles as the bubble visualization, and
+:meth:`PipelineStage.step_stats` returns the measured busy/idle split
+the bench turns into a bubble fraction.
 
-Every forward/backward/idle interval is recorded as a ``STAGE_TICK``
-flight-recorder event, so the Perfetto ``/timeline`` export doubles as
-the bubble visualization, and :meth:`PipelineStage.step_stats` returns
-the measured busy/idle split the bench turns into a bubble fraction.
+Checkpointing: :meth:`PipelineStage.stage_checkpoint` returns the
+stage's param/opt-state slices keyed by global chunk id;
+:func:`merge_stage_checkpoints` reassembles the canonical
+single-program ``{"params", "opt_state", "step"}`` layout (the same
+treedef ``models.training.make_train_step`` produces for the same
+optimizer), and :func:`split_train_state` re-slices it for any other
+``(n_stages, n_virtual)`` — a checkpoint saved at v=2 reloads into a
+v=1 pipeline and vice versa.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "one_f_one_b_order",
+    "interleaved_orders",
+    "stage_virtual_chunks",
     "analytic_gpipe_bubble",
+    "analytic_bubble",
     "PipelineStage",
     "MPMDPipeline",
     "PipelineStepResult",
+    "merge_stage_checkpoints",
+    "split_train_state",
 ]
 
 
-def one_f_one_b_order(stage: int, n_stages: int, n_microbatches: int
-                      ) -> List[Tuple[str, int]]:
-    """The 1F1B schedule as seen by one stage: ``[("F", mb), ...]``.
+def stage_virtual_chunks(stage: int, n_stages: int,
+                         n_virtual: int = 1) -> Tuple[int, ...]:
+    """Global chunk ids hosted by one stage actor: round-robin slabs
+    ``stage, stage+S, stage+2S, ...`` of the ``K = S*v`` virtual
+    stages (Megatron-style interleaving: chunk ``c`` lives on actor
+    ``c % S``, so chunk ``c``'s output always feeds the NEXT actor)."""
+    return tuple(range(stage, n_stages * n_virtual, n_stages))
 
-    Warmup forwards fill the pipe (``n_stages - 1 - stage`` of them —
+
+def _classic_1f1b(stage: int, n_stages: int, n_microbatches: int
+                  ) -> List[Tuple[str, int, int]]:
+    """The v=1 1F1B schedule as seen by one stage (chunk == stage):
+    warmup forwards fill the pipe (``n_stages - 1 - stage`` of them —
     the last stage has none), then the steady state alternates one
     forward with one backward, then the cooldown drains the remaining
-    backwards. Deterministic per (stage, n_stages, M): the driver and
-    the stage actor both derive it, so stream item *j* of stage *s*
-    IS operation ``order[j]`` — no tags ride the wire.
-    """
+    backwards."""
     m = n_microbatches
     warmup = min(n_stages - 1 - stage, m)
-    order = [("F", i) for i in range(warmup)]
+    order = [("F", i, stage) for i in range(warmup)]
     b = 0
     for f in range(warmup, m):
-        order.append(("F", f))
-        order.append(("B", b))
+        order.append(("F", f, stage))
+        order.append(("B", b, stage))
         b += 1
-    order.extend(("B", i) for i in range(b, m))
+    order.extend(("B", i, stage) for i in range(b, m))
     return order
+
+
+@functools.lru_cache(maxsize=256)
+def interleaved_orders(n_stages: int, n_microbatches: int,
+                       n_virtual: int
+                       ) -> Tuple[Tuple[Tuple[str, int, int], ...], ...]:
+    """Per-stage interleaved-1F1B op orders for a ``S x M x v`` grid,
+    as a tuple (stage-indexed) of op tuples ``(op, microbatch, chunk)``.
+
+    Built by a deterministic greedy tick simulation: at each tick every
+    stage runs at most one *runnable* op (an op whose producers
+    finished at a strictly earlier tick — one tick of transport
+    latency), preferring backwards over forwards (1F1B steady state)
+    and breaking ties with the Megatron-style group key ``(mb // S,
+    chunk, mb % S)`` so forwards sweep chunk groups of S microbatches.
+    The result is valid for ANY grid (no ``M % S`` constraint): the
+    simulation only ever schedules dependency-satisfied ops, and a
+    stage executing its list in order while blocking on mailboxes can
+    never deadlock (every op's producers appear at earlier ticks).
+    Deterministic in (S, M, v): the driver and every stage actor derive
+    the same lists, so stream item *j* of stage *s* IS operation
+    ``orders[s][j]`` — no tags ride the wire."""
+    S, M, v = n_stages, n_microbatches, n_virtual
+    K = S * v
+    done_f: Dict[Tuple[int, int], int] = {}
+    done_b: Dict[Tuple[int, int], int] = {}
+    orders: List[List[Tuple[str, int, int]]] = [[] for _ in range(S)]
+    total = 2 * M * K
+    scheduled, t = 0, 0
+    while scheduled < total:
+        picks = []
+        for s in range(S):
+            chunks = stage_virtual_chunks(s, S, v)
+            best = None
+            # backwards first: B(c, i) needs F(c, i) and B(c+1, i)
+            for c in chunks:
+                for i in range(M):
+                    if (c, i) in done_b:
+                        continue
+                    if done_f.get((c, i), t) >= t:
+                        continue
+                    if c < K - 1 and done_b.get((c + 1, i), t) >= t:
+                        continue
+                    key = ("B", i // S, K - 1 - c, i % S)
+                    if best is None or key < best[0]:
+                        best = (key, ("B", i, c))
+            if best is None:
+                # forwards: F(c, i) needs F(c-1, i)
+                for c in chunks:
+                    for i in range(M):
+                        if (c, i) in done_f:
+                            continue
+                        if c > 0 and done_f.get((c - 1, i), t) >= t:
+                            continue
+                        key = ("F", i // S, c, i % S)
+                        if best is None or key < best[0]:
+                            best = (key, ("F", i, c))
+            if best is not None:
+                picks.append((s, best[1]))
+        for s, (op, i, c) in picks:
+            orders[s].append((op, i, c))
+            (done_f if op == "F" else done_b)[(c, i)] = t
+            scheduled += 1
+        t += 1
+    return tuple(tuple(o) for o in orders)
+
+
+def one_f_one_b_order(stage: int, n_stages: int, n_microbatches: int,
+                      n_virtual: int = 1) -> List[Tuple[str, int, int]]:
+    """One stage's pipeline-step op order: ``[(op, microbatch, chunk),
+    ...]`` with op "F"/"B" and ``chunk`` the global virtual-stage id.
+
+    ``n_virtual == 1`` is the classic 1F1B schedule (chunk == stage);
+    ``n_virtual > 1`` interleaves the stage's round-robin chunks via
+    the deterministic greedy simulation in :func:`interleaved_orders`,
+    cutting warmup/cooldown idle by the virtual-stage factor."""
+    if n_virtual <= 1:
+        return _classic_1f1b(stage, n_stages, n_microbatches)
+    return list(interleaved_orders(n_stages, n_microbatches,
+                                   n_virtual)[stage])
+
+
+def analytic_bubble(n_stages: int, n_microbatches: int,
+                    n_virtual: int = 1) -> float:
+    """The analytic pipeline-bubble fraction with interleaved virtual
+    stages, ``(S-1)/(v*M+S-1)``: warmup and cooldown are paid in
+    CHUNK-sized quanta (1/v of a full stage visit), so the idle share
+    of each device's timeline shrinks by the virtual-stage factor
+    (arXiv:2412.14374; Megatron interleaved 1F1B)."""
+    s, m, v = n_stages, n_microbatches, n_virtual
+    return (s - 1) / (v * m + s - 1)
 
 
 def analytic_gpipe_bubble(n_stages: int, n_microbatches: int) -> float:
@@ -85,8 +205,7 @@ def analytic_gpipe_bubble(n_stages: int, n_microbatches: int) -> float:
     of each device's timeline spent idle when M microbatches flow
     through S stages with a full flush between steps. 1F1B has the
     same bubble in steady state; its win is activation memory."""
-    s, m = n_stages, n_microbatches
-    return (s - 1) / (m + s - 1)
+    return analytic_bubble(n_stages, n_microbatches, 1)
 
 
 def _recorder():
@@ -99,32 +218,151 @@ def _recorder():
         return None
 
 
+def _default_stage_optimizer(learning_rate: float, weight_decay: float):
+    """The per-stage optimizer matching ``models.training``'s default
+    MINUS the global-norm clip — clipping needs the cross-stage norm,
+    so the driver reduces per-stage squared norms and every stage
+    applies the same scale inside its fused opt program."""
+    import optax
+    return optax.adamw(learning_rate, b1=0.9, b2=0.95, eps=1e-8,
+                       weight_decay=weight_decay)
+
+
+# --------------------------------------------------------- checkpoints
+def _map_param_subtrees(tree, params_treedef, fn):
+    """Apply ``fn`` to every subtree of ``tree`` whose structure equals
+    ``params_treedef`` (the stage's ``{chunk: param_tree}`` layout),
+    passing other leaves through — the trick ``models.training`` uses
+    to find param-shaped subtrees (Adam moments) inside an arbitrary
+    optax state."""
+    import jax
+
+    def is_p(x):
+        try:
+            return jax.tree.structure(x) == params_treedef
+        except Exception:
+            return False
+
+    return jax.tree.map(lambda sub: fn(sub) if is_p(sub) else sub,
+                        tree, is_leaf=is_p)
+
+
+def _collect_param_subtrees(tree, params_treedef) -> List[Any]:
+    out: List[Any] = []
+    _map_param_subtrees(tree, params_treedef,
+                        lambda sub: (out.append(sub), sub)[1])
+    return out
+
+
+def merge_stage_checkpoints(config, parts: Sequence[Dict]) -> Dict:
+    """Reassemble per-stage checkpoints (from
+    :meth:`PipelineStage.stage_checkpoint`) into the canonical
+    single-program train state ``{"params", "opt_state", "step"}`` —
+    the exact pytree layout ``make_train_step(optimizer=<same
+    optimizer>)`` builds, so the pipeline checkpoint round-trips
+    against the single-program one. Param-shaped subtrees inside the
+    optax state (Adam mu/nu) are found by treedef match and merged
+    chunk-wise; counters are taken from stage 0 (identical across
+    stages by construction)."""
+    import jax
+
+    from ray_tpu.models.transformer import merge_stage_params
+
+    parts = sorted(parts, key=lambda p: p["stage"])
+    chunks: Dict[int, Any] = {}
+    for p in parts:
+        chunks.update(p["chunks"])
+    out: Dict[str, Any] = {
+        "params": merge_stage_params(config, chunks),
+        "step": parts[0].get("step", 0),
+    }
+    if parts[0].get("opt_state") is not None:
+        per_stage = [
+            _collect_param_subtrees(p["opt_state"],
+                                    jax.tree.structure(p["chunks"]))
+            for p in parts]
+        counts = {len(s) for s in per_stage}
+        if len(counts) != 1:
+            raise ValueError(
+                f"stage opt states disagree on param-subtree count: "
+                f"{sorted(counts)}")
+        merged = []
+        for j in range(counts.pop()):
+            union: Dict[int, Any] = {}
+            for s in per_stage:
+                union.update(s[j])
+            merged.append(merge_stage_params(config, union))
+        it = iter(merged)
+        out["opt_state"] = _map_param_subtrees(
+            parts[0]["opt_state"],
+            jax.tree.structure(parts[0]["chunks"]), lambda _: next(it))
+    return out
+
+
+def split_train_state(config, state: Dict, n_stages: int,
+                      n_virtual: int = 1) -> List[Dict]:
+    """Slice a canonical train state into per-stage load parts for any
+    ``(n_stages, n_virtual)`` — the reload target need not match the
+    layout the checkpoint was saved under. Inverse of
+    :func:`merge_stage_checkpoints` (chunk slices of the stacked layer
+    leaves are views of the same weights)."""
+    import jax
+
+    from ray_tpu.models.transformer import stage_slice_params
+
+    K = n_stages * n_virtual
+    full_td = jax.tree.structure(state["params"])
+
+    def slice_for(s):
+        chs = stage_virtual_chunks(s, n_stages, n_virtual)
+        part: Dict[str, Any] = {
+            "params": {c: stage_slice_params(config, state["params"],
+                                             c, K) for c in chs},
+            "step": state.get("step", 0),
+        }
+        if state.get("opt_state") is not None:
+            part["opt_state"] = _map_param_subtrees(
+                state["opt_state"], full_td,
+                lambda sub: {c: stage_slice_params(config, sub, c, K)
+                             for c in chs})
+        return part
+
+    return [slice_for(s) for s in range(n_stages)]
+
+
 class PipelineStage:
     """One pipeline stage, hosted in its own actor process.
 
-    Holds the stage's parameter slice on its pinned device and the two
-    jitted programs (forward-with-vjp, backward-from-saved-residuals).
-    Activations and gradients arrive through mailboxes
-    (:meth:`put_activation` / :meth:`put_grad` / :meth:`put_targets` —
-    tiny actor calls whose object args are pulled worker-to-worker),
-    and one streaming :meth:`run` call per step yields the stage's
-    per-microbatch outputs in its 1F1B order.
+    Holds the stage's ``n_virtual`` parameter chunks on its pinned
+    device, the per-chunk-role jitted forward programs, the shared
+    backward program (backward-from-saved-residuals), and — with
+    ``train=True`` — the fused optimizer program plus resident optax
+    state. Activations and gradients arrive through mailboxes keyed by
+    ``(chunk, microbatch)`` (:meth:`put_activation` / :meth:`put_grad`
+    / :meth:`put_targets` — tiny actor calls whose object args are
+    pulled worker-to-worker), and one streaming :meth:`run` call per
+    step yields the stage's per-op outputs in its deterministic
+    interleaved-1F1B order.
 
     Run with ``max_concurrency >= 2``: ``run`` blocks on mailboxes
     while the feed calls execute on sibling threads.
     """
 
-    #: seconds a mailbox take may starve before the stage fails typed
-    #: (a dead neighbor must surface as an error, never a hang)
-    TAKE_TIMEOUT_S = 120.0
-
     def __init__(self, config, stage: int, n_stages: int, seed: int = 0,
                  device_index: Optional[int] = None,
-                 remat_policy: Optional[str] = None):
+                 remat_policy: Optional[str] = None,
+                 n_virtual: int = 1,
+                 train: bool = False,
+                 learning_rate: float = 1e-5,
+                 weight_decay: float = 0.0,
+                 clip_norm: Optional[float] = 1.0,
+                 optimizer_factory=None,
+                 mailbox_deadline_s: Optional[float] = None):
         import threading
 
         import jax
 
+        from ray_tpu.core.config import get_config
         from ray_tpu.models.transformer import (
             init_params, stage_slice_params)
 
@@ -134,6 +372,15 @@ class PipelineStage:
         self.config = config
         self.stage = stage
         self.n_stages = n_stages
+        self.n_virtual = n_virtual
+        self.n_chunks = n_stages * n_virtual
+        self.chunks = stage_virtual_chunks(stage, n_stages, n_virtual)
+        #: seconds a mailbox take may starve before the stage fails
+        #: typed (a dead neighbor must surface as an error, never a
+        #: hang) — config.pipeline_mailbox_deadline_s unless overridden
+        self.mailbox_deadline_s = float(
+            mailbox_deadline_s if mailbox_deadline_s is not None
+            else get_config().pipeline_mailbox_deadline_s)
         devices = jax.devices()
         self.device = devices[(stage if device_index is None
                                else device_index) % len(devices)]
@@ -141,20 +388,37 @@ class PipelineStage:
         # are bit-identical to the single-program model's (parity is a
         # slicing invariant, not a tolerance)
         params = init_params(config, jax.random.PRNGKey(seed))
-        self.params = jax.device_put(
-            stage_slice_params(config, params, stage, n_stages),
-            self.device)
+        self.params = {
+            c: jax.device_put(
+                stage_slice_params(config, params, c, self.n_chunks),
+                self.device)
+            for c in self.chunks}
         del params
-        self._fwd, self._bwd, self._acc = self._build_programs()
+        self._build_programs()
+        self.optimizer = None
+        self.opt_state = None
+        self.clip_norm = clip_norm
+        if train:
+            factory = optimizer_factory or _default_stage_optimizer
+            self.optimizer = factory(learning_rate, weight_decay)
+            self.opt_state = jax.device_put(
+                self.optimizer.init(self.params), self.device)
+            self._build_opt_program()
+        self._step_count = 0
         self._cond = threading.Condition()
-        self._acts: Dict[int, Any] = {}
-        self._grads_in: Dict[int, Any] = {}
+        self._acts: Dict[Tuple[int, int], Any] = {}
+        self._grads_in: Dict[Tuple[int, int], Any] = {}
         self._targets: Dict[int, Any] = {}
         self._abort = False
-        self._vjps: Dict[int, Any] = {}
-        self.grads = None
-        self._stats = {"busy_s": 0.0, "idle_s": 0.0, "fwd_s": 0.0,
-                       "bwd_s": 0.0, "ops": 0, "span_s": 0.0}
+        self._vjps: Dict[Tuple[int, int], Any] = {}
+        self._grads: Dict[int, Any] = {}
+        self._sqn = None
+        self._stats = self._fresh_stats()
+
+    @staticmethod
+    def _fresh_stats() -> Dict[str, float]:
+        return {"busy_s": 0.0, "idle_s": 0.0, "fwd_s": 0.0,
+                "bwd_s": 0.0, "opt_s": 0.0, "ops": 0, "span_s": 0.0}
 
     # ------------------------------------------------------- programs
     def _build_programs(self):
@@ -163,39 +427,97 @@ class PipelineStage:
 
         from ray_tpu.models.transformer import stage_forward, stage_loss
 
-        c, s, n = self.config, self.stage, self.n_stages
-        last = s == n - 1
-
-        if s == 0:
+        c, K = self.config, self.n_chunks
+        progs: Dict[str, Any] = {}
+        if 0 in self.chunks:
             # token ids are int32: differentiate wrt params only
-            def fwd(p, x):
-                return jax.vjp(lambda q: stage_forward(c, s, n, q, x), p)
-        elif last:
-            def fwd(p, x, ids, mask):
+            def fwd_first(p, x):
+                return jax.vjp(lambda q: stage_forward(c, 0, K, q, x), p)
+            progs["first"] = jax.jit(fwd_first)
+        if K - 1 in self.chunks:
+            def fwd_loss(p, x, ids, mask):
                 def f(q, xx):
-                    h = stage_forward(c, s, n, q, xx)
+                    h = stage_forward(c, K - 1, K, q, xx)
                     return stage_loss(c, q, h, ids, mask)[0]
                 return jax.vjp(f, p, x)
-        else:
-            def fwd(p, x):
+            progs["loss"] = jax.jit(fwd_loss)
+        if any(0 < ch < K - 1 for ch in self.chunks):
+            # any middle chunk: same program, retraced per param shape
+            def fwd_mid(p, x):
                 return jax.vjp(
-                    lambda q, xx: stage_forward(c, s, n, q, xx), p, x)
-
+                    lambda q, xx: stage_forward(c, 1, K, q, xx), p, x)
+            progs["mid"] = jax.jit(fwd_mid)
         # device pinning rides the params: they are committed to
-        # self.device, so jit places every stage program there
-        return (jax.jit(fwd),
-                jax.jit(lambda vjp, g: vjp(g)),
-                jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b)))
+        # self.device, so jit places every stage program there. The
+        # grad accumulator donates the OLD accumulator buffer (CPU
+        # doesn't support donation — skip it there to avoid a
+        # per-compile warning; the arithmetic is identical).
+        self._donate = jax.default_backend() != "cpu"
+        self._fwd_progs = progs
+        self._bwd = jax.jit(lambda vjp, g: vjp(g))
+        self._acc = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b),
+                            donate_argnums=(0,) if self._donate else ())
+
+    def _fwd_for(self, chunk: int):
+        if chunk == 0:
+            return self._fwd_progs["first"]
+        if chunk == self.n_chunks - 1:
+            return self._fwd_progs["loss"]
+        return self._fwd_progs["mid"]
+
+    def _build_opt_program(self):
+        """The fused per-stage optimizer step: clip-scale the
+        accumulated grads by the DRIVER-reduced global norm, run the
+        optax update on this stage's param slice, apply it — params,
+        opt state and grads all donated, so the update is in-place on
+        the stage and nothing heavier than a scalar ever crosses the
+        driver."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        clip = self.clip_norm
+        optimizer = self.optimizer
+
+        def opt_step(params, opt_state, grads, global_sq_norm):
+            if clip is not None:
+                gn = jnp.sqrt(global_sq_norm.astype(jnp.float32))
+                # exactly optax.clip_by_global_norm's select, with the
+                # cross-stage norm in place of the local one
+                scale = jnp.where(gn < clip, 1.0, clip / gn)
+                grads = jax.tree.map(lambda g: g * scale, grads)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt
+
+        self._opt_prog = jax.jit(
+            opt_step,
+            donate_argnums=(0, 1, 2) if self._donate else ())
 
     # ------------------------------------------------------- mailboxes
-    def put_activation(self, i: int, x) -> None:
+    def feed(self, acts=None, grads=None, targets=None) -> None:
+        """Batched mailbox fill: the driver front-loads a whole step's
+        token microbatches, targets and loss seeds in ONE actor call
+        per stage (``acts``/``grads`` keyed ``(chunk, mb)``,
+        ``targets`` keyed ``mb``) instead of 3M unary puts — on a
+        busy box the per-call overhead is the pipeline's fixed tax."""
         with self._cond:
-            self._acts[i] = x
+            if acts:
+                self._acts.update(acts)
+            if grads:
+                self._grads_in.update(grads)
+            if targets:
+                self._targets.update(targets)
             self._cond.notify_all()
 
-    def put_grad(self, i: int, g) -> None:
+    def put_activation(self, chunk: int, i: int, x) -> None:
         with self._cond:
-            self._grads_in[i] = g
+            self._acts[(chunk, i)] = x
+            self._cond.notify_all()
+
+    def put_grad(self, chunk: int, i: int, g) -> None:
+        with self._cond:
+            self._grads_in[(chunk, i)] = g
             self._cond.notify_all()
 
     def put_targets(self, i: int, input_ids, loss_mask=None) -> None:
@@ -212,80 +534,83 @@ class PipelineStage:
             self._abort = True
             self._cond.notify_all()
 
-    def _take(self, box: Dict[int, Any], i: int):
-        deadline = time.monotonic() + self.TAKE_TIMEOUT_S
+    def _take(self, box: Dict, key):
+        deadline = time.monotonic() + self.mailbox_deadline_s
         with self._cond:
-            while i not in box:
+            while key not in box:
                 if self._abort:
                     raise RuntimeError(
                         f"stage {self.stage} aborted waiting for "
-                        f"microbatch {i}")
+                        f"{key}")
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"stage {self.stage} starved waiting for "
-                        f"microbatch {i} (neighbor stage dead?)")
+                        f"{key} beyond pipeline_mailbox_deadline_s="
+                        f"{self.mailbox_deadline_s} (neighbor stage "
+                        f"dead?)")
                 self._cond.wait(0.1)
-            return box.pop(i)
+            return box.pop(key)
 
     # ------------------------------------------------------------ step
     def run(self, n_microbatches: int):
         """One pipeline step as a streaming generator: walks this
-        stage's 1F1B order, blocking on the mailbox each op needs,
-        and yields the op's output as its own stream item — the
-        activation (F, non-last), the (loss, n_tokens) pair (F, last),
-        the upstream input-gradient (B, stage > 0) or the op duration
-        (B, stage 0). Records a ``STAGE_TICK`` span per compute AND
-        per idle interval: the timeline shows the bubbles."""
+        stage's (interleaved) 1F1B order, blocking on the mailbox each
+        op needs, and yields the op's output as its own stream item —
+        the activation (F, non-last chunk), the (loss, n_tokens) pair
+        (F, last chunk), the upstream input-gradient (B, chunk > 0) or
+        the op duration (B, chunk 0). Records a ``STAGE_TICK`` span
+        per compute AND per idle interval, labelled with phase and
+        virtual-stage index: the timeline shows the bubbles."""
         import jax
 
         rec = _recorder()
-        last = self.stage == self.n_stages - 1
-        self._stats = {"busy_s": 0.0, "idle_s": 0.0, "fwd_s": 0.0,
-                       "bwd_s": 0.0, "ops": 0, "span_s": 0.0}
+        K = self.n_chunks
+        self._stats = self._fresh_stats()
         with self._cond:
             self._abort = False
         self._vjps.clear()
-        self.grads = None
+        self._grads = {}
         t_start = time.perf_counter()
-        for op, i in one_f_one_b_order(self.stage, self.n_stages,
-                                       n_microbatches):
+        for op, i, ch in one_f_one_b_order(
+                self.stage, self.n_stages, n_microbatches,
+                self.n_virtual):
             t_wait = time.perf_counter()
             if op == "F":
-                x = self._take(self._acts, i)
-                tgt = self._take(self._targets, i) if last else None
+                x = self._take(self._acts, (ch, i))
+                tgt = self._take(self._targets, i) if ch == K - 1 \
+                    else None
             else:
-                g = self._take(self._grads_in, i)
+                g = self._take(self._grads_in, (ch, i))
             idle = time.perf_counter() - t_wait
             if rec is not None and idle > 1e-4:
-                rec.record("STAGE_TICK", stage=self.stage, mb=i,
+                rec.record("STAGE_TICK", stage=self.stage, mb=i, vs=ch,
                            phase="idle", dur_s=round(idle, 6))
             t0 = time.perf_counter()
             if op == "F":
-                if self.stage == 0:
-                    out, vjp = self._fwd(self.params, x)
-                elif last:
+                if ch == K - 1:
                     import jax.numpy as jnp
                     ids, mask = tgt
                     if mask is None:
                         mask = jnp.ones_like(ids, dtype=jnp.float32)
-                    loss, vjp = self._fwd(self.params, x, ids, mask)
+                    loss, vjp = self._fwd_for(ch)(
+                        self.params[ch], x, ids, mask)
                     n = float(jnp.sum(mask[:, 1:]))
-                    out = {"loss": float(loss), "n_tokens": n}
+                    out: Any = {"loss": float(loss), "n_tokens": n}
                 else:
-                    out, vjp = self._fwd(self.params, x)
+                    out, vjp = self._fwd_for(ch)(self.params[ch], x)
                 if not isinstance(out, dict):
                     jax.block_until_ready(out)
-                self._vjps[i] = vjp
+                self._vjps[(ch, i)] = vjp
             else:
-                parts = self._bwd(self._vjps.pop(i), g)
+                parts = self._bwd(self._vjps.pop((ch, i)), g)
                 gp = parts[0]
-                out = parts[1] if self.stage > 0 else None
-                self.grads = gp if self.grads is None \
-                    else self._acc(self.grads, gp)
+                out = parts[1] if ch > 0 else None
+                self._grads[ch] = gp if self._grads.get(ch) is None \
+                    else self._acc(self._grads[ch], gp)
                 if out is not None:
                     jax.block_until_ready(out)
                 else:
-                    jax.block_until_ready(self.grads)
+                    jax.block_until_ready(self._grads[ch])
             dur = time.perf_counter() - t0
             st = self._stats
             st["busy_s"] += dur
@@ -293,65 +618,166 @@ class PipelineStage:
             st["fwd_s" if op == "F" else "bwd_s"] += dur
             st["ops"] += 1
             if rec is not None:
-                rec.record("STAGE_TICK", stage=self.stage, mb=i,
+                rec.record("STAGE_TICK", stage=self.stage, mb=i, vs=ch,
                            phase="forward" if op == "F" else "backward",
                            dur_s=round(dur, 6))
                 rec.maybe_flush()
             yield out if out is not None else {"dur_s": dur}
         self._stats["span_s"] = time.perf_counter() - t_start
 
+    # ------------------------------------------- fused optimizer step
+    def grad_sq_norm(self) -> float:
+        """Squared L2 norm of this stage's accumulated grads — the
+        stage's contribution to the global clip norm (a single f32
+        scalar; the only gradient-derived value that ever reaches the
+        driver in train mode)."""
+        import jax
+        import jax.numpy as jnp
+
+        missing = [c for c in self.chunks if self._grads.get(c) is None]
+        if missing:
+            raise RuntimeError(
+                f"stage {self.stage}: no accumulated grads for chunks "
+                f"{missing} (run a step first)")
+        if self._sqn is None:
+            self._sqn = jax.jit(lambda g: sum(
+                jnp.sum(jnp.square(x.astype(jnp.float32)))
+                for x in jax.tree.leaves(g)))
+        return float(self._sqn(dict(self._grads)))
+
+    def apply_opt(self, global_sq_norm: float) -> Dict[str, float]:
+        """The per-stage fused optimizer step: one jitted program
+        (clip-scale + optax update + apply, donated buffers) over the
+        stage's accumulated grads. Grads/params/opt-state never leave
+        the actor; returns only scalar metrics."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.optimizer is None:
+            raise RuntimeError("stage built with train=False has no "
+                               "optimizer (pass train=True)")
+        missing = [c for c in self.chunks if self._grads.get(c) is None]
+        if missing:
+            raise RuntimeError(
+                f"stage {self.stage}: no accumulated grads for chunks "
+                f"{missing} (run a step first)")
+        t0 = time.perf_counter()
+        grads = {c: self._grads[c] for c in self.chunks}
+        self.params, self.opt_state = self._opt_prog(
+            self.params, self.opt_state, grads,
+            jnp.float32(global_sq_norm))
+        jax.block_until_ready(self.params)
+        self._grads = {}
+        self._step_count += 1
+        dur = time.perf_counter() - t0
+        st = self._stats
+        st["busy_s"] += dur
+        st["opt_s"] += dur
+        rec = _recorder()
+        if rec is not None:
+            rec.record("STAGE_TICK", stage=self.stage, phase="opt",
+                       dur_s=round(dur, 6))
+            rec.maybe_flush()
+        return {"grad_norm": float(global_sq_norm) ** 0.5,
+                "opt_s": dur, "step": self._step_count}
+
+    # ----------------------------------------------------- checkpoint
+    def stage_checkpoint(self) -> Dict[str, Any]:
+        """Host copy of the stage's train state, keyed by global chunk
+        id — :func:`merge_stage_checkpoints` reassembles the canonical
+        single-program layout from all stages' parts."""
+        import numpy as np
+
+        import jax
+
+        host = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
+        part: Dict[str, Any] = {
+            "stage": self.stage,
+            "n_stages": self.n_stages,
+            "n_virtual": self.n_virtual,
+            "chunks": {c: host(p) for c, p in self.params.items()},
+            "opt_state": (host(self.opt_state)
+                          if self.opt_state is not None else None),
+            "step": self._step_count,
+        }
+        return part
+
+    def load_state(self, part: Dict[str, Any]) -> None:
+        """Load one part from :func:`split_train_state` (params keyed
+        by this stage's chunk ids, opt state in the stage layout)."""
+        import jax
+
+        want = set(self.chunks)
+        got = set(part["params"])
+        if want != got:
+            raise ValueError(
+                f"stage {self.stage} hosts chunks {sorted(want)}, "
+                f"checkpoint part carries {sorted(got)}")
+        self.params = jax.device_put(
+            {int(c): p for c, p in part["params"].items()}, self.device)
+        if part.get("opt_state") is not None:
+            if self.optimizer is None:
+                raise RuntimeError("cannot load optimizer state into a "
+                                   "train=False stage")
+            self.opt_state = jax.device_put(part["opt_state"],
+                                            self.device)
+        self._step_count = int(part.get("step", 0))
+
     # ------------------------------------- serial (unpipelined) path
-    def forward_one(self, i: int, x, input_ids=None, loss_mask=None):
-        """Unary forward for the serial stage-by-stage baseline: same
-        jitted program, no mailbox, one microbatch per call."""
+    def forward_one(self, chunk: int, i: int, x, input_ids=None,
+                    loss_mask=None):
+        """Unary forward for the serial chunk-by-chunk baseline: same
+        jitted programs, no mailbox, one (chunk, microbatch) per
+        call."""
         import jax
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
-        if self.stage == self.n_stages - 1 and self.stage > 0:
+        if chunk == self.n_chunks - 1 and chunk > 0:
             if loss_mask is None:
                 loss_mask = jnp.ones_like(input_ids, dtype=jnp.float32)
-            out, vjp = self._fwd(self.params, x, input_ids, loss_mask)
+            out, vjp = self._fwd_for(chunk)(
+                self.params[chunk], x, input_ids, loss_mask)
             n = float(jnp.sum(loss_mask[:, 1:]))
             res: Any = {"loss": float(out), "n_tokens": n}
         else:
-            out, vjp = self._fwd(self.params, x)
+            out, vjp = self._fwd_for(chunk)(self.params[chunk], x)
             jax.block_until_ready(out)
             res = out
-        self._vjps[i] = vjp
-        self._tick("forward", i, time.perf_counter() - t0)
+        self._vjps[(chunk, i)] = vjp
+        self._tick("forward", i, chunk, time.perf_counter() - t0)
         return res
 
-    def backward_one(self, i: int, g):
+    def backward_one(self, chunk: int, i: int, g):
         t0 = time.perf_counter()
-        parts = self._bwd(self._vjps.pop(i), g)
+        parts = self._bwd(self._vjps.pop((chunk, i)), g)
         gp = parts[0]
-        out = parts[1] if self.stage > 0 else None
-        self.grads = gp if self.grads is None else self._acc(self.grads,
-                                                             gp)
+        out = parts[1] if chunk > 0 else None
+        self._grads[chunk] = gp if self._grads.get(chunk) is None \
+            else self._acc(self._grads[chunk], gp)
         import jax
-        jax.block_until_ready(out if out is not None else self.grads)
-        self._tick("backward", i, time.perf_counter() - t0)
+        jax.block_until_ready(out if out is not None
+                              else self._grads[chunk])
+        self._tick("backward", i, chunk, time.perf_counter() - t0)
         return out
 
-    def _tick(self, phase: str, i: int, dur: float) -> None:
+    def _tick(self, phase: str, i: int, chunk: int, dur: float) -> None:
         st = self._stats
         st["busy_s"] += dur
         st[("fwd_s" if phase == "forward" else "bwd_s")] += dur
         st["ops"] += 1
         rec = _recorder()
         if rec is not None:
-            rec.record("STAGE_TICK", stage=self.stage, mb=i, phase=phase,
-                       dur_s=round(dur, 6))
+            rec.record("STAGE_TICK", stage=self.stage, mb=i, vs=chunk,
+                       phase=phase, dur_s=round(dur, 6))
             rec.maybe_flush()
 
     def reset_step(self) -> None:
         """Serial-path step reset (the streaming ``run`` resets
         itself)."""
         self._vjps.clear()
-        self.grads = None
-        self._stats = {"busy_s": 0.0, "idle_s": 0.0, "fwd_s": 0.0,
-                       "bwd_s": 0.0, "ops": 0, "span_s": 0.0}
+        self._grads = {}
+        self._stats = self._fresh_stats()
         self._t_reset = time.perf_counter()
 
     # ------------------------------------------------------- queries
@@ -361,14 +787,18 @@ class PipelineStage:
             st["span_s"] = time.perf_counter() - self._t_reset
         st["device"] = str(self.device)
         st["stage"] = self.stage
+        st["chunks"] = list(self.chunks)
         return st
 
     def get_grads(self):
-        """Host copy of the accumulated stage-parameter gradients."""
+        """Host copy of the accumulated parameter gradients, keyed by
+        global chunk id (legacy fwd+bwd mode — in train mode grads are
+        consumed in-actor by :meth:`apply_opt`)."""
         import numpy as np
 
         import jax
-        return jax.tree.map(np.asarray, self.grads)
+        return {c: jax.tree.map(np.asarray, g)
+                for c, g in self._grads.items()}
 
     def ping(self) -> int:
         return self.stage
@@ -383,6 +813,10 @@ class PipelineStepResult:
     #: per-stage step_stats dicts
     stage_stats: List[Dict[str, float]]
     wall_s: float
+    #: global gradient norm (train mode; None for fwd+bwd steps)
+    grad_norm: Optional[float] = None
+    #: optimizer step count after this step (train mode)
+    step: Optional[int] = None
 
     @property
     def bubble_fraction(self) -> float:
@@ -396,19 +830,32 @@ class PipelineStepResult:
 
 
 class MPMDPipeline:
-    """Driver-side 1F1B scheduler over :class:`PipelineStage` actors.
+    """Driver-side interleaved-1F1B scheduler over
+    :class:`PipelineStage` actors.
 
     ``step(batch)`` splits the batch into ``n_microbatches`` along the
-    batch axis, feeds stage 0's token microbatches / the last stage's
+    batch axis, feeds chunk 0's token microbatches / the last chunk's
     targets and loss seeds, launches one streaming ``run`` per stage,
-    and routes items (by ref) between neighbors as
+    and routes items (by ref) between neighbor chunks as
     ``streaming.wait_any`` reports them ready. The combined loss is
     the token-weighted mean of the per-microbatch losses — exactly the
     single-program ``lm_loss`` of the full batch.
 
-    ``serial=True`` drives the same actors microbatch-by-microbatch
-    with unary calls and full barriers — the no-overlap baseline the
-    measured bubble fraction is compared against.
+    ``n_virtual > 1`` hosts that many round-robin virtual stage chunks
+    per actor and drives the interleaved schedule — analytic bubble
+    ``(S-1)/(v*M+S-1)``.
+
+    ``train=True`` makes ``step`` a full train step: after the streams
+    drain, the driver reduces the per-stage squared grad norms (one
+    scalar per stage), then every stage runs its fused optimizer
+    program concurrently — gradients, parameters and optimizer state
+    never transit the driver. ``save_checkpoint()`` /
+    ``load_checkpoint()`` move the canonical single-program state
+    layout in and out (any ``n_virtual``).
+
+    ``serial=True`` drives the same actors chunk-by-chunk with unary
+    calls and full barriers — the no-overlap baseline the measured
+    bubble fraction is compared against.
     """
 
     def __init__(self, config, n_stages: int = 2,
@@ -416,24 +863,50 @@ class MPMDPipeline:
                  serial: bool = False,
                  step_timeout_s: float = 300.0,
                  actor_options: Optional[Dict[str, Any]] = None,
-                 remat_policies: Optional[Sequence[Optional[str]]] = None):
+                 remat_policies: Optional[Sequence[Optional[str]]] = None,
+                 n_virtual: int = 1,
+                 train: bool = False,
+                 learning_rate: float = 1e-5,
+                 weight_decay: float = 0.0,
+                 clip_norm: Optional[float] = 1.0,
+                 optimizer_factory=None,
+                 mailbox_deadline_s: Optional[float] = None):
         import ray_tpu
+        from ray_tpu.core.config import get_config
 
         if n_stages < 2:
             raise ValueError("MPMDPipeline needs n_stages >= 2 "
                              "(use the plain train step otherwise)")
+        if n_virtual < 1:
+            raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
+        if n_stages * n_virtual > config.n_layers:
+            raise ValueError(
+                f"n_stages*n_virtual = {n_stages * n_virtual} virtual "
+                f"stages need at least that many layers, model has "
+                f"{config.n_layers}")
         self.config = config
         self.n_stages = n_stages
         self.n_microbatches = n_microbatches
+        self.n_virtual = n_virtual
+        self.n_chunks = n_stages * n_virtual
         self.serial = serial
+        self.train = train
         self.step_timeout_s = step_timeout_s
+        # resolve the mailbox deadline on the DRIVER (its config sees
+        # _system_config overrides) and ship the value to every stage
+        deadline = (mailbox_deadline_s if mailbox_deadline_s is not None
+                    else get_config().pipeline_mailbox_deadline_s)
         opts = {"max_concurrency": 4, "max_restarts": 0}
         opts.update(actor_options or {})
         cls = ray_tpu.remote(**opts)(PipelineStage)
         policies = remat_policies or [None] * n_stages
         self.stages = [
             cls.remote(config, s, n_stages, seed=seed, device_index=s,
-                       remat_policy=policies[s])
+                       remat_policy=policies[s], n_virtual=n_virtual,
+                       train=train, learning_rate=learning_rate,
+                       weight_decay=weight_decay, clip_norm=clip_norm,
+                       optimizer_factory=optimizer_factory,
+                       mailbox_deadline_s=deadline)
             for s in range(n_stages)]
         ray_tpu.get([a.ping.remote() for a in self.stages], timeout=300)
 
@@ -451,7 +924,7 @@ class MPMDPipeline:
         ids_mb = np.split(ids, m)
         mask_mb = np.split(mask, m) if mask is not None else [None] * m
         # per-microbatch label-token counts — known to the driver
-        # without running the model, so the last stage's backward seeds
+        # without running the model, so the last chunk's backward seeds
         # (d total / d loss_i = n_i / N) can be fed up front
         ns = [float(mk[:, 1:].sum()) if mk is not None
               else float(i.shape[0] * (i.shape[1] - 1))
@@ -462,32 +935,51 @@ class MPMDPipeline:
         return (self._step_serial if self.serial
                 else self._step_1f1b)(batch)
 
+    def _opt_tail(self) -> Tuple[Optional[float], Optional[int]]:
+        """Train-mode tail after the backwards drain: reduce the
+        per-stage squared grad norms (scalars), fan the global value
+        back out, and run every stage's fused optimizer step
+        concurrently. No gradient or parameter bytes through the
+        driver — the reduction is S floats each way."""
+        import ray_tpu
+
+        if not self.train:
+            return None, None
+        sq = ray_tpu.get([a.grad_sq_norm.remote() for a in self.stages],
+                         timeout=self.step_timeout_s)
+        gsq = float(sum(sq))
+        mets = ray_tpu.get([a.apply_opt.remote(gsq)
+                            for a in self.stages],
+                           timeout=self.step_timeout_s)
+        return mets[0]["grad_norm"], mets[0]["step"]
+
     def _step_1f1b(self, batch: Dict[str, Any]) -> PipelineStepResult:
         import numpy as np
 
         import ray_tpu
         from ray_tpu.core import streaming
 
-        S, M = self.n_stages, self.n_microbatches
+        S, M, v = self.n_stages, self.n_microbatches, self.n_virtual
+        K = self.n_chunks
         ids_mb, mask_mb, ns = self._split(batch)
         total_n = sum(ns)
         t0 = time.perf_counter()
         hold = []  # keep routed refs alive until the step completes
-        for i in range(M):
-            hold.append(self.stages[0].put_activation.remote(
-                i, ids_mb[i]))
-            last = self.stages[-1]
-            if S > 1:
-                hold.append(last.put_targets.remote(
-                    i, ids_mb[i], mask_mb[i]))
-            # the loss cotangent: scalar n_i / N, feedable up front
-            hold.append(last.put_grad.remote(
-                i, np.float32(ns[i] / total_n)))
+        last = self.stages[-1]  # chunk K-1 lives on the last actor
+        # batched prefeed: stage 0's token microbatches in one call,
+        # the last stage's targets + loss cotangents (scalar n_i / N,
+        # known up front) in another — 2 actor calls instead of 3M
+        hold.append(self.stages[0].feed.remote(
+            acts={(0, i): ids_mb[i] for i in range(M)}))
+        hold.append(last.feed.remote(
+            targets={i: (ids_mb[i], mask_mb[i]) for i in range(M)},
+            grads={(K - 1, i): np.float32(ns[i] / total_n)
+                   for i in range(M)}))
         gens = [a.run.options(num_returns="streaming").remote(M)
                 for a in self.stages]
-        orders = [one_f_one_b_order(s, S, M) for s in range(S)]
+        orders = [one_f_one_b_order(s, S, M, v) for s in range(S)]
         cursors = [0] * S
-        losses: Dict[int, Tuple[float, float]] = {}
+        loss_refs: Dict[int, Any] = {}
         by_gen = {id(g): s for s, g in enumerate(gens)}
         active = list(gens)
         deadline = time.monotonic() + self.step_timeout_s
@@ -506,19 +998,25 @@ class MPMDPipeline:
                     except StopIteration:
                         active.remove(g)
                         continue
-                    op, i = orders[s][cursors[s]]
+                    op, i, ch = orders[s][cursors[s]]
                     cursors[s] += 1
-                    if op == "F" and s < S - 1:
+                    if op == "F" and ch < K - 1:
                         hold.append(
-                            self.stages[s + 1].put_activation.remote(
-                                i, ref))
+                            self.stages[(ch + 1) % S]
+                            .put_activation.remote(ch + 1, i, ref))
                     elif op == "F":
-                        item = ray_tpu.get(ref, timeout=60)
-                        losses[i] = (item["loss"], item["n_tokens"])
-                    elif op == "B" and s > 0:
-                        hold.append(self.stages[s - 1].put_grad.remote(
-                            i, ref))
+                        # tiny loss dicts: batch the gets after drain
+                        loss_refs[i] = ref
+                    elif op == "B" and ch > 0:
+                        hold.append(
+                            self.stages[(ch - 1) % S]
+                            .put_grad.remote(ch - 1, i, ref))
                     hold.append(ref)
+            items = ray_tpu.get([loss_refs[i] for i in range(M)],
+                                timeout=60)
+            losses = {i: (it["loss"], it["n_tokens"])
+                      for i, it in enumerate(items)}
+            grad_norm, opt_step = self._opt_tail()
         except BaseException:
             self._cleanup(gens)
             raise
@@ -529,17 +1027,18 @@ class MPMDPipeline:
         loss = sum(l * n for l, n in mb) / total_n
         return PipelineStepResult(
             loss=loss, n_tokens=total_n, microbatch_losses=mb,
-            stage_stats=stats, wall_s=wall)
+            stage_stats=stats, wall_s=wall, grad_norm=grad_norm,
+            step=opt_step)
 
     def _step_serial(self, batch: Dict[str, Any]) -> PipelineStepResult:
-        """No-overlap baseline: each microbatch walks every stage's
-        forward, then every stage's backward, with a full barrier per
+        """No-overlap baseline: each microbatch walks every chunk's
+        forward, then every chunk's backward, with a full barrier per
         call — what pipelining exists to beat."""
         import numpy as np
 
         import ray_tpu
 
-        S, M = self.n_stages, self.n_microbatches
+        S, M, K = self.n_stages, self.n_microbatches, self.n_chunks
         ids_mb, mask_mb, ns = self._split(batch)
         total_n = sum(ns)
         t0 = time.perf_counter()
@@ -548,25 +1047,52 @@ class MPMDPipeline:
         losses = []
         for i in range(M):
             act = ray_tpu.get(
-                self.stages[0].forward_one.remote(i, ids_mb[i]),
+                self.stages[0].forward_one.remote(0, i, ids_mb[i]),
                 timeout=self.step_timeout_s)
-            for s in range(1, S):
-                out = self.stages[s].forward_one.remote(
-                    i, act, ids_mb[i], mask_mb[i]) if s == S - 1 else \
-                    self.stages[s].forward_one.remote(i, act)
+            for ch in range(1, K):
+                actor = self.stages[ch % S]
+                out = actor.forward_one.remote(
+                    ch, i, act, ids_mb[i], mask_mb[i]) \
+                    if ch == K - 1 else \
+                    actor.forward_one.remote(ch, i, act)
                 act = ray_tpu.get(out, timeout=self.step_timeout_s)
             losses.append((act["loss"], act["n_tokens"]))
             g: Any = np.float32(ns[i] / total_n)
-            for s in range(S - 1, -1, -1):
-                g = ray_tpu.get(self.stages[s].backward_one.remote(i, g),
-                                timeout=self.step_timeout_s)
+            for ch in range(K - 1, -1, -1):
+                g = ray_tpu.get(
+                    self.stages[ch % S].backward_one.remote(ch, i, g),
+                    timeout=self.step_timeout_s)
+        grad_norm, opt_step = self._opt_tail()
         wall = time.perf_counter() - t0
         stats = ray_tpu.get(
             [a.step_stats.remote() for a in self.stages], timeout=60)
         loss = sum(l * n for l, n in losses) / total_n
         return PipelineStepResult(
             loss=loss, n_tokens=total_n, microbatch_losses=losses,
-            stage_stats=stats, wall_s=wall)
+            stage_stats=stats, wall_s=wall, grad_norm=grad_norm,
+            step=opt_step)
+
+    # ---------------------------------------------------- checkpoints
+    def save_checkpoint(self) -> Dict[str, Any]:
+        """Gather per-stage parts and merge them into the canonical
+        single-program ``{"params", "opt_state", "step"}`` layout
+        (checkpointing is an explicit call, not per-step traffic)."""
+        import ray_tpu
+        parts = ray_tpu.get(
+            [a.stage_checkpoint.remote() for a in self.stages],
+            timeout=self.step_timeout_s)
+        return merge_stage_checkpoints(self.config, parts)
+
+    def load_checkpoint(self, state: Dict[str, Any]) -> None:
+        """Load a canonical train state — saved from ANY
+        ``(n_stages, n_virtual)`` layout — into this pipeline."""
+        import ray_tpu
+        parts = split_train_state(self.config, state, self.n_stages,
+                                  self.n_virtual)
+        ray_tpu.get(
+            [a.load_state.remote(p)
+             for a, p in zip(self.stages, parts)],
+            timeout=self.step_timeout_s)
 
     # -------------------------------------------------------- cleanup
     def _cleanup(self, gens) -> None:
@@ -584,10 +1110,16 @@ class MPMDPipeline:
                 pass
 
     def grads(self, timeout: float = 120.0):
-        """Per-stage accumulated parameter-gradient trees (host)."""
+        """Per-stage accumulated parameter-gradient trees (host),
+        keyed by global chunk id; with ``n_virtual == 1`` each stage's
+        single chunk tree is returned bare (legacy shape)."""
         import ray_tpu
-        return ray_tpu.get([a.get_grads.remote() for a in self.stages],
-                           timeout=timeout)
+        parts = ray_tpu.get(
+            [a.get_grads.remote() for a in self.stages],
+            timeout=timeout)
+        if self.n_virtual == 1:
+            return [p[s] for s, p in enumerate(parts)]
+        return parts
 
     def shutdown(self) -> None:
         import ray_tpu

@@ -279,6 +279,88 @@ def test_mpmd_pipeline_midstage_kill_fails_typed_no_hang(
     pipe.shutdown()
 
 
+@pytest.mark.slow
+@pytest.mark.pipeline
+@pytest.mark.chaos
+def test_mpmd_pipeline_train_midstage_kill_fails_typed_no_hang(
+        ray_start_regular):
+    """Chaos regression (interleaved TRAIN pipeline + fault
+    tolerance): SIGKILL a seeded-random stage actor mid-train-step
+    (fwd+bwd+fused per-stage opt, v=2 interleaved). The driver must
+    surface a typed failure — not hang on the dead stage's stream, a
+    neighbor blocked in its mailbox, or the optimizer-tail scalar
+    reduction — drop all stream state (no leaked refs), and leave the
+    cluster usable. Seeded via RAY_TPU_CHAOS_SOAK_SEEDS so
+    tools/chaos_matrix.sh sweeps victim stage and kill timing."""
+    import random
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.core.global_state import global_worker
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    raw = os.environ.get("RAY_TPU_CHAOS_SOAK_SEEDS", "1101")
+    seed = int(raw.replace(",", " ").split()[0])
+    rng = random.Random(seed)
+    S = 3
+    victim = rng.randrange(0, S)
+    delay = rng.uniform(0.02, 0.3)
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=6, n_heads=2, head_dim=16,
+        d_ff=64, max_seq_len=32, rotary_dim=8, block_style="gptj",
+        dtype=jnp.float32, remat=False, ce_chunk_size=8)
+    batch = {"input_ids": np.zeros((6, 16), np.int32),
+             "loss_mask": np.ones((6, 16), np.float32)}
+    pipe = MPMDPipeline(cfg, n_stages=S, n_microbatches=3, seed=0,
+                        n_virtual=2, train=True, learning_rate=1e-3,
+                        step_timeout_s=60.0,
+                        mailbox_deadline_s=45.0)
+    pipe.step(batch)  # compile + one clean train step
+
+    killer = threading.Timer(
+        delay, lambda: ray_tpu.kill(pipe.stages[victim],
+                                    no_restart=True))
+    killer.start()
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as ei:
+        # keep stepping until the kill lands mid-step (steps are fast
+        # at this scale; the bound only exists to keep a regression
+        # from spinning forever)
+        for _ in range(200):
+            pipe.step(batch)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 90, (
+        f"driver hung for {elapsed:.0f}s (seed={seed}, "
+        f"victim={victim}, delay={delay:.2f})")
+    assert isinstance(
+        ei.value, (ray_tpu.RayTpuError, TimeoutError, RuntimeError)), \
+        repr(ei.value)
+    killer.join()
+
+    # no leaked stream refs: the failed step's streams are all dropped
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and global_worker()._streams:
+        time.sleep(0.2)
+    assert not global_worker()._streams, "leaked stream state"
+
+    # the cluster is still healthy: a surviving stage answers, and a
+    # fresh task runs
+    survivor = (victim + 1) % S
+    assert ray_tpu.get(pipe.stages[survivor].ping.remote(),
+                       timeout=60) == survivor
+
+    @ray_tpu.remote
+    def alive():
+        return "ok"
+
+    assert ray_tpu.get(alive.remote(), timeout=60) == "ok"
+    pipe.shutdown()
+
+
 @pytest.mark.streaming
 @pytest.mark.data_streaming
 def test_rollout_stream_midepoch_kill_exactly_once(ray_start_regular):

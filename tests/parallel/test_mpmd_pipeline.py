@@ -1,11 +1,15 @@
 """MPMD pipeline parallelism (parallel/mpmd_pipeline.py).
 
-Fast units cover the 1F1B schedule, the stage split (layer ranges,
-parameter slicing), the local numerics contract — the 2-stage split's
-forward/loss must match the single-program model to <= 1e-5 — and the
-STAGE_TICK Perfetto rendering. The slow end-to-end test runs the real
-actor pipeline on a live cluster: streamed activations, measured
-bubble vs the serial baseline, gradient parity, timeline spans.
+Fast units cover the (interleaved) 1F1B schedule, the stage split
+(layer ranges, parameter slicing, round-robin virtual chunks), the
+local numerics contract — the 2-stage split's forward/loss must match
+the single-program model to <= 1e-5, and the per-stage fused optimizer
+must reproduce the ``make_train_step`` loss trajectory to <= 1e-5 over
+20 steps — the checkpoint merge/split round-trip, and the STAGE_TICK
+Perfetto rendering. The slow end-to-end tests run the real actor
+pipeline on a live cluster: streamed activations, measured bubble vs
+the serial baseline, gradient parity, train-mode transfer accounting,
+timeline spans.
 """
 
 import time
@@ -17,10 +21,11 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.models.transformer import (
-    TransformerConfig, init_params, lm_loss, stage_layer_ranges,
-    stage_slice_params, stage_forward, stage_loss)
+    TransformerConfig, init_params, lm_loss, merge_stage_params,
+    stage_layer_ranges, stage_slice_params, stage_forward, stage_loss)
 from ray_tpu.parallel.mpmd_pipeline import (
-    analytic_gpipe_bubble, one_f_one_b_order)
+    analytic_bubble, analytic_gpipe_bubble, one_f_one_b_order,
+    stage_virtual_chunks)
 
 pytestmark = pytest.mark.pipeline
 
@@ -43,20 +48,22 @@ def test_one_f_one_b_order_invariants():
             for s in range(s_total):
                 order = one_f_one_b_order(s, s_total, m)
                 assert len(order) == 2 * m
-                fwd = [i for op, i in order if op == "F"]
-                bwd = [i for op, i in order if op == "B"]
+                # v=1: chunk id == stage id on every op
+                assert all(c == s for _, _, c in order)
+                fwd = [i for op, i, _ in order if op == "F"]
+                bwd = [i for op, i, _ in order if op == "B"]
                 # every microbatch exactly once per direction, in order
                 assert fwd == list(range(m))
                 assert bwd == list(range(m))
                 # B_i never precedes F_i at the same stage
-                pos = {("F", i): j for j, (op, i) in enumerate(order)
+                pos = {("F", i): j for j, (op, i, _) in enumerate(order)
                        if op == "F"}
-                for j, (op, i) in enumerate(order):
+                for j, (op, i, _) in enumerate(order):
                     if op == "B":
                         assert j > pos[("F", i)]
                 # warmup depth: stages closer to the head hold more
                 # in-flight forwards before their first backward
-                leading_f = next(j for j, (op, _) in enumerate(order)
+                leading_f = next(j for j, (op, _, _) in enumerate(order)
                                  if op == "B")
                 w = min(s_total - 1 - s, m)
                 assert leading_f == (m if w >= m else w + 1)
@@ -64,7 +71,8 @@ def test_one_f_one_b_order_invariants():
 
 def test_one_f_one_b_last_stage_alternates():
     order = one_f_one_b_order(2, 3, 5)
-    assert order[:4] == [("F", 0), ("B", 0), ("F", 1), ("B", 1)]
+    assert order[:4] == [("F", 0, 2), ("B", 0, 2),
+                         ("F", 1, 2), ("B", 1, 2)]
 
 
 def test_analytic_gpipe_bubble():
@@ -74,6 +82,121 @@ def test_analytic_gpipe_bubble():
     # more microbatches -> smaller bubble, monotonically
     bubbles = [analytic_gpipe_bubble(4, m) for m in (1, 2, 4, 8, 16)]
     assert bubbles == sorted(bubbles, reverse=True)
+
+
+def test_analytic_interleaved_bubble():
+    # v=1 is GPipe; more virtual stages shrink the bubble by the
+    # virtual-stage factor (S-1)/(v*M+S-1)
+    assert analytic_bubble(2, 4, 1) == analytic_gpipe_bubble(2, 4)
+    assert analytic_bubble(2, 4, 2) == pytest.approx(1 / 9)
+    assert analytic_bubble(4, 8, 2) == pytest.approx(3 / 19)
+    for s, m in ((2, 4), (3, 6), (4, 8)):
+        bubbles = [analytic_bubble(s, m, v) for v in (1, 2, 3, 4)]
+        assert bubbles == sorted(bubbles, reverse=True)
+
+
+# --------------------------------------------- interleaved order units
+
+
+def _validate_orders(S, M, v):
+    """Every (op, mb, chunk) exactly once across stages, chunks hosted
+    round-robin, and a blocking replay of the per-stage lists (each
+    stage executes in order, waiting for producers) never deadlocks —
+    the exact execution model of the live stage actors."""
+    orders = [one_f_one_b_order(s, S, M, v) for s in range(S)]
+    K = S * v
+    seen = set()
+    for s, order in enumerate(orders):
+        assert len(order) == 2 * M * v
+        for op, i, c in order:
+            assert c % S == s, "chunk hosted by the wrong stage"
+            assert c in stage_virtual_chunks(s, S, v)
+            assert (op, i, c) not in seen, "duplicate op"
+            seen.add((op, i, c))
+    assert len(seen) == 2 * M * K, "missing ops"
+    done = set()
+    cursors = [0] * S
+    while any(cursors[s] < len(orders[s]) for s in range(S)):
+        advanced = False
+        for s in range(S):
+            while cursors[s] < len(orders[s]):
+                op, i, c = orders[s][cursors[s]]
+                if op == "F":
+                    ok = c == 0 or ("F", i, c - 1) in done
+                else:
+                    ok = ("F", i, c) in done and (
+                        c == K - 1 or ("B", i, c + 1) in done)
+                if not ok:
+                    break
+                done.add((op, i, c))
+                cursors[s] += 1
+                advanced = True
+        assert advanced, (
+            f"blocking replay deadlocked at cursors={cursors} "
+            f"for S={S} M={M} v={v}")
+
+
+def test_interleaved_order_grid():
+    for S in (2, 3, 4):
+        for M in (1, 2, 3, 4, 7):
+            for v in (1, 2, 3):
+                _validate_orders(S, M, v)
+
+
+def test_interleaved_order_deterministic():
+    a = one_f_one_b_order(1, 3, 4, 2)
+    b = one_f_one_b_order(1, 3, 4, 2)
+    assert a == b
+    assert a is not b  # callers may mutate their copy
+
+
+def _simulated_bubble(S, M, v):
+    """Replay the per-stage orders event-driven (op cost 1/v, zero
+    transport): the idle share of the makespan."""
+    orders = [one_f_one_b_order(s, S, M, v) for s in range(S)]
+    K = S * v
+    cost = 1.0 / v
+    t_done, clock, cursors = {}, [0.0] * S, [0] * S
+    n = sum(len(o) for o in orders)
+    while len(t_done) < n:
+        for s in range(S):
+            while cursors[s] < len(orders[s]):
+                op, i, c = orders[s][cursors[s]]
+                deps = ([] if c == 0 else [("F", i, c - 1)]) \
+                    if op == "F" else \
+                    [("F", i, c)] + ([] if c == K - 1
+                                     else [("B", i, c + 1)])
+                if not all(d in t_done for d in deps):
+                    break
+                start = max([clock[s]] + [t_done[d] for d in deps])
+                t_done[(op, i, c)] = clock[s] = start + cost
+                cursors[s] += 1
+    return 1.0 - (2 * M * v * cost) / max(clock)
+
+
+def test_interleaved_schedule_shrinks_simulated_bubble():
+    """The whole point of virtual stages: at equal S/M the simulated
+    bubble strictly drops from v=1 to v=2 (and matches the analytic
+    (S-1)/(v*M+S-1) exactly for 2 stages)."""
+    for S, M in ((2, 4), (2, 8), (3, 6), (4, 8)):
+        b1 = _simulated_bubble(S, M, 1)
+        b2 = _simulated_bubble(S, M, 2)
+        assert b2 < b1, (S, M, b1, b2)
+        assert b1 == pytest.approx(analytic_bubble(S, M, 1))
+    assert _simulated_bubble(2, 4, 2) == pytest.approx(
+        analytic_bubble(2, 4, 2))
+
+
+def test_stage_virtual_chunks_round_robin():
+    assert stage_virtual_chunks(0, 2, 2) == (0, 2)
+    assert stage_virtual_chunks(1, 2, 2) == (1, 3)
+    assert stage_virtual_chunks(2, 3, 1) == (2,)
+    # chunks partition [0, K) and chunk c lives on actor c % S
+    for S, v in ((2, 3), (3, 2), (4, 4)):
+        all_chunks = sorted(
+            c for s in range(S)
+            for c in stage_virtual_chunks(s, S, v))
+        assert all_chunks == list(range(S * v))
 
 
 # -------------------------------------------------------- stage split
@@ -182,6 +305,231 @@ def test_vjp_two_program_grad_parity():
                                        atol=1e-5)
 
 
+# ------------------------------------- per-stage fused optimizer step
+
+
+def _make_stages(cfg, S, v, lr=1e-3, clip=1.0, **kw):
+    from ray_tpu.parallel.mpmd_pipeline import PipelineStage
+    return [PipelineStage(cfg, s, S, seed=0, n_virtual=v, train=True,
+                          learning_rate=lr, clip_norm=clip, **kw)
+            for s in range(S)]
+
+
+def _inprocess_train_step(stages, batch, S, v, M):
+    """Clusterless train step over direct PipelineStage objects: the
+    serial chunk walk (same jitted programs as the live actors), the
+    driver-side scalar grad-norm reduction, and every stage's fused
+    optimizer program. Returns (loss, grad_norm)."""
+    K = S * v
+    ids = np.asarray(batch["input_ids"])
+    mask = np.asarray(batch["loss_mask"])
+    ids_mb, mask_mb = np.split(ids, M), np.split(mask, M)
+    ns = [float(mk[:, 1:].sum()) for mk in mask_mb]
+    total_n = sum(ns)
+    losses = []
+    for i in range(M):
+        x = ids_mb[i]
+        for ch in range(K):
+            st = stages[ch % S]
+            out = st.forward_one(ch, i, x, ids_mb[i], mask_mb[i]) \
+                if ch == K - 1 else st.forward_one(ch, i, x)
+            if ch < K - 1:
+                # host hop between chunks, as the wire does (each
+                # stage's params are committed to a distinct device)
+                x = np.asarray(out)
+        losses.append((out["loss"], out["n_tokens"]))
+        g = np.float32(ns[i] / total_n)
+        for ch in range(K - 1, -1, -1):
+            g = stages[ch % S].backward_one(ch, i, g)
+            if g is not None:
+                g = np.asarray(g)
+    gsq = sum(st.grad_sq_norm() for st in stages)
+    mets = [st.apply_opt(gsq) for st in stages]
+    return (sum(l * n for l, n in losses) / total_n,
+            mets[0]["grad_norm"])
+
+
+def _batch(cfg, b=4, s=16, seed=1):
+    ids = np.array(jax.random.randint(jax.random.PRNGKey(seed), (b, s),
+                                      0, cfg.vocab_size))
+    return {"input_ids": ids, "loss_mask": np.ones((b, s), np.float32)}
+
+
+N_PARITY_STEPS = 20
+
+
+@pytest.fixture(scope="module")
+def ref_bundle():
+    """One compiled make_train_step bundle (tiny_config, 1-device
+    mesh, default chain(clip, adamw)) shared by the parity tests —
+    each test re-inits state from seed 0, so sharing the COMPILE is
+    free."""
+    from ray_tpu.models import make_train_step
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dp=1, fsdp=1), jax.devices()[:1])
+    return make_train_step(tiny_config(), mesh, learning_rate=1e-3)
+
+
+@pytest.mark.parametrize("n_virtual", [1, 2])
+def test_per_stage_optimizer_matches_train_step(n_virtual, ref_bundle):
+    """Acceptance numerics, clusterless: the per-stage fused optimizer
+    (grad accumulation + driver-reduced global clip + per-slice adamw)
+    must reproduce the single-program ``make_train_step`` loss
+    trajectory to <= 1e-5 over 20 steps, at v=1 AND v=2."""
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    S, M = 2, 2
+    stages = _make_stages(cfg, S, n_virtual)
+
+    bundle = ref_bundle
+    state = bundle.init(seed=0)
+
+    diffs, gnorm_diffs = [], []
+    for _ in range(N_PARITY_STEPS):
+        loss, gn = _inprocess_train_step(stages, batch, S, n_virtual, M)
+        state, met = bundle.step(state, batch)
+        diffs.append(abs(loss - float(met["loss"])))
+        gnorm_diffs.append(abs(gn - float(met["grad_norm"])))
+    assert max(diffs) <= 1e-5, diffs
+    assert max(gnorm_diffs) <= 1e-4, gnorm_diffs
+    # param parity at the end: stage slices vs the single-program tree
+    K = S * n_virtual
+    for s, st in enumerate(stages):
+        for c in st.chunks:
+            want = stage_slice_params(cfg, state["params"], c, K)
+            for a, b in zip(jax.tree.leaves(st.params[c]),
+                            jax.tree.leaves(want)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=2e-5)
+
+
+def test_apply_opt_requires_grads_and_train_mode():
+    from ray_tpu.parallel.mpmd_pipeline import PipelineStage
+    cfg = tiny_config(n_layers=2)
+    st = PipelineStage(cfg, 0, 2, train=True, learning_rate=1e-3)
+    with pytest.raises(RuntimeError, match="no accumulated grads"):
+        st.apply_opt(1.0)
+    nt = PipelineStage(cfg, 0, 2, train=False)
+    with pytest.raises(RuntimeError, match="train=False"):
+        nt.apply_opt(1.0)
+
+
+# ---------------------------------------------- checkpoint round-trip
+
+
+def test_stage_checkpoint_round_trip_and_cross_v_reload():
+    """Merged per-stage checkpoints reproduce the canonical
+    single-program train-state LAYOUT (same treedef as
+    ``make_train_step`` with the same optimizer) and its VALUES after
+    the same number of steps — and the same checkpoint, saved from a
+    v=2 pipeline, reloads into a v=1 pipeline and continues the
+    trajectory exactly. (One test: the stage sets are the expensive
+    compiles, so the reload path reuses the round-trip's.)"""
+    import optax
+
+    from ray_tpu.models import make_train_step
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.parallel.mpmd_pipeline import (
+        merge_stage_checkpoints, split_train_state)
+
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    S, v, M = 2, 2, 2
+    # clip disabled on both sides so the optimizers are identical
+    stages = _make_stages(cfg, S, v, clip=None)
+    for _ in range(3):
+        _inprocess_train_step(stages, batch, S, v, M)
+    merged = merge_stage_checkpoints(
+        cfg, [st.stage_checkpoint() for st in stages])
+    assert set(merged) == {"params", "opt_state", "step"}
+    assert merged["step"] == 3
+
+    mesh = build_mesh(MeshSpec(dp=1, fsdp=1), jax.devices()[:1])
+    bundle = make_train_step(cfg, mesh, optimizer=optax.adamw(
+        1e-3, b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.0))
+    state = bundle.init(seed=0)
+    for _ in range(3):
+        state, _ = bundle.step(state, batch)
+    # layout round-trips: identical pytree structure...
+    assert jax.tree.structure(
+        {"params": merged["params"], "opt_state": merged["opt_state"]}
+    ) == jax.tree.structure(
+        {"params": state["params"], "opt_state": state["opt_state"]})
+    # ...and identical contents (same optimizer, same 3 steps)
+    for key in ("params", "opt_state"):
+        for a, b in zip(jax.tree.leaves(merged[key]),
+                        jax.tree.leaves(state[key])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
+    # cross-v reload: continue the source stages, then continue a
+    # FRESH v=1 set loaded from the merged checkpoint — trajectories
+    # must agree step for step
+    cont_src = [_inprocess_train_step(stages, batch, S, v, M)[0]
+                for _ in range(3)]
+    fresh = _make_stages(cfg, S, 1, clip=None)
+    parts = split_train_state(cfg, merged, S, 1)
+    # a v=1 part must not load into the leftover v=2 stages
+    with pytest.raises(ValueError, match="hosts chunks"):
+        stages[0].load_state(parts[0])
+    for st, p in zip(fresh, parts):
+        st.load_state(p)
+    assert fresh[0]._step_count == 3
+    cont = [_inprocess_train_step(fresh, batch, S, 1, M)[0]
+            for _ in range(3)]
+    np.testing.assert_allclose(cont, cont_src, atol=1e-6)
+
+
+def test_merge_stage_params_inverts_slicing():
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    for K in (2, 4):
+        chunks = {c: stage_slice_params(cfg, params, c, K)
+                  for c in range(K)}
+        full = merge_stage_params(cfg, chunks)
+        assert jax.tree.structure(full) == jax.tree.structure(params)
+        for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="missing chunks"):
+        merge_stage_params(cfg, {0: stage_slice_params(cfg, params,
+                                                       0, 2)})
+
+
+# ------------------------------------------------- mailbox deadline
+
+
+def test_mailbox_deadline_is_a_config_knob(monkeypatch):
+    from ray_tpu.core.config import Config
+    monkeypatch.setenv("RAY_TPU_PIPELINE_MAILBOX_DEADLINE_S", "7.5")
+    assert Config().pipeline_mailbox_deadline_s == 7.5
+    monkeypatch.delenv("RAY_TPU_PIPELINE_MAILBOX_DEADLINE_S")
+    assert Config().pipeline_mailbox_deadline_s == 120.0
+
+
+def test_mailbox_take_times_out_typed():
+    """A starved mailbox take fails with a typed TimeoutError naming
+    the knob after pipeline_mailbox_deadline_s — never a hang."""
+    from ray_tpu.parallel.mpmd_pipeline import PipelineStage
+    cfg = tiny_config(n_layers=2)
+    st = PipelineStage(cfg, 0, 2, mailbox_deadline_s=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError,
+                       match="pipeline_mailbox_deadline_s=0.2"):
+        next(st.run(1))
+    assert time.monotonic() - t0 < 5.0
+    # abort unblocks a pending take long before the deadline, typed
+    import threading
+    st2 = PipelineStage(cfg, 0, 2, mailbox_deadline_s=30.0)
+    t = threading.Timer(0.2, st2.abort)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="aborted"):
+        next(st2.run(1))
+    assert time.monotonic() - t0 < 5.0
+    t.join()
+
+
 # ------------------------------------------------- STAGE_TICK rendering
 
 
@@ -195,13 +543,26 @@ def test_stage_tick_renders_as_duration_slices():
         {"ev": "STAGE_TICK", "ts": t0 + 0.08, "proc": "worker:b",
          "pid": 2, "stage": 1, "mb": 0, "phase": "idle",
          "dur_s": 0.03},
+        # interleaved chunk + fused-opt spans carry the virtual-stage
+        # index / opt phase in the rendered name
+        {"ev": "STAGE_TICK", "ts": t0 + 0.12, "proc": "worker:a",
+         "pid": 1, "stage": 0, "mb": 1, "vs": 2, "phase": "backward",
+         "dur_s": 0.02},
+        {"ev": "STAGE_TICK", "ts": t0 + 0.15, "proc": "worker:a",
+         "pid": 1, "stage": 0, "phase": "opt", "dur_s": 0.01},
         {"ev": "RETRANSMIT", "ts": t0, "proc": "worker:a", "pid": 1,
          "type": "SIT"},
     ]
     trace = build_chrome_trace(events)
     slices = [e for e in trace["traceEvents"]
               if str(e.get("name", "")).startswith("STAGE_TICK")]
-    assert len(slices) == 2
+    assert len(slices) == 4
+    bwd = next(e for e in slices if "backward" in e["name"])
+    assert bwd["name"] == "STAGE_TICK:backward[1]@c2"
+    assert bwd["args"]["vs"] == 2
+    opt = next(e for e in slices if "opt" in e["name"])
+    assert opt["name"] == "STAGE_TICK:opt"
+    assert opt["ph"] == "X"
     fwd = next(e for e in slices if "forward" in e["name"])
     assert fwd["ph"] == "X"
     assert fwd["name"] == "STAGE_TICK:forward[0]"
@@ -283,6 +644,97 @@ def test_mpmd_pipeline_end_to_end(ray_start_regular):
     assert {"forward", "backward"} <= phases, phases
     pipe.shutdown()
     serial.shutdown()
+
+
+@pytest.mark.slow
+def test_mpmd_pipeline_train_e2e_no_driver_grad_transfer(
+        ray_start_regular):
+    """Acceptance on a live cluster: a v=2 interleaved TRAIN pipeline
+    (fwd+bwd+fused per-stage opt) tracks the single-program
+    ``make_train_step`` loss trajectory to <= 1e-5, and after the
+    warmup step NO gradient or parameter bytes transit the driver —
+    asserted via the runtime's inbound transfer accounting
+    (``runtime_object_bytes_materialized_total`` on the driver
+    process), which a deliberate ``grads()`` fetch then visibly
+    bumps (the counter is not vacuous)."""
+    from ray_tpu.core.metric_defs import runtime_metrics
+    from ray_tpu.models import make_train_step
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    # big enough that a single stage's grads (>= 100KB) could never
+    # hide in the inline-object budget the scalars ride
+    cfg = tiny_config(vocab_size=2048, d_model=64, head_dim=32)
+    batch = _batch(cfg, b=8, s=32)
+    pipe = MPMDPipeline(cfg, n_stages=2, n_microbatches=4, seed=0,
+                        n_virtual=2, train=True, learning_rate=1e-3)
+    mesh = build_mesh(MeshSpec(dp=1, fsdp=1), jax.devices()[:1])
+    bundle = make_train_step(cfg, mesh, learning_rate=1e-3)
+    state = bundle.init(seed=0)
+
+    res = pipe.step(batch)                 # warmup/compile step
+    state, met = bundle.step(state, batch)
+    assert abs(res.loss - float(met["loss"])) <= 1e-5
+    assert res.step == 1
+
+    counter = runtime_metrics().materialized_bytes
+    read = lambda: sum(counter._values.values())  # noqa: E731
+    before = read()
+    n_steps = 3
+    for k in range(n_steps):
+        res = pipe.step(batch)
+        state, met = bundle.step(state, batch)
+        assert abs(res.loss - float(met["loss"])) <= 1e-5, k
+        assert abs(res.grad_norm - float(met["grad_norm"])) <= 1e-4
+    inbound = read() - before
+    # per-step driver inbound is scalar-sized: M loss dicts + stats +
+    # opt metrics. Grad/param trees would be hundreds of KB each.
+    assert inbound < 30_000 * n_steps, (
+        f"driver materialized {inbound} bytes over {n_steps} train "
+        f"steps — grads/params are transiting the driver")
+    # non-vacuity: an explicit grad fetch through the driver IS seen
+    # by the same counter (use a fwd+bwd pipeline so grads survive)
+    fwd = MPMDPipeline(cfg, n_stages=2, n_microbatches=4, seed=0)
+    fwd.step(batch)
+    base = read()
+    grads = fwd.grads()
+    assert grads
+    assert read() - base > 100_000, "transfer accounting is vacuous"
+
+    # opt occupancy landed on the timeline
+    from ray_tpu.util.state import list_task_events
+    ticks = list_task_events(filters=[("ev", "=", "STAGE_TICK")])
+    phases = {t.get("phase") for t in ticks}
+    assert "opt" in phases, phases
+    assert any(t.get("vs") not in (None, t.get("stage"))
+               for t in ticks), "no interleaved chunk ids on spans"
+    pipe.shutdown()
+    fwd.shutdown()
+
+
+@pytest.mark.slow
+def test_mpmd_pipeline_interleaved_checkpoint_live(ray_start_regular):
+    """Live checkpoint round-trip: save from a v=2 train pipeline,
+    reload into a FRESH v=1 train pipeline, trajectories agree."""
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    cfg = tiny_config()
+    batch = _batch(cfg, b=4, s=16)
+    pipe = MPMDPipeline(cfg, n_stages=2, n_microbatches=2, seed=0,
+                        n_virtual=2, train=True, learning_rate=1e-3)
+    for _ in range(2):
+        pipe.step(batch)
+    ckpt = pipe.save_checkpoint()
+    assert ckpt["step"] == 2
+    cont_src = [pipe.step(batch).loss for _ in range(2)]
+
+    re = MPMDPipeline(cfg, n_stages=2, n_microbatches=2, seed=0,
+                      n_virtual=1, train=True, learning_rate=1e-3)
+    re.load_checkpoint(ckpt)
+    cont = [re.step(batch).loss for _ in range(2)]
+    np.testing.assert_allclose(cont, cont_src, atol=1e-6)
+    pipe.shutdown()
+    re.shutdown()
 
 
 @pytest.mark.slow

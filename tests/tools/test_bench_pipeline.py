@@ -19,9 +19,11 @@ pytestmark = [pytest.mark.perf, pytest.mark.pipeline]
 
 
 def test_analytic_bubble_formula():
-    from ray_tpu.parallel.mpmd_pipeline import analytic_gpipe_bubble
+    from ray_tpu.parallel.mpmd_pipeline import (
+        analytic_bubble, analytic_gpipe_bubble)
     assert analytic_gpipe_bubble(2, 4) == pytest.approx(0.2)
     assert analytic_gpipe_bubble(3, 9) == pytest.approx(2 / 11)
+    assert analytic_bubble(2, 4, 2) == pytest.approx(1 / 9)
 
 
 def test_checked_in_pipeline_record_shape():
@@ -49,8 +51,39 @@ def test_checked_in_pipeline_record_shape():
     assert rec["vs_serial"] > 0
 
 
+def test_checked_in_train_variant_shape():
+    """The train variant of the latest record: interleaved v=2's
+    measured bubble beats v=1 at equal S/M, each row carries the
+    analytic (S-1)/(v*M+S-1) next to the measurement, and the
+    per-stage-optimizer pipeline matched the make_train_step loss
+    trajectory to <= 1e-5."""
+    from ray_tpu.parallel.mpmd_pipeline import analytic_bubble
+
+    paths = sorted(p for p in os.listdir(REPO)
+                   if p.startswith("PIPELINE_r") and p.endswith(".json"))
+    with open(os.path.join(REPO, paths[-1])) as f:
+        rec = json.load(f)
+    d = rec["detail"]
+    t = d.get("train")
+    assert t, "latest PIPELINE record predates the train variant"
+    S, M = d["n_stages"], t["n_microbatches"]
+    for v in (1, 2):
+        row = t[f"v{v}"]
+        assert row["tokens_per_s"] > 0
+        assert 0.0 <= row["bubble_fraction"] <= 1.0
+        assert row["analytic_bubble"] == pytest.approx(
+            analytic_bubble(S, M, v), abs=1e-3)
+        assert len(row["losses"]) == t["parity_steps"]
+    # acceptance: the interleave win, measured
+    assert t["v2"]["bubble_fraction"] < t["v1"]["bubble_fraction"]
+    assert t["v2"]["analytic_bubble"] < t["v1"]["analytic_bubble"]
+    # acceptance: fused per-stage optimizer tracks make_train_step
+    assert t["parity_steps"] >= 20
+    assert t["loss_parity_train_abs"] <= 1e-5
+
+
 def test_pipeline_config_splits_evenly():
-    from bench import _pipeline_config
+    from bench import _pipeline_config, _pipeline_train_config
     for on_tpu in (False, True):
         for smoke in (False, True):
             cfg, batch, seq, m, s, steps = _pipeline_config(on_tpu,
@@ -58,12 +91,22 @@ def test_pipeline_config_splits_evenly():
             assert batch % m == 0
             assert cfg.n_layers % s == 0
             assert steps >= 1
+            tcfg, tb, tseq, tm, tsteps = _pipeline_train_config(
+                on_tpu, smoke)
+            assert tb % tm == 0
+            for v in (1, 2):
+                assert tcfg.n_layers >= s * v, (on_tpu, smoke, v)
+            assert tsteps >= 1
+            if not smoke:
+                assert tsteps + 1 >= 20  # the 20-step parity contract
 
 
 @pytest.mark.slow
 def test_bench_pipeline_smoke_subprocess():
     """End-to-end: `bench.py --pipeline --smoke` prints one JSON line
-    the pipeline gate accepts."""
+    the pipeline gate accepts, covering the TRAIN variant (fwd+bwd+
+    fused per-stage opt at v=1 and v=2) inside the smoke budget — the
+    train leg itself must stay under 60s on CPU."""
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--pipeline",
          "--smoke"],
@@ -75,6 +118,13 @@ def test_bench_pipeline_smoke_subprocess():
     rec = json.loads(line)
     assert rec["metric"] == "pipeline_tokens_per_s"
     assert rec["value"] > 0
+    train = rec["detail"]["train"]
+    for v in ("v1", "v2"):
+        assert train[v]["tokens_per_s"] > 0
+        assert "analytic_bubble" in train[v]
+    assert train["loss_parity_train_abs"] <= 1e-5
+    assert train["wall_s"] < 60, (
+        f"smoke train leg took {train['wall_s']}s (must stay < 60s)")
     from tools.perf_gate import compare
     ok, msgs = compare(rec, rec, metric="pipeline")
     assert ok, msgs

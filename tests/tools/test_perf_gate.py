@@ -293,6 +293,46 @@ def test_pipeline_gate_relative_tolerance():
     assert not ok, msgs
 
 
+def _train_record(**kw):
+    rec = _pipeline_record(**kw)
+    rec["detail"]["train"] = {
+        "v1": {"tokens_per_s": 1500.0, "bubble_fraction": 0.20,
+               "analytic_bubble": 0.2},
+        "v2": {"tokens_per_s": 1450.0, "bubble_fraction": 0.14,
+               "analytic_bubble": 0.1111},
+        "parity_steps": 20,
+        "loss_parity_train_abs": 1e-6,
+    }
+    return rec
+
+
+def test_pipeline_extractor_train_rows():
+    from tools.perf_gate import extract_pipeline_metrics
+    m = extract_pipeline_metrics(_train_record())
+    assert m["pipeline/train_v1_tokens_per_s"] == 1500.0
+    assert m["pipeline/train_v2_tokens_per_s"] == 1450.0
+    assert m["pipeline/train_v1_utilization"] == pytest.approx(0.80)
+    assert m["pipeline/train_v2_utilization"] == pytest.approx(0.86)
+    # pre-train records simply have no train rows
+    m0 = extract_pipeline_metrics(_pipeline_record())
+    assert not any(k.startswith("pipeline/train_") for k in m0)
+
+
+def test_pipeline_gate_train_rows_skipped_vs_old_baseline():
+    """A fresh record with the train variant gates cleanly against a
+    baseline that predates it (rows skipped, not failed) but regressed
+    train utilization fails against a train-carrying baseline."""
+    ok, msgs = compare(_train_record(), _pipeline_record(),
+                       metric="pipeline")
+    assert ok, msgs
+    assert any("train_v2_utilization: skipped" in m for m in msgs)
+    worse = _train_record()
+    worse["detail"]["train"]["v2"]["bubble_fraction"] = 0.50
+    ok, msgs = compare(worse, _train_record(), metric="pipeline")
+    assert not ok and any(
+        "FAIL" in m and "train_v2_utilization" in m for m in msgs)
+
+
 def test_pipeline_gate_against_checked_in_baseline():
     from tools.perf_gate import extract_pipeline_metrics
     path, rec = latest_baseline(REPO, metric="pipeline")

@@ -92,6 +92,24 @@ for seed in "${seeds[@]}"; do
     fi
 done
 
+# ---- pipeline soak leg: SIGKILL a seeded-random stage actor mid-
+# interleaved-TRAIN-step (fwd+bwd+fused per-stage opt) → typed failure
+# at the driver, no hang, no leaked stream refs, cluster stays usable
+# (tests/core/test_fault_tolerance.py::
+# test_mpmd_pipeline_train_midstage_kill_fails_typed_no_hang)
+for seed in "${seeds[@]}"; do
+    echo "=== pipeline soak: seed=$seed ==="
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/core/test_fault_tolerance.py::test_mpmd_pipeline_train_midstage_kill_fails_typed_no_hang" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== pipeline seed=$seed PASSED ==="
+    else
+        echo "=== pipeline seed=$seed FAILED ==="
+        failed+=("pipeline:$seed")
+    fi
+done
+
 if [ "${#failed[@]}" -gt 0 ]; then
     echo
     echo "FAILING SEEDS: ${failed[*]}"
@@ -101,6 +119,12 @@ if [ "${#failed[@]}" -gt 0 ]; then
             s="${seed#data:}"
             echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
                  "tests/data/test_streaming_exec.py::test_data_pipeline_chaos_soak -q"
+            continue
+            ;;
+        pipeline:*)
+            s="${seed#pipeline:}"
+            echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
+                 "tests/core/test_fault_tolerance.py::test_mpmd_pipeline_train_midstage_kill_fails_typed_no_hang -q"
             continue
             ;;
         esac

@@ -21,10 +21,12 @@ Three gated series (``--metric``):
   Baselines: ``SERVE_r*.json``; like ``multichip``, an empty/unparseable
   series bootstrap-passes.
 - ``pipeline`` — the MPMD pipeline headline from ``bench.py
-  --pipeline`` (1F1B tokens/s), plus the SPMD-GPipe tokens/s and the
+  --pipeline`` (1F1B tokens/s), plus the SPMD-GPipe tokens/s, the
   stage utilization (1 − measured bubble fraction, so higher is
-  better) when the records carry them. Gated RELATIVELY like
-  ``serve``; baselines ``PIPELINE_r*.json``, bootstrap-passes.
+  better) and the train-variant rows (fwd+bwd+fused per-stage opt,
+  tokens/s + utilization per interleave factor v1/v2) when the
+  records carry them. Gated RELATIVELY like ``serve``; baselines
+  ``PIPELINE_r*.json``, bootstrap-passes.
 - ``data`` — the streaming data-plane headline from ``bench.py
   --data`` (end-to-end rows/s through the generator-fed executor),
   plus the stage-overlap fraction, the prefetch hit rate and the
@@ -142,9 +144,12 @@ def extract_serve_metrics(rec: dict) -> dict:
 
 def extract_pipeline_metrics(rec: dict) -> dict:
     """The MPMD pipeline headline (1F1B tokens/s) plus the SPMD-GPipe
-    tokens/s and the stage utilization (1 − measured bubble fraction —
-    inverted so the shared higher-is-better comparison applies) when
-    the record carries them."""
+    tokens/s, the stage utilization (1 − measured bubble fraction —
+    inverted so the shared higher-is-better comparison applies) and,
+    when the record carries the train variant (fwd+bwd+fused per-stage
+    opt), its per-interleave tokens/s and utilization rows
+    (``pipeline/train_v1_*``, ``pipeline/train_v2_*``). Records that
+    predate a row are simply skipped by the comparison."""
     detail = rec.get("detail") or {}
     out = {"pipeline_tokens_per_s": float(rec["value"]),
            "pipeline/spmd_tokens_per_s": None,
@@ -156,6 +161,16 @@ def extract_pipeline_metrics(rec: dict) -> dict:
     if isinstance(mpmd, dict) and "bubble_fraction" in mpmd:
         out["pipeline/stage_utilization"] = round(
             1.0 - float(mpmd["bubble_fraction"]), 4)
+    train = detail.get("train") or {}
+    for vkey, m in train.items():
+        if not (vkey.startswith("v") and isinstance(m, dict)):
+            continue
+        if "tokens_per_s" in m:
+            out[f"pipeline/train_{vkey}_tokens_per_s"] = \
+                float(m["tokens_per_s"])
+        if "bubble_fraction" in m:
+            out[f"pipeline/train_{vkey}_utilization"] = round(
+                1.0 - float(m["bubble_fraction"]), 4)
     return out
 
 
