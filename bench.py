@@ -80,9 +80,12 @@ def _measure_mfu(cfg, batch: int, seq: int, steps: int, warmup: int,
     n_dev = len(devices)
     spec = MeshSpec(fsdp=n_dev) if n_dev > 1 else MeshSpec()
     mesh = build_mesh(spec, devices)
+    # live telemetry off: its interval sync would serialize the
+    # dispatch-ahead timing loop (bench records these numbers itself)
     bundle = make_train_step(cfg, mesh, learning_rate=1e-4,
                              grad_transport=grad_transport,
-                             shard_weight_update=shard_weight_update)
+                             shard_weight_update=shard_weight_update,
+                             telemetry_interval_s=0)
     state = bundle.init(seed=0)
     ids = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
                              cfg.vocab_size)

@@ -96,9 +96,12 @@ PROTECTED_TYPES = frozenset({"REG", "REGR", "BYE", "RPL", "ERR", "RCN"})
 #: TEV is the flight-recorder flush (core/events.py): reliably
 #: delivered like its peers, and observability loss must never block
 #: task progress — exactly the contract chaos drops exercise.
+#: MRT is the fleet metric snapshot (core/metrics_plane.py): same
+#: contract as TEV, plus reporter-side supersede (drop-oldest) so a
+#: sustained 100% drop window bounds the retransmit backlog.
 DEFAULT_DROPPABLE = frozenset({"RES", "PUT", "PNG", "HBT",
                                "DSP", "ACL", "ASG", "DON",
-                               "SIT", "SEF", "SCR", "TEV"})
+                               "SIT", "SEF", "SCR", "TEV", "MRT"})
 
 
 @dataclass
@@ -456,9 +459,15 @@ class ChaosInjector:
             delayed = dict(payload, __chaos_delayed__=True)
         out = [(delay, delayed)]
         if isinstance(payload, dict) and r_dup < cfg.dup_p(name):
-            # the copy carries the SAME wire seq: receivers must drop it
+            # the copy carries the SAME wire seq: receivers must drop
+            # it. It must be a DISTINCT dict object though: both copies
+            # can coalesce into one MSG_BATCH, where pickle's memo
+            # would collapse one shared object into one deserialized
+            # dict — the first dispatch pops the __wseq__/__rseq__
+            # dedup stamps and the second copy then passes both dedups
+            # (double-handling instead of a deduped duplicate).
             self.stats[("dup", name)] += 1
-            out.append((0.0, payload))
+            out.append((0.0, dict(payload)))
         return out
 
 

@@ -205,6 +205,20 @@ class Config:
     #: counted in the runtime_events_dropped_total metric.
     task_events_ring_size: int = 4096
 
+    # --- fleet metrics plane (core/metrics_plane.py) ---
+    #: Per-process periodic METRIC_REPORT snapshots to the controller.
+    #: RAY_TPU_ENABLE_METRICS_REPORT=0 turns the fleet plane dark
+    #: (process-local /metrics endpoints keep working).
+    enable_metrics_report: bool = True
+    #: Reporter cadence per process (the fleet resolution floor).
+    metrics_report_interval_ms: int = 1000
+    #: Width of one time-series ring slot at the controller (rates and
+    #: quantile windows are computed on this grid).
+    metrics_ring_interval_s: float = 1.0
+    #: Slots retained per (metric, labelset, origin) series — bounds
+    #: the controller's memory (600 x 1s = 10 min of history).
+    metrics_ring_slots: int = 600
+
     # --- TPU ---
     #: Name of the countable chip resource (reference:
     #: python/ray/_private/accelerators/tpu.py uses "TPU").

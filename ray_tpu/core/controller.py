@@ -119,6 +119,19 @@ class Controller:
         self.flight_events: List[dict] = []
         self.recorder = EV.make_recorder("controller", config,
                                          send=self._ingest_events)
+        # fleet metrics plane (core/metrics_plane.py): every process's
+        # METRIC_REPORT snapshots merge here into bounded time-series
+        # rings; the controller's own registry self-ingests through the
+        # same path (MetricsPlane is internally locked — ingest fires
+        # from the loop thread AND the health thread, the dashboard's
+        # HTTP threads query).
+        from ray_tpu.core.metrics_plane import MetricsPlane
+        from ray_tpu.util import metrics as MX
+        self.metrics_plane = MetricsPlane.from_config(config)
+        self.metrics_reporter = MX.make_reporter(
+            self.metrics_plane.ingest,
+            {"node": "head", "pid": os.getpid(), "role": "controller"},
+            config)
         # reliable-delivery sublayer: TASK_DISPATCH/TASK_ASSIGN/
         # TASK_RESULT to workers, nodes and owners get ack/retransmit;
         # resends re-enter _send (thread-safe cross-thread marshal)
@@ -2348,6 +2361,12 @@ class Controller:
                 update_from_state(controller=self)
             except Exception:
                 pass
+            # the controller's own registry joins the fleet plane
+            # through the same reporter path every other process uses
+            try:
+                self.metrics_reporter.maybe_report()
+            except Exception:
+                pass
             for node in list(self.nodes.values()):
                 if node.alive and node.last_heartbeat and \
                         now - node.last_heartbeat > threshold:
@@ -2532,11 +2551,30 @@ class Controller:
     # -------------------------------------------------------- observability
     def _h_state_query(self, identity: bytes, m: dict) -> None:
         self._reply(identity, m["rid"], {
-            "rows": self.state_rows(m["what"], m.get("limit"))})
+            "rows": self.state_rows(m["what"], m.get("limit"),
+                                    m.get("params"))})
 
-    def state_rows(self, what: str, limit: Optional[int] = None):
+    def state_rows(self, what: str, limit: Optional[int] = None,
+                   params: Optional[dict] = None):
         """Loop-thread-only state snapshot (shared by the wire state
-        API and the dashboard head, which holds a direct reference)."""
+        API and the dashboard head, which holds a direct reference).
+        The ``metrics*`` views only touch the internally-locked
+        MetricsPlane, so they are safe from any thread."""
+        if what == "metrics":
+            return self.metrics_plane.catalog()
+        if what == "metrics_query":
+            p = params or {}
+            return self.metrics_plane.query(
+                p.get("name", ""),
+                window_s=float(p.get("window_s", 60.0)),
+                agg=p.get("agg"))
+        if what == "metrics_fleet":
+            p = params or {}
+            return self.metrics_plane.fleet_summary(
+                window_s=float(p.get("window_s", 30.0)))
+        if what == "metrics_latest":
+            return self.metrics_plane.latest_samples(
+                (params or {}).get("name", ""))
         m = {"limit": limit} if limit else {}
         if what == "nodes":
             rows = [{
@@ -2654,6 +2692,12 @@ class Controller:
     def _h_task_events(self, identity: bytes, m: dict) -> None:
         self._ingest_events(m.get("events") or [])
 
+    def _h_metric_report(self, identity: bytes, m: dict) -> None:
+        """Fleet metrics plane ingest: merge one process's periodic
+        snapshot (seq-guarded — exactly-once-effect even past the
+        reliable layer's dedup window)."""
+        self.metrics_plane.ingest(m)
+
     def _h_subscribe(self, identity: bytes, m: dict) -> None:
         self.subs[m["channel"]].add(identity)
 
@@ -2707,6 +2751,7 @@ class Controller:
         P.STATE_QUERY: _h_state_query,
         P.TIMELINE_EVENTS: _h_timeline,
         P.TASK_EVENTS: _h_task_events,
+        P.METRIC_REPORT: _h_metric_report,
         P.SUBSCRIBE: _h_subscribe,
         P.PUBSUB: _h_pubsub,
         P.MSG_ACK: _h_msg_ack,

@@ -268,14 +268,22 @@ def _flow_id(span: str) -> int:
         return 1
 
 
-def build_chrome_trace(events: List[dict]) -> dict:
+def build_chrome_trace(events: List[dict],
+                       counters: Optional[List[dict]] = None) -> dict:
     """Render merged flight-recorder events as Chrome-trace/Perfetto
     JSON: one track (pid) per recording process, ``X`` slices for each
     RUNNING→FINISHED/FAILED execution attempt, instants for the other
     events, and flow arrows (``s``/``f`` pairs keyed by the task's span
     id) from each SUBMITTED site to every execution of that task — so
     a trace id can be followed visually across processes, replays
-    included."""
+    included.
+
+    ``counters`` (optional) are pre-built ``"ph": "C"`` counter events
+    from the fleet metrics plane
+    (``metrics_plane.MetricsPlane.chrome_counters``): each carries a
+    ``proc`` key naming its origin process and is re-homed onto that
+    process's track, so tokens/s / queue-depth / occupancy curves
+    render alongside the spans they explain."""
     procs: Dict[str, int] = {}
     trace_events: List[dict] = []
 
@@ -395,6 +403,15 @@ def build_chrome_trace(events: List[dict]) -> dict:
             "ts": e.get("ts", 0.0) * 1e6,
             "pid": pid_for(e.get("proc", "?")), "tid": 0,
             "args": args})
+
+    for c in counters or ():
+        if not isinstance(c, dict) or c.get("ph") != "C":
+            continue
+        e = dict(c)
+        proc = e.pop("proc", None)
+        if proc is not None:
+            e["pid"] = pid_for(proc)
+        trace_events.append(e)
 
     return {"traceEvents": trace_events, "displayTimeUnit": "ms",
             "otherData": {"source": "ray_tpu flight recorder",

@@ -125,6 +125,43 @@ class RuntimeMetrics:
         self.events_dropped = Counter(
             "runtime_events_dropped_total",
             "Flight-recorder events dropped at the ring-buffer cap")
+        # -- fleet metrics plane (core/metrics_plane.py)
+        self.metric_reports_dropped = Counter(
+            "runtime_metric_reports_dropped_total",
+            "METRIC_REPORT snapshots abandoned by this process "
+            "(superseded in-flight reports beyond the pending bound, "
+            "or a down send path)", tag_keys=("reason",))
+        self.metrics_update_errors = Counter(
+            "runtime_metrics_update_errors_total",
+            "update_from_state gauge-refresh failures (a broken gauge "
+            "path is visible here instead of silently swallowed)",
+            tag_keys=("source",))
+        # -- training telemetry (models/training.py + MPMDPipeline):
+        # the live versions of what bench.py records offline
+        self.train_step_wall = Histogram(
+            "train_step_wall_seconds",
+            "Wall time per optimizer step (dispatch to completion)",
+            boundaries=[0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
+                        10, 30])
+        self.train_tokens_per_s = Gauge(
+            "train_tokens_per_s",
+            "Training throughput over the last telemetry window")
+        self.train_loss = Gauge(
+            "train_loss", "Most recent training loss")
+        self.train_grad_norm = Gauge(
+            "train_grad_norm", "Most recent global gradient norm")
+        self.train_mfu = Gauge(
+            "train_mfu_pct",
+            "Model FLOP utilization (%) from the bench FLOP model "
+            "(flops_per_token x tokens/s over the chip's bf16 peak)")
+        # -- MPMD pipeline (parallel/mpmd_pipeline.py)
+        self.pipeline_mailbox_depth = Gauge(
+            "pipeline_stage_mailbox_depth",
+            "Microbatches parked in a stage actor's mailboxes "
+            "(activations + grads + targets)", tag_keys=("stage",))
+        self.pipeline_bubble = Gauge(
+            "pipeline_bubble_fraction",
+            "Measured pipeline bubble of the most recent step")
         # -- memory / health (reference: memory_manager worker kills)
         self.oom_worker_kills = Counter(
             "runtime_oom_worker_kills_total",
@@ -142,10 +179,33 @@ def runtime_metrics() -> RuntimeMetrics:
         return _defs
 
 
+#: sources whose update_from_state failure has already been logged —
+#: the counter keeps counting, the log fires once per (process, source)
+_update_error_logged: set = set()
+
+
+def _count_update_error(m: "RuntimeMetrics", source: str) -> None:
+    try:
+        m.metrics_update_errors.inc(tags={"source": source})
+    except Exception:
+        pass
+    if source not in _update_error_logged:
+        _update_error_logged.add(source)
+        import logging
+        logging.getLogger(__name__).warning(
+            "update_from_state: %s gauge refresh failed (logged once; "
+            "further failures count in "
+            "runtime_metrics_update_errors_total)", source,
+            exc_info=True)
+
+
 def update_from_state(controller=None, store_stats: Optional[Dict] = None,
                       node_stats: Optional[Dict] = None) -> None:
     """Refresh gauge families from component state (called from the
-    heartbeat/stats paths — gauges snapshot, counters accumulate)."""
+    heartbeat/stats paths — gauges snapshot, counters accumulate).
+    A failing gauge path is counted in
+    ``runtime_metrics_update_errors_total`` and logged once instead of
+    silently swallowed."""
     m = runtime_metrics()
     if controller is not None:
         try:
@@ -164,11 +224,18 @@ def update_from_state(controller=None, store_stats: Optional[Dict] = None,
                 1 for a in controller.actors.values()
                 if a.state in ("PENDING", "STARTING", "RESTARTING")))
         except Exception:
-            pass
+            _count_update_error(m, "controller")
     if store_stats:
-        m.object_store_bytes.set(store_stats.get("used_bytes", 0))
-        m.object_store_objects.set(store_stats.get("num_objects", 0))
+        try:
+            m.object_store_bytes.set(store_stats.get("used_bytes", 0))
+            m.object_store_objects.set(
+                store_stats.get("num_objects", 0))
+        except Exception:
+            _count_update_error(m, "store")
     if node_stats:
-        pct = node_stats.get("mem_percent")
-        if pct is not None:
-            m.node_mem_percent.set(pct)
+        try:
+            pct = node_stats.get("mem_percent")
+            if pct is not None:
+                m.node_mem_percent.set(pct)
+        except Exception:
+            _count_update_error(m, "node")

@@ -212,6 +212,18 @@ class NodeManager:
             rng=self._chaos.rng_for("retransmit")
             if self._chaos is not None else None, name="node",
             recorder=self.recorder)
+        # fleet metrics reporter: the node manager's registry (store
+        # gauges, transport counters) ships with the heartbeat cadence
+        from ray_tpu.util import metrics as MX
+        self.metrics_reporter = MX.make_reporter(
+            lambda payload: self._send(P.METRIC_REPORT, payload),
+            {"node": self.node_id.hex()[:12], "pid": os.getpid(),
+             "role": "node"},
+            self.config,
+            pending_drop=(
+                (lambda keep: self._reliable.drop_oldest_of(
+                    P.METRIC_REPORT, keep))
+                if self._reliable is not None else None))
 
     # ------------------------------------------------------------------ run
     def _register_with_controller(self) -> None:
@@ -803,6 +815,7 @@ class NodeManager:
             self._send(P.HEARTBEAT, {
                 "node_id": self.node_id.binary(), "stats": stats})
             self.recorder.maybe_flush()
+            self.metrics_reporter.maybe_report()
 
     # ----------------------------------------------------------- transfers
     # Receiving side drives (reference: pull_manager.h:52 — the puller

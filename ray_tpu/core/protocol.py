@@ -127,6 +127,14 @@ TASK_EVENTS = b"TEV"         # any->controller {events: [...]}: flight-
                              # the reliable layer (exactly-once-effect)
                              # but is fire-and-forget for the producer —
                              # a flush never blocks task progress.
+METRIC_REPORT = b"MRT"       # any->controller {origin, seq, ts,
+                             # metrics}: periodic full metric snapshot
+                             # (util/metrics.py::MetricsReporter) for
+                             # the fleet metrics plane
+                             # (core/metrics_plane.py). Reliable like
+                             # TEV, fire-and-forget for the producer;
+                             # stale in-flight reports are superseded
+                             # (drop-oldest, counted).
 PUBSUB = b"PUB"              # {channel, data} fanout
 SUBSCRIBE = b"SSC"           # {channel}
 GENERIC_REPLY = b"RPL"

@@ -79,6 +79,12 @@ RELIABLE_TYPES = frozenset({
               # merged event stream exactly-once-effect like the
               # lifecycle messages it describes; the producer side
               # stays fire-and-forget (a flush never blocks a task)
+    b"MRT",   # METRIC_REPORT  any -> controller: fleet metric snapshot
+              # (core/metrics_plane.py). Same contract as TEV —
+              # exactly-once-effect at the controller, fire-and-forget
+              # for the producer; the reporter additionally abandons
+              # superseded in-flight reports via drop_oldest_of (a
+              # snapshot is cumulative, so only the newest matters)
 })
 
 #: payload key carrying ``(sender tag, seq)``; popped before handlers
@@ -267,6 +273,24 @@ class ReliableTransport:
     def unacked(self) -> int:
         with self._lock:
             return len(self._ring)
+
+    def drop_oldest_of(self, mtype: bytes, keep: int) -> int:
+        """Abandon the OLDEST unacked in-flight messages of ``mtype``
+        beyond ``keep`` newest. For supersedable periodic reports
+        (METRIC_REPORT): a newer cumulative snapshot makes older ones
+        worthless, so retransmitting them through an outage is pure
+        backlog — the caller counts what it asked to drop. Returns the
+        number abandoned."""
+        with self._cond:
+            seqs = [s for s, e in self._ring.items()
+                    if e["mtype"] == mtype]
+            n = len(seqs) - max(0, keep)
+            if n <= 0:
+                return 0
+            for s in seqs[:n]:  # ring is seq-ordered: oldest first
+                del self._ring[s]
+            self.stats["dropped_superseded"] += n
+            return n
 
     # ---------------------------------------------------------- receiver
     def on_receive(self, route: Any, payload: Any) -> bool:

@@ -105,6 +105,23 @@ class Runtime:
             if self._chaos is not None else None, name=kind,
             recorder=self.recorder)
 
+        # fleet metrics reporter (util/metrics.py): periodic full-
+        # registry snapshots to the controller's metrics plane as
+        # METRIC_REPORT — fire-and-forget like the flight recorder,
+        # with superseded in-flight reports abandoned from the
+        # reliable ring (drop-oldest, counted) so a dead link never
+        # grows a backlog.
+        from ray_tpu.util import metrics as MX
+        self.metrics_reporter = MX.make_reporter(
+            self._send_metric_report,
+            {"node": node_id.hex()[:12], "pid": os.getpid(),
+             "role": kind},
+            self.config,
+            pending_drop=(
+                (lambda keep: self._reliable.drop_oldest_of(
+                    P.METRIC_REPORT, keep))
+                if self._reliable is not None else None))
+
         self.memory_store = InProcessStore()
         self.reference_counter = ReferenceCounter(self._flush_ref_deltas)
         self.reference_counter.set_owner_zero_fn(self._on_owner_zero)
@@ -284,6 +301,7 @@ class Runtime:
             except Exception:
                 pass
             self.recorder.maybe_flush()
+            self.metrics_reporter.maybe_report()
 
     @property
     def current_task_id(self) -> TaskID:
@@ -327,6 +345,12 @@ class Runtime:
         never grow memory or block a task)."""
         if not self._stopped.is_set():
             self._send(P.TASK_EVENTS, {"events": evs})
+
+    def _send_metric_report(self, payload: dict) -> None:
+        """Metrics-reporter ship hook (same contract as
+        :meth:`_send_events`)."""
+        if not self._stopped.is_set():
+            self._send(P.METRIC_REPORT, payload)
 
     def _send_direct(self, target: bytes, mtype: bytes, payload: Any) -> None:
         """Queue a message for a peer's direct channel (``target`` is the
@@ -735,6 +759,7 @@ class Runtime:
         self.reference_counter.flush()
         self.flush_timeline()
         self.recorder.flush()
+        self.metrics_reporter.release()
         self._stopped.set()
         if self._reliable is not None:
             self._reliable.stop()

@@ -365,7 +365,9 @@ class WorkerExecutor:
                     break
                 # idle: ship any buffered flight-recorder events (e.g.
                 # retransmit events from the reliable layer's thread)
+                # and the periodic fleet metric snapshot
                 self.runtime.recorder.maybe_flush()
+                self.runtime.metrics_reporter.maybe_report()
                 if ran_since_gc:
                     # idle collection: zero-copy arg values that ended up
                     # in reference cycles hold reader leases on their shm
@@ -925,6 +927,10 @@ class WorkerExecutor:
                     "task_id": tid_b, "index": index, "meta": meta,
                     "worker": me, "trace": spec.trace})
             rt.recorder.maybe_flush()
+            # long-lived generators (pipeline stages, data pipelines)
+            # may never hit the idle loop: yield time is their metric
+            # heartbeat
+            rt.metrics_reporter.maybe_report()
 
         def send_eof(count: int) -> None:
             if owner_b:

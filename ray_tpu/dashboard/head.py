@@ -156,6 +156,50 @@ def _make_handler(head: DashboardHead):
                                    400)
                         return
                     self._json({"rows": head.state(what, limit)})
+                elif path == "/metrics":
+                    # the single cluster Prometheus scrape target:
+                    # every process's samples, labelled by origin
+                    # (node/pid/role). MetricsPlane is internally
+                    # locked, so no loop marshal is needed.
+                    self._text(
+                        head.controller.metrics_plane.prometheus_text())
+                elif path == "/api/v0/metrics":
+                    self._json(
+                        {"metrics":
+                         head.controller.metrics_plane.catalog()})
+                elif path == "/api/v0/metrics/query":
+                    # ?name=<metric>&window=<s>&agg=<rate|sum|p99|...>
+                    from urllib.parse import parse_qs
+                    q = parse_qs(parsed.query)
+                    name = (q.get("name") or [""])[0]
+                    if not name:
+                        self._json({"error": "name query param "
+                                    "required"}, 400)
+                        return
+                    try:
+                        window = float((q.get("window") or ["60"])[0])
+                    except ValueError:
+                        self._json({"error": "window must be a "
+                                    "number"}, 400)
+                        return
+                    agg = (q.get("agg") or [None])[0]
+                    try:
+                        self._json(head.controller.metrics_plane.query(
+                            name, window_s=window, agg=agg))
+                    except ValueError as e:
+                        self._json({"error": str(e)}, 400)
+                elif path == "/api/v0/metrics/fleet":
+                    from urllib.parse import parse_qs
+                    q = parse_qs(parsed.query)
+                    try:
+                        window = float((q.get("window") or ["30"])[0])
+                    except ValueError:
+                        self._json({"error": "window must be a "
+                                    "number"}, 400)
+                        return
+                    self._json(
+                        head.controller.metrics_plane.fleet_summary(
+                            window_s=window))
                 elif path == "/api/timeline":
                     self._json(head.state("timeline", 100_000))
                 elif path == "/api/v0/events":
@@ -182,10 +226,14 @@ def _make_handler(head: DashboardHead):
                     # Perfetto/Chrome-trace JSON of the flight-recorder
                     # stream: load it at https://ui.perfetto.dev or
                     # chrome://tracing (one track per process, flow
-                    # arrows along trace ids)
+                    # arrows along trace ids). Fleet metric series ride
+                    # along as counter tracks ("ph":"C") — tokens/s,
+                    # queue depth and occupancy curves next to spans.
                     from ray_tpu.core.events import build_chrome_trace
                     self._json(build_chrome_trace(
-                        head.state("task_events", 100_000)))
+                        head.state("task_events", 100_000),
+                        counters=head.controller.metrics_plane
+                        .chrome_counters()))
                 elif path == "/api/jobs":
                     self._json(head.job_manager.list_jobs())
                 elif path == "/api/version":

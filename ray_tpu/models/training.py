@@ -41,6 +41,11 @@ class TrainStepBundle:
     batch_spec: Any
     grad_transport: str = "fp32"
     shard_weight_update: bool = False
+    #: live-telemetry cadence (see :meth:`_telemetry`); <= 0 disables
+    telemetry_interval_s: float = 0.5
+    _tel_last: float = dataclasses.field(default=0.0, repr=False)
+    _tel_tokens: float = dataclasses.field(default=0.0, repr=False)
+    _tel_steps: int = dataclasses.field(default=0, repr=False)
 
     def init(self, seed: int = 0) -> Dict:
         return self.init_fn(jax.random.PRNGKey(seed))
@@ -49,7 +54,60 @@ class TrainStepBundle:
         if "loss_mask" not in batch:
             batch = dict(batch, loss_mask=jnp.ones_like(
                 batch["input_ids"], dtype=jnp.float32))
-        return self.step_fn(state, batch)
+        out = self.step_fn(state, batch)
+        self._telemetry(batch, out[1])
+        return out
+
+    def _telemetry(self, batch: Dict, metrics: Dict) -> None:
+        """Per-step training telemetry into the fleet metrics plane —
+        the live version of what bench.py records offline. Steps are
+        only *counted* on the hot path; every ``telemetry_interval_s``
+        the accumulated window is closed: block on the (already
+        dispatched) step metrics, then set tokens/s, an MFU gauge from
+        the bench FLOP model (flops_per_token x tokens/s over the
+        chip's bf16 peak across the mesh), loss and grad norm, and
+        observe the mean step wall. Never raises; the interval gate
+        keeps device syncs off the steady-state step path."""
+        if self.telemetry_interval_s <= 0:
+            return
+        import time
+        now = time.monotonic()
+        if not self._tel_last:
+            self._tel_last = now
+        ids = batch["input_ids"]
+        self._tel_tokens += float(ids.size)
+        self._tel_steps += 1
+        elapsed = now - self._tel_last
+        if elapsed < self.telemetry_interval_s:
+            return
+        tokens, steps = self._tel_tokens, self._tel_steps
+        self._tel_last = now
+        self._tel_tokens = 0.0
+        self._tel_steps = 0
+        try:
+            from ray_tpu.core.metric_defs import runtime_metrics
+            m = runtime_metrics()
+            jax.block_until_ready(metrics)
+            tokens_per_s = tokens / elapsed
+            m.train_tokens_per_s.set(tokens_per_s)
+            m.train_step_wall.observe(elapsed / steps)
+            m.train_loss.set(float(metrics["loss"]))
+            m.train_grad_norm.set(float(metrics["grad_norm"]))
+            try:
+                from ray_tpu.parallel.mesh import chip_spec
+                achieved = tokens_per_s * \
+                    self.config.flops_per_token(ids.shape[-1])
+                peak = chip_spec().bf16_flops * max(1, self.mesh.size)
+                m.train_mfu.set(100.0 * achieved / peak)
+            except Exception:
+                pass
+            from ray_tpu.core.global_state import try_global_worker
+            w = try_global_worker()
+            if w is not None and getattr(w, "metrics_reporter",
+                                         None) is not None:
+                w.metrics_reporter.maybe_report()
+        except Exception:
+            pass
 
 
 def _default_optimizer(learning_rate: float, weight_decay: float):
@@ -71,7 +129,9 @@ def make_train_step(config: TransformerConfig, mesh,
                     grad_transport: str = "fp32",
                     shard_weight_update: bool = False,
                     quant_block_size: int = DEFAULT_BLOCK_SIZE,
-                    quant_stochastic: bool = False) -> TrainStepBundle:
+                    quant_stochastic: bool = False,
+                    telemetry_interval_s: float = 0.5
+                    ) -> TrainStepBundle:
     """Build sharded init + train-step functions over ``mesh``.
 
     The optimizer state inherits each parameter's sharding (ZeRO-style
@@ -256,7 +316,8 @@ def make_train_step(config: TransformerConfig, mesh,
                            init_fn=init_fn, step_fn=step_fn,
                            state_shardings=state_sh, batch_spec=batch_sh,
                            grad_transport=grad_transport,
-                           shard_weight_update=shard_weight_update)
+                           shard_weight_update=shard_weight_update,
+                           telemetry_interval_s=telemetry_interval_s)
 
 
 def make_eval_step(config: TransformerConfig, mesh,

@@ -414,6 +414,27 @@ class PipelineStage:
         self._grads: Dict[int, Any] = {}
         self._sqn = None
         self._stats = self._fresh_stats()
+        # live mailbox-depth gauge (fleet metrics plane): how many
+        # microbatches are parked waiting for this stage — the queue
+        # signal behind the bubbles the timeline shows
+        self._mbx_gauge = None
+        try:
+            from ray_tpu.core.metric_defs import runtime_metrics
+            self._mbx_gauge = runtime_metrics().pipeline_mailbox_depth
+        except Exception:
+            pass
+        self._mbx_tags = {"stage": str(stage)}
+
+    def _mbx_report_locked(self) -> None:
+        """Refresh the mailbox-depth gauge (``self._cond`` held)."""
+        if self._mbx_gauge is None:
+            return
+        try:
+            self._mbx_gauge.set(
+                len(self._acts) + len(self._grads_in) +
+                len(self._targets), tags=self._mbx_tags)
+        except Exception:
+            pass
 
     @staticmethod
     def _fresh_stats() -> Dict[str, float]:
@@ -508,16 +529,19 @@ class PipelineStage:
                 self._grads_in.update(grads)
             if targets:
                 self._targets.update(targets)
+            self._mbx_report_locked()
             self._cond.notify_all()
 
     def put_activation(self, chunk: int, i: int, x) -> None:
         with self._cond:
             self._acts[(chunk, i)] = x
+            self._mbx_report_locked()
             self._cond.notify_all()
 
     def put_grad(self, chunk: int, i: int, g) -> None:
         with self._cond:
             self._grads_in[(chunk, i)] = g
+            self._mbx_report_locked()
             self._cond.notify_all()
 
     def put_targets(self, i: int, input_ids, loss_mask=None) -> None:
@@ -549,7 +573,9 @@ class PipelineStage:
                         f"{self.mailbox_deadline_s} (neighbor stage "
                         f"dead?)")
                 self._cond.wait(0.1)
-            return box.pop(key)
+            out = box.pop(key)
+            self._mbx_report_locked()
+            return out
 
     # ------------------------------------------------------------ step
     def run(self, n_microbatches: int):
@@ -932,8 +958,52 @@ class MPMDPipeline:
         return ids_mb, mask_mb, ns
 
     def step(self, batch: Dict[str, Any]) -> PipelineStepResult:
-        return (self._step_serial if self.serial
-                else self._step_1f1b)(batch)
+        res = (self._step_serial if self.serial
+               else self._step_1f1b)(batch)
+        self._record_step_telemetry(batch, res)
+        return res
+
+    def _record_step_telemetry(self, batch: Dict[str, Any],
+                               res: PipelineStepResult) -> None:
+        """Per-step training telemetry into the fleet metrics plane:
+        step wall, tokens/s, measured bubble, grad norm and an MFU
+        gauge from the bench FLOP model — the live versions of what
+        ``bench.py --pipeline`` records offline."""
+        try:
+            from ray_tpu.core.metric_defs import runtime_metrics
+            m = runtime_metrics()
+            m.train_step_wall.observe(res.wall_s)
+            m.pipeline_bubble.set(res.bubble_fraction)
+            m.train_loss.set(res.loss)
+            if res.grad_norm is not None:
+                m.train_grad_norm.set(res.grad_norm)
+            if res.wall_s > 0:
+                import numpy as np
+                ids = np.asarray(batch["input_ids"])
+                tokens_per_s = float(ids.size) / res.wall_s
+                m.train_tokens_per_s.set(tokens_per_s)
+                try:
+                    from ray_tpu.parallel.mesh import chip_spec
+                    achieved = tokens_per_s * \
+                        self.config.flops_per_token(ids.shape[1])
+                    peak = chip_spec().bf16_flops * self.n_stages
+                    m.train_mfu.set(100.0 * achieved / peak)
+                except Exception:
+                    pass
+            rec = _recorder()
+            if rec is not None:
+                rec.maybe_flush()
+            w = None
+            try:
+                from ray_tpu.core.global_state import try_global_worker
+                w = try_global_worker()
+            except Exception:
+                pass
+            if w is not None and getattr(w, "metrics_reporter",
+                                         None) is not None:
+                w.metrics_reporter.maybe_report()
+        except Exception:
+            pass
 
     def _opt_tail(self) -> Tuple[Optional[float], Optional[int]]:
         """Train-mode tail after the backwards drain: reduce the

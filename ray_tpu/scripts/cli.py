@@ -148,6 +148,29 @@ def cmd_memory(args) -> None:
     print(json.dumps(state.summarize_objects(), indent=2))
 
 
+def cmd_top(args) -> None:
+    """`ray-tpu top` — live fleet view from the cluster metrics plane
+    (tools/top.py renders; the dashboard's /api/v0/metrics/fleet
+    serves)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.path.insert(0, repo_root)
+    try:
+        from tools.top import main as top_main
+    except ImportError:
+        raise SystemExit(
+            "ray-tpu top needs tools/top.py from the repository "
+            "checkout (run `python tools/top.py` directly)")
+    argv = []
+    if args.dashboard:
+        argv += ["--dashboard", args.dashboard]
+    if args.once:
+        argv += ["--once"]
+    argv += ["--interval", str(args.interval),
+             "--window", str(args.window)]
+    raise SystemExit(top_main(argv))
+
+
 def cmd_timeline(args) -> None:
     ray_tpu = _connect()
     out = args.output or f"/tmp/ray_tpu/timeline_{int(time.time())}.json"
@@ -291,6 +314,16 @@ def main() -> None:
     sp = sub.add_parser("timeline", help="dump Chrome trace")
     sp.add_argument("--output", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("top", help="live fleet metrics view")
+    sp.add_argument("--dashboard", default=None,
+                    help="dashboard address (defaults to the running "
+                    "session's)")
+    sp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--window", type=float, default=30.0)
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("submit", help="run a script against the cluster")
     sp.add_argument("script")

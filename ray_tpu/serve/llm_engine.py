@@ -493,6 +493,7 @@ class LLMEngine:
             self._params, jnp.asarray(toks), self._cache,
             jnp.asarray(bt), jnp.asarray(lens))
         out = self._np.asarray(out)
+        produced = 0
         with self._lock:
             for req in active:
                 if req.cancelled or self._slots[req.slot] is not req:
@@ -507,11 +508,20 @@ class LLMEngine:
                 req.generated += 1
                 req.out.put(tok)
                 self._tokens_total += 1
+                produced += 1
                 if req.generated >= req.max_new_tokens \
                         or req.seq_len + 1 >= self.config.max_seq_len:
                     self._release_locked(req)
                 else:
                     self._last_tok[req.slot] = tok
+        # decode tokens into the fleet counter (the first token per
+        # request is counted by _record_ttft), so the plane's
+        # rate(serve_engine_tokens_total) IS engine tokens/s
+        if produced and self._metrics is not None:
+            try:
+                self._metrics.serve_tokens.inc(produced)
+            except Exception:
+                pass
 
     def _release_locked(self, req: _Request,
                         err: Optional[BaseException] = None) -> None:
@@ -573,6 +583,16 @@ class LLMEngine:
                 self._recorder.maybe_flush()
             except Exception:
                 pass
+        # a replica decoding flat-out may never hit the worker idle
+        # loop: the stats cadence doubles as the fleet-report heartbeat
+        try:
+            from ray_tpu.core.global_state import try_global_worker
+            w = try_global_worker()
+            if w is not None and getattr(w, "metrics_reporter",
+                                         None) is not None:
+                w.metrics_reporter.maybe_report()
+        except Exception:
+            pass
 
 
 def _resolve_dtype(name):

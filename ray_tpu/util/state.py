@@ -95,6 +95,48 @@ def list_task_events(task_id: Optional[str] = None, filters=None,
     return rows[-limit:]
 
 
+def _fresh_local_report(w) -> None:
+    """Ship this process's current metric snapshot ahead of a plane
+    query (both ride the same FIFO link, so the report lands first —
+    the snapshot the query sees includes what the caller just did)."""
+    try:
+        w.metrics_reporter.report_now()
+    except Exception:
+        pass
+
+
+def list_metrics() -> List[dict]:
+    """The fleet metrics catalog (core/metrics_plane.py): one row per
+    metric name with type, help text, series count, contributing
+    origins, and the fleet total/sum for scalars."""
+    w = global_worker()
+    _fresh_local_report(w)
+    return w.state_query("metrics")
+
+
+def query_metric(name: str, window_s: float = 60.0,
+                 agg: Optional[str] = None) -> Dict[str, Any]:
+    """Fleet-aggregated time series for one metric over the trailing
+    window (see :meth:`MetricsPlane.query` for the ``agg`` table —
+    counter rates, gauge sum/avg/max/min, histogram p50..p99 from
+    bucket deltas)."""
+    w = global_worker()
+    _fresh_local_report(w)
+    return w.state_query(
+        "metrics_query",
+        params={"name": name, "window_s": window_s, "agg": agg})
+
+
+def fleet_metrics(window_s: float = 30.0) -> Dict[str, Any]:
+    """The ``ray-tpu top`` snapshot: per-process rows (tokens/s, queue
+    depth, TTFT quantiles, bubble, retransmits, credit stalls) plus
+    fleet aggregates."""
+    w = global_worker()
+    _fresh_local_report(w)
+    return w.state_query(
+        "metrics_fleet", params={"window_s": window_s})
+
+
 def summarize_task_latency() -> Dict[str, Any]:
     """Per-task-name latency summary from the flight recorder:
     scheduling delay (SUBMITTED→RUNNING) and execution time

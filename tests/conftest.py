@@ -62,6 +62,22 @@ def pytest_runtest_makereport(item, call):
             rep.sections.append(("chaos seed", line))
 
 
+@pytest.fixture(autouse=True)
+def _metrics_registry_isolation():
+    """Scoped metric-registry reset (util/metrics.py): metrics a test
+    registers are unregistered afterwards, so ``_registry`` doesn't
+    grow across the run and one test's labelsets can't bleed into
+    another's Prometheus/fleet snapshot. The process-wide runtime
+    catalog (core/metric_defs.py) is pinned BEFORE the mark so it is
+    never dropped."""
+    from ray_tpu.core.metric_defs import runtime_metrics
+    from ray_tpu.util import metrics as _mx
+    runtime_metrics()
+    mark = _mx.registry_snapshot()
+    yield
+    _mx.restore_registry(mark)
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu

@@ -51,6 +51,29 @@ def test_injector_deterministic_stream():
     assert any(d > 0 for p in plans_a for d, _ in p)  # delays
 
 
+def test_dup_copies_are_distinct_objects_with_shared_wseq():
+    """A duplicated payload must be a separate dict object carrying
+    the SAME __wseq__: both copies can coalesce into one MSG_BATCH,
+    and a shared object would be collapsed by pickle's memo table —
+    the first dispatch pops the dedup stamps and the second copy then
+    double-handles instead of deduping (regression: double-ingested
+    TEV batches under dup_prob)."""
+    import pickle
+
+    cfg = chaos.ChaosConfig(seed=7, dup={"RES": 1.0})
+    inj = chaos.ChaosInjector(cfg, "worker:1")
+    plan = inj.plan_send(None, b"RES", {"x": 1})
+    assert len(plan) == 2
+    (d1, p1), (d2, p2) = plan
+    assert d1 == 0.0 and d2 == 0.0
+    assert p1 is not p2, "dup shares the original payload object"
+    assert p1["__wseq__"] == p2["__wseq__"]
+    # the MSG_BATCH shape survives a pickle round-trip as two objects
+    m1, m2 = pickle.loads(pickle.dumps([p1, p2]))
+    assert m1 is not m2
+    assert m1.pop("__wseq__") == m2.pop("__wseq__")
+
+
 def test_protected_types_never_injected():
     cfg = chaos.ChaosConfig(seed=3, drop_prob=1.0, dup_prob=1.0,
                             delay_prob=1.0,
@@ -420,6 +443,25 @@ def _dump_postmortem(seed) -> None:
             with open(path, "w") as f:
                 json.dump({"seed": seed, "events": [],
                            "error": f"postmortem dump failed: {e}"}, f)
+        except Exception:
+            pass
+    # final fleet metrics snapshot next to the Perfetto postmortem
+    # (tools/chaos_matrix.sh sets the env var; render the dump with
+    # `python tools/top.py --input <file>`)
+    mpath = os.environ.get("RAY_TPU_CHAOS_METRICS_FILE")
+    if not mpath:
+        return
+    try:
+        from ray_tpu.util.state import fleet_metrics, list_metrics
+        with open(mpath, "w") as f:
+            json.dump({"seed": seed,
+                       "fleet_summary": fleet_metrics(),
+                       "catalog": list_metrics()}, f)
+    except Exception as e:
+        try:
+            with open(mpath, "w") as f:
+                json.dump({"seed": seed, "fleet_summary": {"rows": []},
+                           "error": f"metrics dump failed: {e}"}, f)
         except Exception:
             pass
 

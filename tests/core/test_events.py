@@ -225,7 +225,8 @@ def _validate_chrome_trace(trace: dict) -> None:
     evs = trace["traceEvents"]
     assert evs, "empty trace"
     for e in evs:
-        assert e["ph"] in ("X", "i", "M", "s", "f"), e
+        # "C" = fleet-metric counter tracks (core/metrics_plane.py)
+        assert e["ph"] in ("X", "i", "M", "s", "f", "C"), e
         assert isinstance(e["pid"], int)
         if e["ph"] != "M":
             assert isinstance(e["ts"], float) or isinstance(e["ts"], int)

@@ -1,0 +1,82 @@
+"""`ray-tpu top` renderer tests (tools/top.py): golden snapshot of the
+fleet table for a canned summary + the --input CLI path."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tools.top import render  # noqa: E402
+
+pytestmark = pytest.mark.observability
+
+FLEET = {
+    "window_s": 30.0,
+    "ts": 1000.0,
+    "rows": [
+        {"node": "abc123def456", "pid": 42, "role": "worker",
+         "last_report_s": 0.5, "tokens_per_s": 960.5,
+         "train_tokens_per_s": 0.0, "tasks_per_s": 1.25,
+         "queue_depth": 3.0, "ttft_p50_ms": 42.0,
+         "ttft_p99_ms": 180.5, "bubble": None, "mfu_pct": None,
+         "mailbox_depth": None, "retransmits": 7.0,
+         "credit_stall_s": 0.12, "reports_dropped": 0.0},
+        {"node": "abc123def456", "pid": 41, "role": "driver",
+         "last_report_s": 0.1, "tokens_per_s": 0.0,
+         "train_tokens_per_s": 3028.0, "tasks_per_s": 0.0,
+         "queue_depth": None, "ttft_p50_ms": None,
+         "ttft_p99_ms": None, "bubble": 0.137, "mfu_pct": 46.6,
+         "mailbox_depth": 2.0, "retransmits": 0.0,
+         "credit_stall_s": 0.0, "reports_dropped": 0.0},
+    ],
+    "fleet": {"processes": 2, "tokens_per_s": 960.5,
+              "train_tokens_per_s": 3028.0, "tasks_per_s": 1.25,
+              "retransmits": 7.0, "credit_stall_s": 0.12},
+}
+
+GOLDEN = """\
+ray-tpu top — 2 processes | fleet tokens/s 960.5 | train tokens/s \
+3028.0 | tasks/s 1.25 | retx 7 | credit stalls 0.12s | window 30.0s
+       ROLE         NODE    PID   TOK/S TRAIN-T/S TASKS/S QDEPTH \
+TTFT50ms TTFT99ms BUBBLE  MFU%  MBX  RETX STALLs
+------------------------------------------------------------------\
+-----------------------------------------------
+     driver abc123def456     41       0      3028       0      - \
+       -        -  13.7% 46.60    2     0      0
+     worker abc123def456     42  960.50         0    1.25      3 \
+      42   180.50      -     -    -     7   0.12"""
+
+
+def test_render_golden_snapshot():
+    assert render(FLEET) == GOLDEN
+
+
+def test_render_sorts_rows_deterministically():
+    shuffled = dict(FLEET, rows=list(reversed(FLEET["rows"])))
+    assert render(shuffled) == GOLDEN
+
+
+def test_render_empty_fleet():
+    text = render({"rows": [], "fleet": {}, "window_s": 30.0})
+    assert "0 processes" in text
+    assert text.count("\n") == 2  # header + columns + rule only
+
+
+def test_cli_once_from_input_file(tmp_path):
+    """`top.py --input <fleet dump>` renders a saved snapshot (the
+    chaos postmortem path) without a cluster."""
+    path = tmp_path / "fleet.json"
+    path.write_text(json.dumps({"seed": 1101, "fleet_summary": FLEET}))
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "top.py"),
+         "--input", str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == GOLDEN

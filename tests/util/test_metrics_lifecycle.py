@@ -1,0 +1,107 @@
+"""Metrics registry/server lifecycle regressions (util/metrics.py).
+
+- ``serve_prometheus`` close-previous semantics: a second call used to
+  silently overwrite the module global, leaking the old thread and
+  socket; now it stops the previous server first, ``stop_prometheus``
+  exists, and the bind host is a knob.
+- Registry scoping: ``_registry`` used to grow forever across a pytest
+  run with cross-test label state bleeding into Prometheus snapshots;
+  ``registry_snapshot``/``restore_registry`` (wired as an autouse
+  conftest fixture) bound it.
+"""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ray_tpu.util import metrics as MX
+
+pytestmark = pytest.mark.observability
+
+
+def _get(port: int) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        return r.read().decode()
+
+
+def test_serve_prometheus_second_call_closes_previous():
+    g = MX.Gauge("lifecycle_probe")
+    g.set(1.0)
+    p1 = MX.serve_prometheus(0)
+    assert "lifecycle_probe 1.0" in _get(p1)
+    p2 = MX.serve_prometheus(0)
+    assert p2 != p1
+    assert "lifecycle_probe 1.0" in _get(p2)
+    # the first server is GONE (socket closed), not leaked
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        _get(p1)
+    assert MX.stop_prometheus() is True
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        _get(p2)
+    # idempotent: nothing left to stop
+    assert MX.stop_prometheus() is False
+    # and restartable after a stop
+    p3 = MX.serve_prometheus(0)
+    assert "lifecycle_probe 1.0" in _get(p3)
+    MX.stop_prometheus()
+
+
+def test_serve_prometheus_bind_host_knob(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_METRICS_BIND_HOST", "0.0.0.0")
+    port = MX.serve_prometheus(0)
+    try:
+        # 0.0.0.0 binding still answers on loopback
+        assert _get(port).endswith("\n")
+    finally:
+        MX.stop_prometheus()
+    # explicit host argument beats the env knob
+    port = MX.serve_prometheus(0, host="127.0.0.1")
+    try:
+        assert _get(port).endswith("\n")
+    finally:
+        MX.stop_prometheus()
+
+
+def test_registry_scoped_reset():
+    before = len(MX.registry_snapshot())
+    mark = MX.registry_snapshot()
+    c = MX.Counter("scoped_probe_total")
+    c.inc(2.0)
+    assert "scoped_probe_total" in MX.export_prometheus()
+    dropped = MX.restore_registry(mark)
+    assert dropped == 1
+    assert len(MX.registry_snapshot()) == before
+    assert "scoped_probe_total" not in MX.export_prometheus()
+    # the unregistered metric still works locally, just unexported
+    c.inc(1.0)
+    assert c.snapshot()["samples"][0][1] == 3.0
+
+
+def test_isolated_registry_contextmanager():
+    with MX.isolated_registry():
+        MX.Gauge("ctx_probe").set(5.0)
+        assert "ctx_probe" in MX.export_prometheus()
+    assert "ctx_probe" not in MX.export_prometheus()
+
+
+def test_metric_clear_and_unregister():
+    with MX.isolated_registry():
+        h = MX.Histogram("clear_probe_seconds", boundaries=[1.0])
+        h.observe(0.5)
+        assert h.snapshot()["samples"]
+        h.clear()
+        assert not h.snapshot()["samples"]
+        h.unregister()
+        assert "clear_probe_seconds" not in MX.export_prometheus()
+        h.unregister()  # idempotent
+
+
+def test_conftest_fixture_isolates_label_state():
+    """The autouse fixture (tests/conftest.py) unregisters metrics a
+    previous test created: a probe with a unique name must not exist
+    in the registry at test start."""
+    names = [m.info["name"] for m in MX.registry_snapshot()]
+    assert "scoped_probe_total" not in names
+    assert "ctx_probe" not in names

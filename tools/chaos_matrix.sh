@@ -63,11 +63,13 @@ for seed in "${seeds[@]}"; do
     if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
         RAY_TPU_CHAOS_STATS_FILE="$stats_dir/soak_$seed.json" \
         RAY_TPU_CHAOS_POSTMORTEM_FILE="$postmortem_dir/postmortem_$seed.json" \
+        RAY_TPU_CHAOS_METRICS_FILE="$postmortem_dir/fleet_metrics_$seed.json" \
         JAX_PLATFORMS=cpu python -m pytest \
         "tests/core/test_chaos.py::test_chaos_soak" \
         -q -p no:cacheprovider -p no:randomly; then
         echo "=== seed=$seed PASSED ==="
-        rm -f "$postmortem_dir/postmortem_$seed.json"
+        rm -f "$postmortem_dir/postmortem_$seed.json" \
+              "$postmortem_dir/fleet_metrics_$seed.json"
     else
         echo "=== seed=$seed FAILED ==="
         failed+=("$seed")
@@ -139,6 +141,15 @@ if [ "${#failed[@]}" -gt 0 ]; then
                  "(python tools/timeline.py --input $pm)"
         else
             echo "  flight recorder: no postmortem (died before dump)"
+        fi
+        # final fleet metrics snapshot (cluster metrics plane): what
+        # every process was doing when the seed went red
+        fm="$postmortem_dir/fleet_metrics_$seed.json"
+        if [ -f "$fm" ]; then
+            echo "  fleet metrics: $fm" \
+                 "(python tools/top.py --input $fm)"
+        else
+            echo "  fleet metrics: no snapshot (died before dump)"
         fi
     done
     rm -rf "$stats_dir"

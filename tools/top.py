@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""top.py — `ray-tpu top`: live fleet view from the cluster metrics
+plane.
+
+One row per reporting process (driver / workers / node managers /
+controller), built from the controller's aggregated time-series rings
+(``ray_tpu/core/metrics_plane.py``): serving tokens/s and queue depth,
+fleet TTFT p50/p99, training tokens/s and MFU, pipeline bubble and
+mailbox depth, reliable-layer retransmits and streaming credit stalls.
+
+Usage:
+
+  # against a live dashboard (address from the running session if
+  # omitted — RAY_TPU_SESSION_DIR or /tmp/ray_tpu/latest_session):
+  python tools/top.py [--dashboard http://127.0.0.1:8265]
+
+  # one-shot snapshot (tests, scripts, CI artifacts):
+  python tools/top.py --once
+
+  # render a saved fleet summary (e.g. a chaos postmortem dump):
+  python tools/top.py --input fleet_metrics_1101.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_COLS = (
+    # (header, width, key, format)
+    ("ROLE", 11, "role", "s"),
+    ("NODE", 13, "node", "s"),
+    ("PID", 7, "pid", "d"),
+    ("TOK/S", 8, "tokens_per_s", "g"),
+    ("TRAIN-T/S", 10, "train_tokens_per_s", "g"),
+    ("TASKS/S", 8, "tasks_per_s", "g"),
+    ("QDEPTH", 7, "queue_depth", "g"),
+    ("TTFT50ms", 9, "ttft_p50_ms", "g"),
+    ("TTFT99ms", 9, "ttft_p99_ms", "g"),
+    ("BUBBLE", 7, "bubble", "pct"),
+    ("MFU%", 6, "mfu_pct", "g"),
+    ("MBX", 5, "mailbox_depth", "g"),
+    ("RETX", 6, "retransmits", "g"),
+    ("STALLs", 7, "credit_stall_s", "g"),
+)
+
+
+def _cell(value, width: int, fmt: str) -> str:
+    if value is None:
+        s = "-"
+    elif fmt == "s":
+        s = str(value)
+    elif fmt == "d":
+        s = str(int(value))
+    elif fmt == "pct":
+        s = f"{100.0 * float(value):.1f}%"
+    else:
+        v = float(value)
+        s = str(int(v)) if v == int(v) else f"{v:.2f}"
+    if len(s) > width:
+        s = s[:width - 1] + "~"
+    return s.rjust(width)
+
+
+def render(fleet: Dict) -> str:
+    """Deterministic text table for one fleet summary (sorted by
+    (role, node, pid) so snapshots golden-compare)."""
+    rows = sorted(fleet.get("rows", []),
+                  key=lambda r: (str(r.get("role")), str(r.get("node")),
+                                 int(r.get("pid", 0))))
+    f = fleet.get("fleet", {})
+    out: List[str] = []
+    out.append(
+        f"ray-tpu top — {f.get('processes', len(rows))} processes | "
+        f"fleet tokens/s {f.get('tokens_per_s', 0)} | "
+        f"train tokens/s {f.get('train_tokens_per_s', 0)} | "
+        f"tasks/s {f.get('tasks_per_s', 0)} | "
+        f"retx {int(f.get('retransmits', 0))} | "
+        f"credit stalls {f.get('credit_stall_s', 0)}s | "
+        f"window {fleet.get('window_s', 0)}s")
+    header = "".join(h.rjust(w) for h, w, _, _ in _COLS)
+    out.append(header)
+    out.append("-" * len(header))
+    for r in rows:
+        out.append("".join(_cell(r.get(k), w, fmt)
+                           for _, w, k, fmt in _COLS))
+    return "\n".join(out)
+
+
+def _default_dashboard() -> str:
+    session = os.environ.get("RAY_TPU_SESSION_DIR")
+    if not session and os.path.exists("/tmp/ray_tpu/latest_session"):
+        with open("/tmp/ray_tpu/latest_session") as fh:
+            session = fh.read().strip()
+    if session:
+        path = os.path.join(session, "dashboard.json")
+        if os.path.exists(path):
+            with open(path) as fh:
+                return json.load(fh)["address"]
+    raise SystemExit(
+        "No dashboard found (pass --dashboard http://host:port, or "
+        "set RAY_TPU_SESSION_DIR / start a cluster here)")
+
+
+def fetch_fleet(dashboard: str, window_s: float = 30.0) -> Dict:
+    import urllib.request
+    url = (dashboard.rstrip("/") +
+           f"/api/v0/metrics/fleet?window={window_s:g}")
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live fleet view from the cluster metrics plane")
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--dashboard",
+                     help="dashboard address (http://host:port)")
+    src.add_argument("--input",
+                     help="render a saved fleet-summary JSON instead "
+                     "of a live cluster (e.g. a chaos postmortem "
+                     "metrics dump)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period for the live view (s)")
+    ap.add_argument("--window", type=float, default=30.0,
+                    help="rate/quantile window (s)")
+    args = ap.parse_args(argv)
+
+    if args.input:
+        with open(args.input) as fh:
+            data = json.load(fh)
+        # accept a bare fleet summary or a postmortem wrapper
+        fleet = data.get("fleet_summary", data) \
+            if isinstance(data, dict) else data
+        print(render(fleet))
+        return 0
+
+    dashboard = args.dashboard or _default_dashboard()
+
+    def fetch():
+        try:
+            return fetch_fleet(dashboard, args.window)
+        except Exception as e:
+            raise SystemExit(
+                f"failed to fetch fleet metrics from {dashboard}: {e}")
+
+    if args.once:
+        print(render(fetch()))
+        return 0
+    try:
+        while True:
+            text = render(fetch())
+            sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
