@@ -21,6 +21,17 @@ Reported: tokens/s/chip (headline), TTFT p50/p99, inter-token latency
 p50/p99, the engine's batch-occupancy histogram, and the engine/model
 config that produced them. ``--smoke`` shrinks everything for CI.
 
+**Fleet mode** (``detail.fleet``): the same harness against an
+N-replica deployment under a many-client Poisson load where every
+prompt opens with a COMMON system prompt (>= 4 KV blocks long — the
+high-traffic shape prefix sharing exists for), with prompt-lookup
+speculative decode on and the handle's gauge-aware routing; then the
+identical schedule replays against a fleet with sharing+speculation
+OFF and round-robin routing (the pre-PR baseline). Emits fleet
+tokens/s/chip, fleet p99 TTFT, the aggregate prefix hit rate, the
+speculation acceptance rate, and ``vs_baseline`` — the fleet rows
+gated by ``tools/perf_gate.py --metric serve``.
+
 On TPU the model is sized up with the chip; on CPU a tiny config keeps
 the harness runnable anywhere (the CPU record is a smoke point for the
 serve series, like the CPU BENCH records).
@@ -46,11 +57,15 @@ def _percentile(xs: List[float], p: float) -> Optional[float]:
 
 def make_workload(n_requests: int, clients: int, seed: int,
                   mean_interarrival_s: float,
-                  prompt_rng=(4, 48), out_rng=(8, 32)) -> List[dict]:
+                  prompt_rng=(4, 48), out_rng=(8, 32),
+                  system_prompt: Optional[List[int]] = None) -> List[dict]:
     """Seeded request schedule: Poisson arrivals (exponential
     inter-arrival gaps), uniform prompt/output lengths. The SAME
-    schedule replays against both engine modes."""
+    schedule replays against both engine modes. ``system_prompt``
+    (fleet mode) is prepended to every request's sampled tail — the
+    shared-prefix traffic shape."""
     rng = random.Random(seed)
+    sys_p = list(system_prompt or [])
     t = 0.0
     reqs = []
     for i in range(n_requests):
@@ -58,7 +73,8 @@ def make_workload(n_requests: int, clients: int, seed: int,
         plen = rng.randint(*prompt_rng)
         reqs.append({
             "arrival_s": t,
-            "prompt": [rng.randrange(2, 128) for _ in range(plen)],
+            "prompt": sys_p + [rng.randrange(2, 128)
+                               for _ in range(plen)],
             "max_new_tokens": rng.randint(*out_rng),
             "client": i % clients,
         })
@@ -66,16 +82,19 @@ def make_workload(n_requests: int, clients: int, seed: int,
 
 
 def run_load(handle_factory, workload: List[dict], clients: int,
-             timeout_s: float = 600.0) -> Dict:
+             timeout_s: float = 600.0,
+             handle_opts: Optional[Dict] = None) -> Dict:
     """Replay the schedule with one thread + one handle per client;
     per-request TTFT / inter-token gaps are recorded client-side (what
-    a user of the HTTP proxy would observe)."""
+    a user of the HTTP proxy would observe). ``handle_opts`` are extra
+    ``handle.options`` (fleet mode: ``routing_policy``)."""
     per_client: Dict[int, List[dict]] = {c: [] for c in range(clients)}
     for r in workload:
         per_client[r["client"]].append(r)
     results: List[dict] = []
     errors: List[str] = []
     lock = threading.Lock()
+    opts = dict(handle_opts or {})
     t0 = time.monotonic()
 
     def client_loop(cid: int):
@@ -87,7 +106,7 @@ def run_load(handle_factory, workload: List[dict], clients: int,
             rec = {"client": cid, "tokens": 0}
             t_submit = time.monotonic()
             try:
-                gen = handle.options(stream=True).generate.remote(
+                gen = handle.options(stream=True, **opts).generate.remote(
                     r["prompt"], r["max_new_tokens"])
                 prev = None
                 gaps = []
@@ -139,8 +158,97 @@ def _ms(v: Optional[float]) -> Optional[float]:
     return round(v * 1e3, 2) if v is not None else None
 
 
+def _fleet_leg(name: str, model: Dict, engine: Dict, workload: List[dict],
+               clients: int, replicas: int, policy: str,
+               timeout_s: float = 600.0) -> Dict:
+    """One fleet measurement: deploy ``replicas`` copies, warm every
+    replica's jitted programs round-robin outside the window, replay
+    the schedule with ``policy`` routing, and fold in the per-replica
+    engine counters (prefix hits, speculation acceptance)."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import api as serve_api
+
+    dep = serve.deployment(
+        name=name, num_replicas=replicas,
+        max_ongoing_requests=4 * clients + 8)(serve.LLMServer)
+    serve.run(dep.bind(model=model, engine=engine), name=name)
+    handle = serve.get_app_handle(name)
+    for _ in range(replicas):
+        list(handle.options(
+            stream=True, routing_policy="round_robin").generate.remote(
+                workload[0]["prompt"][:4], 2))
+    load = run_load(lambda: serve.get_app_handle(name), workload,
+                    clients, timeout_s=timeout_s,
+                    handle_opts={"routing_policy": policy})
+    ctrl = serve_api._controller_or_none()
+    reps = ray_tpu.get(ctrl.get_replicas.remote(name))
+    stats = [ray_tpu.get(r.stats.remote(), timeout=60) for r in reps]
+    engines = [s.get("engine") or {} for s in stats]
+    hit = sum(e.get("prefix_hit_blocks_total") or 0 for e in engines)
+    pblocks = sum(e.get("prompt_blocks_total") or 0 for e in engines)
+    drafted = sum((e.get("spec") or {}).get("drafted") or 0
+                  for e in engines)
+    accepted = sum((e.get("spec") or {}).get("accepted") or 0
+                   for e in engines)
+    serve.delete(name)
+    return {
+        "replicas": replicas,
+        "routing": policy,
+        "tokens_per_s": load["tokens_per_s"],
+        "tokens_per_s_chip": round(load["tokens_per_s"] / replicas, 2),
+        "ttft_ms": load["ttft_ms"],
+        "inter_token_ms": load["inter_token_ms"],
+        "wall_s": load["wall_s"],
+        "tokens_total": load["tokens_total"],
+        "requests_done": load["requests_done"],
+        "errors": load["errors"],
+        "prefix_hit_blocks": hit,
+        "prompt_blocks": pblocks,
+        "prefix_hit_rate": round(hit / pblocks, 4) if pblocks else None,
+        "spec_drafted": drafted,
+        "spec_accepted": accepted,
+        "spec_acceptance": (round(accepted / drafted, 4)
+                            if drafted else None),
+        "per_replica_tokens": [e.get("tokens_total") for e in engines],
+    }
+
+
+def bench_fleet(model: Dict, engine: Dict, replicas: int, clients: int,
+                requests: int, seed: int, sys_prompt_tokens: int,
+                prompt_rng, out_rng, mean_interarrival_s: float,
+                timeout_s: float = 600.0) -> Dict:
+    """The fleet comparison: prefix sharing + prompt-lookup speculation
+    + gauge routing vs the sharing-off / speculation-off / round-robin
+    baseline on the SAME seeded schedule. Every prompt opens with one
+    common system prompt ``sys_prompt_tokens`` long (>= 4 KV blocks)."""
+    rng = random.Random(seed + 1)
+    system_prompt = [rng.randrange(2, 128)
+                     for _ in range(sys_prompt_tokens)]
+    workload = make_workload(requests, clients, seed,
+                             mean_interarrival_s=mean_interarrival_s,
+                             prompt_rng=prompt_rng, out_rng=out_rng,
+                             system_prompt=system_prompt)
+    eng_on = dict(engine, enable_prefix_sharing=True, spec_tokens=4)
+    eng_off = dict(engine, enable_prefix_sharing=False, spec_tokens=0)
+    fleet = _fleet_leg("llm_fleet", model, eng_on, workload, clients,
+                       replicas, policy="gauge", timeout_s=timeout_s)
+    base = _fleet_leg("llm_fleet_base", model, eng_off, workload,
+                      clients, replicas, policy="round_robin",
+                      timeout_s=timeout_s)
+    fleet["system_prompt_tokens"] = sys_prompt_tokens
+    fleet["clients"] = clients
+    fleet["requests"] = requests
+    fleet["baseline"] = base
+    fleet["vs_baseline"] = (
+        round(fleet["tokens_per_s_chip"] / base["tokens_per_s_chip"], 2)
+        if base["tokens_per_s_chip"] else None)
+    return fleet
+
+
 def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
-          seed: int = 0) -> dict:
+          seed: int = 0, fleet_replicas: int = 0,
+          fleet_clients: int = 0, fleet_requests: int = 0) -> dict:
     import jax
 
     import ray_tpu
@@ -159,6 +267,12 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
         workload = make_workload(requests, clients, seed,
                                  mean_interarrival_s=0.02,
                                  prompt_rng=(4, 12), out_rng=(6, 10))
+        fleet_kw = dict(replicas=fleet_replicas or 2,
+                        clients=fleet_clients or 6,
+                        requests=fleet_requests or 12,
+                        sys_prompt_tokens=4 * engine["kv_block_size"],
+                        prompt_rng=(2, 6), out_rng=(6, 10),
+                        mean_interarrival_s=0.02, timeout_s=120.0)
     elif on_tpu:
         model = {"vocab_size": 32000, "d_model": 2048, "n_layers": 8,
                  "n_heads": 16, "head_dim": 128, "d_ff": 8192,
@@ -169,6 +283,12 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
         workload = make_workload(requests, clients, seed,
                                  mean_interarrival_s=0.05,
                                  prompt_rng=(32, 512), out_rng=(32, 128))
+        fleet_kw = dict(replicas=fleet_replicas or 4,
+                        clients=fleet_clients or 200,
+                        requests=fleet_requests or 400,
+                        sys_prompt_tokens=4 * engine["kv_block_size"],
+                        prompt_rng=(16, 128), out_rng=(32, 128),
+                        mean_interarrival_s=0.02)
     else:
         # CPU sizing: wide enough that a decode step is weight-stream /
         # gemv bound, so step cost is nearly batch-independent — the
@@ -185,9 +305,16 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
         workload = make_workload(requests, clients, seed,
                                  mean_interarrival_s=0.005,
                                  prompt_rng=(8, 24), out_rng=(24, 48))
+        fleet_kw = dict(replicas=fleet_replicas or 2,
+                        clients=fleet_clients or 32,
+                        requests=fleet_requests or 64,
+                        sys_prompt_tokens=4 * engine["kv_block_size"],
+                        prompt_rng=(4, 16), out_rng=(16, 32),
+                        mean_interarrival_s=0.01)
 
-    ray_tpu.init(num_cpus=max(8, clients + 4), _num_initial_workers=3,
-                 ignore_reinit_error=True)
+    ray_tpu.init(num_cpus=max(8, clients + 4,
+                              fleet_kw["clients"] // 2 + 6),
+                 _num_initial_workers=3, ignore_reinit_error=True)
     modes = {}
     stats = {}
     try:
@@ -209,6 +336,11 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                 workload, clients)
             stats[mode] = handle.stats.remote().result(timeout_s=60)
             serve.delete(name)
+        # fleet leg: shared system prompt, gauge routing, prefix
+        # sharing + speculation vs the round-robin no-sharing baseline
+        t_fleet = time.monotonic()
+        fleet = bench_fleet(model, engine, seed=seed, **fleet_kw)
+        fleet["leg_wall_s"] = round(time.monotonic() - t_fleet, 2)
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
@@ -238,6 +370,7 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                                   "prefill_chunks", "free_blocks",
                                   "total_blocks")}
                              for m, s in stats.items()},
+            "fleet": fleet,
         },
     }
 
@@ -249,9 +382,19 @@ def main() -> int:
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fleet-replicas", type=int, default=0,
+                    help="fleet-leg replica count (0 = per-backend "
+                         "default: 2 CPU / 4 TPU)")
+    ap.add_argument("--fleet-clients", type=int, default=0,
+                    help="fleet-leg Poisson clients (0 = default)")
+    ap.add_argument("--fleet-requests", type=int, default=0,
+                    help="fleet-leg request count (0 = default)")
     args = ap.parse_args()
     rec = bench(smoke=args.smoke, clients=args.clients,
-                requests=args.requests, seed=args.seed)
+                requests=args.requests, seed=args.seed,
+                fleet_replicas=args.fleet_replicas,
+                fleet_clients=args.fleet_clients,
+                fleet_requests=args.fleet_requests)
     print(json.dumps(rec))
     return 0
 

@@ -121,6 +121,18 @@ class RuntimeMetrics:
         self.serve_tokens_per_s = Gauge(
             "serve_engine_tokens_per_s",
             "Engine decode throughput since start")
+        self.serve_prefix_hits = Counter(
+            "serve_engine_prefix_hit_blocks_total",
+            "Prompt KV blocks whose prefill was skipped via a radix "
+            "prefix-cache match (shared or copy-on-write)")
+        self.serve_blocks_shared = Gauge(
+            "serve_engine_blocks_shared",
+            "KV blocks currently referenced by more than one sequence")
+        self.serve_spec_accept = Histogram(
+            "serve_engine_spec_accept_ratio",
+            "Accepted/drafted ratio per speculative verify step "
+            "(prompt-lookup multi-token decode)",
+            boundaries=[0.0, 0.25, 0.5, 0.75, 1.0])
         # -- flight recorder (core/events.py)
         self.events_dropped = Counter(
             "runtime_events_dropped_total",

@@ -27,6 +27,7 @@ from ray_tpu.serve._private.replica import get_multiplexed_model_id
 from ray_tpu.serve.llm_engine import (
     EngineConfig, EngineDeadError, LLMEngine, LLMServer,
     RequestTooLargeError)
+from ray_tpu.serve.prefix_cache import PrefixBlockPool
 
 __all__ = [
     "Application",
@@ -39,6 +40,7 @@ __all__ = [
     "EngineDeadError",
     "LLMEngine",
     "LLMServer",
+    "PrefixBlockPool",
     "RequestTooLargeError",
     "batch",
     "delete",

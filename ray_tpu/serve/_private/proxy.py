@@ -195,7 +195,7 @@ class HTTPProxy:
             found = self._match(path)
         return found
 
-    def _handle_for(self, name: str, stream: bool):
+    def _handle_for(self, name: str, stream: bool, req=None):
         from ray_tpu.serve.handle import DeploymentHandle
         table = self._stream_handles if stream else self._handles
         h = table.get(name)
@@ -204,6 +204,15 @@ class HTTPProxy:
             if stream:
                 h = h.options(stream=True)
             table[name] = h
+        # session affinity for multi-turn clients: every request
+        # carrying the same x-session-id lands on the same replica, so
+        # the conversation's shared prefix stays warm in that replica's
+        # radix KV cache (options() shares the cached handle's router —
+        # load/affinity state spans all sessions)
+        if req is not None:
+            sid = req.header("x-session-id")
+            if sid:
+                h = h.options(stream=stream, session_id=sid)
         return h
 
     # ---------------------------------------------------------- dispatch
@@ -226,7 +235,8 @@ class HTTPProxy:
         return json.loads(req.body) if req.body else None
 
     async def _dispatch_unary(self, req_route, req, writer, loop):
-        handle = self._handle_for(req_route["name"], stream=False)
+        handle = self._handle_for(req_route["name"], stream=False,
+                                  req=req)
 
         def call():
             payload = self._payload(req)
@@ -249,7 +259,8 @@ class HTTPProxy:
         await self._write_simple(writer, 200, result)
 
     async def _dispatch_stream(self, req_route, req, writer, loop):
-        handle = self._handle_for(req_route["name"], stream=True)
+        handle = self._handle_for(req_route["name"], stream=True,
+                                  req=req)
 
         def start():
             payload = self._payload(req)

@@ -133,9 +133,13 @@ class Replica:
         return True
 
     def stats(self) -> Dict[str, Any]:
+        import os
+        # pid lets gauge-aware routers map this replica onto the fleet
+        # metrics plane's per-origin rows when direct probes go quiet
         out = {"replica_id": self.replica_id,
                "ongoing": self._num_ongoing,
-               "total": self._num_total}
+               "total": self._num_total,
+               "pid": os.getpid()}
         # engine-aware deployments (LLMServer & friends) expose their
         # scheduler counters; surface them for the autoscaler's
         # engine-gauge scale-up signals (queue depth, TTFT)

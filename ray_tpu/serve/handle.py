@@ -1,19 +1,51 @@
-"""DeploymentHandle: client-side router with power-of-two-choices.
+"""DeploymentHandle: client-side router — gauge-aware by default.
 
 Reference: ``python/ray/serve/handle.py`` + ``_private/router.py:259``
-and ``replica_scheduler/pow_2_scheduler.py:44`` — pick two candidate
-replicas, route to the less loaded. Load here is the router's own
-outstanding-refs count per replica (completed refs are drained with a
-zero-timeout wait) plus live streams, refreshed replica membership comes
-from the controller when its version bumps (simplified LongPollHost).
+and ``replica_scheduler/pow_2_scheduler.py:44``. Three routing
+policies (``options(routing_policy=...)``, default ``"gauge"``):
+
+- ``"gauge"`` — route on the per-replica ENGINE gauges (free decode
+  slots, free KV blocks, queue depth, TTFT EWMA from
+  ``Replica.stats()``), probed asynchronously and cached for
+  ``gauge_refresh_s``; replicas without engine gauges (plain
+  deployments) fall back to power-of-two-choices. When direct probes
+  go quiet the router backfills from the controller's fleet metrics
+  plane (``/api/v0/metrics/fleet``), matching rows to replicas by pid.
+- ``"pow2"`` — classic power-of-two-choices on the router's own
+  outstanding-refs count per replica plus live streams.
+- ``"round_robin"`` — cycle the membership list (the pre-gauge
+  baseline; ``bench_serve --fleet`` measures gauge routing against it).
+
+``options(session_id=...)`` adds **session affinity**: every call with
+the same session id lands on the same replica while it lives, so a
+multi-turn conversation's shared prefix KV blocks are HIT in that
+replica's radix cache instead of re-prefetched cold elsewhere.
 """
 
 from __future__ import annotations
 
 import random
+import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+
+
+def gauge_score(g: Dict[str, Any]) -> float:
+    """Desirability of a replica from its engine gauges (higher is
+    better): capacity to start decoding now (free slots), room for new
+    sequences' KV (free blocks), minus admission backlog and the
+    latency users are currently seeing (TTFT EWMA)."""
+    free_slots = g.get("free_slots") or 0
+    total_slots = free_slots + (g.get("active_slots") or 0)
+    slots_frac = free_slots / total_slots if total_slots else 0.0
+    total_blocks = g.get("total_blocks") or 0
+    blocks_frac = (g.get("free_blocks") or 0) / total_blocks \
+        if total_blocks else 0.0
+    queue = g.get("queue_depth") or 0
+    ttft = g.get("ttft_ewma_s") or 0.0
+    return 2.0 * slots_frac + blocks_frac - 0.5 * queue \
+        - min(float(ttft), 2.0)
 
 
 class DeploymentResponse:
@@ -120,6 +152,11 @@ class _Router:
     affinity. One _Router is shared by a handle and every configured
     copy made via ``options()``, so load tracking spans them all."""
 
+    #: seconds a gauge snapshot stays fresh before a new async probe
+    gauge_refresh_s = 0.5
+    #: direct-probe silence after which the fleet plane backfills
+    gauge_stale_s = 3.0
+
     def __init__(self, deployment_name: str, controller):
         self.deployment_name = deployment_name
         self.controller = controller
@@ -132,6 +169,16 @@ class _Router:
         # model id -> stable replica key (soft affinity, reference:
         # multiplexed model routing in replica_scheduler)
         self.model_affinity: Dict[str, bytes] = {}
+        # session id -> stable replica key: multi-turn stickiness so a
+        # session's shared prefix blocks stay where its KV lives
+        self.session_affinity: Dict[str, bytes] = {}
+        self.policy = "gauge"
+        # -- gauge cache: rkey -> {"t": monotonic, <engine stats>}
+        self.gauges: Dict[bytes, Dict[str, Any]] = {}
+        self._gauge_refs: Dict[bytes, Any] = {}   # in-flight probes
+        self._pids: Dict[int, bytes] = {}         # replica pid -> rkey
+        self._last_probe = 0.0
+        self._rr_next = 0
 
     @staticmethod
     def _key(replica) -> bytes:
@@ -158,6 +205,15 @@ class _Router:
                             if k in live}
             self.model_affinity = {m: k for m, k in
                                    self.model_affinity.items() if k in live}
+            self.session_affinity = {
+                s: k for s, k in self.session_affinity.items()
+                if k in live}
+            self.gauges = {k: v for k, v in self.gauges.items()
+                           if k in live}
+            self._gauge_refs = {k: v for k, v in self._gauge_refs.items()
+                                if k in live}
+            self._pids = {p: k for p, k in self._pids.items()
+                          if k in live}
 
     def load(self, replica) -> int:
         k = self._key(replica)
@@ -168,25 +224,135 @@ class _Router:
             self.outstanding[k] = list(pending)
         return len(self.outstanding[k]) + self.streams.get(k, 0)
 
-    def pick(self, model_id: Optional[str]):
+    # -- gauge probing ------------------------------------------------
+    def _poll_gauges(self) -> None:
+        """Harvest completed async ``Replica.stats`` probes (never
+        blocks the request path) and launch a fresh round when the
+        cache ages past ``gauge_refresh_s``."""
+        now = time.monotonic()
+        for k, ref in list(self._gauge_refs.items()):
+            try:
+                ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            except Exception:
+                del self._gauge_refs[k]
+                continue
+            if not ready:
+                continue
+            del self._gauge_refs[k]
+            try:
+                s = ray_tpu.get(ref)
+            except Exception:
+                self.gauges.pop(k, None)
+                continue
+            if isinstance(s, dict):
+                g = dict(s.get("engine") or {})
+                g["ongoing"] = s.get("ongoing")
+                g["t"] = now
+                self.gauges[k] = g
+                pid = s.get("pid")
+                if pid is not None:
+                    self._pids[int(pid)] = k
+        if now - self._last_probe >= self.gauge_refresh_s:
+            self._last_probe = now
+            for r in self.replicas:
+                k = self._key(r)
+                if k not in self._gauge_refs:
+                    try:
+                        self._gauge_refs[k] = r.stats.remote()
+                    except Exception:
+                        pass
+
+    def _fleet_backfill(self) -> None:
+        """Direct probes gone quiet (replica event loops saturated):
+        fall back to the controller's metrics plane —
+        ``/api/v0/metrics/fleet`` aggregates every replica's engine
+        gauges — and map rows onto replicas by pid."""
+        if not self._pids:
+            return
+        try:
+            from ray_tpu.util.state import fleet_metrics
+            rows = fleet_metrics(window_s=10.0).get("rows") or []
+        except Exception:
+            return
+        now = time.monotonic()
+        for row in rows:
+            k = self._pids.get(row.get("pid"))
+            if k is None:
+                continue
+            g = self.gauges.setdefault(k, {})
+            if now - g.get("t", 0.0) <= self.gauge_stale_s:
+                continue   # direct probe is fresher
+            if row.get("queue_depth") is not None:
+                g["queue_depth"] = row["queue_depth"]
+            if row.get("ttft_p50_ms") is not None:
+                g["ttft_ewma_s"] = row["ttft_p50_ms"] / 1e3
+            g["t"] = now
+
+    @staticmethod
+    def _has_signal(g: Dict[str, Any]) -> bool:
+        return any(key in g for key in
+                   ("free_slots", "queue_depth", "ttft_ewma_s"))
+
+    def _fresh_gauges(self) -> Dict[bytes, Dict[str, Any]]:
+        now = time.monotonic()
+        fresh = {k: g for k, g in self.gauges.items()
+                 if now - g.get("t", 0.0) <= self.gauge_stale_s
+                 and self._has_signal(g)}
+        if not fresh:
+            self._fleet_backfill()
+            fresh = {k: g for k, g in self.gauges.items()
+                     if now - g.get("t", 0.0) <= self.gauge_stale_s
+                     and self._has_signal(g)}
+        return fresh
+
+    def pick(self, model_id: Optional[str],
+             session_id: Optional[str] = None,
+             policy: Optional[str] = None):
         """Returns (replica, stable_key)."""
         n = len(self.replicas)
         by_key = {self._key(r): r for r in self.replicas}
+        policy = policy or self.policy
+        if session_id is not None:
+            k = self.session_affinity.get(session_id)
+            if k is not None and k in by_key:
+                # sticky: this session's earlier turns' prefix blocks
+                # live (warm) in this replica's radix cache
+                return by_key[k], k
         if model_id is not None:
             k = self.model_affinity.get(model_id)
             if k is not None and k in by_key:
                 # soft affinity: keep one model's requests on one replica
                 # so its weights stay resident
                 return by_key[k], k
+        replica = None
         if n == 1:
             replica = self.replicas[0]
-        else:
+        elif policy == "round_robin":
+            replica = self.replicas[self._rr_next % n]
+            self._rr_next += 1
+        elif policy == "gauge":
+            self._poll_gauges()
+            fresh = self._fresh_gauges()
+            scored = [(gauge_score(fresh[self._key(r)]), i, r)
+                      for i, r in enumerate(self.replicas)
+                      if self._key(r) in fresh]
+            if scored:
+                # in-flight work this router already routed but the
+                # gauges haven't seen yet still counts against a
+                # replica (prevents herding between probe rounds)
+                best = max(scored, key=lambda t: (
+                    t[0] - 0.25 * self.load(t[2]), -t[1]))
+                replica = best[2]
+        if replica is None:
+            # pow2 (or gauge fallback: no engine gauges yet/at all)
             i, j = random.sample(range(n), 2)
             a, b = self.replicas[i], self.replicas[j]
             replica = a if self.load(a) <= self.load(b) else b
         k = self._key(replica)
         if model_id is not None:
             self.model_affinity[model_id] = k
+        if session_id is not None:
+            self.session_affinity[session_id] = k
         return replica, k
 
     def stream_started(self, k: bytes) -> None:
@@ -212,13 +378,17 @@ class _MethodCaller:
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
                  app_name: str = "default", _router: Optional[_Router] = None,
-                 _stream: bool = False, _model_id: Optional[str] = None):
+                 _stream: bool = False, _model_id: Optional[str] = None,
+                 _session_id: Optional[str] = None,
+                 _routing_policy: Optional[str] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._controller = controller
         self._router = _router or _Router(deployment_name, controller)
         self._stream = _stream
         self._model_id = _model_id
+        self._session_id = _session_id
+        self._routing_policy = _routing_policy
 
     # -- routing ------------------------------------------------------
     def _route(self, method: str, args, kwargs):
@@ -234,7 +404,8 @@ class DeploymentHandle:
         kwargs = {k: (v._to_object_ref()
                       if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
-        replica, rkey = r.pick(self._model_id)
+        replica, rkey = r.pick(self._model_id, self._session_id,
+                               self._routing_policy)
         if self._stream:
             # core streaming generator task: the replica method's items
             # arrive as first-class objects with backpressure and the
@@ -277,25 +448,38 @@ class DeploymentHandle:
 
     def options(self, *, stream: bool = False,
                 multiplexed_model_id: Optional[str] = None,
+                session_id: Optional[str] = None,
+                routing_policy: Optional[str] = None,
                 **kwargs) -> "DeploymentHandle":
         """Configured copy of this handle (reference: handle.options).
-        Unknown options raise rather than silently no-op."""
+        ``session_id`` pins every call to one replica while it lives
+        (multi-turn prefix-cache affinity); ``routing_policy`` selects
+        "gauge" (default) / "pow2" / "round_robin". Unknown options
+        raise rather than silently no-op."""
         if kwargs:
             raise TypeError(
                 f"unsupported handle options: {sorted(kwargs)}")
+        if routing_policy not in (None, "gauge", "pow2", "round_robin"):
+            raise ValueError(
+                f"unknown routing_policy {routing_policy!r}")
         return DeploymentHandle(
             self.deployment_name, self._controller, self.app_name,
             _router=self._router, _stream=stream,
-            _model_id=multiplexed_model_id)
+            _model_id=multiplexed_model_id, _session_id=session_id,
+            _routing_policy=routing_policy)
 
     def __reduce__(self):
         # options survive pickling; router state is rebuilt on the far
         # side (membership is fetched fresh there anyway)
         return (_rebuild_handle,
                 (self.deployment_name, self._controller, self.app_name,
-                 self._stream, self._model_id))
+                 self._stream, self._model_id, self._session_id,
+                 self._routing_policy))
 
 
-def _rebuild_handle(deployment_name, controller, app_name, stream, model_id):
+def _rebuild_handle(deployment_name, controller, app_name, stream,
+                    model_id, session_id=None, routing_policy=None):
     return DeploymentHandle(deployment_name, controller, app_name,
-                            _stream=stream, _model_id=model_id)
+                            _stream=stream, _model_id=model_id,
+                            _session_id=session_id,
+                            _routing_policy=routing_policy)

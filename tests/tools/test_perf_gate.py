@@ -221,6 +221,76 @@ def test_serve_cli_self_compare_and_bootstrap(tmp_path):
         or "PASS" in r.stdout
 
 
+def _fleet_record(tps_chip=250.0, p99_ms=1000.0, hit=0.7, accept=0.4,
+                  **kw):
+    rec = _serve_record(**kw)
+    rec["detail"]["fleet"] = {
+        "tokens_per_s_chip": tps_chip,
+        "ttft_ms": {"p50": p99_ms / 3, "p99": p99_ms},
+        "prefix_hit_rate": hit,
+        "spec_acceptance": accept,
+    }
+    return rec
+
+
+def test_serve_fleet_rows_extracted():
+    m = extract_serve_metrics(_fleet_record())
+    assert m["serve/fleet_tokens_per_s_chip"] == 250.0
+    assert m["serve/fleet_prefix_hit_rate"] == 0.7
+    assert m["serve/fleet_spec_acceptance"] == 0.4
+    # p99 TTFT is lower-is-better: gated as its inverse (first tokens
+    # per second), so the shared relative comparison applies
+    assert m["serve/fleet_ttft_p99_inv"] == pytest.approx(1.0)
+
+
+def test_serve_fleet_ttft_regression_fails_as_inverse():
+    base = _fleet_record(p99_ms=1000.0)
+    ok, _ = compare(_fleet_record(p99_ms=1100.0), base, metric="serve")
+    assert ok            # 10% slower p99 -> inverse -9%, inside 15%
+    ok, msgs = compare(_fleet_record(p99_ms=1500.0), base,
+                       metric="serve")
+    assert not ok        # 50% slower p99 -> inverse -33% FAILS
+    assert any("fleet_ttft_p99_inv" in m and "FAIL" in m for m in msgs)
+    # and a fleet-throughput drop fails independently
+    ok, msgs = compare(_fleet_record(tps_chip=150.0), base,
+                       metric="serve")
+    assert not ok
+    assert any("fleet_tokens_per_s_chip" in m and "FAIL" in m
+               for m in msgs)
+
+
+def test_serve_fleet_rows_bootstrap_skip_vs_prefleet_baseline():
+    """Gating a fleet-era record against a pre-fleet baseline (r01) —
+    the fleet rows skip instead of failing bootstrap."""
+    ok, msgs = compare(_fleet_record(), _serve_record(), metric="serve")
+    assert ok
+    for row in ("fleet_tokens_per_s_chip", "fleet_ttft_p99_inv",
+                "fleet_prefix_hit_rate", "fleet_spec_acceptance"):
+        assert any(row in m and "skipped" in m for m in msgs), (row,
+                                                               msgs)
+
+
+def test_checked_in_r02_fleet_acceptance():
+    """The acceptance criteria, locked in by the checked-in record:
+    prefix hit rate >= 0.5 under the shared system prompt and fleet
+    tokens/s/chip strictly above the no-sharing round-robin baseline
+    on the same seed."""
+    with open(os.path.join(REPO, "SERVE_r02.json")) as f:
+        rec = parse_bench_record(json.load(f))
+    fleet = rec["detail"]["fleet"]
+    assert fleet["system_prompt_tokens"] >= \
+        4 * rec["detail"]["engine"]["kv_block_size"]
+    assert fleet["prefix_hit_rate"] >= 0.5
+    assert fleet["baseline"]["routing"] == "round_robin"
+    assert fleet["tokens_per_s_chip"] > \
+        fleet["baseline"]["tokens_per_s_chip"]
+    assert fleet["vs_baseline"] > 1.0
+    assert fleet["spec_acceptance"] is not None
+    m = extract_serve_metrics(rec)
+    assert m["serve/fleet_tokens_per_s_chip"] == \
+        fleet["tokens_per_s_chip"]
+
+
 def test_serve_baseline_backend_matching(tmp_path):
     (tmp_path / "SERVE_r01.json").write_text(
         json.dumps(_serve_record(tps=5000.0, backend="tpu")))

@@ -94,6 +94,27 @@ for seed in "${seeds[@]}"; do
     fi
 done
 
+# ---- serve-fleet soak leg: a 2-replica LLM fleet (prefix-sharing
+# radix KV + speculative decode + gauge routing) streams shared-prefix
+# requests under 5% drops with one replica SIGKILLed mid-decode; the
+# router must fail over without a hang and every request must end with
+# exactly one complete greedy stream (exactly-once token accounting;
+# pre-kill partials must be prefixes of the final stream), surviving
+# pools auditing clean (tests/serve/test_llm_engine.py::
+# test_serve_fleet_chaos_soak)
+for seed in "${seeds[@]}"; do
+    echo "=== serve-fleet soak: seed=$seed ==="
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/serve/test_llm_engine.py::test_serve_fleet_chaos_soak" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== serve seed=$seed PASSED ==="
+    else
+        echo "=== serve seed=$seed FAILED ==="
+        failed+=("serve:$seed")
+    fi
+done
+
 # ---- pipeline soak leg: SIGKILL a seeded-random stage actor mid-
 # interleaved-TRAIN-step (fwd+bwd+fused per-stage opt) → typed failure
 # at the driver, no hang, no leaked stream refs, cluster stays usable
@@ -127,6 +148,12 @@ if [ "${#failed[@]}" -gt 0 ]; then
             s="${seed#pipeline:}"
             echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
                  "tests/core/test_fault_tolerance.py::test_mpmd_pipeline_train_midstage_kill_fails_typed_no_hang -q"
+            continue
+            ;;
+        serve:*)
+            s="${seed#serve:}"
+            echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
+                 "tests/serve/test_llm_engine.py::test_serve_fleet_chaos_soak -q"
             continue
             ;;
         esac

@@ -18,8 +18,12 @@ Three gated series (``--metric``):
 - ``serve`` — the continuous-batching serving headline from
   ``bench_serve.py`` (tokens/s/chip), gated RELATIVELY: a fresh record
   more than ``--tolerance`` PERCENT below baseline (default 15%) fails.
-  Baselines: ``SERVE_r*.json``; like ``multichip``, an empty/unparseable
-  series bootstrap-passes.
+  Fleet-era records additionally gate the many-replica rows
+  (``detail.fleet``): fleet tokens/s/chip, fleet p99 TTFT (lower is
+  better — gated as its inverse 1000/p99_ms), prefix-cache hit rate
+  and speculation acceptance; pre-fleet baselines skip those rows
+  (bootstrap). Baselines: ``SERVE_r*.json``; like ``multichip``, an
+  empty/unparseable series bootstrap-passes.
 - ``pipeline`` — the MPMD pipeline headline from ``bench.py
   --pipeline`` (1F1B tokens/s), plus the SPMD-GPipe tokens/s, the
   stage utilization (1 − measured bubble fraction, so higher is
@@ -135,10 +139,32 @@ def extract_multichip_metrics(rec: dict) -> dict:
 def extract_serve_metrics(rec: dict) -> dict:
     """The serving headline (tokens/s/chip) plus the batching speedup
     when the record carries one (older records without it are skipped
-    by the comparison)."""
+    by the comparison), and — from fleet-era records (``detail.fleet``,
+    PR 12's many-replica mode) — the fleet rows: fleet tokens/s/chip,
+    fleet p99 TTFT gated lower-is-better as its inverse
+    (``1000/p99_ms``, first tokens per second — the shared relative
+    comparison is higher-is-better), the aggregate prefix-cache hit
+    rate and the speculation acceptance rate. Pre-fleet baselines
+    (SERVE_r01) carry none of these, so the fleet rows bootstrap-skip
+    against them."""
     out = {"serve_tokens_per_s_chip": float(rec["value"])}
     vs = rec.get("vs_serial")
     out["serve_vs_serial"] = float(vs) if vs is not None else None
+    fleet = (rec.get("detail") or {}).get("fleet") or {}
+    if isinstance(fleet, dict):
+        if fleet.get("tokens_per_s_chip") is not None:
+            out["serve/fleet_tokens_per_s_chip"] = \
+                float(fleet["tokens_per_s_chip"])
+        p99 = (fleet.get("ttft_ms") or {}).get("p99")
+        if p99:
+            out["serve/fleet_ttft_p99_inv"] = round(1000.0 / float(p99),
+                                                    4)
+        if fleet.get("prefix_hit_rate") is not None:
+            out["serve/fleet_prefix_hit_rate"] = \
+                float(fleet["prefix_hit_rate"])
+        if fleet.get("spec_acceptance") is not None:
+            out["serve/fleet_spec_acceptance"] = \
+                float(fleet["spec_acceptance"])
     return out
 
 
