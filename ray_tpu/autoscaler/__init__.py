@@ -1,18 +1,32 @@
-"""Autoscaler: scale node pools to pending demand.
+"""Autoscaler: scale node pools — and whole TPU slices — to pending
+demand.
 
 Reference: ``python/ray/autoscaler/`` (v1 StandardAutoscaler + providers).
+The slice layer (``slices.py``) adds the TPU-native gang unit: atomic
+multi-host slices acquired for SLICE_PACK/SLICE_SPREAD placement
+groups, drained preemption-aware on maintenance events, released whole.
 """
 
 from ray_tpu.autoscaler.autoscaler import (
     AutoscalerMonitor, NodeTypeConfig, StandardAutoscaler)
-from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider, FakeSliceProvider, NodeProvider,
+    SliceCapacityError)
+from ray_tpu.autoscaler.slices import (
+    SliceInfo, SliceManager, SliceTypeConfig, hosts_for_topology)
 from ray_tpu.autoscaler.v2 import AutoscalerV2
 
 __all__ = [
     "AutoscalerMonitor",
     "AutoscalerV2",
     "FakeNodeProvider",
+    "FakeSliceProvider",
     "NodeProvider",
     "NodeTypeConfig",
+    "SliceCapacityError",
+    "SliceInfo",
+    "SliceManager",
+    "SliceTypeConfig",
     "StandardAutoscaler",
+    "hosts_for_topology",
 ]

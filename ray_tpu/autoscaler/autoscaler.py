@@ -41,16 +41,27 @@ def _fits(node_resources: Dict[str, float],
 
 def collect_demand_snapshot(controller) -> dict:
     """Controller-loop-thread: pending demand + per-node busyness.
-    Shared by the v1 StandardAutoscaler and the v2 reconciler."""
+    Shared by the v1 StandardAutoscaler, the v2 reconciler, and the
+    SliceManager (which consumes ``slice_demand``)."""
     c = controller
     demand: List[Dict[str, float]] = []
+    slice_demand: List[dict] = []
     for key, q in c.ready_queues.items():
         for tid in q:
             t = c.tasks.get(tid)
             if t is not None and t.state == "QUEUED":
                 demand.append(c._sched_res(t.spec))
     for _, spec in c.pending_pgs:
-        demand.extend(b.resources for b in spec.bundles)
+        if spec.strategy in ("SLICE_PACK", "SLICE_SPREAD"):
+            # slice-spanning gangs demand a WHOLE slice, not loose
+            # nodes: surfaced separately so the node autoscaler never
+            # launches singles for them (autoscaler/slices.py consumes)
+            slice_demand.append({
+                "hosts": len(spec.bundles)
+                if spec.strategy == "SLICE_SPREAD" else 1,
+                "bundles": [dict(b.resources) for b in spec.bundles]})
+        else:
+            demand.extend(b.resources for b in spec.bundles)
     busy_nodes = set()
     for lease in c.leases.values():
         busy_nodes.add(lease.node_b)
@@ -62,8 +73,8 @@ def collect_demand_snapshot(controller) -> dict:
         if info.state != "DEAD" and info.node_id is not None:
             busy_nodes.add(info.node_id.binary())
     alive = {nb for nb, n in c.nodes.items() if n.alive}
-    return {"demand": demand, "busy_nodes": busy_nodes,
-            "alive_nodes": alive}
+    return {"demand": demand, "slice_demand": slice_demand,
+            "busy_nodes": busy_nodes, "alive_nodes": alive}
 
 
 def drain_node_if_idle(controller, node_b: bytes) -> bool:
@@ -227,14 +238,27 @@ class StandardAutoscaler:
 
 
 class AutoscalerMonitor:
-    """Background loop driving update() (reference: monitor.py:126)."""
+    """Background loop driving update() (reference: monitor.py:126).
+    Drives anything with an ``update()`` — v1, v2, or a SliceManager.
 
-    def __init__(self, autoscaler: StandardAutoscaler,
+    Every wait goes through the stop Event (never a bare
+    ``time.sleep``), so :meth:`stop` interrupts a sleeping loop
+    promptly. Repeated ``update()`` failures back off with the shared
+    jittered exponential (``util/backoff.py``) instead of hammering a
+    broken provider at the fixed interval; one success resets it."""
+
+    def __init__(self, autoscaler,
                  interval_s: float = 5.0):
         self.autoscaler = autoscaler
         self.interval_s = interval_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        from ray_tpu.util.backoff import ExponentialBackoff
+        # equal jitter keeps a floor of interval/2 — a failing pass
+        # must never retry faster than a healthy one polls
+        self._backoff = ExponentialBackoff(
+            base=max(0.1, interval_s), cap=max(60.0, interval_s),
+            jitter="equal")
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -242,11 +266,16 @@ class AutoscalerMonitor:
         self._thread.start()
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        delay = self.interval_s
+        while not self._stop.wait(delay):
             try:
                 self.autoscaler.update()
             except Exception:
                 logger.exception("autoscaler update failed")
+                delay = self._backoff.next_delay()
+            else:
+                self._backoff.reset()
+                delay = self.interval_s
 
     def stop(self) -> None:
         self._stop.set()

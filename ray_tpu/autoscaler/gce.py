@@ -193,7 +193,13 @@ class TPUApiClient:
     def wait_operation(self, operation: dict, timeout_s: float = 600.0,
                        poll_s: float = 5.0) -> dict:
         """Poll a long-running operation to completion (reference:
-        GCPTPU.wait_for_operation)."""
+        GCPTPU.wait_for_operation). Polling rides the shared jittered
+        backoff (util/backoff.py) growing to ``poll_s`` — fast first
+        checks for quick operations, de-correlated steady-state polls
+        for slow ones — through the injectable ``sleep_fn``."""
+        from ray_tpu.util.backoff import ExponentialBackoff
+        bo = ExponentialBackoff(base=min(1.0, poll_s), cap=poll_s,
+                                jitter="equal", rng=self._rng)
         deadline = time.monotonic() + timeout_s
         op = operation
         while not op.get("done"):
@@ -201,7 +207,7 @@ class TPUApiClient:
                 raise TPUApiError(
                     f"operation {op.get('name')} timed out "
                     f"after {timeout_s}s")
-            time.sleep(poll_s)
+            self._sleep(bo.next_delay())
             op = self.get_operation(op["name"])
         if "error" in op:
             # surface the operation metadata alongside the error: the
@@ -258,6 +264,8 @@ class GCETPUNodeProvider(NodeProvider):
         self._list_cache_at = 0.0
         self.list_cache_ttl_s = float(
             provider_config.get("list_cache_ttl_s", 5.0))
+        #: (slice id, notice) pairs already reported as drain events
+        self._maintenance_seen: set = set()
 
     # ----------------------------------------------------------- listing
     def _list_cluster_nodes(self) -> List[dict]:
@@ -362,6 +370,8 @@ class GCETPUNodeProvider(NodeProvider):
             raise KeyError(f"unknown provider node {node_id}")
         if op is not None:
             self.api.wait_operation(op, timeout_s=timeout_s)
+        from ray_tpu.util.backoff import ExponentialBackoff
+        bo = ExponentialBackoff(base=1.0, cap=5.0, jitter="equal")
         deadline = time.monotonic() + timeout_s
         while True:
             node = self.api.get_node(meta["name"])
@@ -374,7 +384,9 @@ class GCETPUNodeProvider(NodeProvider):
             if time.monotonic() > deadline:
                 raise TPUApiError(f"slice {node_id} not READY "
                                   f"after {timeout_s}s")
-            time.sleep(5.0)
+            # jittered poll through the API client's injectable sleep
+            # (tests never really wait; real runs don't sync-poll)
+            self.api._sleep(bo.next_delay())
 
     # ------------------------------------------------------- termination
     def terminate_node(self, node_id: str) -> None:
@@ -413,6 +425,49 @@ class GCETPUNodeProvider(NodeProvider):
             if n.get("labels", {}).get(LABEL_NODE_ID) == node_id:
                 return list(n.get("networkEndpoints", []))
         return []
+
+    # ---- slice-granular API: one provider node IS one pod slice ----
+    def create_slice(self, slice_type: str, topology: str = "",
+                     host_resources: Optional[Dict[str, float]] = None
+                     ) -> str:
+        return self.create_node(
+            slice_type,
+            dict(host_resources or self._resources.get(slice_type, {})))
+
+    def delete_slice(self, slice_id: str) -> None:
+        self.terminate_node(slice_id)
+
+    def slice_hosts(self, slice_id: str) -> List[str]:
+        eps = self.host_endpoints(slice_id)
+        return [e.get("ipAddress") or f"{slice_id}-host{i}"
+                for i, e in enumerate(eps)]
+
+    def maintenance_events(self) -> List[dict]:
+        """Upcoming-maintenance drain notices from the node listing:
+        the TPU API surfaces scheduled host maintenance on the node
+        body (``upcomingMaintenance``) and self-repair as the
+        REPAIRING state — either one means the slice's hosts are about
+        to bounce, so the SliceManager drains proactively. Each
+        (slice, notice) pair is reported once."""
+        out: List[dict] = []
+        for n in self._list_cluster_nodes():
+            nid = n.get("labels", {}).get(LABEL_NODE_ID)
+            if not nid:
+                continue
+            notice = n.get("upcomingMaintenance")
+            if notice is None and n.get("state") == "REPAIRING":
+                notice = "REPAIRING"
+            if notice is None:
+                continue
+            key = (nid, json.dumps(notice, sort_keys=True)
+                   if isinstance(notice, dict) else str(notice))
+            with self._lock:
+                if key in self._maintenance_seen:
+                    continue
+                self._maintenance_seen.add(key)
+            out.append({"slice_id": nid, "kind": "maintenance",
+                        "event_id": f"gce-{len(self._maintenance_seen)}"})
+        return out
 
 
 def state_resolver(provider_node_label: str = LABEL_NODE_ID):

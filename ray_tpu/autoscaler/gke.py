@@ -113,8 +113,12 @@ class K8sApiClient:
                     raise K8sApiError(
                         f"k8s API {method} {path} unreachable: "
                         f"{e.reason}") from e
-            self._sleep(min(30.0, 2.0 ** attempt)
-                        * (0.5 + 0.5 * self._rng.random()))
+            # shared retry shape (util/backoff.py): same envelope as
+            # the historical inline formula — equal jitter, base 1s,
+            # 30s cap
+            from ray_tpu.util.backoff import backoff_delay
+            self._sleep(backoff_delay(attempt, base=1.0, cap=30.0,
+                                      jitter="equal", rng=self._rng))
             attempt += 1
 
     # ----------------------------------------------------------- objects
@@ -178,6 +182,8 @@ class GKETPUNodeProvider(NodeProvider):
         self._pods_cache_at = 0.0
         self.pods_cache_ttl_s = float(
             provider_config.get("pods_cache_ttl_s", 5.0))
+        #: (slice id, annotation) pairs already reported as drains
+        self._maintenance_seen: set = set()
 
     # ------------------------------------------------------------ helpers
     def _group_index(self, cr: dict, group: str) -> int:
@@ -333,3 +339,44 @@ class GKETPUNodeProvider(NodeProvider):
                     == node_id:
                 n += 1
         return max(1, n)
+
+    # ---- slice-granular API: one workergroup replica IS one slice ----
+    def create_slice(self, slice_type: str, topology: str = "",
+                     host_resources: Optional[Dict[str, float]] = None
+                     ) -> str:
+        return self.create_node(
+            slice_type,
+            dict(host_resources
+                 or self._resources.get(slice_type, {})))
+
+    def delete_slice(self, slice_id: str) -> None:
+        self.terminate_node(slice_id)
+
+    def slice_hosts(self, slice_id: str) -> List[str]:
+        return [p["metadata"].get("name", "")
+                for p in self._cluster_pods()
+                if p["metadata"].get("labels", {}).get(LABEL_NODE_ID)
+                == slice_id]
+
+    def maintenance_events(self) -> List[dict]:
+        """Kubernetes drain notices: a pod annotated
+        ``ray-tpu/maintenance`` (what a node-drain webhook or the
+        operator stamps ahead of TPU maintenance) flags its whole
+        slice for a preemption-aware drain. Each (slice, annotation)
+        pair is reported once."""
+        out: List[dict] = []
+        for p in self._cluster_pods():
+            md = p.get("metadata", {})
+            nid = md.get("labels", {}).get(LABEL_NODE_ID)
+            notice = (md.get("annotations") or {}).get(
+                "ray-tpu/maintenance")
+            if not nid or notice is None:
+                continue
+            key = (nid, str(notice))
+            with self._lock:
+                if key in self._maintenance_seen:
+                    continue
+                self._maintenance_seen.add(key)
+            out.append({"slice_id": nid, "kind": "maintenance",
+                        "event_id": f"gke-{len(self._maintenance_seen)}"})
+        return out

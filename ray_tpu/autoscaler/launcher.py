@@ -12,8 +12,13 @@ host VM of a slice via the command runner (reference:
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import signal
 import subprocess
+import sys
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import yaml
@@ -85,6 +90,64 @@ def validate_cluster_config(cfg: Dict[str, Any]) -> Dict[str, Any]:
         raise ConfigError(
             f"'head_node_type' {head_type!r} is not one of "
             f"available_node_types {sorted(types)}")
+    # ---- slices: the gang units `ray-tpu up` brings up whole and the
+    # SliceManager scales (autoscaler/slices.py)
+    slices = cfg.setdefault("slices", {})
+    if not isinstance(slices, dict):
+        raise ConfigError("'slices' must be a mapping")
+    from ray_tpu.autoscaler.slices import hosts_for_topology
+    for name, s in slices.items():
+        if not isinstance(s, dict):
+            raise ConfigError(f"'slices.{name}' must be a mapping")
+        path = f"slices.{name}."
+        topo = need(s, "topology", str, path)
+        try:
+            n_hosts = hosts_for_topology(topo)
+        except ValueError as e:
+            raise ConfigError(f"'{path}topology': {e}") from None
+        s.setdefault("count", 1)
+        s.setdefault("min_slices", 0)
+        s.setdefault("max_slices", max(int(s.get("count") or 0), 4))
+        for bound in ("count", "min_slices", "max_slices"):
+            if not isinstance(s[bound], int) or s[bound] < 0:
+                raise ConfigError(
+                    f"'{path}{bound}' must be a non-negative integer")
+        if s["count"] > s["max_slices"]:
+            raise ConfigError(
+                f"'{path}count' ({s['count']}) exceeds max_slices "
+                f"({s['max_slices']})")
+        res = s.setdefault("host_resources", {"CPU": 1})
+        if not isinstance(res, dict):
+            raise ConfigError(f"'{path}host_resources' must be a mapping")
+        for k, v in res.items():
+            if not isinstance(v, (int, float)) or v < 0:
+                raise ConfigError(
+                    f"'{path}host_resources.{k}' must be a "
+                    f"non-negative number")
+        s.setdefault("node_config", {})
+        if not isinstance(s["node_config"], dict):
+            raise ConfigError(f"'{path}node_config' must be a mapping")
+        placement = s.get("placement")
+        if placement is not None:
+            if not isinstance(placement, dict):
+                raise ConfigError(f"'{path}placement' must be a mapping")
+            strat = placement.setdefault("strategy", "SLICE_SPREAD")
+            if strat not in ("SLICE_PACK", "SLICE_SPREAD"):
+                raise ConfigError(
+                    f"'{path}placement.strategy' must be SLICE_PACK "
+                    f"or SLICE_SPREAD, got {strat!r}")
+            bundles = placement.get("bundles")
+            if not isinstance(bundles, list) or not bundles or \
+                    not all(isinstance(b, dict) for b in bundles):
+                raise ConfigError(
+                    f"'{path}placement.bundles' must be a non-empty "
+                    f"list of resource mappings")
+            if strat == "SLICE_SPREAD" and len(bundles) > n_hosts:
+                raise ConfigError(
+                    f"'{path}placement.bundles': {len(bundles)} "
+                    f"bundles exceed the {n_hosts} host VM(s) of "
+                    f"topology {topo!r} (SLICE_SPREAD needs one "
+                    f"distinct host per bundle)")
     cfg.setdefault("max_workers", 8)
     cfg.setdefault("setup_commands", [])
     cfg.setdefault("head_start_commands", [])
@@ -147,6 +210,7 @@ def _make_provider(cfg: Dict[str, Any],
     if ptype == "gce_tpu":
         from ray_tpu.autoscaler.gce import (
             GCETPUNodeProvider, state_resolver)
+        from ray_tpu.autoscaler.slices import hosts_for_topology
         provider_cfg["cluster_name"] = cfg["cluster_name"]
         provider_cfg["node_configs"] = {
             name: t.get("node_config", {})
@@ -154,12 +218,25 @@ def _make_provider(cfg: Dict[str, Any],
         provider_cfg["resources"] = {
             name: t["resources"]
             for name, t in cfg["available_node_types"].items()}
+        # slices are provider nodes too (one node == one slice):
+        # slice-level resources = per-host resources x host count
+        for name, s in cfg.get("slices", {}).items():
+            provider_cfg["node_configs"].setdefault(
+                name, s.get("node_config", {}))
+            hosts = hosts_for_topology(s["topology"])
+            provider_cfg["resources"].setdefault(name, {
+                k: v * hosts
+                for k, v in s.get("host_resources", {}).items()})
         return GCETPUNodeProvider(provider_cfg, api=api,
                                   resolve_internal=state_resolver())
     if ptype == "fake":
         from ray_tpu.autoscaler.node_provider import FakeNodeProvider
         return FakeNodeProvider(provider_cfg.get("session_dir", "/tmp"),
                                 provider_cfg)
+    if ptype == "fake_slice":
+        from ray_tpu.autoscaler.node_provider import FakeSliceProvider
+        return FakeSliceProvider(provider_cfg.get("session_dir"),
+                                 provider_cfg)
     raise ConfigError(f"unknown provider type {ptype!r}")
 
 
@@ -219,10 +296,21 @@ class ClusterLauncher:
                              .cfg["cluster_name"])
                     for c in self.cfg["worker_start_commands"]]):
                 runner.run(cmd)
-        logger.info("cluster %s is up (head=%s ip=%s)",
-                    self.cfg["cluster_name"], head, head_ip)
+        # bring up the configured gang slices whole (the SliceManager
+        # running on the head scales them from there)
+        slice_ids: List[str] = []
+        if self.cfg.get("slices") and \
+                hasattr(self.provider, "create_slice"):
+            for name, s in self.cfg["slices"].items():
+                for _ in range(int(s.get("count", 1))):
+                    slice_ids.append(self.provider.create_slice(
+                        name, s.get("topology", ""),
+                        s.get("host_resources")))
+        logger.info("cluster %s is up (head=%s ip=%s slices=%d)",
+                    self.cfg["cluster_name"], head, head_ip,
+                    len(slice_ids))
         return {"head_node": head, "head_ip": head_ip,
-                "created": created}
+                "created": created, "slices": slice_ids}
 
     def _existing_head(self) -> Optional[str]:
         head_type = self.cfg["head_node_type"]
@@ -287,3 +375,138 @@ class ClusterLauncher:
             cmd += ["-i", key]
         cmd.append(f"{self.cfg['auth']['ssh_user']}@{ips[0]}")
         return cmd
+
+
+# ------------------------------------------------------- local launcher
+class LocalClusterLauncher:
+    """``ray-tpu up/down`` against the LOCAL fake providers: the head
+    is a local daemon (``ray_tpu.scripts.head``) and every slice's
+    host VMs are local node-manager processes (``FakeSliceProvider``)
+    — the zero-cloud round-trip the subprocess tests drive, and the
+    laptop-scale way to try gang scheduling end to end.
+
+    State lives under the session dir (``provider.session_dir`` in the
+    YAML, default ``/tmp/ray_tpu/<cluster_name>``): the head pid in
+    ``launcher_state.json`` and the slice inventory in the provider's
+    own ``fake_slices.json`` — so ``down`` from a fresh process finds
+    everything ``up`` started."""
+
+    STATE_FILE = "launcher_state.json"
+
+    def __init__(self, cfg: Dict[str, Any]):
+        self.cfg = cfg
+        self.session_dir = cfg["provider"].get("session_dir") or \
+            os.path.join("/tmp/ray_tpu", cfg["cluster_name"])
+
+    def _state_path(self) -> str:
+        return os.path.join(self.session_dir, self.STATE_FILE)
+
+    def _load_state(self) -> dict:
+        try:
+            with open(self._state_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _head_alive(self) -> bool:
+        pid = self._load_state().get("head_pid")
+        if not pid:
+            return False
+        try:
+            os.kill(pid, 0)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False
+
+    def _provider(self):
+        from ray_tpu.autoscaler.node_provider import FakeSliceProvider
+        pcfg = dict(self.cfg["provider"])
+        pcfg["session_dir"] = self.session_dir
+        return FakeSliceProvider(self.session_dir, pcfg)
+
+    # -------------------------------------------------------------- up
+    def up(self, wait_ready_s: float = 30.0) -> Dict[str, Any]:
+        os.makedirs(self.session_dir, exist_ok=True)
+        head_type = self.cfg["head_node_type"]
+        head_res = self.cfg["available_node_types"][head_type][
+            "resources"]
+        created_head = False
+        if not self._head_alive():
+            cmd = [sys.executable, "-m", "ray_tpu.scripts.head",
+                   "--session-dir", self.session_dir,
+                   "--num-cpus", str(head_res.get("CPU", 1)),
+                   "--initial-workers", "1"]
+            with open(os.path.join(self.session_dir, "head.log"),
+                      "ab") as log:
+                proc = subprocess.Popen(
+                    cmd, stdout=log, stderr=subprocess.STDOUT,
+                    start_new_session=True)
+            with open(self._state_path(), "w") as f:
+                json.dump({"head_pid": proc.pid}, f)
+            created_head = True
+            # ready == controller socket bound AND session.json
+            # written (init writes the json after the bind; drivers
+            # need both to connect)
+            markers = [os.path.join(self.session_dir, p)
+                       for p in ("controller.sock", "session.json")]
+            deadline = time.monotonic() + wait_ready_s
+            while not all(os.path.exists(p) for p in markers):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"head daemon exited rc={proc.returncode} "
+                        f"(see {self.session_dir}/head.log)")
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"head not ready after {wait_ready_s}s")
+                time.sleep(0.1)
+        provider = self._provider()
+        slice_ids: List[str] = []
+        for name, s in self.cfg.get("slices", {}).items():
+            for _ in range(int(s.get("count", 1))):
+                slice_ids.append(provider.create_slice(
+                    name, s["topology"], s.get("host_resources")))
+        logger.info("local cluster %s up: session=%s slices=%s",
+                    self.cfg["cluster_name"], self.session_dir,
+                    slice_ids)
+        return {"session_dir": self.session_dir,
+                "head_pid": self._load_state().get("head_pid"),
+                "created": created_head, "slices": slice_ids}
+
+    # ------------------------------------------------------------ down
+    def down(self, keep_head: bool = False) -> Dict[str, Any]:
+        provider = self._provider()
+        gone = list(provider.non_terminated_nodes())
+        for sid in gone:
+            provider.delete_slice(sid)
+        head_pid = self._load_state().get("head_pid")
+        if head_pid and not keep_head:
+            try:
+                os.kill(head_pid, signal.SIGTERM)
+                for _ in range(100):
+                    try:
+                        # reap if it's our own child, else a zombie
+                        # would keep answering signal 0 forever
+                        os.waitpid(head_pid, os.WNOHANG)
+                    except ChildProcessError:
+                        pass
+                    os.kill(head_pid, 0)
+                    time.sleep(0.1)
+                else:
+                    os.kill(head_pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                os.remove(self._state_path())
+            except OSError:
+                pass
+        logger.info("local cluster %s down: %d slice(s) terminated",
+                    self.cfg["cluster_name"], len(gone))
+        return {"terminated": gone, "head_pid": head_pid}
+
+
+def make_launcher(cfg: Dict[str, Any], **kwargs):
+    """The right launcher for the config's provider: local fakes get
+    the process-spawning round-trip, clouds get the SSH bootstrap."""
+    if cfg["provider"]["type"].startswith("fake"):
+        return LocalClusterLauncher(cfg)
+    return ClusterLauncher(cfg, **kwargs)

@@ -196,7 +196,8 @@ class AutoscalerV2:
 
     def __init__(self, controller, provider: NodeProvider,
                  node_types: List[NodeTypeConfig],
-                 idle_timeout_s: float = 60.0):
+                 idle_timeout_s: float = 60.0,
+                 slice_manager=None):
         self.controller = controller
         self.provider = provider
         self.node_types = {t.name: t for t in node_types}
@@ -204,6 +205,11 @@ class AutoscalerV2:
         self.scheduler = ResourceDemandScheduler(self.node_types)
         self.idle_timeout_s = idle_timeout_s
         self._idle_since: Dict[str, float] = {}
+        #: optional slice-granular layer (autoscaler/slices.py): the
+        #: reconciler hands it the same demand snapshot each pass, so
+        #: unplaceable SLICE_* placement groups demand whole slices
+        #: and idle slices scale down as a unit
+        self.slice_manager = slice_manager
 
     # -------------------------------------------------------- reconcile
     def update(self) -> Dict[str, Any]:
@@ -214,8 +220,12 @@ class AutoscalerV2:
         provider_nodes = set(self.provider.non_terminated_nodes())
 
         # 0. adopt provider nodes we didn't launch (head-start nodes,
-        # restarts of this reconciler)
+        # restarts of this reconciler) — slices the slice layer owns
+        # stay out of the node-granular books: their lifecycle (and
+        # SLICE_* flight events) belongs to the SliceManager alone
         known = {i.provider_node_id for i in self.storage.list()}
+        if self.slice_manager is not None:
+            known |= set(self.slice_manager.slices)
         for pid in provider_nodes - known:
             inst = self.storage.add(self.provider.node_type(pid))
             self.storage.transition(inst.instance_id, REQUESTED,
@@ -307,6 +317,10 @@ class AutoscalerV2:
                 self.storage.transition(iid, TERMINATED)
                 self._idle_since.pop(inst.provider_node_id, None)
                 terminated.append(iid)
-        return {"launched": launched, "terminated": terminated,
-                "instances": {i.instance_id: i.status
-                              for i in self.storage.list()}}
+        out = {"launched": launched, "terminated": terminated,
+               "instances": {i.instance_id: i.status
+                             for i in self.storage.list()}}
+        # 5. slice-granular layer: gang demand -> whole slices
+        if self.slice_manager is not None:
+            out["slices"] = self.slice_manager.update(snap=snap)
+        return out

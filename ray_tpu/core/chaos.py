@@ -145,7 +145,16 @@ class ChaosConfig:
 
     ``disk``/``disk_fault_prob`` drive the spill-path disk faults
     (ops: ``"spill_write"`` -> EIO/ENOSPC, ``"restore_read"`` ->
-    EIO/truncated read), consumed by :class:`DiskFaultInjector`."""
+    EIO/truncated read), consumed by :class:`DiskFaultInjector`.
+
+    ``maintenance`` schedules **simulated TPU maintenance events**
+    against slice providers (consumed by
+    ``autoscaler/node_provider.py::FakeSliceProvider``): a list of
+    ``{"after_s": t, "slice_index": i, "kind": "maintenance"}``
+    entries — ``t`` seconds after provider creation the i-th slice it
+    created (0-based, by creation order) receives a drain notice, which
+    the SliceManager turns into the full preemption-aware drain
+    (notice → draining → placement groups reschedule → release)."""
 
     seed: int = 0
     drop_prob: float = 0.0            # over DEFAULT_DROPPABLE
@@ -159,6 +168,7 @@ class ChaosConfig:
     latency: List[Dict] = field(default_factory=list)
     disk_fault_prob: float = 0.0      # over all spill-path disk ops
     disk: Dict[str, float] = field(default_factory=dict)
+    maintenance: List[Dict] = field(default_factory=list)
 
     @classmethod
     def from_env(cls) -> Optional["ChaosConfig"]:
@@ -201,6 +211,7 @@ class ChaosConfig:
                 "latency": self.latency,
                 "disk_fault_prob": self.disk_fault_prob,
                 "disk": self.disk,
+                "maintenance": self.maintenance,
             }),
         }
 

@@ -217,6 +217,7 @@ class Controller:
         self.functions: Dict[str, bytes] = {}
         self.pgs: Dict[bytes, PlacementGroupSpec] = {}
         self.pg_states: Dict[bytes, str] = {}
+        self.pg_creators: Dict[bytes, bytes] = {}  # pg_id -> creator identity
         self.pending_pgs: Deque[Tuple[bytes, PlacementGroupSpec]] = collections.deque()
         self.subs: Dict[str, Set[bytes]] = collections.defaultdict(set)
 
@@ -563,6 +564,16 @@ class Controller:
                 # its node's (re-)registration hasn't arrived yet — stash,
                 # else the worker is lost from the pool forever
                 self._orphan_workers[nid].append((identity, m))
+                return
+            if not node.alive:
+                # a worker of a DEAD node re-announcing in its death
+                # throes (a RECONNECT races the node teardown — seen
+                # when a drained slice's hosts get the proactive death
+                # notice ~1s before their processes exit): admitting it
+                # — especially _restore_actor_binding below — would
+                # resurrect an actor onto a walking-dead worker whose
+                # death nobody will ever report again, and callers
+                # would retarget it forever
                 return
             if identity not in node.all_workers:
                 node.all_workers[identity] = {"pid": m.get("pid"),
@@ -1246,7 +1257,13 @@ class Controller:
                     empties.append(key)
             for key in empties:
                 del self.ready_queues[key]
-        self._maybe_place_pgs()
+        if self._maybe_place_pgs():
+            # a freshly-placed gang can unblock queued work pinned to
+            # its bundles (actor creations waiting on the reservation):
+            # drain once more now instead of waiting for the health
+            # loop's forced pass
+            self._sched_dirty = True
+            self._maybe_schedule()
 
     def _assign_node(self, tid: bytes, t: PendingTask, node_id: NodeID) -> None:
         t.node_id = node_id
@@ -2064,6 +2081,7 @@ class Controller:
         spec: PlacementGroupSpec = m["spec"]
         b = spec.pg_id.binary()
         self.pgs[b] = spec
+        self.pg_creators[b] = identity
         if self.scheduler.reserve_placement_group(spec):
             self.pg_states[b] = "CREATED"
             self._reply(identity, m["rid"], {"state": "CREATED",
@@ -2073,9 +2091,11 @@ class Controller:
             self.pending_pgs.append((identity, spec))
             self._reply(identity, m["rid"], {"state": "PENDING"})
 
-    def _maybe_place_pgs(self) -> None:
+    def _maybe_place_pgs(self) -> int:
+        """Retry pending gang reservations; returns how many placed."""
         if not self.pending_pgs:
-            return
+            return 0
+        placed = 0
         still = collections.deque()
         while self.pending_pgs:
             identity, spec = self.pending_pgs.popleft()
@@ -2084,16 +2104,50 @@ class Controller:
                 continue
             if self.scheduler.reserve_placement_group(spec):
                 self.pg_states[b] = "CREATED"
-                self._send(identity, P.PG_UPDATE, {
-                    "pg_id": b, "state": "CREATED",
-                    "bundle_nodes": [bd.node_id.binary() for bd in spec.bundles]})
+                placed += 1
+                if identity:
+                    self._send(identity, P.PG_UPDATE, {
+                        "pg_id": b, "state": "CREATED",
+                        "bundle_nodes": [bd.node_id.binary() for bd in spec.bundles]})
             else:
                 still.append((identity, spec))
         self.pending_pgs = still
+        return placed
+
+    def _reschedule_pgs_on_nodes(self, node_bs) -> int:
+        """Gang reservations touching these nodes (a dying host or a
+        draining slice) are torn down atomically and re-queued: the
+        group goes RESCHEDULING until fresh capacity — typically a new
+        slice — admits every bundle again (reference: the GCS pg
+        manager reschedules bundles on node death; slice drains reuse
+        the same path). Returns how many groups were re-queued."""
+        targets = set(node_bs)
+        n = 0
+        for b, spec in list(self.pgs.items()):
+            if self.pg_states.get(b) != "CREATED":
+                continue
+            if not any(bd.node_id is not None
+                       and bd.node_id.binary() in targets
+                       for bd in spec.bundles):
+                continue
+            self.scheduler.release_placement_group(spec.pg_id)
+            for bd in spec.bundles:
+                bd.node_id = None
+            self.pg_states[b] = "RESCHEDULING"
+            creator = self.pg_creators.get(b, b"")
+            self.pending_pgs.append((creator, spec))
+            if creator:
+                self._send(creator, P.PG_UPDATE,
+                           {"pg_id": b, "state": "RESCHEDULING"})
+            n += 1
+        if n:
+            self._sched_dirty = True
+        return n
 
     def _h_remove_pg(self, identity: bytes, m: dict) -> None:
         b = m["pg_id"]
         self.pgs.pop(b, None)
+        self.pg_creators.pop(b, None)
         self.pg_states[b] = "REMOVED"
         self.scheduler.release_placement_group(PlacementGroupID(b))
         self._sched_dirty = True  # freed bundle capacity
@@ -2547,6 +2601,10 @@ class Controller:
         for worker_identity in list(node.all_workers):
             self._h_worker_exit(node.identity, {
                 "worker_identity": worker_identity, "node_id": node_b})
+        # gang reservations that spanned this host reschedule as a unit
+        # (a preempted slice host strands its whole placement group)
+        if self._reschedule_pgs_on_nodes({node_b}):
+            self._maybe_schedule()
 
     # -------------------------------------------------------- observability
     def _h_state_query(self, identity: bytes, m: dict) -> None:
@@ -2614,6 +2672,8 @@ class Controller:
                 "pg_id": PlacementGroupID(b).hex(), "state": self.pg_states.get(b),
                 "strategy": spec.strategy, "name": spec.name,
                 "bundles": [bd.resources for bd in spec.bundles],
+                "bundle_nodes": [bd.node_id.hex() if bd.node_id else None
+                                 for bd in spec.bundles],
             } for b, spec in self.pgs.items()]
         elif what == "jobs":
             rows = list(self.jobs.values())

@@ -49,6 +49,15 @@ Event taxonomy (the ``ev`` field):
                    as duration slices, so the Perfetto timeline IS
                    the pipeline-bubble visualization with per-chunk
                    forward/backward/optimizer occupancy per track
+``SLICE_UP``       a TPU slice fully joined: every host VM registered
+                   (``slice``/``type``/``hosts``)
+``SLICE_DRAIN``    slice began draining (maintenance notice, idle
+                   scale-down, or host death — ``reason``); no new
+                   leases land on its hosts from this instant
+``SLICE_DOWN``     slice released back to the provider; carries
+                   ``dur_s`` = notice-to-release drain time, so the
+                   drain window renders as a duration slice on
+                   ``/timeline`` (the preemption postmortem)
 =================  =====================================================
 """
 
@@ -74,6 +83,9 @@ ACK_RTT = "ACK_RTT"
 CREDIT_STALL = "CREDIT_STALL"
 DELIVERY_FAILED = "DELIVERY_FAILED"
 STAGE_TICK = "STAGE_TICK"
+SLICE_UP = "SLICE_UP"
+SLICE_DRAIN = "SLICE_DRAIN"
+SLICE_DOWN = "SLICE_DOWN"
 
 #: lifecycle events a task timeline is built from (exporter slice pairs)
 LIFECYCLE = (SUBMITTED, LEASED, DISPATCHED, RUNNING, YIELDED,

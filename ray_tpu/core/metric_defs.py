@@ -174,6 +174,20 @@ class RuntimeMetrics:
         self.pipeline_bubble = Gauge(
             "pipeline_bubble_fraction",
             "Measured pipeline bubble of the most recent step")
+        # -- slice autoscaling (autoscaler/slices.py): the gang unit's
+        # lifecycle as fleet gauges
+        self.slices_up = Gauge(
+            "autoscaler_slices_up",
+            "TPU slices fully joined (every host VM registered and "
+            "alive)")
+        self.slice_hosts_pending = Gauge(
+            "autoscaler_slice_hosts_pending",
+            "Host VMs of acquired slices that have not registered yet")
+        self.slice_drain_seconds = Histogram(
+            "autoscaler_slice_drain_seconds",
+            "Notice-to-release drain duration per slice (maintenance "
+            "or idle scale-down)",
+            boundaries=[0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120])
         # -- memory / health (reference: memory_manager worker kills)
         self.oom_worker_kills = Counter(
             "runtime_oom_worker_kills_total",

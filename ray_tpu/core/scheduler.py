@@ -15,13 +15,18 @@ construction and the "spillback" path disappears. Policies implemented:
 - **node-affinity** (hard/soft, ``scheduling_strategies.py:41``),
 - **node-label** (hard/soft label matching),
 - **placement-group bundles** (``bundle_scheduling_policy.h``): PACK /
-  SPREAD / STRICT_PACK / STRICT_SPREAD.
+  SPREAD / STRICT_PACK / STRICT_SPREAD, plus the TPU-native gang pair
+  SLICE_PACK / SLICE_SPREAD.
 
-TPU-specific: pod-slice gang resources. A node that is host 0 of a slice
-carries a ``TPU-{pod_type}-head`` resource (reference:
-``python/ray/_private/accelerators/tpu.py:379-382``); STRICT_PACK bundles
-requesting TPU land on ICI-connected hosts of one slice via the node's
-``slice_id`` label.
+TPU-specific: pod-slice gang placement. Every host VM of a slice
+registers with the slice's id in its ``ray-tpu-slice-id`` label
+(stamped by the cluster launcher / slice providers; reference:
+``python/ray/_private/accelerators/tpu.py:379-382`` pins gangs via a
+``TPU-{pod_type}-head`` resource — here the label IS the gang key).
+``SLICE_SPREAD`` bundles land on DISTINCT ICI-connected hosts of ONE
+slice; ``SLICE_PACK`` packs all bundles onto one slice's hosts with
+co-residency allowed. Both are all-or-nothing: no slice admits the
+whole gang → the group stays pending (never a partial reservation).
 """
 
 from __future__ import annotations
@@ -35,6 +40,18 @@ from ray_tpu.core.ids import NodeID, PlacementGroupID
 from ray_tpu.core.task_spec import Bundle, PlacementGroupSpec, SchedulingStrategy
 
 EPS = 1e-9
+
+#: node label carrying the provider slice id: every host VM of a TPU
+#: slice registers with it, so SLICE_* placement groups can gang over
+#: hosts that share one ICI domain. The GCE provider's per-slice node
+#: label (``ray-tpu-node-id``, one provider node == one slice) is
+#: accepted as a fallback spelling.
+SLICE_LABEL = "ray-tpu-slice-id"
+
+
+def node_slice_id(labels: Dict[str, str]) -> Optional[str]:
+    """The slice a node belongs to, or None for loose nodes."""
+    return labels.get(SLICE_LABEL) or labels.get("ray-tpu-node-id")
 
 
 class NodeResources:
@@ -245,6 +262,8 @@ class ClusterResourceScheduler:
     def _plan_bundles(self, spec: PlacementGroupSpec
                       ) -> Optional[List[Tuple[Bundle, NodeID]]]:
         nodes = self._alive_nodes()
+        if spec.strategy in ("SLICE_PACK", "SLICE_SPREAD"):
+            return self._plan_slice_bundles(spec, nodes)
         if spec.strategy in ("STRICT_PACK",):
             # all bundles on one node; TPU slices: prefer nodes sharing a
             # slice_id label whose head carries the gang resource.
@@ -311,6 +330,53 @@ class ClusterResourceScheduler:
             if not placed:
                 return None
         return plan
+
+    def _plan_slice_bundles(self, spec: PlacementGroupSpec,
+                            nodes: List[NodeResources]
+                            ) -> Optional[List[Tuple[Bundle, NodeID]]]:
+        """Gang-plan every bundle onto the hosts of ONE slice,
+        all-or-nothing. SLICE_SPREAD: one bundle per DISTINCT host (a
+        gang with more bundles than a slice has hosts can never use
+        that slice). SLICE_PACK: first-fit over the slice's hosts,
+        co-residency allowed. Slices are tried in deterministic id
+        order so repeated planning under identical state picks the
+        same slice."""
+        groups: Dict[str, List[NodeResources]] = {}
+        for n in nodes:
+            sid = node_slice_id(n.labels)
+            if sid:
+                groups.setdefault(sid, []).append(n)
+        for sid in sorted(groups):
+            hosts = sorted(groups[sid], key=lambda n: n.node_id)
+            if spec.strategy == "SLICE_SPREAD" and \
+                    len(spec.bundles) > len(hosts):
+                continue
+            sim: Dict[NodeID, Dict[str, float]] = {
+                n.node_id: dict(n.available) for n in hosts}
+            plan: List[Tuple[Bundle, NodeID]] = []
+            used: set = set()
+            ok = True
+            for b in spec.bundles:
+                placed = False
+                for n in hosts:
+                    if spec.strategy == "SLICE_SPREAD" and \
+                            n.node_id in used:
+                        continue
+                    av = sim[n.node_id]
+                    if all(av.get(k, 0.0) + EPS >= v
+                           for k, v in b.resources.items()):
+                        for k, v in b.resources.items():
+                            av[k] = av.get(k, 0.0) - v
+                        plan.append((b, n.node_id))
+                        used.add(n.node_id)
+                        placed = True
+                        break
+                if not placed:
+                    ok = False
+                    break
+            if ok:
+                return plan
+        return None
 
     def release_placement_group(self, pg_id: PlacementGroupID) -> None:
         with self._lock:

@@ -177,7 +177,11 @@ class Bundle:
 class PlacementGroupSpec:
     pg_id: PlacementGroupID
     bundles: List[Bundle]
-    strategy: str = "PACK"  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    #: PACK | SPREAD | STRICT_PACK | STRICT_SPREAD, plus the TPU gang
+    #: pair SLICE_PACK | SLICE_SPREAD (all bundles on hosts of ONE
+    #: slice; SPREAD = one bundle per distinct host — see
+    #: core/scheduler.py::_plan_slice_bundles)
+    strategy: str = "PACK"
     name: str = ""
     creator_job: Optional[JobID] = None
 

@@ -234,33 +234,45 @@ def cmd_job(args) -> None:
         print(client.stop_job(args.submission_id))
 
 
+def _cluster_config(args) -> str:
+    path = getattr(args, "config_opt", None) or args.config
+    if not path:
+        raise SystemExit("a cluster YAML is required "
+                         "(ray-tpu up --config cluster.yaml)")
+    return path
+
+
 def cmd_up(args) -> None:
     """Create/bootstrap a cluster from YAML (reference: `ray up`,
-    commands.py:create_or_update_cluster)."""
+    commands.py:create_or_update_cluster). Fake providers
+    (`type: fake_slice`) get the local round-trip: head daemon + every
+    slice's host VMs as local node-manager processes."""
     from ray_tpu.autoscaler.launcher import (
-        ClusterLauncher, load_cluster_config)
-    cfg = load_cluster_config(args.config)
+        load_cluster_config, make_launcher)
+    cfg = load_cluster_config(_cluster_config(args))
     if not args.yes:
         ans = input(f"Launch cluster {cfg['cluster_name']!r} "
                     f"({cfg['provider']['type']})? [y/N] ")
         if ans.strip().lower() not in ("y", "yes"):
             print("aborted")
             return
-    out = ClusterLauncher(cfg).up()
+    out = make_launcher(cfg).up()
     print(json.dumps(out))
 
 
 def cmd_down(args) -> None:
     from ray_tpu.autoscaler.launcher import (
-        ClusterLauncher, load_cluster_config)
-    cfg = load_cluster_config(args.config)
+        load_cluster_config, make_launcher)
+    cfg = load_cluster_config(_cluster_config(args))
     if not args.yes:
         ans = input(f"Tear down cluster {cfg['cluster_name']!r}? [y/N] ")
         if ans.strip().lower() not in ("y", "yes"):
             print("aborted")
             return
-    gone = ClusterLauncher(cfg).down(keep_head=args.keep_head)
-    print(json.dumps({"terminated": gone}))
+    out = make_launcher(cfg).down(keep_head=args.keep_head)
+    if isinstance(out, list):  # ClusterLauncher returns the node list
+        out = {"terminated": out}
+    print(json.dumps(out))
 
 
 def cmd_attach(args) -> None:
@@ -332,12 +344,16 @@ def main() -> None:
     sp.set_defaults(fn=cmd_submit)
 
     sp = sub.add_parser("up", help="launch a cluster from YAML config")
-    sp.add_argument("config")
+    sp.add_argument("config", nargs="?", default=None)
+    sp.add_argument("--config", dest="config_opt", default=None,
+                    help="cluster YAML (alias of the positional)")
     sp.add_argument("-y", "--yes", action="store_true")
     sp.set_defaults(fn=cmd_up)
 
     sp = sub.add_parser("down", help="tear down a YAML-config cluster")
-    sp.add_argument("config")
+    sp.add_argument("config", nargs="?", default=None)
+    sp.add_argument("--config", dest="config_opt", default=None,
+                    help="cluster YAML (alias of the positional)")
     sp.add_argument("-y", "--yes", action="store_true")
     sp.add_argument("--keep-head", action="store_true")
     sp.set_defaults(fn=cmd_down)

@@ -3,8 +3,14 @@
 Equivalent of the reference's ``python/ray/util/placement_group.py`` over
 the GCS placement-group manager (``gcs_placement_group_manager.h:230``,
 2-phase commit scheduler ``gcs_placement_group_scheduler.h:419``). For TPU,
-a STRICT_PACK group over ``{"TPU": chips_per_host}`` bundles is the unit
-that pins a pod slice's hosts.
+the slice-spanning strategies are the unit that pins a pod slice's hosts:
+``SLICE_SPREAD`` gang-reserves one bundle per DISTINCT host VM of one
+slice (pipeline stages / serve replicas each on their own host),
+``SLICE_PACK`` packs all bundles onto one slice's hosts. Both reserve
+all-or-nothing and stay PENDING until a slice with capacity exists — the
+slice autoscaler (``autoscaler/slices.py``) reads pending slice gangs as
+whole-slice demand. A drained/preempted slice flips its groups to
+RESCHEDULING; ``ready()`` blocks again until a fresh reservation lands.
 """
 
 from __future__ import annotations
@@ -28,19 +34,33 @@ class PlacementGroup:
         self._state = state
         self.bundle_nodes = bundle_nodes or []
 
+    @property
+    def state(self) -> str:
+        """Latest known state (CREATED / PENDING / RESCHEDULING)."""
+        ev = global_worker().pg_events.get(self.id.binary())
+        if ev:
+            self._state = ev.get("state", self._state)
+        return self._state
+
     def ready(self, timeout: Optional[float] = None) -> bool:
         """Block until the group is placed (reference returns an ObjectRef;
-        a blocking bool keeps the API surface minimal)."""
-        if self._state == "CREATED":
-            return True
+        a blocking bool keeps the API surface minimal). A group whose
+        slice drained re-enters PENDING as RESCHEDULING — ready()
+        then blocks again until a fresh gang reservation lands."""
         w = global_worker()
         deadline = None if timeout is None else time.monotonic() + timeout
         with w.pg_cond:
             while True:
                 ev = w.pg_events.get(self.id.binary())
-                if ev and ev.get("state") == "CREATED":
-                    self._state = "CREATED"
-                    self.bundle_nodes = ev.get("bundle_nodes", [])
+                # the latest controller event wins over the cached
+                # create-reply state (a RESCHEDULING notice must
+                # invalidate an old CREATED)
+                state = ev.get("state") if ev else self._state
+                self._state = state
+                if state == "CREATED":
+                    if ev:
+                        self.bundle_nodes = ev.get(
+                            "bundle_nodes", self.bundle_nodes)
                     return True
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
@@ -55,8 +75,20 @@ class PlacementGroup:
                                  self._state, self.bundle_nodes))
 
 
+#: the strategies the bundle planner implements
+#: (core/scheduler.py::_plan_bundles)
+STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD",
+              "SLICE_PACK", "SLICE_SPREAD")
+
+
 def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
                     name: str = "") -> PlacementGroup:
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            f"unknown placement strategy {strategy!r} "
+            f"(one of {', '.join(STRATEGIES)})")
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
     w = global_worker()
     spec = PlacementGroupSpec(
         pg_id=PlacementGroupID.of(w.job_id),

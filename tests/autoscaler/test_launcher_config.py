@@ -1,0 +1,110 @@
+"""YAML config validation for the cluster launcher — the `slices:`
+section in particular: unknown topology strings, bundle counts
+exceeding slice hosts, bound sanity, and a golden round-trip of the
+example YAML checked into docs/ (all clusterless)."""
+
+import copy
+import os
+
+import pytest
+import yaml
+
+from ray_tpu.autoscaler.launcher import (
+    ConfigError, validate_cluster_config)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _base(slices=None):
+    cfg = {
+        "cluster_name": "t",
+        "provider": {"type": "fake_slice", "session_dir": "/tmp/x"},
+        "head_node_type": "head",
+        "available_node_types": {"head": {"resources": {"CPU": 1}}},
+    }
+    if slices is not None:
+        cfg["slices"] = slices
+    return cfg
+
+
+def test_valid_slices_section_fills_defaults():
+    cfg = validate_cluster_config(_base({
+        "pod": {"topology": "4x4"}}))
+    s = cfg["slices"]["pod"]
+    assert s["count"] == 1
+    assert s["min_slices"] == 0
+    assert s["max_slices"] >= 1
+    assert s["host_resources"] == {"CPU": 1}
+
+
+@pytest.mark.parametrize("topo", [
+    "v5litepod-16", "4", "2x", "axb", "0x4", "1x2x3x4", ""])
+def test_unknown_topology_string_rejected(topo):
+    with pytest.raises(ConfigError, match="topology"):
+        validate_cluster_config(_base({"pod": {"topology": topo}}))
+
+
+def test_topology_must_be_string():
+    with pytest.raises(ConfigError):
+        validate_cluster_config(_base({"pod": {"topology": 16}}))
+
+
+def test_bundles_exceeding_slice_hosts_rejected():
+    # 2x4 -> 2 hosts; 3 SLICE_SPREAD bundles cannot each get a host
+    with pytest.raises(ConfigError, match="exceed"):
+        validate_cluster_config(_base({"pod": {
+            "topology": "2x4",
+            "placement": {"strategy": "SLICE_SPREAD",
+                          "bundles": [{"CPU": 1}] * 3}}}))
+    # SLICE_PACK co-resides: the same bundle count is fine
+    cfg = validate_cluster_config(_base({"pod": {
+        "topology": "2x4",
+        "placement": {"strategy": "SLICE_PACK",
+                      "bundles": [{"CPU": 1}] * 3}}}))
+    assert cfg["slices"]["pod"]["placement"]["strategy"] == "SLICE_PACK"
+    # and a host-per-bundle SPREAD fits exactly
+    validate_cluster_config(_base({"pod": {
+        "topology": "2x4",
+        "placement": {"bundles": [{"CPU": 1}] * 2}}}))
+
+
+def test_placement_strategy_and_bundles_validated():
+    with pytest.raises(ConfigError, match="strategy"):
+        validate_cluster_config(_base({"pod": {
+            "topology": "2x2",
+            "placement": {"strategy": "STRICT_SPREAD",
+                          "bundles": [{"CPU": 1}]}}}))
+    with pytest.raises(ConfigError, match="bundles"):
+        validate_cluster_config(_base({"pod": {
+            "topology": "2x2", "placement": {"bundles": []}}}))
+
+
+def test_slice_bounds_validated():
+    with pytest.raises(ConfigError, match="count"):
+        validate_cluster_config(_base({"pod": {
+            "topology": "2x2", "count": 5, "max_slices": 2}}))
+    with pytest.raises(ConfigError, match="min_slices"):
+        validate_cluster_config(_base({"pod": {
+            "topology": "2x2", "min_slices": -1}}))
+    with pytest.raises(ConfigError, match="host_resources"):
+        validate_cluster_config(_base({"pod": {
+            "topology": "2x2", "host_resources": {"CPU": -1}}}))
+    with pytest.raises(ConfigError, match="must be a mapping"):
+        validate_cluster_config(_base({"pod": ["topology"]}))
+
+
+def test_example_yaml_golden_round_trip():
+    """The checked-in docs/cluster.yaml validates, and validation is
+    idempotent: re-validating the normalized config changes nothing
+    (defaults are stable, nothing is mangled)."""
+    path = os.path.join(REPO_ROOT, "docs", "cluster.yaml")
+    with open(path) as f:
+        raw = yaml.safe_load(f)
+    cfg = validate_cluster_config(copy.deepcopy(raw))
+    # the example's declared fields survive normalization verbatim
+    assert cfg["cluster_name"] == raw["cluster_name"]
+    assert cfg["slices"]["trainers"]["topology"] == "4x4"
+    assert len(cfg["slices"]["trainers"]["placement"]["bundles"]) == 4
+    again = validate_cluster_config(copy.deepcopy(cfg))
+    assert again == cfg

@@ -1,0 +1,471 @@
+"""Slice gang-scheduling units — all clusterless (no processes): the
+topology math, the all-or-nothing SLICE_PACK/SLICE_SPREAD bundle
+planner, the pure scaling planner, the in-memory FakeSliceProvider,
+and the SliceManager lifecycle (acquire -> UP -> maintenance drain ->
+release) against a stub controller. The multi-process e2e lives in
+test_slice_e2e.py (slow)."""
+
+import os
+
+import pytest
+
+from ray_tpu.autoscaler.node_provider import (
+    FakeSliceProvider, SliceCapacityError)
+from ray_tpu.autoscaler.slices import (
+    DRAINING, RELEASED, REQUESTED, UP, SliceInfo, SliceManager,
+    SliceTypeConfig, hosts_for_topology, plan_slice_scaling)
+from ray_tpu.core.events import FlightRecorder
+from ray_tpu.core.ids import NodeID, PlacementGroupID
+from ray_tpu.core.scheduler import (
+    SLICE_LABEL, ClusterResourceScheduler, NodeResources)
+from ray_tpu.core.task_spec import Bundle, PlacementGroupSpec
+
+
+# ------------------------------------------------------------- topology
+def test_hosts_for_topology():
+    assert hosts_for_topology("1x1") == 1
+    assert hosts_for_topology("2x2") == 1
+    assert hosts_for_topology("2x4") == 2
+    assert hosts_for_topology("4x4") == 4
+    assert hosts_for_topology("2x2x4") == 4
+    assert hosts_for_topology("8x8") == 16
+
+
+@pytest.mark.parametrize("bad", [
+    "", "v5litepod-16", "4", "2x", "x2", "axb", "2x-2", "0x4",
+    "1x2x3x4", 16, None])
+def test_hosts_for_topology_rejects_unknown(bad):
+    with pytest.raises(ValueError):
+        hosts_for_topology(bad)
+
+
+# ------------------------------------------------- gang bundle planning
+def _mk_scheduler(slices, loose=0, cpu=1.0):
+    """slices: {slice_id: n_hosts} -> scheduler with labeled hosts."""
+    sched = ClusterResourceScheduler()
+    ids = {}
+    for sid, n in slices.items():
+        ids[sid] = []
+        for _ in range(n):
+            nid = NodeID(os.urandom(28))
+            sched.add_node(NodeResources(
+                nid, {"CPU": cpu, "chip": 4}, {SLICE_LABEL: sid}))
+            ids[sid].append(nid)
+    for _ in range(loose):
+        sched.add_node(NodeResources(
+            NodeID(os.urandom(28)), {"CPU": cpu, "chip": 4}))
+    return sched, ids
+
+
+def _pg(bundles, strategy):
+    from ray_tpu.core.ids import JobID
+    return PlacementGroupSpec(
+        pg_id=PlacementGroupID.of(JobID.from_int(1)),
+        bundles=[Bundle(resources=dict(b)) for b in bundles],
+        strategy=strategy)
+
+
+def test_slice_spread_all_bundles_on_distinct_hosts():
+    sched, ids = _mk_scheduler({"sliceA": 4}, loose=2)
+    spec = _pg([{"chip": 1}] * 4, "SLICE_SPREAD")
+    assert sched.reserve_placement_group(spec)
+    nodes = [b.node_id for b in spec.bundles]
+    assert len(set(nodes)) == 4  # distinct hosts
+    assert set(nodes) == set(ids["sliceA"])  # all inside the slice
+
+
+def test_slice_spread_never_straddles_slices():
+    # 2+2 hosts across two slices could hold 3 bundles loosely, but a
+    # gang must sit inside ONE slice: only the 4-host slice qualifies
+    sched, ids = _mk_scheduler({"small": 2, "big": 4})
+    spec = _pg([{"chip": 1}] * 3, "SLICE_SPREAD")
+    assert sched.reserve_placement_group(spec)
+    assert {b.node_id for b in spec.bundles} <= set(ids["big"])
+
+
+def test_slice_spread_atomic_partial_capacity_reserves_nothing():
+    # 4-host slice, but one host's chips are already taken: a 4-bundle
+    # SPREAD gang must reserve NOTHING (stays pending, never partial)
+    sched, ids = _mk_scheduler({"sliceA": 4})
+    victim = ids["sliceA"][0]
+    assert sched.try_acquire(victim, {"chip": 4})
+    before = {n.node_id: dict(n.available)
+              for n in sched.nodes.values()}
+    spec = _pg([{"chip": 1}] * 4, "SLICE_SPREAD")
+    assert not sched.reserve_placement_group(spec)
+    after = {n.node_id: dict(n.available) for n in sched.nodes.values()}
+    assert before == after  # no partial leases leaked
+    assert all(b.node_id is None for b in spec.bundles)
+
+
+def test_slice_spread_more_bundles_than_hosts_pends():
+    sched, _ = _mk_scheduler({"sliceA": 4})
+    assert not sched.reserve_placement_group(
+        _pg([{"chip": 1}] * 5, "SLICE_SPREAD"))
+
+
+def test_slice_pack_corresides_on_one_slice():
+    sched, ids = _mk_scheduler({"sliceA": 2}, loose=3)
+    spec = _pg([{"chip": 2}] * 4, "SLICE_PACK")  # 8 chips over 2 hosts
+    assert sched.reserve_placement_group(spec)
+    assert {b.node_id for b in spec.bundles} <= set(ids["sliceA"])
+
+
+def test_slice_pack_ignores_loose_nodes():
+    sched, _ = _mk_scheduler({}, loose=4)  # capacity, but no slice
+    assert not sched.reserve_placement_group(
+        _pg([{"chip": 1}] * 2, "SLICE_PACK"))
+
+
+def test_slice_release_frees_whole_gang():
+    sched, _ = _mk_scheduler({"sliceA": 4})
+    spec = _pg([{"chip": 4}] * 4, "SLICE_SPREAD")
+    assert sched.reserve_placement_group(spec)
+    assert not sched.reserve_placement_group(
+        _pg([{"chip": 1}] * 4, "SLICE_SPREAD"))
+    sched.release_placement_group(spec.pg_id)
+    assert sched.reserve_placement_group(
+        _pg([{"chip": 1}] * 4, "SLICE_SPREAD"))
+
+
+# ------------------------------------------------------ scaling planner
+def _types(**kw):
+    t = SliceTypeConfig("pod", topology="4x4",
+                        host_resources={"CPU": 1, "chip": 4}, **kw)
+    return {"pod": t}
+
+
+def test_plan_acquires_for_pending_gang():
+    plan = plan_slice_scaling(
+        [{"hosts": 4, "bundles": [{"chip": 1}] * 4}], [], _types())
+    assert plan == {"acquire": {"pod": 1}, "release": []}
+
+
+def test_plan_existing_slice_absorbs_demand():
+    live = [SliceInfo("s1", "pod", 4, state=UP)]
+    plan = plan_slice_scaling(
+        [{"hosts": 4, "bundles": [{"chip": 1}] * 4}], live, _types())
+    assert plan["acquire"] == {}
+
+
+def test_plan_draining_slice_does_not_absorb():
+    live = [SliceInfo("s1", "pod", 4, state=DRAINING)]
+    plan = plan_slice_scaling(
+        [{"hosts": 4, "bundles": [{"chip": 1}] * 4}], live, _types())
+    assert plan["acquire"] == {"pod": 1}
+
+
+def test_plan_respects_max_and_floor():
+    types = _types(max_slices=1)
+    live = [SliceInfo("s1", "pod", 4, state=UP)]
+    plan = plan_slice_scaling(
+        [{"hosts": 4, "bundles": [{"chip": 1}] * 4}] * 3, live, types)
+    assert plan["acquire"] == {}  # capped
+    types = _types(min_slices=2)
+    plan = plan_slice_scaling([], [], types)
+    assert plan["acquire"] == {"pod": 2}  # floor with no demand
+
+
+def test_plan_infeasible_demand_launches_nothing():
+    # 8-host gang can never fit a 4-host type; per-bundle shape too big
+    plan = plan_slice_scaling(
+        [{"hosts": 8, "bundles": [{"chip": 1}] * 8}], [], _types())
+    assert plan["acquire"] == {}
+    plan = plan_slice_scaling(
+        [{"hosts": 1, "bundles": [{"chip": 64}]}], [], _types())
+    assert plan["acquire"] == {}
+
+
+def test_plan_releases_idle_above_floor_only():
+    types = _types(min_slices=1)
+    live = [SliceInfo("s1", "pod", 4, state=UP),
+            SliceInfo("s2", "pod", 4, state=UP)]
+    plan = plan_slice_scaling([], live, types,
+                              idle_slice_ids=["s1", "s2"])
+    assert len(plan["release"]) == 1  # floor keeps one
+    # pending gang demand vetoes any release
+    plan = plan_slice_scaling(
+        [{"hosts": 4, "bundles": [{"chip": 1}] * 4}], live, types,
+        idle_slice_ids=["s1", "s2"])
+    assert plan["release"] == []
+
+
+# --------------------------------------------------- in-memory provider
+def test_fake_slice_provider_inmemory_lifecycle():
+    p = FakeSliceProvider(provider_config={"max_slices": 2})
+    sid = p.create_slice("pod", "4x4", {"CPU": 1})
+    assert p.non_terminated_nodes() == [sid]
+    assert p.node_type(sid) == "pod"
+    assert p.expected_internal_count(sid) == 4
+    assert len(p.internal_ids(sid)) == 4
+    assert len(p.slice_hosts(sid)) == 4
+    assert p.node_resources(sid) == {"CPU": 4.0}
+    p.create_slice("pod", "2x2", {"CPU": 1})
+    with pytest.raises(SliceCapacityError):
+        p.create_slice("pod", "2x2", {"CPU": 1})  # fake stockout
+    p.delete_slice(sid)
+    assert sid not in p.non_terminated_nodes()
+    p.shutdown()
+
+
+def test_fake_slice_provider_maintenance_injection():
+    p = FakeSliceProvider()
+    sid = p.create_slice("pod", "2x2", {"CPU": 1})
+    assert p.maintenance_events() == []
+    eid = p.inject_maintenance(sid)
+    evs = p.maintenance_events()
+    assert [e["slice_id"] for e in evs] == [sid]
+    assert evs[0]["event_id"] == eid
+    assert p.maintenance_events() == []  # reported exactly once
+
+
+def test_fake_slice_provider_chaos_schedule(monkeypatch):
+    from ray_tpu.core.chaos import ChaosConfig
+    cfg = ChaosConfig(seed=7, maintenance=[
+        {"after_s": 0.0, "slice_index": 1}])
+    for k, v in cfg.env().items():
+        monkeypatch.setenv(k, v)
+    p = FakeSliceProvider()
+    s0 = p.create_slice("pod", "2x2", {"CPU": 1})
+    # schedule targets slice index 1 — nothing fires while only
+    # slice 0 exists
+    assert p.maintenance_events() == []
+    s1 = p.create_slice("pod", "2x2", {"CPU": 1})
+    evs = p.maintenance_events()
+    assert [e["slice_id"] for e in evs] == [s1]
+    assert s0 in p.non_terminated_nodes()
+    assert p.maintenance_events() == []  # fires once
+
+
+# --------------------------------------------------------- SliceManager
+class _StubScheduler:
+    def __init__(self):
+        self.draining = {}
+
+    def set_draining(self, node_id, flag):
+        self.draining[node_id.binary()] = flag
+
+
+class _StubController:
+    def __init__(self):
+        self.scheduler = _StubScheduler()
+        self.rescheduled = []
+        self.nodes = {}
+        self.leases = {}
+        self.actors = {}
+        self._lease_node = {}
+        self.recorder = FlightRecorder("test", capacity=1024)
+        self.events = []
+
+    def call_on_loop(self, fn, timeout=None):
+        return fn()
+
+    def _reschedule_pgs_on_nodes(self, node_bs):
+        self.rescheduled.append(set(node_bs))
+        return 1
+
+    def _maybe_schedule(self, force=False):
+        pass
+
+
+def _snap(alive=(), busy=(), slice_demand=()):
+    return {"demand": [], "slice_demand": list(slice_demand),
+            "busy_nodes": set(busy), "alive_nodes": set(alive)}
+
+
+def _events(ctrl):
+    evs = ctrl.recorder.drain()
+    ctrl.events.extend(evs)
+    return ctrl.events
+
+
+def test_slice_manager_acquire_to_up_records_event():
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(ctrl, p, [SliceTypeConfig(
+        "pod", "4x4", {"CPU": 1, "chip": 4})])
+    sid = mgr.acquire_slice("pod")
+    assert mgr.slices[sid].state == REQUESTED
+    ids = p.internal_ids(sid)
+    # half-joined slice stays REQUESTED (never partially UP)
+    mgr.update(_snap(alive=ids[:2]))
+    assert mgr.slices[sid].state == REQUESTED
+    mgr.update(_snap(alive=ids))
+    assert mgr.slices[sid].state == UP
+    evs = _events(ctrl)
+    ups = [e for e in evs if e["ev"] == "SLICE_UP"]
+    assert ups and ups[0]["slice"] == sid and ups[0]["hosts"] == 4
+
+
+def test_slice_manager_maintenance_drain_reschedules_and_releases():
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "4x4", {"CPU": 1})],
+        drain_deadline_s=0.0)  # busy hosts release at the deadline
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    assert mgr.slices[sid].state == UP
+    p.inject_maintenance(sid)
+    # hosts busy: the drain must still never hang — deadline releases
+    mgr.update(_snap(alive=ids, busy=ids[:1]))
+    assert mgr.slices[sid].state == RELEASED
+    assert sid not in p.non_terminated_nodes()
+    # drain marked every host unschedulable and re-queued its gangs
+    assert set(ids) <= set(ctrl.scheduler.draining)
+    assert all(ctrl.scheduler.draining[i] for i in ids)
+    assert ctrl.rescheduled and ctrl.rescheduled[0] == set(ids)
+    names = [e["ev"] for e in _events(ctrl)]
+    assert names.count("SLICE_UP") == 1
+    assert names.count("SLICE_DRAIN") == 1
+    assert names.count("SLICE_DOWN") == 1
+    down = [e for e in ctrl.events if e["ev"] == "SLICE_DOWN"][0]
+    assert down["reason"] == "maintenance"
+    assert "dur_s" in down
+
+
+def test_slice_manager_quiet_drain_releases_before_deadline():
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "2x4", {"CPU": 1})],
+        drain_deadline_s=3600.0)
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    p.inject_maintenance(sid)
+    mgr.update(_snap(alive=ids))  # no busy hosts -> immediate release
+    assert mgr.slices[sid].state == RELEASED
+
+
+def test_slice_manager_scales_up_for_pending_gang_demand():
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(ctrl, p, [SliceTypeConfig(
+        "pod", "4x4", {"CPU": 1, "chip": 4})])
+    out = mgr.update(_snap(slice_demand=[
+        {"hosts": 4, "bundles": [{"chip": 1}] * 4}]))
+    assert len(out["acquired"]) == 1
+    sid = out["acquired"][0]
+    assert p.expected_internal_count(sid) == 4
+    # same pending demand next pass: the REQUESTED slice absorbs it
+    out = mgr.update(_snap(slice_demand=[
+        {"hosts": 4, "bundles": [{"chip": 1}] * 4}]))
+    assert out["acquired"] == []
+
+
+def test_slice_manager_capacity_stockout_keeps_demand_pending():
+    ctrl = _StubController()
+    p = FakeSliceProvider(provider_config={"max_slices": 0})
+    mgr = SliceManager(ctrl, p, [SliceTypeConfig("pod", "4x4")])
+    out = mgr.update(_snap(slice_demand=[
+        {"hosts": 4, "bundles": [{"CPU": 1}] * 4}]))
+    assert out["acquired"] == []  # deferred, no partial anything
+
+
+def test_slice_manager_idle_slice_scales_down_as_unit():
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "2x4", {"CPU": 1})],
+        idle_timeout_s=0.0)
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    # a busy host holds the slice up (the idle clock never starts)
+    mgr.update(_snap(alive=ids, busy=ids[:1]))
+    assert mgr.slices[sid].state == UP
+    out = mgr.update(_snap(alive=ids))  # idle past (zero) timeout
+    assert sid in out["released"]
+    assert mgr.slices[sid].state == RELEASED
+    assert sid not in p.non_terminated_nodes()
+    down = [e for e in _events(ctrl) if e["ev"] == "SLICE_DOWN"]
+    assert down and down[0]["reason"] == "idle"
+
+
+def test_slice_manager_host_death_drains_broken_slice():
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "2x4", {"CPU": 1})],
+        drain_deadline_s=0.0)
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    assert mgr.slices[sid].state == UP
+    # one host vanishes without notice (hard preemption)
+    mgr.update(_snap(alive=ids[1:]))
+    assert mgr.slices[sid].state == RELEASED
+    down = [e for e in _events(ctrl) if e["ev"] == "SLICE_DOWN"]
+    assert down and down[0]["reason"] == "host-death"
+
+
+def test_slice_manager_gauges_track_lifecycle():
+    from ray_tpu.core.metric_defs import runtime_metrics
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(ctrl, p, [SliceTypeConfig(
+        "pod", "4x4", {"CPU": 1})], drain_deadline_s=0.0)
+    sid = mgr.acquire_slice("pod")
+    mgr._update_gauges()
+    m = runtime_metrics()
+
+    def gauge_value(g):
+        samples = g.snapshot()["samples"]
+        return samples[0][1] if samples else None
+
+    assert gauge_value(m.slice_hosts_pending) == 4.0
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    assert gauge_value(m.slices_up) == 1.0
+    assert gauge_value(m.slice_hosts_pending) == 0.0
+    p.inject_maintenance(sid)
+    mgr.update(_snap(alive=ids))
+    assert gauge_value(m.slices_up) == 0.0
+    hist = m.slice_drain_seconds.snapshot()
+    assert hist["samples"]  # drain duration observed
+
+
+# ----------------------------------------------------- monitor backoff
+def test_autoscaler_monitor_backs_off_on_failures_and_stops_promptly():
+    import time as _time
+
+    from ray_tpu.autoscaler import AutoscalerMonitor
+
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+
+        def update(self):
+            self.calls += 1
+            raise RuntimeError("provider down")
+
+    mon = AutoscalerMonitor(Flaky(), interval_s=4.0)
+    waits = []
+    real_stop = mon._stop
+
+    class FakeEvent:
+        def wait(self, delay):
+            waits.append(delay)
+            return len(waits) > 4  # stop after 4 sleeps
+
+        def set(self):
+            real_stop.set()
+
+    mon._stop = FakeEvent()
+    mon._loop()
+    # first wait is the healthy interval; failures then grow with the
+    # shared jittered backoff (equal jitter keeps the interval/2
+    # floor: attempt n waits in [4*2^n / 2, 4*2^n])
+    assert waits[0] == 4.0
+    assert 2.0 <= waits[1] <= 4.0
+    assert 4.0 <= waits[2] <= 8.0
+    assert 8.0 <= waits[3] <= 16.0
+    assert 16.0 <= waits[4] <= 32.0
+
+    # stop() interrupts a long sleep promptly (event wait, not sleep)
+    slow = AutoscalerMonitor(Flaky(), interval_s=3600.0)
+    slow.start()
+    t0 = _time.monotonic()
+    slow.stop()
+    assert _time.monotonic() - t0 < 2.0

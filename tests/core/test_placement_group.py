@@ -33,6 +33,25 @@ def test_pg_infeasible_pends(ray_start_regular):
     remove_placement_group(pg)
 
 
+def test_pg_unknown_strategy_rejected(ray_start_regular):
+    with pytest.raises(ValueError, match="strategy"):
+        placement_group([{"CPU": 1}], strategy="SLICE_SHUFFLE")
+    with pytest.raises(ValueError, match="bundle"):
+        placement_group([], strategy="PACK")
+
+
+def test_pg_slice_strategy_pends_without_a_slice(ray_start_regular):
+    # capacity exists, but no node carries a slice label: a
+    # slice-spanning gang must stay PENDING (whole-slice demand for
+    # the slice autoscaler), never fall back to loose nodes
+    pg = placement_group([{"CPU": 1}], strategy="SLICE_SPREAD")
+    assert not pg.ready(timeout=1.0)
+    assert pg.state == "PENDING"
+    rows = {r["pg_id"]: r for r in placement_group_table()}
+    assert rows[pg.id.hex()]["state"] == "PENDING"
+    remove_placement_group(pg)
+
+
 def test_pg_strict_pack_atomicity(ray_start_regular):
     # 2+2 CPUs fits the 4-CPU node; a second identical PG must pend
     pg1 = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")

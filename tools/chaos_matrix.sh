@@ -133,6 +133,27 @@ for seed in "${seeds[@]}"; do
     fi
 done
 
+# ---- slice-preemption soak leg: a SLICE_SPREAD gang on a
+# FakeSliceProvider cluster steps a 2-stage actor pipeline while the
+# chaos harness's maintenance schedule preempts the slice mid-step;
+# invariants: the placement group reschedules onto a fresh slice,
+# every step completes, typed errors only, no hangs, no leaked slices
+# (tests/autoscaler/test_slice_e2e.py::test_slice_preemption_soak)
+for seed in "${seeds[@]}"; do
+    echo "=== slice-preemption soak: seed=$seed ==="
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        RAY_TPU_CHAOS_POSTMORTEM_FILE="$postmortem_dir/slice_postmortem_$seed.json" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/autoscaler/test_slice_e2e.py::test_slice_preemption_soak" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== slice seed=$seed PASSED ==="
+        rm -f "$postmortem_dir/slice_postmortem_$seed.json"
+    else
+        echo "=== slice seed=$seed FAILED ==="
+        failed+=("slice:$seed")
+    fi
+done
+
 if [ "${#failed[@]}" -gt 0 ]; then
     echo
     echo "FAILING SEEDS: ${failed[*]}"
@@ -154,6 +175,17 @@ if [ "${#failed[@]}" -gt 0 ]; then
             s="${seed#serve:}"
             echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
                  "tests/serve/test_llm_engine.py::test_serve_fleet_chaos_soak -q"
+            continue
+            ;;
+        slice:*)
+            s="${seed#slice:}"
+            echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
+                 "tests/autoscaler/test_slice_e2e.py::test_slice_preemption_soak -q"
+            pm="$postmortem_dir/slice_postmortem_$s.json"
+            if [ -f "$pm" ]; then
+                echo "  flight recorder: $pm" \
+                     "(python tools/timeline.py --input $pm)"
+            fi
             continue
             ;;
         esac
