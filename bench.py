@@ -360,62 +360,201 @@ def _measure_mpmd(pipe, batch_d, steps: int) -> dict:
                               for st in res.stage_stats]}
 
 
+def _measure_plan(plan, cfg, batch_d, steps: int,
+                  lr: float = 1e-3, stage_mesh=None) -> dict:
+    """Measure one ParallelPlan lowering: compile step, then
+    ``steps`` timed steps (median — shared CPU bench boxes deschedule).
+    Returns tokens/s, step wall, measured bubble (pipeline lowerings)
+    and the loss trajectory (entry 0 = the compile step)."""
+    import statistics
+
+    prog = plan.build(cfg, learning_rate=lr, seed=0,
+                      stage_mesh=stage_mesh) \
+        if plan.pp > 1 else \
+        plan.build(cfg, learning_rate=lr, seed=0,
+                   telemetry_interval_s=0)
+    res = prog.step(batch_d)          # compile
+    losses = [res.loss]
+    dts, bubbles = [], []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        res = prog.step(batch_d)
+        dts.append(time.perf_counter() - t0)
+        losses.append(res.loss)
+        if res.bubble_fraction is not None:
+            bubbles.append(res.bubble_fraction)
+    med = statistics.median(dts)
+    b, s = batch_d["input_ids"].shape
+    out = {"tokens_per_s": round(b * s / med, 1),
+           "step_ms": round(med * 1e3, 2),
+           "losses": [round(l, 8) for l in losses]}
+    if bubbles:
+        out["bubble_fraction"] = round(sum(bubbles) / len(bubbles), 4)
+    if res.grad_norm is not None:
+        out["grad_norm"] = round(res.grad_norm, 6)
+    out["_result"] = res
+    out["_program"] = prog
+    return out
+
+
 def _measure_train(cfg, batch_d, S: int, M: int, v: int, steps: int,
                    lr: float = 1e-3) -> dict:
     """Train-variant measurement at one interleave factor: the full
     fwd+bwd+fused-per-stage-opt pipeline (grads/params/opt state
     resident on the stages; the driver only reduces the scalar grad
-    norm). Returns steady-state tokens/s, the measured bubble, the
-    analytic interleaved bubble (S-1)/(v*M+S-1) next to it, and the
-    loss trajectory (entry 0 = the compile step)."""
-    from ray_tpu.parallel.mpmd_pipeline import (
-        MPMDPipeline, analytic_bubble)
+    norm), lowered through ``ParallelPlan`` like everything else.
+    Returns steady-state tokens/s, the measured bubble, the analytic
+    interleaved bubble (S-1)/(v*M+S-1) next to it, and the loss
+    trajectory (entry 0 = the compile step)."""
+    from ray_tpu.parallel.mpmd_pipeline import analytic_bubble
+    from ray_tpu.parallel.plan import ParallelPlan
 
-    import statistics
-
-    pipe = MPMDPipeline(cfg, n_stages=S, n_microbatches=M, seed=0,
-                        n_virtual=v, train=True, learning_rate=lr)
-    res = pipe.step(batch_d)          # compile
-    losses = [res.loss]
-    dts, bubbles = [], []
-    for _ in range(steps):
-        t0 = time.perf_counter()
-        res = pipe.step(batch_d)
-        dts.append(time.perf_counter() - t0)
-        losses.append(res.loss)
-        bubbles.append(res.bubble_fraction)
-    med = statistics.median(dts)
-    pipe.shutdown()
-    b, s = batch_d["input_ids"].shape
-    return {"tokens_per_s": round(b * s / med, 1),
-            "step_ms": round(med * 1e3, 2),
-            "bubble_fraction": round(sum(bubbles) / len(bubbles), 4),
-            "analytic_bubble": round(analytic_bubble(S, M, v), 4),
-            "grad_norm": round(res.grad_norm, 6),
-            "losses": [round(l, 8) for l in losses],
-            "stage_busy_ms": [round(st["busy_s"] * 1e3, 2)
-                              for st in res.stage_stats],
-            "stage_opt_ms": [round(st["opt_s"] * 1e3, 2)
-                             for st in res.stage_stats]}
+    row = _measure_plan(
+        ParallelPlan(pp=S, virtual=v, n_microbatches=M),
+        cfg, batch_d, steps, lr=lr)
+    res, prog = row.pop("_result"), row.pop("_program")
+    prog.shutdown()
+    row["analytic_bubble"] = round(analytic_bubble(S, M, v), 4)
+    row["stage_busy_ms"] = [round(st["busy_s"] * 1e3, 2)
+                            for st in res.detail.stage_stats]
+    row["stage_opt_ms"] = [round(st["opt_s"] * 1e3, 2)
+                           for st in res.detail.stage_stats]
+    return row
 
 
 def _train_reference_losses(cfg, batch_d, n: int,
                             lr: float = 1e-3) -> list:
     """The single-program make_train_step loss trajectory the pipeline
-    train variants are gated against (<= 1e-5 parity)."""
+    train variants are gated against (<= 1e-5 parity) — the SPMD
+    lowering of the same ParallelPlan surface."""
+    from ray_tpu.parallel.plan import ParallelPlan
+
+    prog = ParallelPlan(pp=1).build(cfg, learning_rate=lr, seed=0,
+                                    telemetry_interval_s=0)
+    return [prog.step(batch_d).loss for _ in range(n)]
+
+
+def _stage_reduce_wire(cfg, n_stages: int, dp: int) -> dict:
+    """Measured wire accounting of the per-stage gradient reduction:
+    lower the SAME ``collective.psum_tree`` program a dp-mesh stage
+    compiles for one stage's gradient slab, and sum the payload bytes
+    of every cross-device collective in the compiled HLO (all-reduce
+    counted twice: it is reduce-scatter + all-gather fused). The int8
+    row's all-gather really is ``s8[...]`` in the compiled module —
+    int8 values + per-block f32 scales on the wire, not error
+    injection. Wall clock of the reduction rides along; on the CPU
+    backend the "wire" is shared memory, so the byte column is the
+    backend-independent signal there."""
+    import re
+
+    import numpy as np
+
     import jax
+    from jax.sharding import PartitionSpec as P
 
-    from ray_tpu.models.training import make_train_step
+    from ray_tpu.models.transformer import (
+        init_params, stage_slice_params)
+    from ray_tpu.parallel import collective as coll
     from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.parallel.quantization import compression_ratio
+    from ray_tpu.util.jax_compat import shard_map
 
-    mesh = build_mesh(MeshSpec(dp=1, fsdp=1), jax.devices()[:1])
-    bundle = make_train_step(cfg, mesh, learning_rate=lr)
-    state = bundle.init(seed=0)
-    out = []
-    for _ in range(n):
-        state, met = bundle.step(state, batch_d)
-        out.append(float(met["loss"]))
+    shapes = jax.eval_shape(
+        lambda: stage_slice_params(
+            cfg, init_params(cfg, jax.random.PRNGKey(0)), 0, n_stages))
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree.leaves(shapes))
+    mesh = build_mesh(MeshSpec(dp=dp), jax.devices()[:dp])
+    x = np.zeros((dp, n), np.float32)
+    dt_bytes = {"f64": 8, "f32": 4, "u32": 4, "s32": 4, "bf16": 2,
+                "f16": 2, "s8": 1, "u8": 1, "pred": 1}
+    out = {"grad_numel": n, "dp": dp}
+    for tr in ("fp32", "int8"):
+        def body(xl, _tr=tr):
+            return coll.psum_tree({"g": xl[0]}, ("dp", "fsdp"), dp,
+                                  transport=_tr)["g"]
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P(("dp",)),
+                              out_specs=P(), check_vma=False))
+        txt = f.lower(x).compile().as_text()
+        total = 0
+        for m in re.finditer(
+                r"=\s*(\w+)\[([\d,]*)\][^=\n]*?\s"
+                r"(all-gather|all-reduce|reduce-scatter|"
+                r"collective-permute|all-to-all)\(", txt):
+            dt, dims, op = m.group(1), m.group(2), m.group(3)
+            numel = 1
+            for d in dims.split(","):
+                if d:
+                    numel *= int(d)
+            nbytes = numel * dt_bytes.get(dt, 4)
+            total += 2 * nbytes if op == "all-reduce" else nbytes
+        r = f(x)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            r = f(x)
+        jax.block_until_ready(r)
+        out[tr] = {"collective_bytes": total,
+                   "reduce_ms": round(
+                       (time.perf_counter() - t0) / 10 * 1e3, 3)}
+    fb = out["fp32"]["collective_bytes"]
+    ib = out["int8"]["collective_bytes"]
+    out["measured_comm_reduction"] = round(1.0 - ib / max(fb, 1), 4)
+    out["analytic_compression"] = round(compression_ratio(n), 2)
     return out
+
+
+def _measure_plan3d(cfg, batch_d, S: int, M: int, steps: int,
+                    ref_losses: list) -> dict:
+    """The 3D matrix: nested pp×dp lowerings of one ParallelPlan —
+    each PipelineStage hosts a shard_map'd dp program over its own
+    mesh, grads reduced once per step by the real fp32/int8 collective
+    and applied under the cross-replica flat-sharded update. The
+    ``pp_dp1_reference`` row runs the SAME shard_map'd stage programs
+    on a 1-device stage mesh (identical recompute backward, zero
+    cross-rank comm), so each variant's step excess over it is
+    attributable to stage-mesh communication. fp32 rows must track the
+    single-program ``make_train_step`` trajectory to <= 1e-5; the
+    int8 rows additionally carry the measured collective-byte
+    reduction of the stage's gradient wire (``wire``)."""
+    from ray_tpu.parallel.plan import ParallelPlan
+
+    dp = 2
+
+    def parity(losses):
+        return round(max(abs(a - b)
+                         for a, b in zip(losses, ref_losses)), 9)
+
+    def run(plan):
+        row = _measure_plan(plan, cfg, batch_d, steps, stage_mesh=True)
+        row.pop("_result")
+        row.pop("_program").shutdown()
+        row["loss_parity_abs"] = parity(row["losses"])
+        return row
+
+    base = run(ParallelPlan(pp=S, dp=1, n_microbatches=M))
+    variants = {}
+    for gt in ("fp32", "int8"):
+        name = f"pp{S}_dp{dp}_{gt}"
+        row = run(ParallelPlan(pp=S, dp=dp, n_microbatches=M,
+                               grad_transport=gt,
+                               shard_weight_update=True))
+        row["comm_split_ms"] = {
+            "compute_ms": base["step_ms"],
+            "comm_ms": round(max(row["step_ms"] - base["step_ms"],
+                                 0.0), 2)}
+        variants[name] = row
+    wire = _stage_reduce_wire(cfg, S, dp)
+    return {
+        "grid": {"pp": S, "dp": dp, "fsdp": 1, "virtual": 1,
+                 "n_microbatches": M},
+        "pp_dp1_reference": base,
+        "variants": variants,
+        "wire": wire,
+        "loss_parity_3d_abs": variants[f"pp{S}_dp{dp}_fp32"][
+            "loss_parity_abs"],
+        "int8_wire_reduction": wire["measured_comm_reduction"],
+    }
 
 
 def _measure_spmd_gpipe(cfg, batch: int, seq: int, n_microbatches: int,
@@ -540,6 +679,13 @@ def pipeline_main(smoke: bool = False) -> None:
             for key in ("v1", "v2")
             for a, b in zip(train[key]["losses"], ref_losses)), 9)
         train["wall_s"] = round(time.perf_counter() - t_train, 2)
+        # 3D matrix: nested pp×dp stage meshes with real fp32/int8
+        # grad collectives + sharded update, gated against the same
+        # make_train_step reference trajectory (smoke shrinks steps;
+        # the recorded full run carries the 20-step parity)
+        p3_steps = 2 if smoke else train_steps
+        plan3d = _measure_plan3d(tcfg, tbatch, S, tM, p3_steps,
+                                 ref_losses[:p3_steps + 1])
         ticks = len(list_task_events(filters=[("ev", "=", "STAGE_TICK")]))
     finally:
         ray_tpu.shutdown()
@@ -554,6 +700,7 @@ def pipeline_main(smoke: bool = False) -> None:
         "serial": ser,
         "spmd_gpipe": spmd,
         "train": train,
+        "plan3d": plan3d,
         "analytic_gpipe_bubble": round(analytic_gpipe_bubble(S, M), 4),
         "loss_parity_abs": round(parity, 9),
         "single_program_loss": ref_loss,

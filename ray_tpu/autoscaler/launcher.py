@@ -240,6 +240,43 @@ def _make_provider(cfg: Dict[str, Any],
     raise ConfigError(f"unknown provider type {ptype!r}")
 
 
+def slice_type_configs(cfg: Dict[str, Any]):
+    """The ``slices:`` section of a validated config as
+    :class:`~ray_tpu.autoscaler.slices.SliceTypeConfig` rows — what a
+    SliceManager scales."""
+    from ray_tpu.autoscaler.slices import SliceTypeConfig
+    return [
+        SliceTypeConfig(
+            name,
+            topology=s["topology"],
+            host_resources=dict(s.get("host_resources", {"CPU": 1})),
+            min_slices=int(s.get("min_slices", 0)),
+            max_slices=int(s.get("max_slices", 4)))
+        for name, s in cfg.get("slices", {}).items()]
+
+
+def build_slice_manager(controller, cfg: Dict[str, Any],
+                        provider: Optional[NodeProvider] = None,
+                        idle_timeout_s: float = 3600.0,
+                        drain_deadline_s: float = 30.0):
+    """Construct the head's SliceManager from a validated cluster
+    config — the wiring ``scripts/head`` runs automatically when the
+    config has a ``slices:`` section (ROADMAP item 1: tests/drivers no
+    longer build it by hand). Returns None when the config defines no
+    slice types. Slices already created by the launcher are adopted,
+    not re-acquired. The generous default ``idle_timeout_s`` keeps the
+    monitor from releasing the ``count:`` slices ``up`` just created
+    while a driver is still connecting."""
+    types = slice_type_configs(cfg)
+    if not types:
+        return None
+    from ray_tpu.autoscaler.slices import SliceManager
+    provider = provider or _make_provider(cfg)
+    return SliceManager(controller, provider, types,
+                        idle_timeout_s=idle_timeout_s,
+                        drain_deadline_s=drain_deadline_s)
+
+
 def node_type_configs(cfg: Dict[str, Any]) -> List[NodeTypeConfig]:
     """Worker node types for the autoscaler: every type but the head."""
     return [
@@ -430,12 +467,22 @@ class LocalClusterLauncher:
         head_type = self.cfg["head_node_type"]
         head_res = self.cfg["available_node_types"][head_type][
             "resources"]
+        # persist the normalized config where the head daemon (and a
+        # later `down` from a fresh process) can find it: the head
+        # auto-starts the SliceManager monitor from its slices: section
+        cfg_path = os.path.join(self.session_dir, "cluster.yaml")
+        cfg_copy = dict(self.cfg)
+        cfg_copy["provider"] = dict(self.cfg["provider"],
+                                    session_dir=self.session_dir)
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(cfg_copy, f)
         created_head = False
         if not self._head_alive():
             cmd = [sys.executable, "-m", "ray_tpu.scripts.head",
                    "--session-dir", self.session_dir,
                    "--num-cpus", str(head_res.get("CPU", 1)),
-                   "--initial-workers", "1"]
+                   "--initial-workers", "1",
+                   "--cluster-config", cfg_path]
             with open(os.path.join(self.session_dir, "head.log"),
                       "ab") as log:
                 proc = subprocess.Popen(

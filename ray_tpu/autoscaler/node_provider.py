@@ -231,6 +231,9 @@ class FakeSliceProvider(NodeProvider):
         #: pid}], index, host_resources, created_at}
         self._slices: Dict[str, dict] = {}
         self._procs: Dict[str, List[subprocess.Popen]] = {}
+        #: slice ids THIS instance deleted — reload/persist merges must
+        #: not resurrect them from another process's stale write
+        self._deleted: set = set()
         self._created = 0
         self._t0 = time.monotonic()
         self._pending_events: List[dict] = []
@@ -256,13 +259,53 @@ class FakeSliceProvider(NodeProvider):
         self._slices = data.get("slices", {})
         self._created = data.get("created", len(self._slices))
 
+    def reload_state(self) -> None:
+        """Merge slices persisted by ANOTHER process into this
+        instance (the head-started SliceManager monitor and a
+        ``ray-tpu up`` launcher share one state file from different
+        pids): disk wins for slices whose host procs this instance
+        doesn't own and didn't itself delete. Called by
+        ``SliceManager.adopt_existing`` before every reconcile pass."""
+        if not self.session_dir:
+            return
+        try:
+            with open(self._state_path()) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        disk = data.get("slices", {})
+        with self._lock:
+            deleted = getattr(self, "_deleted", set())
+            for sid, meta in disk.items():
+                if sid not in self._slices and sid not in deleted:
+                    self._slices[sid] = meta
+            for sid in list(self._slices):
+                if sid not in disk and sid not in self._procs:
+                    self._slices.pop(sid)
+            self._created = max(self._created,
+                                int(data.get("created", 0)))
+
     def _persist_locked(self) -> None:
         if not self.session_dir:
             return
         tmp = self._state_path() + ".tmp"
         os.makedirs(self.session_dir, exist_ok=True)
+        # merge-on-write: keep slices another process persisted (and
+        # this instance neither owns nor deleted) instead of clobbering
+        # them with our in-memory view
+        merged = dict(self._slices)
+        deleted = getattr(self, "_deleted", set())
+        try:
+            with open(self._state_path()) as f:
+                disk = json.load(f).get("slices", {})
+            for sid, meta in disk.items():
+                if sid not in merged and sid not in deleted \
+                        and sid not in self._procs:
+                    merged[sid] = meta
+        except (OSError, ValueError):
+            pass
         with open(tmp, "w") as f:
-            json.dump({"slices": self._slices,
+            json.dump({"slices": merged,
                        "created": self._created}, f)
         os.replace(tmp, self._state_path())
 
@@ -319,6 +362,7 @@ class FakeSliceProvider(NodeProvider):
         with self._lock:
             meta = self._slices.pop(slice_id, None)
             procs = self._procs.pop(slice_id, [])
+            self._deleted.add(slice_id)
             self._persist_locked()
         if meta is None:
             return
@@ -359,6 +403,49 @@ class FakeSliceProvider(NodeProvider):
         with self._lock:
             meta = self._slices.get(slice_id)
             return [h["host"] for h in meta["hosts"]] if meta else []
+
+    def kill_host(self, slice_id: str, host_index: int) -> int:
+        """Hard-preempt ONE host VM of a slice: SIGKILL the host's
+        node-manager process AND every descendant process group (the
+        zygote runs in its own session, so workers would otherwise
+        outlive their node manager — a real VM death takes all of
+        them). Chaos helper for the 3D gang-kill leg; returns the
+        node-manager pid killed."""
+        with self._lock:
+            meta = self._slices.get(slice_id)
+            if meta is None:
+                raise KeyError(f"unknown slice {slice_id}")
+            pid = meta["hosts"][host_index].get("pid")
+        if not pid:
+            raise RuntimeError(
+                f"slice {slice_id} host {host_index} has no pid "
+                f"(in-memory mode?)")
+        seen, stack = set(), [pid]
+        while stack:
+            p = stack.pop()
+            if p in seen:
+                continue
+            seen.add(p)
+            try:
+                import glob
+                for f in glob.glob(f"/proc/{p}/task/*/children"):
+                    with open(f) as fh:
+                        stack.extend(int(c) for c in fh.read().split())
+            except OSError:
+                pass
+        own = os.getpgid(0)
+        pgids = set()
+        for p in seen:
+            try:
+                pgids.add(os.getpgid(p))
+            except (ProcessLookupError, PermissionError):
+                pass
+        for pg in pgids - {own}:
+            try:
+                os.killpg(pg, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        return pid
 
     # ----------------------------------------------------- node contract
     def non_terminated_nodes(self) -> List[str]:

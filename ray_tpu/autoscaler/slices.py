@@ -225,6 +225,41 @@ class SliceManager:
         self._idle_since: Dict[str, float] = {}
         self._recorder = recorder if recorder is not None \
             else getattr(controller, "recorder", None)
+        self.adopt_existing()
+
+    def adopt_existing(self) -> None:
+        """Adopt slices the provider already tracks but this manager
+        didn't acquire — e.g. the ``count:`` slices ``ray-tpu up``
+        created before the head-started monitor came up. Without
+        adoption the manager would double-acquire for the first gang
+        an existing slice could host. Adopted slices start REQUESTED
+        and flip UP through the normal :meth:`_sync` join path. Called
+        at construction and on every :meth:`update` pass (cheap), so
+        slices created by a concurrent launcher are picked up too."""
+        reload_state = getattr(self.provider, "reload_state", None)
+        if reload_state is not None:
+            try:
+                reload_state()
+            except Exception:
+                logger.exception("provider reload_state failed")
+        try:
+            existing = self.provider.non_terminated_nodes()
+        except Exception:
+            return
+        for sid in existing:
+            if sid in self.slices:
+                continue
+            try:
+                tname = self.provider.node_type(sid)
+            except Exception:
+                continue
+            t = self.slice_types.get(tname)
+            if t is None:
+                continue
+            self.slices[sid] = SliceInfo(
+                slice_id=sid, type=tname, num_hosts=t.num_hosts)
+            logger.info("slices: adopted existing %s (%s, %d hosts)",
+                        sid, tname, t.num_hosts)
 
     # -------------------------------------------------------- plumbing
     def _record(self, ev: str, **data) -> None:
@@ -439,6 +474,7 @@ class SliceManager:
         and idleness (down, whole slices only)."""
         if snap is None:
             snap = self._snapshot()
+        self.adopt_existing()
         self._sync(snap)
         self.poll_maintenance()
         released = self._finish_drains(snap)

@@ -2085,7 +2085,8 @@ class Controller:
         if self.scheduler.reserve_placement_group(spec):
             self.pg_states[b] = "CREATED"
             self._reply(identity, m["rid"], {"state": "CREATED",
-                                             "bundle_nodes": [bd.node_id.binary() for bd in spec.bundles]})
+                                             "bundle_nodes": [bd.node_id.binary() for bd in spec.bundles],
+                                             "bundle_labels": self.scheduler.bundle_labels(spec)})
         else:
             self.pg_states[b] = "PENDING"
             self.pending_pgs.append((identity, spec))
@@ -2108,7 +2109,8 @@ class Controller:
                 if identity:
                     self._send(identity, P.PG_UPDATE, {
                         "pg_id": b, "state": "CREATED",
-                        "bundle_nodes": [bd.node_id.binary() for bd in spec.bundles]})
+                        "bundle_nodes": [bd.node_id.binary() for bd in spec.bundles],
+                        "bundle_labels": self.scheduler.bundle_labels(spec)})
             else:
                 still.append((identity, spec))
         self.pending_pgs = still
@@ -2674,6 +2676,7 @@ class Controller:
                 "bundles": [bd.resources for bd in spec.bundles],
                 "bundle_nodes": [bd.node_id.hex() if bd.node_id else None
                                  for bd in spec.bundles],
+                "bundle_labels": self.scheduler.bundle_labels(spec),
             } for b, spec in self.pgs.items()]
         elif what == "jobs":
             rows = list(self.jobs.values())

@@ -389,6 +389,20 @@ class ClusterResourceScheduler:
         with self._lock:
             return [nid for nid, _ in self._pg_reservations.get(pg_id, [])]
 
+    def bundle_labels(self, spec: PlacementGroupSpec
+                      ) -> List[Dict[str, str]]:
+        """Per-bundle node labels of a placed gang — the gang → mesh
+        hand-off: ``ray-tpu-slice-id`` on every bundle tells the driver
+        (``parallel.plan``) WHICH slice hosts the gang, so stage meshes
+        and bench records can name their ICI domain."""
+        with self._lock:
+            out: List[Dict[str, str]] = []
+            for bd in spec.bundles:
+                n = self.nodes.get(bd.node_id) \
+                    if bd.node_id is not None else None
+                out.append(dict(n.labels) if n is not None else {})
+            return out
+
     # ---- views ----
     def cluster_resources(self) -> Dict[str, float]:
         with self._lock:

@@ -24,7 +24,8 @@ from ray_tpu.models.transformer import (
     TransformerConfig, init_params, logical_axes, lm_loss)
 from ray_tpu.parallel.quantization import DEFAULT_BLOCK_SIZE, fake_quant
 from ray_tpu.parallel.sharding import (
-    ShardingRules, FSDP_RULES, shard_params, batch_sharding, replicated)
+    ShardingRules, FSDP_RULES, shard_params, batch_sharding, replicated,
+    flatten_tree, unflatten_like)
 
 GRAD_TRANSPORTS = ("fp32", "int8")
 
@@ -110,12 +111,23 @@ class TrainStepBundle:
             pass
 
 
+def default_optimizer(learning_rate: float, weight_decay: float = 0.0,
+                      clip_norm: Optional[float] = 1.0):
+    """The standard training optimizer: global-norm clip (when
+    ``clip_norm`` is set) chained onto AdamW. ``parallel.plan`` builds
+    the SAME optimizer for every lowering so checkpoints round-trip
+    between the SPMD step and the MPMD pipeline with identical
+    treedefs (the pipeline applies the clip leg manually with the
+    cross-stage norm — arithmetically the same update)."""
+    adamw = optax.adamw(learning_rate, b1=0.9, b2=0.95, eps=1e-8,
+                        weight_decay=weight_decay)
+    if clip_norm is None:
+        return adamw
+    return optax.chain(optax.clip_by_global_norm(clip_norm), adamw)
+
+
 def _default_optimizer(learning_rate: float, weight_decay: float):
-    return optax.chain(
-        optax.clip_by_global_norm(1.0),
-        optax.adamw(learning_rate, b1=0.9, b2=0.95, eps=1e-8,
-                    weight_decay=weight_decay),
-    )
+    return default_optimizer(learning_rate, weight_decay, 1.0)
 
 
 def make_train_step(config: TransformerConfig, mesh,
@@ -196,22 +208,9 @@ def make_train_step(config: TransformerConfig, mesh,
         n_shards *= mesh.shape[a]
     flat_sh = NamedSharding(mesh, P(update_axes) if update_axes else P())
 
-    def _flat_len(n: int) -> int:
-        chunk = -(-n // n_shards)
-        chunk = -(-chunk // quant_block_size) * quant_block_size
-        return chunk * n_shards
-
-    def _flatten_leaf(x):
-        flat = x.reshape(-1)
-        return jnp.pad(flat, (0, _flat_len(x.size) - x.size))
-
     def _flatten_tree(tree, constrain_to=None):
-        def one(x):
-            f = _flatten_leaf(x)
-            if constrain_to is not None:
-                f = jax.lax.with_sharding_constraint(f, constrain_to)
-            return f
-        return jax.tree.map(one, tree)
+        return flatten_tree(tree, n_shards, quant_block_size,
+                            constrain_to=constrain_to)
 
     def init_raw(key):
         params = init_params(config, key)
@@ -291,9 +290,7 @@ def make_train_step(config: TransformerConfig, mesh,
             updates, new_opt = optimizer.update(
                 gflat, state["opt_state"], pflat)
             new_pflat = optax.apply_updates(pflat, updates)
-            new_params = jax.tree.map(
-                lambda p, f: f[:p.size].reshape(p.shape),
-                state["params"], new_pflat)
+            new_params = unflatten_like(state["params"], new_pflat)
         else:
             updates, new_opt = optimizer.update(
                 grads, state["opt_state"], state["params"])

@@ -25,6 +25,7 @@ from ray_tpu.parallel import quantization
 
 __all__ = [
     "mpmd_pipeline",
+    "ParallelPlan",
     "MeshSpec",
     "build_mesh",
     "local_mesh",
@@ -41,9 +42,13 @@ __all__ = [
 
 
 def __getattr__(name):
-    # mpmd_pipeline imports lazily: it pulls in the actor/runtime layer,
-    # which plain sharding users shouldn't pay for at import time
+    # mpmd_pipeline / plan import lazily: they pull in the
+    # actor/runtime and model layers, which plain sharding users
+    # shouldn't pay for at import time
     if name == "mpmd_pipeline":
         import importlib
         return importlib.import_module("ray_tpu.parallel.mpmd_pipeline")
+    if name == "ParallelPlan":
+        from ray_tpu.parallel.plan import ParallelPlan
+        return ParallelPlan
     raise AttributeError(name)

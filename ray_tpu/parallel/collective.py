@@ -83,6 +83,80 @@ class Group:
         return self._seqs[kind]
 
 
+def axis_world_size(mesh, axes) -> int:
+    """Total rank count across the named mesh axes."""
+    n = 1
+    for a in axes:
+        n *= int(mesh.shape[a])
+    return n
+
+
+def quantized_psum(x, axes, world: int,
+                   block_size: Optional[int] = None,
+                   stochastic_rounding: bool = False,
+                   key=None, mean: bool = False):
+    """Two-leg int8-quantized all-reduce of a per-rank tensor, callable
+    INSIDE a ``shard_map`` region (EQuARX, arXiv:2506.17615): quantize
+    the local payload blockwise, accumulate partial sums in f32 via
+    ``psum_scatter``, REquantize the reduced chunk, then all-gather
+    int8 values + per-block f32 scales — so the gather leg moves real
+    int8 bytes across the ``axes`` links, not f32 tensors — and
+    dequantize at the edge. Chunk boundaries round up to whole quant
+    blocks so no block straddles two ranks' chunks. Returns the reduced
+    tensor in ``x``'s shape (f32).
+
+    This is the reduction the eager collective stubs compile
+    (:func:`_build_stub`) AND the one each pipeline stage runs over its
+    own dp×fsdp mesh (``parallel.mpmd_pipeline``) — one wire format,
+    every topology."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.parallel import quantization as qz
+
+    block = int(block_size or qz.DEFAULT_BLOCK_SIZE)
+    n = x.size
+    chunk = qz._padded_len(-(-n // world), block)
+    padded = jnp.pad(x.astype(jnp.float32).reshape(-1),
+                     (0, chunk * world - n))
+    q, s = qz.quantize_int8(padded, block, stochastic_rounding, key)
+    sent = qz.dequantize_int8(q, s)                    # f32 accum leg
+    mine = jax.lax.psum_scatter(sent.reshape(world, chunk), axes,
+                                scatter_dimension=0, tiled=False)
+    q2, s2 = qz.quantize_int8(mine, block)             # gather leg
+    qg = jax.lax.all_gather(q2, axes, axis=0, tiled=False)
+    sg = jax.lax.all_gather(s2, axes, axis=0, tiled=False)
+    full = (qg.astype(jnp.float32) * sg[..., None]).reshape(-1)
+    if mean:
+        full = full / world
+    return full[:n].reshape(x.shape)
+
+
+def psum_tree(tree, axes, world: int, transport: str = "fp32",
+              block_size: Optional[int] = None,
+              stochastic_rounding: bool = False, key=None,
+              mean: bool = False):
+    """Reduce every leaf of a pytree across the named mesh axes, inside
+    a ``shard_map`` region: ``transport="fp32"`` is a plain ``psum``
+    (exact); ``"int8"`` routes each leaf through
+    :func:`quantized_psum` — real int8 values + f32 scales on the
+    gather leg. With ``stochastic_rounding`` each leaf folds its index
+    into ``key`` so no two leaves share a rounding stream."""
+    import jax
+
+    if transport == "fp32":
+        red = jax.lax.pmean if mean else jax.lax.psum
+        return jax.tree.map(lambda g: red(g, axes), tree)
+    leaves, treedef = jax.tree.flatten(tree)
+    out = []
+    for i, g in enumerate(leaves):
+        k = jax.random.fold_in(key, i) if key is not None else None
+        out.append(quantized_psum(
+            g, axes, world, block_size=block_size,
+            stochastic_rounding=stochastic_rounding, key=k, mean=mean))
+    return jax.tree.unflatten(treedef, out)
+
+
 def _build_stub(mesh, op: str, **kw):
     """Compile one collective as a shard_map program over the mesh.
 
@@ -137,18 +211,13 @@ def _build_stub(mesh, op: str, **kw):
         # dequantizes. Chunk boundaries are rounded up to whole quant
         # blocks so no block ever straddles two ranks' chunks.
         import jax.numpy as jnp
-        from ray_tpu.parallel import quantization as qz
 
         world = int(mesh.devices.size)
-        block = int(kw.get("block_size") or qz.DEFAULT_BLOCK_SIZE)
+        block = kw.get("block_size")
         sr = bool(kw.get("stochastic_rounding", False))
 
         def f(x, seed):
             local = x[0]
-            n = local.size
-            chunk = qz._padded_len(-(-n // world), block)
-            padded = jnp.pad(local.astype(jnp.float32).reshape(-1),
-                             (0, chunk * world - n))
             key = None
             if sr:
                 idx = 0
@@ -156,17 +225,9 @@ def _build_stub(mesh, op: str, **kw):
                     idx = idx * mesh.shape[a] + jax.lax.axis_index(a)
                 key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
                 key = jax.random.fold_in(key, idx)
-            q, s = qz.quantize_int8(padded, block, sr, key)
-            sent = qz.dequantize_int8(q, s)                # f32 accum leg
-            mine = jax.lax.psum_scatter(sent.reshape(world, chunk), axes,
-                                        scatter_dimension=0, tiled=False)
-            q2, s2 = qz.quantize_int8(mine, block)          # gather leg
-            qg = jax.lax.all_gather(q2, axes, axis=0, tiled=False)
-            sg = jax.lax.all_gather(s2, axes, axis=0, tiled=False)
-            full = (qg.astype(jnp.float32) * sg[..., None]).reshape(-1)
-            if reduce_op == "mean":
-                full = full / world
-            out = full[:n].reshape(local.shape)
+            out = quantized_psum(local, axes, world, block_size=block,
+                                 stochastic_rounding=sr, key=key,
+                                 mean=reduce_op == "mean")
             if op == "quantized_reducescatter":
                 return jnp.stack(jnp.split(out, world, axis=0))
             return out

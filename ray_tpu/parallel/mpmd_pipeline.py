@@ -357,7 +357,15 @@ class PipelineStage:
                  weight_decay: float = 0.0,
                  clip_norm: Optional[float] = 1.0,
                  optimizer_factory=None,
-                 mailbox_deadline_s: Optional[float] = None):
+                 mailbox_deadline_s: Optional[float] = None,
+                 dp: int = 1,
+                 fsdp: int = 1,
+                 grad_transport: str = "fp32",
+                 shard_weight_update: bool = False,
+                 quant_block_size: Optional[int] = None,
+                 quant_stochastic: bool = False,
+                 stage_mesh: Optional[bool] = None,
+                 device_indices: Optional[Sequence[int]] = None):
         import threading
 
         import jax
@@ -365,16 +373,39 @@ class PipelineStage:
         from ray_tpu.core.config import get_config
         from ray_tpu.models.transformer import (
             init_params, stage_slice_params)
+        from ray_tpu.parallel.quantization import DEFAULT_BLOCK_SIZE
 
         if remat_policy is not None:
             config = dataclasses.replace(config, remat=None,
                                          remat_policy=remat_policy)
+        if grad_transport not in ("fp32", "int8"):
+            raise ValueError(f"grad_transport must be 'fp32' or 'int8', "
+                             f"got {grad_transport!r}")
         self.config = config
         self.stage = stage
         self.n_stages = n_stages
         self.n_virtual = n_virtual
         self.n_chunks = n_stages * n_virtual
         self.chunks = stage_virtual_chunks(stage, n_stages, n_virtual)
+        #: the stage's own data-parallel grid: every mailbox microbatch
+        #: is sharded batch-wise over a dp×fsdp mesh of this actor's
+        #: devices, and the fused optimizer runs the cross-replica
+        #: sharded-update path over the same axes (3D = pp × dp × fsdp)
+        self.dp = int(dp)
+        self.fsdp = int(fsdp)
+        self.n_model = self.dp * self.fsdp
+        self.grad_transport = grad_transport
+        self.shard_weight_update = bool(shard_weight_update)
+        self.quant_block_size = int(quant_block_size
+                                    or DEFAULT_BLOCK_SIZE)
+        self.quant_stochastic = bool(quant_stochastic)
+        #: shard_map'd stage programs: automatic when the stage grid is
+        #: nontrivial; ``stage_mesh=True`` forces the path onto a
+        #: 1-device mesh (the bench's comm/compute reference and the
+        #: clusterless tests use this to exercise the 3D programs
+        #: without multiple devices)
+        self.use_mesh = (self.n_model > 1 if stage_mesh is None
+                         else bool(stage_mesh))
         #: seconds a mailbox take may starve before the stage fails
         #: typed (a dead neighbor must surface as an error, never a
         #: hang) — config.pipeline_mailbox_deadline_s unless overridden
@@ -382,27 +413,61 @@ class PipelineStage:
             mailbox_deadline_s if mailbox_deadline_s is not None
             else get_config().pipeline_mailbox_deadline_s)
         devices = jax.devices()
-        self.device = devices[(stage if device_index is None
-                               else device_index) % len(devices)]
+        self.mesh = None
+        if self.use_mesh:
+            from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+            if device_indices is None:
+                base = (stage if device_index is None
+                        else device_index) * self.n_model
+                device_indices = [(base + j) % len(devices)
+                                  for j in range(self.n_model)]
+            mine = [devices[i % len(devices)] for i in device_indices]
+            if len({d.id for d in mine}) < self.n_model:
+                raise ValueError(
+                    f"stage {stage} needs {self.n_model} distinct "
+                    f"devices for its dp={self.dp} x fsdp={self.fsdp} "
+                    f"mesh, process has {len(devices)}")
+            self.mesh = build_mesh(
+                MeshSpec(dp=self.dp, fsdp=self.fsdp), mine)
+            self.device = mine[0]
+        else:
+            self.device = devices[(stage if device_index is None
+                                   else device_index) % len(devices)]
         # full init from the shared seed, then slice: the stage weights
         # are bit-identical to the single-program model's (parity is a
         # slicing invariant, not a tolerance)
         params = init_params(config, jax.random.PRNGKey(seed))
         self.params = {
-            c: jax.device_put(
-                stage_slice_params(config, params, c, self.n_chunks),
-                self.device)
+            c: self._place_params(
+                stage_slice_params(config, params, c, self.n_chunks))
             for c in self.chunks}
         del params
         self._build_programs()
         self.optimizer = None
         self.opt_state = None
         self.clip_norm = clip_norm
+        #: flat 1/N optimizer shards only make sense on a stage mesh
+        self._opt_flat = self.use_mesh and self.shard_weight_update
         if train:
             factory = optimizer_factory or _default_stage_optimizer
             self.optimizer = factory(learning_rate, weight_decay)
-            self.opt_state = jax.device_put(
-                self.optimizer.init(self.params), self.device)
+            if self._opt_flat:
+                # optimizer state lives flat-sharded over the stage
+                # mesh (1/N resident per device): init inside jit so
+                # the flat constraint shards the moments at creation
+                from ray_tpu.parallel.sharding import flatten_tree
+                world, block = self.n_model, self.quant_block_size
+                flat_sh = self._flat_sharding()
+                init_prog = jax.jit(lambda p: self.optimizer.init(
+                    flatten_tree(p, world, block,
+                                 constrain_to=flat_sh)))
+                self.opt_state = init_prog(self.params)
+            elif self.use_mesh:
+                self.opt_state = self._place_params(
+                    self.optimizer.init(self.params))
+            else:
+                self.opt_state = jax.device_put(
+                    self.optimizer.init(self.params), self.device)
             self._build_opt_program()
         self._step_count = 0
         self._cond = threading.Condition()
@@ -411,7 +476,9 @@ class PipelineStage:
         self._targets: Dict[int, Any] = {}
         self._abort = False
         self._vjps: Dict[Tuple[int, int], Any] = {}
+        self._inputs: Dict[Tuple[int, int], Any] = {}
         self._grads: Dict[int, Any] = {}
+        self._red_cache = None
         self._sqn = None
         self._stats = self._fresh_stats()
         # live mailbox-depth gauge (fleet metrics plane): how many
@@ -441,8 +508,48 @@ class PipelineStage:
         return {"busy_s": 0.0, "idle_s": 0.0, "fwd_s": 0.0,
                 "bwd_s": 0.0, "opt_s": 0.0, "ops": 0, "span_s": 0.0}
 
+    # ---------------------------------------------- mesh placement
+    def _batch_spec(self):
+        from jax.sharding import PartitionSpec as P
+        return P(("dp", "fsdp"))
+
+    def _flat_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(("dp", "fsdp")))
+
+    def _place_params(self, tree):
+        """Stage params (and param-shaped state) live replicated over
+        the stage mesh — the dp×fsdp axes shard the BATCH; the fsdp
+        distinction shows up in the flat 1/N optimizer shards of the
+        cross-replica update, not the compute layout."""
+        import jax
+        if self.mesh is None:
+            return jax.device_put(tree, self.device)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(tree, NamedSharding(self.mesh, P()))
+
+    def _place_batch(self, x):
+        """Ship one mailbox payload to the stage's devices: batch dim 0
+        sharded over (dp, fsdp) on a mesh stage, plain device_put on a
+        single-device stage."""
+        import jax
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding
+        return jax.device_put(x, NamedSharding(self.mesh,
+                                               self._batch_spec()))
+
+    def _place_scalar(self, x):
+        import jax
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(x, NamedSharding(self.mesh, P()))
+
     # ------------------------------------------------------- programs
     def _build_programs(self):
+        if self.mesh is not None:
+            return self._build_mesh_programs()
         import jax
         import jax.numpy as jnp
 
@@ -479,6 +586,129 @@ class PipelineStage:
         self._acc = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b),
                             donate_argnums=(0,) if self._donate else ())
 
+    def _build_mesh_programs(self):
+        """The dp×fsdp stage programs: every forward/backward is a
+        ``shard_map`` over the stage's own mesh — params replicated in,
+        the microbatch sharded batch-wise over ``("dp", "fsdp")``.
+
+        Backwards RECOMPUTE the stage forward from the saved input
+        (stage-level remat): residuals never cross the shard_map
+        boundary, so the sharded path needs no per-residual specs. Each
+        rank's parameter gradients come back STACKED on a leading
+        world axis (per-rank partial sums, no reduction in the
+        backward); one :func:`collective.psum_tree` pass at optimizer
+        time puts the whole step's gradient bytes on the wire at once —
+        f32 ``psum`` for ``grad_transport="fp32"``, the two-leg
+        int8-quantized reduction (REAL int8 values + f32 scales in the
+        all-gather) for ``"int8"``."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.models.transformer import stage_forward, stage_loss
+        from ray_tpu.parallel.collective import psum_tree
+        from ray_tpu.util.jax_compat import shard_map
+
+        c, K = self.config, self.n_chunks
+        mesh, world = self.mesh, self.n_model
+        axes = ("dp", "fsdp")
+        bspec = self._batch_spec()
+        rep = P()
+
+        def smap(f, in_specs, out_specs):
+            return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs,
+                                     check_vma=False))
+
+        def stack(tree):
+            return jax.tree.map(lambda a: a[None], tree)
+
+        fwd: Dict[str, Any] = {}
+        bwd: Dict[str, Any] = {}
+        if 0 in self.chunks:
+            fwd["first"] = smap(
+                lambda p, x: stage_forward(c, 0, K, p, x),
+                (rep, bspec), bspec)
+
+            def bwd_first(p, x, g):
+                _, vjp = jax.vjp(
+                    lambda q: stage_forward(c, 0, K, q, x), p)
+                (gp,) = vjp(g)
+                return stack(gp)
+            bwd["first"] = smap(bwd_first, (rep, bspec, bspec), bspec)
+        if K - 1 in self.chunks:
+            def fwd_loss(p, x, ids, mask):
+                h = stage_forward(c, K - 1, K, p, x)
+                loss, n = stage_loss(c, p, h, ids, mask)
+                n_tot = jax.lax.psum(n, axes)
+                loss_w = jax.lax.psum(loss * n, axes) \
+                    / jnp.maximum(n_tot, 1.0)
+                return loss_w, n_tot
+            fwd["loss"] = smap(fwd_loss, (rep, bspec, bspec, bspec),
+                               (rep, rep))
+
+            def bwd_loss(p, x, ids, mask, seed):
+                # local loss is the mean over the LOCAL shard's tokens;
+                # the cotangent rescales it so summed-over-ranks grads
+                # equal the global-mean gradient: seed is the driver's
+                # n_mb/N, local seed = seed * n_loc/n_mb = n_loc/N
+                def f(q, xx):
+                    h = stage_forward(c, K - 1, K, q, xx)
+                    return stage_loss(c, q, h, ids, mask)[0]
+                _, vjp = jax.vjp(f, p, x)
+                n_loc = jnp.sum(mask[:, 1:])
+                n_mb = jax.lax.psum(n_loc, axes)
+                gp, gx = vjp(seed * n_loc / jnp.maximum(n_mb, 1.0))
+                return stack(gp), gx
+            bwd["loss"] = smap(bwd_loss,
+                               (rep, bspec, bspec, bspec, rep),
+                               (bspec, bspec))
+        if any(0 < ch < K - 1 for ch in self.chunks):
+            fwd["mid"] = smap(
+                lambda p, x: stage_forward(c, 1, K, p, x),
+                (rep, bspec), bspec)
+
+            def bwd_mid(p, x, g):
+                _, vjp = jax.vjp(
+                    lambda q, xx: stage_forward(c, 1, K, q, xx), p, x)
+                gp, gx = vjp(g)
+                return stack(gp), gx
+            bwd["mid"] = smap(bwd_mid, (rep, bspec, bspec),
+                              (bspec, bspec))
+
+        # the once-per-step gradient reduction: stacked per-rank
+        # accumulators in, reduced (replicated) gradients out — the
+        # stage's REAL bytes on the wire
+        tr, block = self.grad_transport, self.quant_block_size
+        sr = self.quant_stochastic
+
+        def reduce_body(stacked, seed):
+            local = jax.tree.map(lambda a: a[0], stacked)
+            key = None
+            if sr:
+                idx = 0
+                for a in axes:
+                    idx = idx * mesh.shape[a] + jax.lax.axis_index(a)
+                key = jax.random.fold_in(jax.random.PRNGKey(0xE8), seed)
+                key = jax.random.fold_in(key, idx)
+            return psum_tree(local, axes, world, transport=tr,
+                             block_size=block, stochastic_rounding=sr,
+                             key=key)
+        self._reduce_prog = smap(reduce_body, (bspec, rep), rep)
+
+        self._donate = jax.default_backend() != "cpu"
+        self._m_fwd = fwd
+        self._m_bwd = bwd
+        self._acc = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b),
+                            donate_argnums=(0,) if self._donate else ())
+
+    def _role_for(self, chunk: int) -> str:
+        if chunk == 0:
+            return "first"
+        if chunk == self.n_chunks - 1:
+            return "loss"
+        return "mid"
+
     def _fwd_for(self, chunk: int):
         if chunk == 0:
             return self._fwd_progs["first"]
@@ -499,6 +729,13 @@ class PipelineStage:
 
         clip = self.clip_norm
         optimizer = self.optimizer
+        opt_flat = self._opt_flat
+        if opt_flat:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ray_tpu.parallel.sharding import flatten_tree
+            world, block = self.n_model, self.quant_block_size
+            flat_sh = self._flat_sharding()
+            rep_sh = NamedSharding(self.mesh, P())
 
         def opt_step(params, opt_state, grads, global_sq_norm):
             if clip is not None:
@@ -507,6 +744,23 @@ class PipelineStage:
                 # cross-stage norm in place of the local one
                 scale = jnp.where(gn < clip, 1.0, clip / gn)
                 grads = jax.tree.map(lambda g: g * scale, grads)
+            if opt_flat:
+                # cross-replica sharded update over the stage mesh
+                # (arXiv:2004.13336): scatter grads + master params to
+                # flat 1/N shards, update only the local optimizer
+                # shard, gather fresh params via the constraint back
+                gflat = flatten_tree(grads, world, block,
+                                     constrain_to=flat_sh)
+                pflat = flatten_tree(params, world, block,
+                                     constrain_to=flat_sh)
+                updates, new_opt = optimizer.update(
+                    gflat, opt_state, pflat)
+                new_pflat = optax.apply_updates(pflat, updates)
+                new_params = jax.tree.map(
+                    lambda p, f: jax.lax.with_sharding_constraint(
+                        f[:p.size].reshape(p.shape), rep_sh),
+                    params, new_pflat)
+                return new_params, new_opt
             updates, new_opt = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             return new_params, new_opt
@@ -577,6 +831,82 @@ class PipelineStage:
             self._mbx_report_locked()
             return out
 
+    # ----------------------------------------------------- op helpers
+    def _fwd_op(self, ch: int, i: int, x, tgt):
+        """One forward op: returns the yieldable output — the
+        activation, or the ``{"loss", "n_tokens"}`` dict on the last
+        chunk — and saves what the matching backward needs: the vjp
+        residuals on a single-device stage, the raw (placed) inputs on
+        a mesh stage (whose backward recomputes)."""
+        import jax
+        import jax.numpy as jnp
+
+        K = self.n_chunks
+        if self.mesh is not None:
+            x = self._place_batch(x)
+            if ch == K - 1:
+                ids, mask = tgt
+                if mask is None:
+                    import numpy as np
+                    mask = np.ones(np.asarray(ids).shape, np.float32)
+                ids = self._place_batch(ids)
+                mask = self._place_batch(mask)
+                loss, n = self._m_fwd["loss"](self.params[ch], x,
+                                              ids, mask)
+                self._inputs[(ch, i)] = (x, ids, mask)
+                return {"loss": float(loss), "n_tokens": float(n)}
+            out = self._m_fwd[self._role_for(ch)](self.params[ch], x)
+            self._inputs[(ch, i)] = (x,)
+            jax.block_until_ready(out)
+            return out
+        if ch == K - 1:
+            ids, mask = tgt
+            if mask is None:
+                mask = jnp.ones_like(ids, dtype=jnp.float32)
+            loss, vjp = self._fwd_for(ch)(self.params[ch], x, ids, mask)
+            n = float(jnp.sum(mask[:, 1:]))
+            out: Any = {"loss": float(loss), "n_tokens": n}
+        else:
+            out, vjp = self._fwd_for(ch)(self.params[ch], x)
+            jax.block_until_ready(out)
+        self._vjps[(ch, i)] = vjp
+        return out
+
+    def _bwd_op(self, ch: int, i: int, g):
+        """One backward op: accumulates this chunk's parameter
+        gradients in-actor and returns the upstream input-gradient
+        (None on chunk 0). Mesh stages recompute the forward from the
+        saved input and accumulate per-rank STACKED partials — the
+        cross-rank reduction waits for :meth:`_reduced_grads`."""
+        import jax
+
+        if self.mesh is not None:
+            saved = self._inputs.pop((ch, i))
+            role = self._role_for(ch)
+            if role == "loss":
+                x, ids, mask = saved
+                gp, gx = self._m_bwd["loss"](
+                    self.params[ch], x, ids, mask,
+                    self._place_scalar(g))
+                out = gx if ch > 0 else None
+            elif role == "first":
+                gp = self._m_bwd["first"](self.params[ch], saved[0],
+                                          self._place_batch(g))
+                out = None
+            else:
+                gp, out = self._m_bwd["mid"](self.params[ch], saved[0],
+                                             self._place_batch(g))
+            self._red_cache = None
+        else:
+            parts = self._bwd(self._vjps.pop((ch, i)), g)
+            gp = parts[0]
+            out = parts[1] if ch > 0 else None
+        self._grads[ch] = gp if self._grads.get(ch) is None \
+            else self._acc(self._grads[ch], gp)
+        jax.block_until_ready(out if out is not None
+                              else self._grads[ch])
+        return out
+
     # ------------------------------------------------------------ step
     def run(self, n_microbatches: int):
         """One pipeline step as a streaming generator: walks this
@@ -595,7 +925,9 @@ class PipelineStage:
         with self._cond:
             self._abort = False
         self._vjps.clear()
+        self._inputs.clear()
         self._grads = {}
+        self._red_cache = None
         t_start = time.perf_counter()
         for op, i, ch in one_f_one_b_order(
                 self.stage, self.n_stages, n_microbatches,
@@ -613,30 +945,9 @@ class PipelineStage:
                            phase="idle", dur_s=round(idle, 6))
             t0 = time.perf_counter()
             if op == "F":
-                if ch == K - 1:
-                    import jax.numpy as jnp
-                    ids, mask = tgt
-                    if mask is None:
-                        mask = jnp.ones_like(ids, dtype=jnp.float32)
-                    loss, vjp = self._fwd_for(ch)(
-                        self.params[ch], x, ids, mask)
-                    n = float(jnp.sum(mask[:, 1:]))
-                    out: Any = {"loss": float(loss), "n_tokens": n}
-                else:
-                    out, vjp = self._fwd_for(ch)(self.params[ch], x)
-                if not isinstance(out, dict):
-                    jax.block_until_ready(out)
-                self._vjps[(ch, i)] = vjp
+                out = self._fwd_op(ch, i, x, tgt)
             else:
-                parts = self._bwd(self._vjps.pop((ch, i)), g)
-                gp = parts[0]
-                out = parts[1] if ch > 0 else None
-                self._grads[ch] = gp if self._grads.get(ch) is None \
-                    else self._acc(self._grads[ch], gp)
-                if out is not None:
-                    jax.block_until_ready(out)
-                else:
-                    jax.block_until_ready(self._grads[ch])
+                out = self._bwd_op(ch, i, g)
             dur = time.perf_counter() - t0
             st = self._stats
             st["busy_s"] += dur
@@ -652,6 +963,29 @@ class PipelineStage:
         self._stats["span_s"] = time.perf_counter() - t_start
 
     # ------------------------------------------- fused optimizer step
+    def _require_grads(self) -> None:
+        missing = [c for c in self.chunks if self._grads.get(c) is None]
+        if missing:
+            raise RuntimeError(
+                f"stage {self.stage}: no accumulated grads for chunks "
+                f"{missing} (run a step first)")
+
+    def _reduced_grads(self):
+        """The step's accumulated gradients, reduced across the stage
+        mesh (identity on single-device stages). On mesh stages this is
+        THE stage communication op — one ``psum_tree`` pass over the
+        whole accumulated gradient per step: plain f32 ``psum`` for
+        fp32 transport, the two-leg int8 reduction (real int8 bytes in
+        the gather) for int8. Cached until the next backward/step."""
+        if self.mesh is None:
+            return {c: self._grads[c] for c in self.chunks}
+        if self._red_cache is None:
+            import numpy as np
+            stacked = {c: self._grads[c] for c in self.chunks}
+            self._red_cache = self._reduce_prog(
+                stacked, np.uint32(self._step_count))
+        return self._red_cache
+
     def grad_sq_norm(self) -> float:
         """Squared L2 norm of this stage's accumulated grads — the
         stage's contribution to the global clip norm (a single f32
@@ -660,16 +994,12 @@ class PipelineStage:
         import jax
         import jax.numpy as jnp
 
-        missing = [c for c in self.chunks if self._grads.get(c) is None]
-        if missing:
-            raise RuntimeError(
-                f"stage {self.stage}: no accumulated grads for chunks "
-                f"{missing} (run a step first)")
+        self._require_grads()
         if self._sqn is None:
             self._sqn = jax.jit(lambda g: sum(
                 jnp.sum(jnp.square(x.astype(jnp.float32)))
                 for x in jax.tree.leaves(g)))
-        return float(self._sqn(dict(self._grads)))
+        return float(self._sqn(self._reduced_grads()))
 
     def apply_opt(self, global_sq_norm: float) -> Dict[str, float]:
         """The per-stage fused optimizer step: one jitted program
@@ -682,18 +1012,15 @@ class PipelineStage:
         if self.optimizer is None:
             raise RuntimeError("stage built with train=False has no "
                                "optimizer (pass train=True)")
-        missing = [c for c in self.chunks if self._grads.get(c) is None]
-        if missing:
-            raise RuntimeError(
-                f"stage {self.stage}: no accumulated grads for chunks "
-                f"{missing} (run a step first)")
+        self._require_grads()
         t0 = time.perf_counter()
-        grads = {c: self._grads[c] for c in self.chunks}
+        grads = self._reduced_grads()
         self.params, self.opt_state = self._opt_prog(
             self.params, self.opt_state, grads,
             jnp.float32(global_sq_norm))
         jax.block_until_ready(self.params)
         self._grads = {}
+        self._red_cache = None
         self._step_count += 1
         dur = time.perf_counter() - t0
         st = self._stats
@@ -717,13 +1044,25 @@ class PipelineStage:
         import jax
 
         host = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
+        chunks = {c: host(p) for c, p in self.params.items()}
+        opt = None
+        if self.opt_state is not None:
+            opt = host(self.opt_state)
+            if self._opt_flat:
+                # flat 1/N shards back to the canonical param-shaped
+                # layout, so a 3D checkpoint merges/reloads like any
+                # other (the flat layout is a residency optimization,
+                # not a checkpoint format)
+                from ray_tpu.parallel.sharding import unflatten_like
+                opt = _map_param_subtrees(
+                    opt, jax.tree.structure(chunks),
+                    lambda sub: unflatten_like(chunks, sub))
         part: Dict[str, Any] = {
             "stage": self.stage,
             "n_stages": self.n_stages,
             "n_virtual": self.n_virtual,
-            "chunks": {c: host(p) for c, p in self.params.items()},
-            "opt_state": (host(self.opt_state)
-                          if self.opt_state is not None else None),
+            "chunks": chunks,
+            "opt_state": opt,
             "step": self._step_count,
         }
         return part
@@ -739,14 +1078,26 @@ class PipelineStage:
             raise ValueError(
                 f"stage {self.stage} hosts chunks {sorted(want)}, "
                 f"checkpoint part carries {sorted(got)}")
-        self.params = jax.device_put(
-            {int(c): p for c, p in part["params"].items()}, self.device)
+        self.params = self._place_params(
+            {int(c): p for c, p in part["params"].items()})
         if part.get("opt_state") is not None:
             if self.optimizer is None:
                 raise RuntimeError("cannot load optimizer state into a "
                                    "train=False stage")
-            self.opt_state = jax.device_put(part["opt_state"],
-                                            self.device)
+            opt = part["opt_state"]
+            if self._opt_flat:
+                # canonical param-shaped state back into flat 1/N
+                # shards over the stage mesh
+                from ray_tpu.parallel.sharding import flatten_tree
+                world, block = self.n_model, self.quant_block_size
+                flat_sh = self._flat_sharding()
+                td = jax.tree.structure(self.params)
+                place = jax.jit(lambda o: _map_param_subtrees(
+                    o, td, lambda sub: flatten_tree(
+                        sub, world, block, constrain_to=flat_sh)))
+                self.opt_state = place(opt)
+            else:
+                self.opt_state = self._place_params(opt)
         self._step_count = int(part.get("step", 0))
 
     # ------------------------------------- serial (unpipelined) path
@@ -755,35 +1106,16 @@ class PipelineStage:
         """Unary forward for the serial chunk-by-chunk baseline: same
         jitted programs, no mailbox, one (chunk, microbatch) per
         call."""
-        import jax
-        import jax.numpy as jnp
-
         t0 = time.perf_counter()
-        if chunk == self.n_chunks - 1 and chunk > 0:
-            if loss_mask is None:
-                loss_mask = jnp.ones_like(input_ids, dtype=jnp.float32)
-            out, vjp = self._fwd_for(chunk)(
-                self.params[chunk], x, input_ids, loss_mask)
-            n = float(jnp.sum(loss_mask[:, 1:]))
-            res: Any = {"loss": float(out), "n_tokens": n}
-        else:
-            out, vjp = self._fwd_for(chunk)(self.params[chunk], x)
-            jax.block_until_ready(out)
-            res = out
-        self._vjps[(chunk, i)] = vjp
+        tgt = (input_ids, loss_mask) \
+            if chunk == self.n_chunks - 1 and chunk > 0 else None
+        res = self._fwd_op(chunk, i, x, tgt)
         self._tick("forward", i, chunk, time.perf_counter() - t0)
         return res
 
     def backward_one(self, chunk: int, i: int, g):
         t0 = time.perf_counter()
-        parts = self._bwd(self._vjps.pop((chunk, i)), g)
-        gp = parts[0]
-        out = parts[1] if chunk > 0 else None
-        self._grads[chunk] = gp if self._grads.get(chunk) is None \
-            else self._acc(self._grads[chunk], gp)
-        import jax
-        jax.block_until_ready(out if out is not None
-                              else self._grads[chunk])
+        out = self._bwd_op(chunk, i, g)
         self._tick("backward", i, chunk, time.perf_counter() - t0)
         return out
 
@@ -802,7 +1134,9 @@ class PipelineStage:
         """Serial-path step reset (the streaming ``run`` resets
         itself)."""
         self._vjps.clear()
+        self._inputs.clear()
         self._grads = {}
+        self._red_cache = None
         self._stats = self._fresh_stats()
         self._t_reset = time.perf_counter()
 
@@ -819,10 +1153,16 @@ class PipelineStage:
     def get_grads(self):
         """Host copy of the accumulated parameter gradients, keyed by
         global chunk id (legacy fwd+bwd mode — in train mode grads are
-        consumed in-actor by :meth:`apply_opt`)."""
+        consumed in-actor by :meth:`apply_opt`). Mesh stages return the
+        cross-rank REDUCED gradients (one reduction, cached)."""
         import numpy as np
 
         import jax
+        if self.mesh is not None:
+            if any(self._grads.get(c) is None for c in self.chunks):
+                return {}
+            return {c: jax.tree.map(np.asarray, g)
+                    for c, g in self._reduced_grads().items()}
         return {c: jax.tree.map(np.asarray, g)
                 for c, g in self._grads.items()}
 
@@ -896,7 +1236,15 @@ class MPMDPipeline:
                  weight_decay: float = 0.0,
                  clip_norm: Optional[float] = 1.0,
                  optimizer_factory=None,
-                 mailbox_deadline_s: Optional[float] = None):
+                 mailbox_deadline_s: Optional[float] = None,
+                 dp: int = 1,
+                 fsdp: int = 1,
+                 grad_transport: str = "fp32",
+                 shard_weight_update: bool = False,
+                 quant_block_size: Optional[int] = None,
+                 quant_stochastic: bool = False,
+                 stage_mesh: Optional[bool] = None,
+                 placement_group=None):
         import ray_tpu
         from ray_tpu.core.config import get_config
 
@@ -910,6 +1258,8 @@ class MPMDPipeline:
                 f"n_stages*n_virtual = {n_stages * n_virtual} virtual "
                 f"stages need at least that many layers, model has "
                 f"{config.n_layers}")
+        if dp < 1 or fsdp < 1:
+            raise ValueError(f"dp/fsdp must be >= 1, got {dp}/{fsdp}")
         self.config = config
         self.n_stages = n_stages
         self.n_microbatches = n_microbatches
@@ -917,6 +1267,12 @@ class MPMDPipeline:
         self.n_chunks = n_stages * n_virtual
         self.serial = serial
         self.train = train
+        self.dp = dp
+        self.fsdp = fsdp
+        self.n_model = dp * fsdp
+        self._stage_mesh = (self.n_model > 1 if stage_mesh is None
+                            else bool(stage_mesh))
+        self.placement_group = placement_group
         self.step_timeout_s = step_timeout_s
         # resolve the mailbox deadline on the DRIVER (its config sees
         # _system_config overrides) and ship the value to every stage
@@ -924,16 +1280,40 @@ class MPMDPipeline:
                     else get_config().pipeline_mailbox_deadline_s)
         opts = {"max_concurrency": 4, "max_restarts": 0}
         opts.update(actor_options or {})
-        cls = ray_tpu.remote(**opts)(PipelineStage)
         policies = remat_policies or [None] * n_stages
-        self.stages = [
-            cls.remote(config, s, n_stages, seed=seed, device_index=s,
-                       remat_policy=policies[s], n_virtual=n_virtual,
-                       train=train, learning_rate=learning_rate,
-                       weight_decay=weight_decay, clip_norm=clip_norm,
-                       optimizer_factory=optimizer_factory,
-                       mailbox_deadline_s=deadline)
-            for s in range(n_stages)]
+        self.stages = []
+        for s in range(n_stages):
+            stage_opts = dict(opts)
+            if placement_group is not None:
+                # gang → mesh hand-off: one stage actor per bundle of a
+                # (typically SLICE_SPREAD) placement group — each stage
+                # builds its dp×fsdp mesh from the devices of the host
+                # its bundle reserved
+                from ray_tpu.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy)
+                stage_opts["scheduling_strategy"] = \
+                    PlacementGroupSchedulingStrategy(
+                        placement_group,
+                        placement_group_bundle_index=s)
+                device_indices = list(range(self.n_model))
+            else:
+                device_indices = list(range(s * self.n_model,
+                                            (s + 1) * self.n_model))
+            cls = ray_tpu.remote(**stage_opts)(PipelineStage)
+            self.stages.append(cls.remote(
+                config, s, n_stages, seed=seed, device_index=s,
+                remat_policy=policies[s], n_virtual=n_virtual,
+                train=train, learning_rate=learning_rate,
+                weight_decay=weight_decay, clip_norm=clip_norm,
+                optimizer_factory=optimizer_factory,
+                mailbox_deadline_s=deadline,
+                dp=dp, fsdp=fsdp, grad_transport=grad_transport,
+                shard_weight_update=shard_weight_update,
+                quant_block_size=quant_block_size,
+                quant_stochastic=quant_stochastic,
+                stage_mesh=stage_mesh,
+                device_indices=(device_indices if self._stage_mesh
+                                else None)))
         ray_tpu.get([a.ping.remote() for a in self.stages], timeout=300)
 
     # ---------------------------------------------------------- steps
@@ -947,6 +1327,11 @@ class MPMDPipeline:
         if ids.shape[0] % m:
             raise ValueError(f"batch {ids.shape[0]} not divisible by "
                              f"{m} microbatches")
+        if self._stage_mesh and (ids.shape[0] // m) % self.n_model:
+            raise ValueError(
+                f"microbatch rows ({ids.shape[0] // m}) not divisible "
+                f"by the stage mesh dp*fsdp = {self.dp}*{self.fsdp} "
+                f"= {self.n_model}")
         ids_mb = np.split(ids, m)
         mask_mb = np.split(mask, m) if mask is not None else [None] * m
         # per-microbatch label-token counts — known to the driver

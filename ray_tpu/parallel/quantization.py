@@ -94,6 +94,29 @@ def compression_ratio(numel: int,
     return (4.0 * numel) / (nblocks * block_size + 4.0 * nblocks)
 
 
+def wire_bytes(numel: int, block_size: int = DEFAULT_BLOCK_SIZE,
+               transport: str = "int8") -> int:
+    """Bytes one rank ships per reduction leg for a ``numel`` tensor:
+    f32 transport moves ``4 * numel``; int8 moves 1 byte/elem (after
+    block padding) plus a 4-byte f32 scale per block. The benches use
+    this for the analytic comm column next to measured step excess."""
+    if transport == "fp32":
+        return 4 * numel
+    nblocks = -(-numel // block_size)
+    return nblocks * block_size + 4 * nblocks
+
+
+def tree_wire_bytes(shapes, block_size: int = DEFAULT_BLOCK_SIZE,
+                    transport: str = "int8") -> int:
+    """Sum of :func:`wire_bytes` over an iterable of array shapes (or
+    sizes) — the per-step gradient wire budget of one rank."""
+    total = 0
+    for s in shapes:
+        numel = int(s) if np.isscalar(s) else int(np.prod(s)) if s else 1
+        total += wire_bytes(numel, block_size, transport)
+    return total
+
+
 # ------------------------------------------------- NumPy reference twins
 def quantize_int8_np(x: np.ndarray, block_size: int = DEFAULT_BLOCK_SIZE
                      ) -> Tuple[np.ndarray, np.ndarray]:

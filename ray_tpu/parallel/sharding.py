@@ -97,3 +97,50 @@ def infer_param_logical_axes(params) -> object:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# ----------------------------------------------- flat 1/N update shards
+# Cross-replica sharded weight update (arXiv:2004.13336): gradients and
+# master-param working copies flatten to 1-D, pad to n_shards * k quant
+# blocks (so int8 transport and the flat layout share block boundaries),
+# and shard over the data axes — each rank updates only its 1/N chunk of
+# the flat optimizer state, then the fresh params all-gather back.
+# Shared by ``models.training.make_train_step(shard_weight_update=True)``
+# and the per-stage fused optimizer of ``parallel.mpmd_pipeline``.
+
+def flat_pad_len(n: int, n_shards: int, block_size: int) -> int:
+    """Padded flat length: the smallest multiple of ``n_shards`` whole
+    quant blocks that holds ``n`` elements."""
+    chunk = -(-n // n_shards)
+    chunk = -(-chunk // block_size) * block_size
+    return chunk * n_shards
+
+
+def flatten_leaf(x, n_shards: int, block_size: int):
+    """1-D zero-padded flat view of one leaf (see :func:`flat_pad_len`)."""
+    import jax.numpy as jnp
+    flat = x.reshape(-1)
+    return jnp.pad(flat, (0, flat_pad_len(x.size, n_shards, block_size)
+                          - x.size))
+
+
+def flatten_tree(tree, n_shards: int, block_size: int,
+                 constrain_to=None):
+    """Flatten every leaf; an optional sharding constraint on each flat
+    leaf compiles to the reduce-scatter (grads) / scatter (params)."""
+    import jax
+
+    def one(x):
+        f = flatten_leaf(x, n_shards, block_size)
+        if constrain_to is not None:
+            f = jax.lax.with_sharding_constraint(f, constrain_to)
+        return f
+    return jax.tree.map(one, tree)
+
+
+def unflatten_like(template, flat_tree):
+    """Invert :func:`flatten_tree`: slice each padded flat leaf back to
+    its template leaf's size and shape."""
+    import jax
+    return jax.tree.map(lambda p, f: f[:p.size].reshape(p.shape),
+                        template, flat_tree)

@@ -3,6 +3,14 @@
 Runs controller + node manager and blocks until signaled. Started by
 ``ray-tpu start --head`` (reference analog:
 ``python/ray/_private/services.py`` daemon spawning).
+
+With ``--cluster-config <yaml>`` the head also owns the slice layer:
+when the config has a ``slices:`` section it constructs the
+SliceManager (``autoscaler/launcher.py::build_slice_manager`` — slices
+the launcher already created are adopted, not re-acquired) and polls it
+under an ``AutoscalerMonitor``, so pending SLICE_PACK/SLICE_SPREAD
+gangs acquire slices and maintenance drains run WITHOUT any driver or
+test building the manager by hand (ROADMAP item 1).
 """
 
 from __future__ import annotations
@@ -17,6 +25,27 @@ import time
 import uuid
 
 
+def _start_slice_monitor(config_path: str, interval_s: float):
+    """Build the SliceManager from the cluster config and start its
+    monitor loop. Returns (monitor, manager) or (None, None) when the
+    config has no slices section."""
+    import ray_tpu.api as api
+    from ray_tpu.autoscaler.autoscaler import AutoscalerMonitor
+    from ray_tpu.autoscaler.launcher import (
+        build_slice_manager, load_cluster_config)
+
+    cfg = load_cluster_config(config_path)
+    mgr = build_slice_manager(api._head.controller, cfg)
+    if mgr is None:
+        return None, None
+    monitor = AutoscalerMonitor(mgr, interval_s=interval_s)
+    monitor.start()
+    print(f"ray_tpu head: slice monitor up "
+          f"({', '.join(sorted(mgr.slice_types))})")
+    sys.stdout.flush()
+    return monitor, mgr
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--session-dir", default=None)
@@ -24,6 +53,11 @@ def main() -> None:
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--resources", default="{}")
     p.add_argument("--initial-workers", type=int, default=2)
+    p.add_argument("--cluster-config", default=None,
+                   help="validated cluster YAML; a slices: section "
+                        "auto-starts the SliceManager monitor")
+    p.add_argument("--slice-monitor-interval-s", type=float,
+                   default=1.0)
     args = p.parse_args()
 
     import ray_tpu
@@ -42,10 +76,21 @@ def main() -> None:
     print(f"ray_tpu head running; session_dir={info['session_dir']}")
     sys.stdout.flush()
 
+    monitor = mgr = None
+    if args.cluster_config:
+        try:
+            monitor, mgr = _start_slice_monitor(
+                args.cluster_config, args.slice_monitor_interval_s)
+        except Exception as e:  # noqa: BLE001 — head must still serve
+            print(f"ray_tpu head: slice monitor failed to start: {e}")
+            sys.stdout.flush()
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    if monitor is not None:
+        monitor.stop()
     ray_tpu.shutdown()
 
 

@@ -27,12 +27,16 @@ from ray_tpu.core.task_spec import Bundle, PlacementGroupSpec
 class PlacementGroup:
     def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
                  strategy: str, state: str = "PENDING",
-                 bundle_nodes: Optional[List[bytes]] = None):
+                 bundle_nodes: Optional[List[bytes]] = None,
+                 bundle_labels: Optional[List[Dict[str, str]]] = None):
         self.id = pg_id
         self.bundle_specs = bundles
         self.strategy = strategy
         self._state = state
         self.bundle_nodes = bundle_nodes or []
+        #: per-bundle node labels of the current reservation (the
+        #: gang → mesh hand-off: carries ``ray-tpu-slice-id``)
+        self.bundle_labels = bundle_labels or []
 
     @property
     def state(self) -> str:
@@ -61,6 +65,8 @@ class PlacementGroup:
                     if ev:
                         self.bundle_nodes = ev.get(
                             "bundle_nodes", self.bundle_nodes)
+                        self.bundle_labels = ev.get(
+                            "bundle_labels", self.bundle_labels)
                     return True
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
@@ -70,9 +76,24 @@ class PlacementGroup:
     def wait(self, timeout_seconds: float = 30.0) -> bool:
         return self.ready(timeout=timeout_seconds)
 
+    def slice_id(self) -> Optional[str]:
+        """The TPU slice hosting this gang, when every placed bundle's
+        node carries the same ``ray-tpu-slice-id`` label — the handle a
+        driver uses to name the ICI domain its stage meshes share
+        (``parallel.plan`` logs it; benches record it). None for loose
+        placements or before the gang is placed."""
+        from ray_tpu.core.scheduler import node_slice_id
+        if not self.bundle_labels:
+            return None
+        ids = {node_slice_id(labels or {})
+               for labels in self.bundle_labels}
+        ids.discard(None)
+        return ids.pop() if len(ids) == 1 else None
+
     def __reduce__(self):
         return (PlacementGroup, (self.id, self.bundle_specs, self.strategy,
-                                 self._state, self.bundle_nodes))
+                                 self._state, self.bundle_nodes,
+                                 self.bundle_labels))
 
 
 #: the strategies the bundle planner implements
@@ -97,7 +118,8 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
     reply = w.request(P.CREATE_PG, {"spec": spec})
     return PlacementGroup(spec.pg_id, bundles, strategy,
                           state=reply["state"],
-                          bundle_nodes=reply.get("bundle_nodes"))
+                          bundle_nodes=reply.get("bundle_nodes"),
+                          bundle_labels=reply.get("bundle_labels"))
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:

@@ -108,3 +108,95 @@ def test_example_yaml_golden_round_trip():
     assert len(cfg["slices"]["trainers"]["placement"]["bundles"]) == 4
     again = validate_cluster_config(copy.deepcopy(cfg))
     assert again == cfg
+
+
+def test_slice_type_configs_and_build_slice_manager():
+    """The head-started monitor wiring: a validated config's slices:
+    section maps to SliceTypeConfig rows; build_slice_manager wires a
+    SliceManager over them (None without a slices section) and ADOPTS
+    slices the launcher already created instead of re-acquiring."""
+    from ray_tpu.autoscaler.launcher import (
+        build_slice_manager, slice_type_configs)
+    from ray_tpu.autoscaler.node_provider import FakeSliceProvider
+
+    cfg = validate_cluster_config({
+        "cluster_name": "t",
+        "provider": {"type": "fake_slice"},
+        "head_node_type": "head",
+        "available_node_types": {"head": {"resources": {"CPU": 1}}},
+        "slices": {"pod": {"topology": "2x4", "count": 1,
+                           "min_slices": 1, "max_slices": 3,
+                           "host_resources": {"CPU": 2,
+                                              "hostchip": 4}}},
+    })
+    types = slice_type_configs(cfg)
+    assert [(t.name, t.topology, t.num_hosts, t.min_slices,
+             t.max_slices) for t in types] == [("pod", "2x4", 2, 1, 3)]
+    assert types[0].host_resources == {"CPU": 2, "hostchip": 4}
+
+    class Ctrl:
+        scheduler = None
+        nodes = {}
+        leases = {}
+        actors = {}
+        recorder = None
+
+        def call_on_loop(self, fn):
+            return fn()
+
+    # a pre-existing slice (ray-tpu up's count:) is adopted, so a
+    # feasible pending gang does NOT trigger a second acquire
+    provider = FakeSliceProvider(None, {"max_slices": 4})
+    sid = provider.create_slice("pod", "2x4", {"CPU": 2, "hostchip": 4})
+    mgr = build_slice_manager(Ctrl(), cfg, provider=provider)
+    assert mgr is not None
+    assert sid in mgr.slices and mgr.slices[sid].state == "REQUESTED"
+    snap = {"demand": [],
+            "slice_demand": [{"hosts": 2, "bundles": [{"CPU": 1}] * 2}],
+            "busy_nodes": set(), "alive_nodes": set()}
+    out = mgr.update(snap)
+    assert out["acquired"] == []
+    assert len(provider.non_terminated_nodes()) == 1
+
+    # a config with no slices section builds no manager
+    bare = validate_cluster_config({
+        "cluster_name": "t2",
+        "provider": {"type": "fake_slice"},
+        "head_node_type": "head",
+        "available_node_types": {"head": {"resources": {"CPU": 1}}},
+    })
+    assert build_slice_manager(Ctrl(), bare, provider=provider) is None
+
+
+def test_local_launcher_writes_cluster_yaml_for_head(tmp_path):
+    """LocalClusterLauncher.up persists the normalized config into the
+    session dir and points the head daemon at it (--cluster-config) so
+    the head can start the slice monitor; verified clusterless by
+    inspecting the written file."""
+    import yaml as _yaml
+
+    from ray_tpu.autoscaler.launcher import LocalClusterLauncher
+
+    session = str(tmp_path / "sess")
+    cfg = validate_cluster_config({
+        "cluster_name": "wr",
+        "provider": {"type": "fake_slice", "session_dir": session},
+        "head_node_type": "head",
+        "available_node_types": {"head": {"resources": {"CPU": 1}}},
+        "slices": {"pod": {"topology": "2x2", "count": 0}},
+    })
+    launcher = LocalClusterLauncher(cfg)
+    out = launcher.up()
+    try:
+        path = os.path.join(session, "cluster.yaml")
+        assert os.path.exists(path)
+        with open(path) as f:
+            saved = _yaml.safe_load(f)
+        assert saved["slices"]["pod"]["topology"] == "2x2"
+        assert saved["provider"]["session_dir"] == session
+        # the written config re-validates unchanged (head loads it)
+        assert validate_cluster_config(copy.deepcopy(saved))["slices"] \
+            == saved["slices"]
+        assert out["slices"] == []
+    finally:
+        launcher.down()

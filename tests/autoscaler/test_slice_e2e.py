@@ -8,6 +8,7 @@ test_slices.py (tier-1)."""
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -272,6 +273,154 @@ def test_cli_up_down_round_trip(tmp_path):
                 break
             assert time.monotonic() < deadline, "head survived down"
             time.sleep(0.2)
+
+
+def test_head_started_slice_monitor_acquires_for_gang(tmp_path):
+    """ROADMAP item 1 satellite: with a ``slices:`` section in the
+    cluster config, the HEAD process constructs and polls the
+    SliceManager automatically — a driver's pending SLICE_SPREAD gang
+    acquires a whole slice with no manager built by the driver or the
+    test. ``count: 0`` ensures the slice can only come from the
+    head-started monitor reacting to gang demand."""
+    from ray_tpu.autoscaler.launcher import (
+        LocalClusterLauncher, validate_cluster_config)
+
+    session = str(tmp_path / "cluster")
+    cfg = validate_cluster_config({
+        "cluster_name": "head-mon",
+        "provider": {"type": "fake_slice", "session_dir": session},
+        "head_node_type": "head",
+        "available_node_types": {"head": {"resources": {"CPU": 1}}},
+        "slices": {"pod": {"topology": "2x4", "count": 0,
+                           "host_resources": {"CPU": 1,
+                                              "hostchip": 4}}},
+    })
+    launcher = LocalClusterLauncher(cfg)
+    out = launcher.up()
+    assert out["slices"] == []          # count 0: up creates nothing
+    try:
+        ray_tpu.init(address=session)
+        try:
+            pg = placement_group([{"hostchip": 1}] * 2,
+                                 strategy="SLICE_SPREAD")
+            # only the head's monitor can satisfy this: it must see the
+            # pending gang, acquire a 2-host slice, and place it
+            assert pg.ready(timeout=120), \
+                "head-started SliceManager never acquired a slice"
+            assert len(set(pg.bundle_nodes)) == 2
+            assert pg.slice_id() is not None
+            rows = {n["node_id"]: n for n in ray_tpu.nodes()}
+            sids = {_slice_of(rows[nb.hex()]) for nb in pg.bundle_nodes}
+            assert len(sids) == 1 and None not in sids
+            remove_placement_group(pg)
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        launcher.down()
+
+
+def test_plan3d_gang_host_kill_typed_failure(head):
+    """chaos-matrix 3D leg: a ParallelPlan(pp=2, dp=2,
+    slice_strategy=SLICE_SPREAD) trains on a gang-scheduled slice; one
+    host VM of the sharded stage gang is SIGKILLed mid-train-step. The
+    driver must fail TYPED (never hang), the placement group must flip
+    to RESCHEDULING once the manager notices the dead host, and
+    shutdown must drain pools/streams cleanly."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.models.transformer import TransformerConfig
+    from ray_tpu.parallel.plan import ParallelPlan
+
+    seeds = [int(s) for s in os.environ.get(
+        "RAY_TPU_CHAOS_SOAK_SEEDS", "5505").split()]
+    seed = seeds[0]
+    ctrl = _controller()
+    provider = FakeSliceProvider(head["session_dir"], {"max_slices": 4})
+    mgr = SliceManager(
+        ctrl, provider,
+        [SliceTypeConfig("pod", "2x4", {"CPU": 2, "hostchip": 4})],
+        idle_timeout_s=3600.0, drain_deadline_s=5.0)
+    prog = None
+    try:
+        sid = mgr.acquire_slice("pod")
+        assert mgr.wait_until_up(sid, timeout_s=90)
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=4, n_heads=2,
+            head_dim=16, d_ff=64, max_seq_len=32, rotary_dim=8,
+            block_style="gptj", dtype=jnp.float32, remat=False,
+            ce_chunk_size=8)
+        plan = ParallelPlan(pp=2, dp=2, n_microbatches=2,
+                            slice_strategy="SLICE_SPREAD")
+        prog = plan.build(cfg, learning_rate=1e-3, seed=0,
+                          placement_bundle={"CPU": 1, "hostchip": 1},
+                          placement_timeout_s=60, step_timeout_s=45)
+        # the gang really landed on the slice (gang -> mesh hand-off)
+        assert prog.pg is not None
+        assert prog.pg.slice_id() == sid
+        ids = np.array(jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size))
+        batch = {"input_ids": ids,
+                 "loss_mask": np.ones((8, 16), np.float32)}
+        res = prog.step(batch)        # compile + first step works
+        assert res.loss > 0
+
+        # SIGKILL one host VM of the gang mid-train-step (seeded
+        # delay): provider.kill_host takes down the node manager AND
+        # its worker process groups — the whole-VM death a real
+        # preemption delivers. The driver keeps stepping until the
+        # kill lands, so the failure is guaranteed to hit a step in
+        # flight (not the gap between steps).
+        import random
+        delay = 0.05 + random.Random(f"{seed}:3d").random() * 0.4
+        err: list = []
+        stop = threading.Event()
+
+        def _steps():
+            try:
+                while not stop.is_set():
+                    prog.step(batch)
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+
+        t = threading.Thread(target=_steps)
+        t.start()
+        time.sleep(delay)
+        provider.kill_host(sid, 1)
+        t.join(timeout=180)
+        stop.set()
+        assert not t.is_alive(), "driver hung after host SIGKILL"
+        assert err, "steps kept succeeding on a dead gang host"
+        from ray_tpu.exceptions import ActorDiedError
+        assert isinstance(
+            err[0], TYPED_RETRYABLE
+            + (ActorDiedError, TimeoutError, RuntimeError)), \
+            f"untyped failure: {type(err[0]).__name__}: {err[0]}"
+
+        # the manager notices the dead host, drains the slice as a
+        # unit, and the gang flips to RESCHEDULING (then re-reserves
+        # on a fresh slice on a later pass)
+        deadline = time.monotonic() + 120
+        while True:
+            mgr.update()
+            state = prog.pg.state
+            if state in ("RESCHEDULING", "CREATED") and \
+                    mgr.slices[sid].state in ("DRAINING", "RELEASED"):
+                break
+            assert time.monotonic() < deadline, \
+                (state, mgr.slices[sid].state)
+            time.sleep(0.5)
+        # typed failure + clean drain: shutdown returns promptly
+        t0 = time.monotonic()
+        prog.shutdown()
+        prog = None
+        assert time.monotonic() - t0 < 60, "shutdown hung"
+    finally:
+        if prog is not None:
+            prog.shutdown()
+        mgr.shutdown()
+        provider.shutdown()
 
 
 def test_drain_node_if_idle_race_no_lost_tasks(head):

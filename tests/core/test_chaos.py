@@ -615,6 +615,7 @@ def test_restore_eio_retries_then_reports_local_loss(tmp_path):
         store.destroy()
 
 
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_restore_eio_recovers_via_repull():
     """Acceptance: a get whose LOCAL restore hits injected EIO (every

@@ -93,6 +93,7 @@ def test_state_survives_controller_restart(cluster):
     assert ray_tpu.get(f.remote(41), timeout=120) == 42
 
 
+@pytest.mark.slow
 def test_inflight_tasks_resubmitted_after_restart(cluster):
     @ray_tpu.remote
     def slow(x):

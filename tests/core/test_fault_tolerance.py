@@ -120,6 +120,7 @@ def test_inflight_call_during_restart_is_unavailable(ray_start_regular):
         pytest.fail("actor did not come back after restart")
 
 
+@pytest.mark.slow
 def test_pull_timeout_when_holder_node_dies():
     """Object-pull timeout path (pull_timeout_s): the only holder node
     is SIGKILLed while the object is being pulled. The destination's

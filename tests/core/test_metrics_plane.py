@@ -460,6 +460,7 @@ def test_mrt_exactly_once_effect_under_drops_and_dups():
             os.environ.pop("RAY_TPU_METRICS_REPORT_INTERVAL_MS", None)
 
 
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_mrt_full_drop_window_stalls_nothing_counts_drops():
     """A 100% MRT-drop window: the cluster keeps scheduling (reports

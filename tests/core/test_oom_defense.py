@@ -27,6 +27,7 @@ def oom_cluster():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_oom_kill_surfaces_out_of_memory_error(oom_cluster):
     @ray_tpu.remote(max_retries=0)
     def hog():

@@ -238,6 +238,7 @@ def _clear_chaos_env():
     os.environ.pop(chaos.ENV_CONFIG, None)
 
 
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_dropped_dispatch_redelivered_exactly_once(tmp_path):
     """Drop a third of TASK_DISPATCH / ACTOR_CALL sends: the retransmit

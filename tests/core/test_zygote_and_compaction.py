@@ -104,6 +104,7 @@ def _spawn_via_zygote(sock_path, env, log_path, timeout=30.0):
     return json.loads(data)["pid"]
 
 
+@pytest.mark.slow
 def test_zygote_parent_death_cleanup(tmp_path):
     """The zygote exits (and unlinks its socket) when the watched
     parent pid dies — unclean node deaths must not leak daemons."""

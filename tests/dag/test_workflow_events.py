@@ -88,6 +88,7 @@ def test_timer_listener(ray_session, tmp_path):
     assert time.time() >= target
 
 
+@pytest.mark.slow
 def test_event_survives_cluster_restart(tmp_path):
     """The VERDICT scenario: a workflow waits on an event, the cluster
     goes down mid-wait, an HTTP POST delivers the event while/after the

@@ -66,6 +66,7 @@ def test_node_process_stats_flow_to_state_api(cluster):
     assert any(r["kind"] == "node_manager" for r in rows)
 
 
+@pytest.mark.slow
 def test_profile_endpoint_returns_flamegraph_artifact(cluster):
     pytest.importorskip("psutil")
     addr = _dashboard_address(cluster)

@@ -11,6 +11,7 @@ import ray_tpu
 from ray_tpu import data as rd
 
 
+@pytest.mark.slow
 def test_range_count_take(ray_session):
     ds = rd.range(100, parallelism=4)
     assert ds.num_blocks() == 4

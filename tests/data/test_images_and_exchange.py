@@ -27,6 +27,7 @@ def _write_images(tmp_path, n=6, shape=(12, 10), vary=False):
     return paths
 
 
+@pytest.mark.slow
 def test_read_images_round_trip(ray_session, tmp_path):
     _write_images(tmp_path, n=6, shape=(12, 10))
     ds = rdata.read_images(str(tmp_path), include_paths=True)
@@ -65,6 +66,7 @@ def test_read_images_packs_small_files_into_blocks(ray_session, tmp_path):
     assert len(ds.take_all()) == 8
 
 
+@pytest.mark.slow
 def test_streaming_shuffle_overlaps_production(ray_session):
     """The exchange's map side consumes blocks while upstream reads are
     still producing: with a read window smaller than the block count,

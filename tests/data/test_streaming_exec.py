@@ -63,6 +63,7 @@ def _two_stage(n_rows=1200, parallelism=6, pool=2):
 
 
 # ------------------------------------------------ ordered / unordered
+@pytest.mark.slow
 def test_ordered_unordered_parity(data_cluster, ctx):
     """Completion-order execution delivers exactly the ordered run's
     multiset; ordered keeps submission order."""

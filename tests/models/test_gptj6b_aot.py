@@ -77,6 +77,7 @@ print(json.dumps({
 """
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("_", [0])
 def test_gptj6b_aot_lowers_and_fits_v5e(_, tmp_path):
     repo = os.path.dirname(os.path.dirname(

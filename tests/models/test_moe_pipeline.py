@@ -29,6 +29,7 @@ def test_moe_forward_routes_and_conserves(cpu_mesh_devices):
     assert 0.9 < float(aux) < 2.5
 
 
+@pytest.mark.slow
 def test_moe_train_step_on_ep_mesh(cpu_mesh_devices):
     cfg = get_config("moe-tiny")
     mesh = build_mesh(MeshSpec(dp=2, ep=2, tp=2), cpu_mesh_devices)

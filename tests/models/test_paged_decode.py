@@ -87,6 +87,7 @@ def test_prefill_decode_parity_vs_full_forward(style, kv_heads):
     np.testing.assert_allclose(dec, full[:, prompt:], **TOL)
 
 
+@pytest.mark.slow
 def test_single_vs_chunked_prefill_identical():
     """Chunk size must be invisible: prefilling in chunks of 2 and in
     one chunk of 8 writes identical caches and logits."""

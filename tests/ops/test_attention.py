@@ -189,6 +189,7 @@ def test_flash_cross_length_causal():
                                np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_pallas_backward_matches_reference_and_xla():
     """The Pallas dq/dk/dv kernels (P recomputed from the saved LSE)
     must match both the dense reference gradients and the lax.scan

@@ -418,6 +418,7 @@ def test_apply_opt_requires_grads_and_train_mode():
 # ---------------------------------------------- checkpoint round-trip
 
 
+@pytest.mark.slow
 def test_stage_checkpoint_round_trip_and_cross_v_reload():
     """Merged per-stage checkpoints reproduce the canonical
     single-program train-state LAYOUT (same treedef as

@@ -1,0 +1,284 @@
+"""ParallelPlan: one config lowering to SPMD, MPMD, or nested 3D
+(parallel/plan.py) — lowering selection, the dp×fsdp shard_map'd stage
+programs (parity against ``make_train_step``), real int8 grad bytes on
+the stage wire, and the lowering-independent checkpoint contract:
+a state saved under (S=2, v=2, dp=2) reloads into (S=1, dp=1) and back
+with exact value AND treedef parity.
+
+Clusterless: stages are driven in-process (the live actor pipeline is
+covered by test_mpmd_pipeline.py's slow tests and the slice-gang e2e in
+tests/autoscaler/test_slice_e2e.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.parallel.plan import ParallelPlan
+
+pytestmark = pytest.mark.pipeline
+
+
+def tiny_config(**kw):
+    base = dict(vocab_size=128, d_model=32, n_layers=4, n_heads=2,
+                head_dim=16, d_ff=64, max_seq_len=32, rotary_dim=8,
+                block_style="gptj", dtype=jnp.float32, remat=False,
+                ce_chunk_size=8)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _batch(cfg, b=8, s=16, seed=1):
+    ids = np.array(jax.random.randint(jax.random.PRNGKey(seed), (b, s),
+                                      0, cfg.vocab_size))
+    return {"input_ids": ids, "loss_mask": np.ones((b, s), np.float32)}
+
+
+# ----------------------------------------------------- lowering choice
+def test_plan_lowering_selection():
+    assert ParallelPlan().lowering == "spmd"
+    assert ParallelPlan(dp=4, fsdp=2).lowering == "spmd"
+    assert ParallelPlan(pp=2).lowering == "mpmd"
+    assert ParallelPlan(pp=2, virtual=2).lowering == "mpmd"
+    assert ParallelPlan(pp=2, dp=2).lowering == "mpmd3d"
+    assert ParallelPlan(pp=4, dp=2, fsdp=2).lowering == "mpmd3d"
+    p = ParallelPlan(pp=2, dp=2, fsdp=2)
+    assert p.stage_world == 4 and p.world_size == 8
+    for field in ("pp=2", "dp=2", "fsdp=2"):
+        assert field in p.describe()
+
+
+def test_plan_validation():
+    with pytest.raises(ValueError, match=">= 1"):
+        ParallelPlan(pp=0)
+    with pytest.raises(ValueError, match="virtual"):
+        ParallelPlan(virtual=2)          # needs pp >= 2
+    with pytest.raises(ValueError, match="grad_transport"):
+        ParallelPlan(grad_transport="int4")
+    with pytest.raises(ValueError, match="slice_strategy"):
+        ParallelPlan(slice_strategy="SPREAD")
+    with pytest.raises(ValueError, match="chunks"):
+        ParallelPlan(pp=2, virtual=4).validate_config(tiny_config())
+    plan = ParallelPlan(pp=2, dp=2, n_microbatches=2)
+    plan.validate_batch(8)
+    with pytest.raises(ValueError, match="microbatches"):
+        plan.validate_batch(9)
+    with pytest.raises(ValueError, match="dp\\*fsdp"):
+        plan.validate_batch(6)           # 3 rows/mb not divisible by 2
+    with pytest.raises(ValueError, match="dp\\*fsdp"):
+        ParallelPlan(dp=4).validate_batch(6)
+
+
+# --------------------------------------------------- SPMD lowering
+def test_spmd_program_step_and_canonical_checkpoint():
+    """pp=1 lowers to make_train_step behind the uniform TrainProgram
+    interface; its checkpoint is the CANONICAL layout (plain AdamW
+    state — the chain(clip, adamw) wrapper unwrapped), so it matches
+    the pipeline lowerings treedef-for-treedef."""
+    import optax
+
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    prog = ParallelPlan().build(cfg, learning_rate=1e-3, seed=0,
+                                telemetry_interval_s=0)
+    r1 = prog.step(batch)
+    r2 = prog.step(batch)
+    assert r2.loss < r1.loss
+    assert r2.step == 2 and r2.grad_norm > 0
+    ck = prog.save_checkpoint()
+    assert set(ck) == {"params", "opt_state", "step"}
+    assert ck["step"] == 2
+    # canonical == bare AdamW state treedef (no chain wrapper)
+    adamw = optax.adamw(1e-3, b1=0.9, b2=0.95, eps=1e-8,
+                        weight_decay=0.0)
+    want = jax.tree.structure(adamw.init(ck["params"]))
+    assert jax.tree.structure(ck["opt_state"]) == want
+
+    # load into a fresh program (different seed): trajectory continues
+    fresh = ParallelPlan().build(cfg, learning_rate=1e-3, seed=9,
+                                 telemetry_interval_s=0)
+    fresh.load_checkpoint(ck)
+    a, b = prog.step(batch), fresh.step(batch)
+    assert abs(a.loss - b.loss) <= 1e-6
+    assert b.step == 3
+
+
+# --------------------------------------- nested stages, in-process
+def _make_stages(cfg, S, v, dp=1, fsdp=1, clip=1.0, lr=1e-3, **kw):
+    from ray_tpu.parallel.mpmd_pipeline import PipelineStage
+    return [PipelineStage(cfg, s, S, seed=0, n_virtual=v, train=True,
+                          learning_rate=lr, clip_norm=clip,
+                          dp=dp, fsdp=fsdp,
+                          device_indices=list(range(dp * fsdp)), **kw)
+            for s in range(S)]
+
+
+def _inprocess_train_step(stages, batch, S, v, M):
+    """One full train step driven in-process (the driver loop of
+    MPMDPipeline without actors): fwd chain, bwd chain, driver-reduced
+    grad-norm scalar, per-stage fused opt."""
+    K = S * v
+    ids = np.asarray(batch["input_ids"])
+    mask = np.asarray(batch["loss_mask"])
+    ids_mb, mask_mb = np.split(ids, M), np.split(mask, M)
+    ns = [float(mk[:, 1:].sum()) for mk in mask_mb]
+    total = sum(ns)
+    losses = []
+    for i in range(M):
+        x = ids_mb[i]
+        for ch in range(K):
+            st = stages[ch % S]
+            out = st.forward_one(ch, i, x, ids_mb[i], mask_mb[i]) \
+                if ch == K - 1 else st.forward_one(ch, i, x)
+            if ch < K - 1:
+                x = np.asarray(out)
+        losses.append((out["loss"], out["n_tokens"]))
+        g = np.float32(ns[i] / total)
+        for ch in range(K - 1, -1, -1):
+            g = stages[ch % S].backward_one(ch, i, g)
+            if g is not None:
+                g = np.asarray(g)
+    gsq = sum(st.grad_sq_norm() for st in stages)
+    mets = [st.apply_opt(gsq) for st in stages]
+    return (sum(l * n for l, n in losses) / total,
+            mets[0]["grad_norm"])
+
+
+def test_nested_stage_mesh_matches_spmd_short():
+    """The shard_map'd dp=2 stage programs (recompute backward, psum'd
+    grads, fused opt) reproduce the SPMD lowering's loss trajectory —
+    the quick tier-1 parity; the recorded bench carries the 20-step
+    acceptance run."""
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    S, v, M = 2, 1, 2
+    stages = _make_stages(cfg, S, v, dp=2)
+    assert all(st.mesh is not None for st in stages)
+    ref = ParallelPlan().build(cfg, learning_rate=1e-3, seed=0,
+                               telemetry_interval_s=0)
+    for _ in range(5):
+        loss, gn = _inprocess_train_step(stages, batch, S, v, M)
+        r = ref.step(batch)
+        assert abs(loss - r.loss) <= 1e-5
+        assert abs(gn - r.grad_norm) <= 1e-4
+
+
+def test_nested_int8_stage_wire_is_quantized_and_tracks_fp32():
+    """int8 grad transport on the stage mesh: the reduction program's
+    compiled HLO moves REAL s8 payloads (not in-graph error
+    injection), and the trajectory stays close to (but not bit-equal
+    with) fp32 — the quantization error is the proof it went through
+    the wire format."""
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    S, v, M = 2, 1, 2
+    q = _make_stages(cfg, S, v, dp=2, grad_transport="int8")
+    f = _make_stages(cfg, S, v, dp=2)
+    ql = fl = None
+    diffs = []
+    for _ in range(3):
+        ql, _ = _inprocess_train_step(q, batch, S, v, M)
+        fl, _ = _inprocess_train_step(f, batch, S, v, M)
+        diffs.append(abs(ql - fl))
+    assert 0.0 < max(diffs) < 5e-2
+    # the compiled reduce program all-gathers int8 values
+    stacked = {c: q[0]._grads.get(c) for c in q[0].chunks}
+    # grads were consumed by apply_opt; lower the program on dummy
+    # shapes instead: reuse the stage's compiled reduce via one more
+    # bwd pass
+    _ = [st.reset_step() for st in q]
+    import re
+    x = np.asarray(batch["input_ids"])[:4]
+    st0 = q[0]
+    st0.forward_one(0, 0, x)
+    stN = q[1]
+    act = np.asarray(st0._m_fwd["first"](st0.params[0],
+                                         st0._place_batch(x)))
+    stN.forward_one(1, 0, act, x, np.ones_like(x, np.float32))
+    stN.backward_one(1, 0, np.float32(1.0))
+    stacked = {c: stN._grads[c] for c in stN.chunks}
+    txt = stN._reduce_prog.lower(stacked, np.uint32(0)) \
+        .compile().as_text()
+    assert re.search(r"all-gather[^\n]*s8\[|s8\[[0-9,]*\][^\n]*"
+                     r"all-gather", txt) or "s8[" in txt
+
+
+def test_sharded_update_flat_opt_state_checkpoints_param_shaped():
+    """shard_weight_update=True keeps the stage's optimizer state in
+    flat 1/N shards over the mesh, but stage_checkpoint converts back
+    to the canonical param-shaped layout — and reloads from it."""
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    S, v, M = 2, 1, 2
+    stages = _make_stages(cfg, S, v, dp=2, shard_weight_update=True)
+    plain = _make_stages(cfg, S, v, dp=2)
+    for _ in range(2):
+        l1, _ = _inprocess_train_step(stages, batch, S, v, M)
+        l2, _ = _inprocess_train_step(plain, batch, S, v, M)
+        assert abs(l1 - l2) <= 1e-5   # flat layout is residency, not math
+    a = stages[0].stage_checkpoint()
+    b = plain[0].stage_checkpoint()
+    assert jax.tree.structure(a["opt_state"]) == \
+        jax.tree.structure(b["opt_state"])
+    for x, y in zip(jax.tree.leaves(a["opt_state"]),
+                    jax.tree.leaves(b["opt_state"])):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=2e-5)
+    # reload round-trip through the flat layout
+    stages[0].load_state({"params": a["chunks"],
+                          "opt_state": a["opt_state"],
+                          "step": a["step"]})
+    c = stages[0].stage_checkpoint()
+    for x, y in zip(jax.tree.leaves(a["opt_state"]),
+                    jax.tree.leaves(c["opt_state"])):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=1e-7)
+
+
+# ------------------------------- checkpoint across lowerings (3D <-> SPMD)
+@pytest.mark.slow
+def test_checkpoint_round_trip_across_lowerings():
+    """The satellite acceptance: save under (S=2, v=2, dp=2, fsdp=1),
+    reload into the (S=1, dp=1) make_train_step lowering and vice
+    versa — exact value + treedef parity after equal steps, and the
+    continued trajectories agree."""
+    from ray_tpu.parallel.mpmd_pipeline import (
+        merge_stage_checkpoints, split_train_state)
+
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    S, v, M = 2, 2, 2
+    stages = _make_stages(cfg, S, v, dp=2)
+    spmd = ParallelPlan().build(cfg, learning_rate=1e-3, seed=0,
+                                telemetry_interval_s=0)
+    for _ in range(3):
+        _inprocess_train_step(stages, batch, S, v, M)
+        spmd.step(batch)
+
+    # 3D -> canonical == SPMD canonical: same treedef, same values
+    ck3 = merge_stage_checkpoints(
+        cfg, [st.stage_checkpoint() for st in stages])
+    ck1 = spmd.save_checkpoint()
+    assert ck3["step"] == ck1["step"] == 3
+    assert jax.tree.structure(ck3) == jax.tree.structure(ck1)
+    for a, b in zip(jax.tree.leaves(ck3), jax.tree.leaves(ck1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5)
+
+    # 3D checkpoint -> fresh SPMD program: trajectories continue equal
+    fresh_spmd = ParallelPlan().build(cfg, learning_rate=1e-3, seed=5,
+                                      telemetry_interval_s=0)
+    fresh_spmd.load_checkpoint(ck3)
+    # SPMD checkpoint -> fresh 3D stage set (vice versa)
+    fresh_stages = _make_stages(cfg, S, v, dp=2)
+    for st, part in zip(fresh_stages,
+                        split_train_state(cfg, ck1, S, v)):
+        st.load_state(part)
+    for _ in range(3):
+        l3, _ = _inprocess_train_step(stages, batch, S, v, M)
+        ls = fresh_spmd.step(batch).loss
+        lf, _ = _inprocess_train_step(fresh_stages, batch, S, v, M)
+        assert abs(l3 - ls) <= 1e-5
+        assert abs(l3 - lf) <= 1e-5

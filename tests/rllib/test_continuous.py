@@ -50,6 +50,7 @@ def test_diag_gaussian_entropy_value():
         np.asarray(diag_gaussian_entropy(log_std)), expect, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_ppo_pendulum_one_iteration(ray_session):
     """PPO builds a Gaussian policy for a Box space and completes a
     train step with finite losses; actions flow back to the env as

@@ -14,6 +14,7 @@ from ray_tpu.rllib import (  # noqa: E402
     ImportanceSampling, TD3Config, WeightedImportanceSampling)
 
 
+@pytest.mark.slow
 def test_ddpg_pendulum_one_iteration(ray_session):
     config = (DDPGConfig()
               .environment("Pendulum-v1")

@@ -22,6 +22,7 @@ def test_replay_buffer_ring_and_sample():
     assert s["rewards"].min() >= 4.0
 
 
+@pytest.mark.slow
 def test_dqn_learns_cartpole(ray_session):
     config = (DQNConfig().environment("CartPole-v1")
               .env_runners(num_env_runners=2, num_envs_per_env_runner=2)

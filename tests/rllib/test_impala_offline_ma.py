@@ -40,6 +40,7 @@ def test_vtrace_matches_onpolicy_td():
     np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_impala_learns_cartpole(ray_session):
     config = (IMPALAConfig().environment("CartPole-v1")
               .env_runners(num_env_runners=2, num_envs_per_env_runner=4)
@@ -117,6 +118,7 @@ def test_bc_clones_expert(ray_session, cartpole_offline_data):
         algo.cleanup()
 
 
+@pytest.mark.slow
 def test_marwil_learns_from_offline(ray_session, cartpole_offline_data):
     config = (MARWILConfig().environment("CartPole-v1")
               .training(lr=3e-3, train_batch_size=512, beta=1.0)
@@ -177,6 +179,7 @@ def _make_echo_team():
     return EchoTeam
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_learns(ray_session):
     config = (MultiAgentPPOConfig()
               .environment(_make_echo_team())

@@ -61,6 +61,7 @@ def test_appo_cartpole_learns(ray_session):
         algo.cleanup()
 
 
+@pytest.mark.slow
 def test_appo_one_iteration(ray_session):
     """Cheap structural check: APPO trains one iteration, reports
     V-trace metrics, and its ratio statistics are finite."""

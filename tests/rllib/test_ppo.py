@@ -61,6 +61,7 @@ def test_ppo_config_fluent_and_build(ray_session):
         algo.cleanup()
 
 
+@pytest.mark.slow
 def test_ppo_learns_cartpole(ray_session):
     config = (PPOConfig()
               .environment("CartPole-v1")
@@ -111,6 +112,7 @@ def test_ppo_checkpoint_roundtrip(ray_session, tmp_path):
         algo.cleanup()
 
 
+@pytest.mark.slow
 def test_multi_learner_group_matches_local(ray_session):
     spec = RLModuleSpec(observation_dim=4, num_actions=2)
     rng = np.random.default_rng(1)

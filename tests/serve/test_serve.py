@@ -107,6 +107,7 @@ def test_batching(serve_session):
     assert max(sizes) > 1  # requests actually batched
 
 
+@pytest.mark.slow
 def test_replica_failure_recovery(serve_session):
     @serve.deployment(num_replicas=1, health_check_period_s=0.5)
     class Fragile:

@@ -16,6 +16,7 @@ sys.path.insert(0, REPO)
 pytestmark = [pytest.mark.serve_llm]
 
 
+@pytest.mark.slow
 def test_bench_serve_smoke_subprocess():
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                RAY_TPU_JAX_PLATFORM="cpu")

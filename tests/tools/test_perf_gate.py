@@ -403,6 +403,63 @@ def test_pipeline_gate_train_rows_skipped_vs_old_baseline():
         "FAIL" in m and "train_v2_utilization" in m for m in msgs)
 
 
+def _plan3d_record(fp32_tok=400.0, int8_tok=380.0,
+                   wire_reduction=0.62, **kw):
+    rec = _train_record(**kw)
+    rec["detail"]["plan3d"] = {
+        "grid": {"pp": 2, "dp": 2, "fsdp": 1, "virtual": 1,
+                 "n_microbatches": 4},
+        "pp_dp1_reference": {"tokens_per_s": 420.0, "step_ms": 100.0},
+        "variants": {
+            "pp2_dp2_fp32": {"tokens_per_s": fp32_tok,
+                             "loss_parity_abs": 8e-7,
+                             "comm_split_ms": {"compute_ms": 100.0,
+                                               "comm_ms": 5.0}},
+            "pp2_dp2_int8": {"tokens_per_s": int8_tok,
+                             "loss_parity_abs": 5e-4,
+                             "comm_split_ms": {"compute_ms": 100.0,
+                                               "comm_ms": 3.0}},
+        },
+        "wire": {"measured_comm_reduction": wire_reduction,
+                 "fp32": {"collective_bytes": 4000000},
+                 "int8": {"collective_bytes": 1520000}},
+        "loss_parity_3d_abs": 8e-7,
+        "int8_wire_reduction": wire_reduction,
+    }
+    return rec
+
+
+def test_pipeline_extractor_3d_rows():
+    from tools.perf_gate import extract_pipeline_metrics
+    m = extract_pipeline_metrics(_plan3d_record())
+    assert m["pipeline/3d_pp2_dp2_fp32_tokens_per_s"] == 400.0
+    assert m["pipeline/3d_pp2_dp2_int8_tokens_per_s"] == 380.0
+    assert m["pipeline/3d_int8_wire_reduction"] == \
+        pytest.approx(0.62)
+    # pre-3D records simply carry no 3D rows
+    m0 = extract_pipeline_metrics(_train_record())
+    assert not any(k.startswith("pipeline/3d_") for k in m0)
+
+
+def test_pipeline_gate_3d_rows_bootstrap_and_regression():
+    """Fresh 3D rows bootstrap-skip against a pre-3D baseline; a
+    regressed 3D variant (or a collapsed int8 wire reduction) fails
+    against a 3D-carrying one."""
+    ok, msgs = compare(_plan3d_record(), _train_record(),
+                       metric="pipeline")
+    assert ok, msgs
+    assert any("3d_pp2_dp2_fp32_tokens_per_s: skipped" in m
+               for m in msgs)
+    ok, msgs = compare(_plan3d_record(fp32_tok=200.0),
+                       _plan3d_record(), metric="pipeline")
+    assert not ok and any(
+        "FAIL" in m and "3d_pp2_dp2_fp32" in m for m in msgs)
+    ok, msgs = compare(_plan3d_record(wire_reduction=0.1),
+                       _plan3d_record(), metric="pipeline")
+    assert not ok and any(
+        "FAIL" in m and "3d_int8_wire_reduction" in m for m in msgs)
+
+
 def test_pipeline_gate_against_checked_in_baseline():
     from tools.perf_gate import extract_pipeline_metrics
     path, rec = latest_baseline(REPO, metric="pipeline")

@@ -111,6 +111,7 @@ def test_ray_client_end_to_end(client_server):
     assert "CLIENT-OK" in proc.stdout
 
 
+@pytest.mark.slow
 def test_client_get_outlives_connection_timeout(client_server,
                                                 ray_start_shared):
     """A task running longer than the client's connection timeout must

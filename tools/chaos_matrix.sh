@@ -154,6 +154,27 @@ for seed in "${seeds[@]}"; do
     fi
 done
 
+# ---- 3D-parallelism soak leg: a ParallelPlan(pp=2, dp=2,
+# SLICE_SPREAD) pipeline trains on a gang-scheduled fake slice; one
+# host VM of the sharded stage gang is SIGKILLed mid-train-step at a
+# seeded delay. Invariants: typed failure at the driver (no hang), the
+# placement group flips to RESCHEDULING once the SliceManager notices
+# the dead host, pools/streams drain clean on shutdown
+# (tests/autoscaler/test_slice_e2e.py::
+# test_plan3d_gang_host_kill_typed_failure)
+for seed in "${seeds[@]}"; do
+    echo "=== 3d-gang soak: seed=$seed ==="
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/autoscaler/test_slice_e2e.py::test_plan3d_gang_host_kill_typed_failure" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== 3d seed=$seed PASSED ==="
+    else
+        echo "=== 3d seed=$seed FAILED ==="
+        failed+=("3d:$seed")
+    fi
+done
+
 if [ "${#failed[@]}" -gt 0 ]; then
     echo
     echo "FAILING SEEDS: ${failed[*]}"
@@ -175,6 +196,12 @@ if [ "${#failed[@]}" -gt 0 ]; then
             s="${seed#serve:}"
             echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
                  "tests/serve/test_llm_engine.py::test_serve_fleet_chaos_soak -q"
+            continue
+            ;;
+        3d:*)
+            s="${seed#3d:}"
+            echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
+                 "tests/autoscaler/test_slice_e2e.py::test_plan3d_gang_host_kill_typed_failure -q"
             continue
             ;;
         slice:*)

@@ -197,6 +197,19 @@ def extract_pipeline_metrics(rec: dict) -> dict:
         if "bubble_fraction" in m:
             out[f"pipeline/train_{vkey}_utilization"] = round(
                 1.0 - float(m["bubble_fraction"]), 4)
+    # 3D matrix rows (ParallelPlan nested pp×dp lowerings): per-variant
+    # tokens/s plus the measured collective-byte reduction of the int8
+    # stage wire. Pre-3D baselines carry none of these — bootstrap-skip.
+    p3 = detail.get("plan3d") or {}
+    for name, row in (p3.get("variants") or {}).items():
+        if isinstance(row, dict) and "tokens_per_s" in row:
+            out[f"pipeline/3d_{name}_tokens_per_s"] = \
+                float(row["tokens_per_s"])
+    wire = p3.get("wire") or {}
+    if isinstance(wire, dict) and \
+            wire.get("measured_comm_reduction") is not None:
+        out["pipeline/3d_int8_wire_reduction"] = \
+            float(wire["measured_comm_reduction"])
     return out
 
 
