@@ -32,6 +32,23 @@ tokens/s/chip, fleet p99 TTFT, the aggregate prefix hit rate, the
 speculation acceptance rate, and ``vs_baseline`` — the fleet rows
 gated by ``tools/perf_gate.py --metric serve``.
 
+**Paged-kernel legs** (``detail.paged_kernel`` / ``detail.mixed_len``):
+the Pallas paged-attention kernel vs the XLA gather reference on one
+mixed-length batch — exact parity (fp32-softmax tolerance) plus the
+page-count work reduction that per-sequence length skipping buys
+(FLOPs ∝ live tokens; on TPU the compiled kernel is also wall-clocked
+against the reference, on CPU the kernel runs in interpret mode so
+only the work accounting is meaningful) — and a live mixed short+long
+engine run reporting ``decode_block_work_frac`` (pages touched / window
+pages) and the engine's per-step prefill/decode device-wall split.
+
+**Autoscaling under load** (``detail.scale_up``, ``--scale-up-mid-load``):
+a deliberately backlogged single replica must scale up MID-RUN off its
+engine gauges; the leg asserts routed traffic reaches the new replica
+(``new_replica_share``) and records TTFT recovery against the same
+schedule on a pinned 1-replica fleet (recovery > 1 needs one chip per
+replica — on a shared CPU core a second replica only time-slices).
+
 On TPU the model is sized up with the chip; on CPU a tiny config keeps
 the harness runnable anywhere (the CPU record is a smoke point for the
 serve series, like the CPU BENCH records).
@@ -105,6 +122,7 @@ def run_load(handle_factory, workload: List[dict], clients: int,
                 time.sleep(delay)
             rec = {"client": cid, "tokens": 0}
             t_submit = time.monotonic()
+            rec["t_submit_s"] = t_submit - t0
             try:
                 gen = handle.options(stream=True, **opts).generate.remote(
                     r["prompt"], r["max_new_tokens"])
@@ -141,6 +159,11 @@ def run_load(handle_factory, workload: List[dict], clients: int,
     wall = max(t_last - t0, 1e-9)
     ttfts = [r["ttft_s"] for r in results if "ttft_s" in r]
     gaps = [g for r in results for g in r.get("gaps", ())]
+    # submit-ordered (t_submit_s, ttft_s) pairs: the scale-up leg reads
+    # early-vs-late TTFT off this series (compact — no per-token gaps)
+    series = sorted(
+        ((round(r["t_submit_s"], 3), round(r["ttft_s"], 4))
+         for r in results if "ttft_s" in r))
     return {
         "tokens_total": total_tokens,
         "wall_s": round(wall, 3),
@@ -150,12 +173,292 @@ def run_load(handle_factory, workload: List[dict], clients: int,
                     "p99": _ms(_percentile(ttfts, 99))},
         "inter_token_ms": {"p50": _ms(_percentile(gaps, 50)),
                            "p99": _ms(_percentile(gaps, 99))},
+        "ttft_series": series,
         "errors": errors,
     }
 
 
 def _ms(v: Optional[float]) -> Optional[float]:
     return round(v * 1e3, 2) if v is not None else None
+
+
+# ------------------------------------------------- paged-kernel legs
+def make_mixed_workload(n_requests: int, clients: int, seed: int,
+                        engine: Dict,
+                        mean_interarrival_s: float = 0.01) -> List[dict]:
+    """Short+long requests sharing decode slots — the traffic shape
+    length-aware block skipping exists for: alternate requests either
+    stop after a few tokens or decode out to the engine window, so at
+    any decode step the slot array holds wildly different live lengths
+    while the XLA reference pays the full window for every slot."""
+    rng = random.Random(seed)
+    window = engine["max_seq_len"]
+    t = 0.0
+    reqs = []
+    for i in range(n_requests):
+        t += rng.expovariate(1.0 / mean_interarrival_s)
+        plen = rng.randint(4, 8)
+        long = i % 2 == 1
+        out = (window - plen - 2) if long else rng.randint(4, 8)
+        reqs.append({
+            "arrival_s": t,
+            "prompt": [rng.randrange(2, 128) for _ in range(plen)],
+            "max_new_tokens": max(2, out),
+            "client": i % clients,
+            "long": long,
+        })
+    return reqs
+
+
+def run_engine_load(engine, workload: List[dict],
+                    timeout_s: float = 300.0) -> Dict:
+    """Replay a schedule straight against one :class:`LLMEngine`
+    (no serve layer — this leg measures engine decode work, not
+    routing). One consumer thread per request, schedule-paced."""
+    results: List[dict] = []
+    errors: List[str] = []
+    lock = threading.Lock()
+    t0 = time.monotonic()
+
+    def consume(r):
+        delay = r["arrival_s"] - (time.monotonic() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            toks = list(engine.generate_sync(
+                r["prompt"], r["max_new_tokens"], timeout_s=timeout_s))
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+            return
+        with lock:
+            results.append({"tokens": len(toks), "long": r.get("long")})
+
+    threads = [threading.Thread(target=consume, args=(r,))
+               for r in workload]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=timeout_s)
+    wall = max(time.monotonic() - t0, 1e-9)
+    return {"tokens_total": sum(r["tokens"] for r in results),
+            "wall_s": round(wall, 3),
+            "requests_done": len(results),
+            "errors": errors}
+
+
+def bench_mixed_lengths(model: Dict, engine: Dict, seed: int,
+                        requests: int = 24, clients: int = 8) -> Dict:
+    """The length-aware serving claim, measured on a live engine: a
+    mixed short+long workload's decode steps touch
+    ``decode_pages_live`` pages out of the ``decode_pages_window`` the
+    gather reference pays — ``work_reduction = 1 − live/window`` is the
+    FLOP fraction the Pallas kernel's block skipping removes (wall
+    clock follows on TPU where the kernel dispatches; the accounting
+    is backend-independent). Also reports the engine's device-wall
+    split (prefill vs decode) per step."""
+    from ray_tpu.models import TransformerConfig
+    from ray_tpu.serve.llm_engine import EngineConfig, LLMEngine
+
+    mconf = {k: v for k, v in model.items()}
+    if "dtype" in mconf:
+        from ray_tpu.serve.llm_engine import _resolve_dtype
+        mconf["dtype"] = _resolve_dtype(mconf["dtype"])
+    eng = LLMEngine(TransformerConfig(**mconf), EngineConfig(**engine),
+                    seed=seed)
+    try:
+        # warm the jitted programs outside the window
+        list(eng.generate_sync([3, 5, 7], 2))
+        workload = make_mixed_workload(requests, clients, seed, engine)
+        load = run_engine_load(eng, workload)
+        s = eng.stats()
+    finally:
+        eng.shutdown()
+    frac = s.get("decode_block_work_frac")
+    steps = max(s.get("decode_steps") or 0, 1)
+    return {
+        "requests": requests,
+        "tokens_total": load["tokens_total"],
+        "wall_s": load["wall_s"],
+        "errors": load["errors"],
+        "decode_steps": s.get("decode_steps"),
+        "decode_pages_live": s.get("decode_pages_live"),
+        "decode_pages_window": s.get("decode_pages_window"),
+        "decode_block_work_frac": frac,
+        "work_reduction": (round(1.0 - frac, 4)
+                           if frac is not None else None),
+        "decode_wall_s": s.get("decode_wall_s"),
+        "prefill_wall_s": s.get("prefill_wall_s"),
+        "decode_step_ms": round(
+            1e3 * (s.get("decode_wall_s") or 0.0) / steps, 3),
+    }
+
+
+def bench_paged_kernel(on_tpu: bool, seed: int = 0) -> Dict:
+    """Kernel-vs-reference leg at the op level: one mixed-length paged
+    batch (half the sequences near-empty, half filling the window).
+    Everywhere: exact-parity check (fp32-softmax tolerance) and the
+    page-count work reduction the lens skipping buys. On TPU: compiled
+    wall-clock of kernel vs gather reference (the dispatch the engine
+    takes); on CPU the kernel runs in interpret mode, so wall times are
+    reported for the reference only and the FLOP proportionality
+    stands in as the gain metric."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.ops import paged_attention, paged_work_pages
+
+    B, H, KVH = 8, 8, 2
+    D = 128 if on_tpu else 32
+    bs, T = (32, 32) if on_tpu else (16, 8)
+    rng = np.random.default_rng(seed)
+    N = 1 + B * T
+    dt = np.float32
+    kc = rng.normal(size=(N, bs, KVH, D)).astype(dt)
+    vc = rng.normal(size=(N, bs, KVH, D)).astype(dt)
+    q = rng.normal(size=(B, 1, H, D)).astype(dt)
+    bt = rng.permutation(np.arange(1, N)).astype(np.int32).reshape(B, T)
+    # mixed lengths: even slots hold a handful of tokens, odd slots a
+    # full window — the serving slot array under ragged traffic
+    lens = np.asarray([bs + 3 if i % 2 == 0 else T * bs
+                       for i in range(B)], np.int32)
+    pos = (lens - 1)[:, None].astype(np.int32)
+
+    ref_fn = jax.jit(lambda *a: paged_attention(*a, impl="reference"))
+    ker_fn = jax.jit(lambda q_, k_, v_, bt_, p_, l_: paged_attention(
+        q_, k_, v_, bt_, p_, lens=l_, impl="kernel"))
+    ref = np.asarray(ref_fn(q, kc, vc, bt, pos))
+    ker = np.asarray(ker_fn(q, kc, vc, bt, pos, lens))
+    parity = float(np.max(np.abs(ref - ker)))
+
+    pages_live = int(np.sum(paged_work_pages(lens, bs)))
+    pages_window = B * T
+    out = {
+        "batch": B, "block_size": bs, "table_len": T,
+        "heads": H, "kv_heads": KVH, "head_dim": D,
+        "lens": lens.tolist(),
+        "parity_max_abs": round(parity, 8),
+        "pages_live": pages_live,
+        "pages_window": pages_window,
+        "work_reduction": round(1.0 - pages_live / pages_window, 4),
+        "kernel_mode": "compiled" if on_tpu else "interpret",
+    }
+
+    def _time(fn, args, iters=20):
+        r = fn(*args)
+        jax.block_until_ready(r)
+        t0 = time.monotonic()
+        for _ in range(iters):
+            r = fn(*args)
+        jax.block_until_ready(r)
+        return (time.monotonic() - t0) / iters
+
+    wall_ref = _time(ref_fn, (q, kc, vc, bt, pos))
+    out["wall_ref_ms"] = round(wall_ref * 1e3, 4)
+    if on_tpu:
+        # interpret-mode wall is interpreter overhead, not kernel cost:
+        # only the compiled TPU kernel is timed against the reference
+        wall_ker = _time(ker_fn, (q, kc, vc, bt, pos, lens))
+        out["wall_kernel_ms"] = round(wall_ker * 1e3, 4)
+        out["kernel_speedup"] = round(wall_ref / wall_ker, 3) \
+            if wall_ker else None
+    return out
+
+
+def _scale_up_run(name: str, model: Dict, engine: Dict,
+                  workload: List[dict], clients: int,
+                  autoscale: bool, timeout_s: float):
+    """One measurement of the scale-up comparison: deploy (with or
+    without the gauge-driven autoscaler), replay the schedule, and
+    return (load, per-replica token counts)."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import api as serve_api
+
+    kw: Dict = {"max_ongoing_requests": 4 * clients + 8}
+    if autoscale:
+        kw["autoscaling_config"] = {
+            "min_replicas": 1, "max_replicas": 2,
+            # classic ongoing-request pressure is hidden by continuous
+            # batching; scale on the ENGINE backlog instead
+            "target_ongoing_requests": 1e9,
+            "target_queue_depth": 1.0,
+            "upscale_delay_s": 0.5,
+            "downscale_delay_s": 3600.0,
+        }
+    else:
+        kw["num_replicas"] = 1
+    dep = serve.deployment(name=name, **kw)(serve.LLMServer)
+    serve.run(dep.bind(model=model, engine=engine), name=name)
+    handle = serve.get_app_handle(name)
+    list(handle.options(stream=True).generate.remote([2, 3, 5], 2))
+    load = run_load(lambda: serve.get_app_handle(name), workload,
+                    clients, timeout_s=timeout_s,
+                    handle_opts={"routing_policy": "gauge"})
+    ctrl = serve_api._controller_or_none()
+    reps = ray_tpu.get(ctrl.get_replicas.remote(name))
+    stats = [ray_tpu.get(r.stats.remote(), timeout=60) for r in reps]
+    per_replica = [(s.get("engine") or {}).get("tokens_total") or 0
+                   for s in stats]
+    serve.delete(name)
+    return load, per_replica
+
+
+def _scale_up_leg(model: Dict, engine: Dict, seed: int, clients: int,
+                  requests: int, mean_interarrival_s: float,
+                  timeout_s: float = 300.0) -> Dict:
+    """Autoscaling fleet under load: a deliberately backlogged single
+    replica must scale up MID-RUN off its engine gauges, the gauge
+    router must start sending traffic to the new replica, and tail
+    TTFT must recover. Recovery is measured against the SAME seeded
+    schedule on a pinned 1-replica fleet: ``ttft_recovery`` =
+    late-half p99 TTFT without the autoscaler / with it (> 1 means
+    the added replica absorbed the backlog)."""
+    # sustained marginal overload, not a burst: arrivals spread across
+    # the whole leg so the single-replica baseline's queue KEEPS
+    # growing while the autoscaled fleet's second replica (joining
+    # warm — LLMServer compiles in __init__) absorbs the tail
+    workload = make_workload(requests, clients, seed,
+                             mean_interarrival_s=mean_interarrival_s,
+                             prompt_rng=(4, 12), out_rng=(32, 48))
+
+    def late_p99(load) -> Optional[float]:
+        ttfts = [t for _, t in load.get("ttft_series") or []]
+        return _percentile(ttfts[len(ttfts) // 2:], 99)
+
+    auto, per_replica = _scale_up_run(
+        "llm_scaleup", model, engine, workload, clients,
+        autoscale=True, timeout_s=timeout_s)
+    base, _ = _scale_up_run(
+        "llm_scaleup_base", model, engine, workload, clients,
+        autoscale=False, timeout_s=timeout_s)
+    late_auto, late_base = late_p99(auto), late_p99(base)
+    total = sum(per_replica) or 1
+    new_tokens = min(per_replica) if len(per_replica) > 1 else 0
+    return {
+        "requests": requests,
+        "clients": clients,
+        "replicas_end": len(per_replica),
+        "per_replica_tokens": per_replica,
+        "new_replica_tokens": new_tokens,
+        # fraction of fleet tokens the mid-run replica served — the
+        # machine-independent proof that routing reached it (wall-clock
+        # recovery needs one chip per replica; on a shared CPU core a
+        # second replica only time-slices, so ttft_recovery < 1 there)
+        "new_replica_share": round(new_tokens / total, 4),
+        "scaled_up": len(per_replica) > 1,
+        "tokens_per_s": auto["tokens_per_s"],
+        "ttft_ms": auto["ttft_ms"],
+        "ttft_p99_late_ms": _ms(late_auto),
+        "baseline_tokens_per_s": base["tokens_per_s"],
+        "baseline_ttft_ms": base["ttft_ms"],
+        "baseline_ttft_p99_late_ms": _ms(late_base),
+        "ttft_recovery": (round(late_base / late_auto, 3)
+                          if late_base and late_auto else None),
+        "errors": auto["errors"] + base["errors"],
+        "wall_s": auto["wall_s"],
+    }
 
 
 def _fleet_leg(name: str, model: Dict, engine: Dict, workload: List[dict],
@@ -248,7 +551,8 @@ def bench_fleet(model: Dict, engine: Dict, replicas: int, clients: int,
 
 def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
           seed: int = 0, fleet_replicas: int = 0,
-          fleet_clients: int = 0, fleet_requests: int = 0) -> dict:
+          fleet_clients: int = 0, fleet_requests: int = 0,
+          scale_up: bool = True) -> dict:
     import jax
 
     import ray_tpu
@@ -273,6 +577,9 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                         sys_prompt_tokens=4 * engine["kv_block_size"],
                         prompt_rng=(2, 6), out_rng=(6, 10),
                         mean_interarrival_s=0.02, timeout_s=120.0)
+        mixed_kw = dict(requests=10, clients=4)
+        scale_kw = dict(clients=8, requests=40,
+                        mean_interarrival_s=0.06, timeout_s=150.0)
     elif on_tpu:
         model = {"vocab_size": 32000, "d_model": 2048, "n_layers": 8,
                  "n_heads": 16, "head_dim": 128, "d_ff": 8192,
@@ -289,6 +596,9 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                         sys_prompt_tokens=4 * engine["kv_block_size"],
                         prompt_rng=(16, 128), out_rng=(32, 128),
                         mean_interarrival_s=0.02)
+        mixed_kw = dict(requests=64, clients=32)
+        scale_kw = dict(clients=64, requests=128,
+                        mean_interarrival_s=0.005)
     else:
         # CPU sizing: wide enough that a decode step is weight-stream /
         # gemv bound, so step cost is nearly batch-independent — the
@@ -311,6 +621,14 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                         sys_prompt_tokens=4 * engine["kv_block_size"],
                         prompt_rng=(4, 16), out_rng=(16, 32),
                         mean_interarrival_s=0.01)
+        mixed_kw = dict(requests=24, clients=8)
+        scale_kw = dict(clients=12, requests=100,
+                        mean_interarrival_s=0.06)
+
+    # clusterless legs first: the paged-kernel op comparison and the
+    # mixed-length engine run need a device, not the cluster
+    paged = bench_paged_kernel(on_tpu, seed=seed)
+    mixed = bench_mixed_lengths(model, engine, seed=seed, **mixed_kw)
 
     ray_tpu.init(num_cpus=max(8, clients + 4,
                               fleet_kw["clients"] // 2 + 6),
@@ -341,6 +659,16 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
         t_fleet = time.monotonic()
         fleet = bench_fleet(model, engine, seed=seed, **fleet_kw)
         fleet["leg_wall_s"] = round(time.monotonic() - t_fleet, 2)
+        # autoscaling fleet under load: a backlogged single replica
+        # must scale up MID-RUN and TTFT must recover (--scale-up-mid-
+        # load; a deliberately small engine so the backlog forms fast)
+        scale = None
+        if scale_up:
+            t_scale = time.monotonic()
+            scale = _scale_up_leg(
+                model, dict(engine, decode_slots=1), seed=seed,
+                **scale_kw)
+            scale["leg_wall_s"] = round(time.monotonic() - t_scale, 2)
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
@@ -371,6 +699,9 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                                   "total_blocks")}
                              for m, s in stats.items()},
             "fleet": fleet,
+            "paged_kernel": paged,
+            "mixed_len": mixed,
+            "scale_up": scale,
         },
     }
 
@@ -389,12 +720,18 @@ def main() -> int:
                     help="fleet-leg Poisson clients (0 = default)")
     ap.add_argument("--fleet-requests", type=int, default=0,
                     help="fleet-leg request count (0 = default)")
+    ap.add_argument("--scale-up-mid-load",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="run the autoscaling-fleet-under-load leg "
+                         "(one backlogged replica must scale up "
+                         "mid-run; --no-scale-up-mid-load skips it)")
     args = ap.parse_args()
     rec = bench(smoke=args.smoke, clients=args.clients,
                 requests=args.requests, seed=args.seed,
                 fleet_replicas=args.fleet_replicas,
                 fleet_clients=args.fleet_clients,
-                fleet_requests=args.fleet_requests)
+                fleet_requests=args.fleet_requests,
+                scale_up=args.scale_up_mid_load)
     print(json.dumps(rec))
     return 0
 

@@ -81,6 +81,12 @@ class TransformerConfig:
     attn_impl: str = "auto"                  # ops.multihead_attention impl
     attn_block_q: int = 0                    # 0 = chip-aware default
     attn_block_k: int = 0
+    # Paged decode path (serving): "auto" dispatches the Pallas paged
+    # kernel on TPU (interpret mode off-TPU when forced to "kernel");
+    # "reference" pins the pure-XLA gather. paged_block_r = 0 picks the
+    # chip-aware query-row block (ops.paged_flash.default_paged_block_r).
+    paged_impl: str = "auto"
+    paged_block_r: int = 0
     # MoE (0 = dense): every layer's MLP becomes n_experts experts with
     # Switch top-1 routing, weights sharded on the ep mesh axis
     n_experts: int = 0
@@ -613,10 +619,12 @@ def init_kv_cache(config: TransformerConfig, num_blocks: int,
 
 
 def _paged_attn_sublayer(c, h, lp, sin, cos, layout, kc, vc,
-                         block_tables, positions, write_mask):
+                         block_tables, positions, write_mask, lens):
     """Decode-path attention sublayer: project qkv for the new tokens,
     rotate at their absolute positions, write k/v into the cache blocks,
-    then attend against the (now-updated) paged cache. Returns
+    then attend against the (now-updated) paged cache. ``lens`` is the
+    per-sequence live token count after this call's writes — the Pallas
+    kernel skips whole cache blocks past it. Returns
     (attn_out, kc, vc)."""
     e = h.shape[-1]
     dt = c.dtype
@@ -638,7 +646,9 @@ def _paged_attn_sublayer(c, h, lp, sin, cos, layout, kc, vc,
     kc = kc.at[bid, slot].set(k.astype(kc.dtype), mode="drop")
     vc = vc.at[bid, slot].set(v.astype(vc.dtype), mode="drop")
 
-    att = paged_attention(q, kc, vc, block_tables, positions)
+    att = paged_attention(q, kc, vc, block_tables, positions,
+                          lens=lens, impl=c.paged_impl,
+                          block_r=c.paged_block_r or None)
     out = jnp.einsum("bshd,hde->bse", att,
                      lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
     return out, kc, vc
@@ -648,10 +658,13 @@ def _forward_with_cache(c: TransformerConfig, params: Dict,
                         ids: jnp.ndarray, cache: Dict[str, jnp.ndarray],
                         block_tables: jnp.ndarray,
                         positions: jnp.ndarray,
-                        write_mask: jnp.ndarray):
+                        write_mask: jnp.ndarray,
+                        lens: jnp.ndarray):
     """Shared trunk of :func:`prefill` and :func:`decode_step`:
     (B, C) token ids at absolute ``positions`` -> (B, C, vocab) logits,
-    writing each layer's k/v into the paged cache as it goes."""
+    writing each layer's k/v into the paged cache as it goes. ``lens``
+    (B,) is each sequence's live token count including this call's
+    writes — the attention kernel's length-skipping bound."""
     if c.n_experts:
         raise NotImplementedError(
             "paged decode does not support MoE configs yet")
@@ -667,7 +680,7 @@ def _forward_with_cache(c: TransformerConfig, params: Dict,
         h = layer_norm(x, lp["ln_scale"], lp["ln_bias"])
         att, kc, vc = _paged_attn_sublayer(
             c, h, lp, sin, cos, layout, kc, vc,
-            block_tables, positions, write_mask)
+            block_tables, positions, write_mask, lens)
         mlp, _ = _mlp_sublayer(c, h, lp)
         return x + (att + mlp).astype(x.dtype), kc, vc
 
@@ -675,7 +688,7 @@ def _forward_with_cache(c: TransformerConfig, params: Dict,
         h = rms_norm(x, lp["attn_norm"])
         att, kc, vc = _paged_attn_sublayer(
             c, h, lp, sin, cos, layout, kc, vc,
-            block_tables, positions, write_mask)
+            block_tables, positions, write_mask, lens)
         x = x + att.astype(x.dtype)
         h2 = rms_norm(x, lp["mlp_norm"]).astype(c.dtype)
         mlp, _ = _mlp_sublayer(c, h2, lp)
@@ -721,8 +734,11 @@ def prefill(config: TransformerConfig, params: Dict, tokens: jnp.ndarray,
     positions = start_pos[:, None] + jnp.arange(chunk, dtype=jnp.int32)
     write_mask = jnp.arange(chunk, dtype=jnp.int32)[None, :] \
         < lens[:, None]
+    # live tokens after this chunk's writes: earlier chunks + this one
+    live = (start_pos + lens).astype(jnp.int32)
     return _forward_with_cache(config, params, tokens, cache,
-                               block_tables, positions, write_mask)
+                               block_tables, positions, write_mask,
+                               live)
 
 
 def decode_step(config: TransformerConfig, params: Dict,
@@ -738,7 +754,8 @@ def decode_step(config: TransformerConfig, params: Dict,
     write_mask = jnp.ones_like(positions, dtype=bool)
     logits, cache = _forward_with_cache(
         config, params, token_ids[:, None], cache,
-        block_tables, positions, write_mask)
+        block_tables, positions, write_mask,
+        seq_lens.astype(jnp.int32) + 1)
     return logits[:, 0], cache
 
 

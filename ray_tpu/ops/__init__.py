@@ -20,6 +20,9 @@ from ray_tpu.ops.attention import (
     multihead_attention, attention_reference, paged_attention)
 from ray_tpu.ops.flash_attention import (
     flash_attention, default_flash_blocks, autotune_flash_blocks)
+from ray_tpu.ops.paged_flash import (
+    paged_flash_attention, default_paged_block_r, autotune_paged_block_r,
+    paged_work_pages)
 from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.ops.cross_entropy import cross_entropy_loss, fused_lm_head_loss
 
@@ -34,6 +37,10 @@ __all__ = [
     "flash_attention",
     "default_flash_blocks",
     "autotune_flash_blocks",
+    "paged_flash_attention",
+    "default_paged_block_r",
+    "autotune_paged_block_r",
+    "paged_work_pages",
     "ring_attention",
     "cross_entropy_loss",
     "fused_lm_head_loss",

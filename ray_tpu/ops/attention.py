@@ -41,10 +41,23 @@ def attention_reference(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                       ).astype(q.dtype)
 
 
+def _can_use_paged_kernel(q: jnp.ndarray, k_cache: jnp.ndarray) -> bool:
+    """TPU dispatch guard for the Pallas paged kernel: head_dim must
+    tile the lanes; tiny KV blocks fall back (per-page matmuls would be
+    bookkeeping-bound)."""
+    d = q.shape[-1]
+    bs = k_cache.shape[1]
+    return d % 128 == 0 and bs % 8 == 0
+
+
 def paged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                     v_cache: jnp.ndarray, block_tables: jnp.ndarray,
                     q_positions: jnp.ndarray, *,
-                    sm_scale: Optional[float] = None) -> jnp.ndarray:
+                    lens: Optional[jnp.ndarray] = None,
+                    sm_scale: Optional[float] = None,
+                    impl: str = "auto",
+                    block_r: Optional[int] = None,
+                    interpret: bool = False) -> jnp.ndarray:
     """Attention of new-token queries against a paged KV cache.
 
     The serving decode/prefill primitive: keys and values live in a pool
@@ -57,12 +70,21 @@ def paged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     [b, i]`` — causal by construction, so the SAME call serves batched
     single-token decode (``q`` of shape ``[B, 1, H, D]``) and chunked
     prefill (``[B, C, H, D]``, the chunk's own keys having been written to
-    the cache first). GQA caches store ``kv_heads < num_heads``; heads are
-    repeated at read time.
+    the cache first). GQA caches store ``kv_heads < num_heads``; queries
+    are grouped onto their kv head at read time — the cache is never
+    repeated.
 
-    Pure-XLA gather implementation (one ``take`` per sequence over its
-    block table, f32 softmax) — the reference path CPU tests exercise and
-    the TPU baseline until a Pallas paged kernel lands. Work is
+    ``impl``: "auto" | "kernel" | "reference". "kernel" is the Pallas
+    paged kernel (:mod:`ray_tpu.ops.paged_flash`) — auto-selected on
+    TPU when shapes tile; off-TPU it runs in interpret mode (parity
+    tests). ``lens [B]`` is the per-sequence LIVE token count; the
+    kernel skips whole blocks past it, making decode work proportional
+    to live tokens instead of the table window. ``lens = None``
+    derives a conservative bound from ``q_positions`` (every key the
+    queries may attend).
+
+    The reference path is the pure-XLA gather (one ``take`` per
+    sequence over its block table, f32 softmax): work is
     O(B * C * T * block_size) regardless of true lengths; keep
     ``block_tables`` sized to the serving window, not the model max.
     """
@@ -71,16 +93,41 @@ def paged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     t = block_tables.shape[1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "kernel" if ((on_tpu or interpret)
+                            and _can_use_paged_kernel(q, k_cache)) \
+            else "reference"
+    if impl == "kernel":
+        from ray_tpu.ops.paged_flash import paged_flash_attention
+        if lens is None:
+            lens = jnp.max(q_positions, axis=1).astype(jnp.int32) + 1
+        if jax.default_backend() != "tpu":
+            interpret = True
+        return paged_flash_attention(
+            q, k_cache, v_cache, block_tables, q_positions, lens,
+            sm_scale=sm_scale, block_r=block_r, interpret=interpret)
+    if impl != "reference":
+        raise ValueError(f"unknown paged attention impl: {impl!r}")
     # Gather each sequence's blocks: [B, T, bs, KVH, D] -> [B, K, KVH, D]
     k = jnp.take(k_cache, block_tables, axis=0).reshape(b, t * bs, kvh, d)
     v = jnp.take(v_cache, block_tables, axis=0).reshape(b, t * bs, kvh, d)
-    if kvh != h:
-        rep = h // kvh
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     # key slot j of the gathered view holds absolute position j
     key_pos = jnp.arange(t * bs, dtype=jnp.int32)
     mask = key_pos[None, None, :] <= q_positions[:, :, None]   # [B, C, K]
+    if kvh != h:
+        # GQA read without materializing a repeated cache copy: group
+        # the (tiny) queries onto their kv head and einsum over the
+        # grouped axes — XLA broadcasts k/v across the group in the
+        # contraction instead of writing an h/kvh-times-larger gather.
+        rep = h // kvh
+        qg = q.reshape(b, c, kvh, rep, d).astype(jnp.float32)
+        s = jnp.einsum("bqgrd,bkgd->bgrqk", qg,
+                       k.astype(jnp.float32)) * sm_scale
+        s = jnp.where(mask[:, None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", p, v.astype(jnp.float32))
+        return o.reshape(b, c, h, d).astype(q.dtype)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
     s = jnp.where(mask[:, None], s, _NEG_INF)

@@ -493,11 +493,13 @@ def _load_disk_cache() -> None:
             continue
 
 
-def _persist_winner(key: tuple, blocks: Tuple[int, int]) -> None:
-    """Write-through one timed winner (read-modify-write + atomic
-    rename; concurrent replicas may race, last writer wins — every
-    intermediate state is a valid cache). Best-effort: a read-only
-    filesystem must not break autotuning."""
+def persist_cached_blocks(disk_key: str, blocks: Tuple[int, int]) -> None:
+    """Write-through one timed winner under an arbitrary string key
+    (read-modify-write + atomic rename; concurrent replicas may race,
+    last writer wins — every intermediate state is a valid cache).
+    Best-effort: a read-only filesystem must not break autotuning.
+    Shared by the flash and paged autotuners — foreign key formats
+    coexist in the same JSON."""
     if not _disk_cache_enabled():
         return
     path = _autotune_cache_path()
@@ -508,13 +510,35 @@ def _persist_winner(key: tuple, blocks: Tuple[int, int]) -> None:
                 data = json.load(f)
         except (OSError, ValueError):
             data = {}
-        data[_disk_key(key)] = list(blocks)
+        data[disk_key] = list(blocks)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(data, f, indent=0, sort_keys=True)
         os.replace(tmp, path)
     except OSError:
         pass
+
+
+def load_cached_blocks(disk_key: str) -> Optional[Tuple[int, int]]:
+    """Look one persisted winner up by its exact string key (the
+    generic side of the disk cache — the flash loader's bulk merge
+    stays keyed on its own 5-part format)."""
+    if not _disk_cache_enabled():
+        return None
+    try:
+        with open(_autotune_cache_path()) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    v = data.get(disk_key)
+    try:
+        return (int(v[0]), int(v[1])) if v is not None else None
+    except (TypeError, ValueError, IndexError):
+        return None
+
+
+def _persist_winner(key: tuple, blocks: Tuple[int, int]) -> None:
+    persist_cached_blocks(_disk_key(key), blocks)
 
 _AUTOTUNE_CANDIDATES = (
     (256, 256), (256, 512), (512, 512), (512, 1024),

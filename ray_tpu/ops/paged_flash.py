@@ -1,0 +1,345 @@
+"""Pallas paged-attention decode kernel — the length-aware serving
+fast path.
+
+The serving hot loop attends a handful of new-token queries per
+sequence against a paged KV cache (``[num_blocks, block_size, kv_heads,
+head_dim]`` pool + per-sequence block tables). The pure-XLA reference
+(:func:`ray_tpu.ops.attention.paged_attention`) gathers the WHOLE
+table window every step — work is O(B · T · block_size) regardless of
+how many tokens a sequence actually holds. This kernel makes decode
+work proportional to **live tokens**:
+
+- grid ``(batch, kv_head_group, q_row_blocks, table_slots)`` with the
+  table-slot axis innermost so the online-softmax accumulators
+  (m, l, acc in f32 VMEM scratch) persist across a sequence's pages;
+- the block table and per-sequence ``lens`` ride **scalar prefetch**
+  (:class:`pltpu.PrefetchScalarGridSpec`): the k/v BlockSpec index
+  maps read the table to DMA exactly the physical page a grid step
+  needs;
+- table slots past ``ceil(lens[b] / block_size)`` are **skipped** —
+  their index map clamps to the last live page (an unchanged block
+  index issues no new copy) and ``pl.when`` skips the matmuls, so a
+  16-token sequence in a 1024-token window does 1/64th of the window's
+  work instead of all of it;
+- GQA is handled by **indexing kv heads in-kernel**: queries are
+  regrouped host-side to ``[B, kv_heads, C·group, D]`` rows (a
+  transpose of the tiny q tensor, not of the cache) and each grid step
+  loads ONE kv head's page — the cache is never repeated or copied.
+
+Rows are padded to ``block_r`` (chip-aware default via
+:func:`default_paged_block_r`; :func:`autotune_paged_block_r` times a
+candidate grid once and persists the winner through the SAME on-disk
+cache as ``autotune_flash_blocks``). Padded rows carry position −1 —
+fully masked, dropped on unpack.
+
+``interpret=True`` runs the kernel on CPU (tier-1 parity tests); on
+TPU it compiles with parallel/arbitrary dimension semantics like the
+flash kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is importable on CPU too (for interpret mode)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from ray_tpu.ops.flash_attention import (
+    load_cached_blocks, persist_cached_blocks)
+
+_NEG_INF = -1e30
+
+
+def paged_work_pages(lens, block_size: int):
+    """Pages a length-aware kernel touches per sequence:
+    ``max(ceil(lens / block_size), 1)`` (an idle ``lens = 0`` slot still
+    runs its one trash page so the batch shape stays fixed). Works on
+    numpy and jax arrays — the engine's FLOP accounting and the bench's
+    work-reduction math share this definition with the kernel."""
+    return ((lens + block_size - 1) // block_size).clip(min=1) \
+        if hasattr(lens, "clip") else max(-(-lens // block_size), 1)
+
+
+def _paged_kernel(bt_ref, lens_ref, q_ref, pos_ref, k_ref, v_ref, o_ref,
+                  m_s, l_s, acc_s, *, bs: int, sm_scale: float):
+    """One (batch b, kv head g, row block r, table slot t) step: fold
+    page t of sequence b into the row block's online softmax. Scalar
+    refs (bt, lens) land in SMEM ahead of the body — the same values
+    the index maps used to pick this step's page."""
+    b = pl.program_id(0)
+    t = pl.program_id(3)
+    nt = pl.num_programs(3)
+
+    @pl.when(t == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    # Length-aware skipping: slots past the live pages do nothing (and
+    # their k/v index maps re-point at the last live page, so no DMA).
+    pages = jnp.maximum(pl.cdiv(lens_ref[b], bs), 1)
+
+    @pl.when(t < pages)
+    def _compute():
+        q = q_ref[0, 0]                        # (block_r, d)
+        k = k_ref[0, :, 0, :]                  # (bs, d) — one page, one
+        v = v_ref[0, :, 0, :]                  # kv head, indexed in-kernel
+        rows_pos = pos_ref[0]                  # (block_r,) int32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        key_pos = t * bs + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(key_pos <= rows_pos[:, None], s, _NEG_INF)
+
+        m_prev = m_s[...]                      # (block_r, 128) lanes equal
+        l_prev = l_s[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next[:, 0:1])
+        l_s[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        m_s[...] = m_next
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_s[...] = acc_s[...] * alpha[:, 0:1] + pv
+
+    @pl.when(t == nt - 1)
+    def _final():
+        l = l_s[:, 0:1]
+        # padded (position −1) rows never scored a key: emit zeros
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[...] / l).astype(o_ref.dtype)
+
+
+def paged_flash_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                          v_cache: jnp.ndarray,
+                          block_tables: jnp.ndarray,
+                          q_positions: jnp.ndarray,
+                          lens: jnp.ndarray, *,
+                          sm_scale: Optional[float] = None,
+                          block_r: Optional[int] = None,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Paged attention of new-token queries against the block pool.
+
+    Same contract as the XLA reference
+    (:func:`ray_tpu.ops.attention.paged_attention`): ``q`` is
+    ``[B, C, H, D]`` at absolute ``q_positions [B, C]``, caches are
+    ``[N, bs, KVH, D]``, ``block_tables [B, T]``. ``lens [B]`` is the
+    number of LIVE cached positions per sequence (after this step's
+    writes); table slots past ``ceil(lens/bs)`` are skipped entirely.
+    Rows whose position ≥ ``lens[b]`` (padded prefill tail) attend only
+    live keys — their outputs are the caller's to discard, exactly as
+    with the reference path.
+    """
+    b, c, h, d = q.shape
+    n_blocks, bs, g, _ = k_cache.shape
+    t = block_tables.shape[1]
+    if h % g:
+        raise ValueError(f"n_heads {h} not divisible by kv_heads {g}")
+    rep = h // g
+    rows = c * rep
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if not block_r:
+        block_r = default_paged_block_r(
+            rows, d, chip="cpu" if interpret else None)
+    block_r = max(8, min(block_r, _round8(rows)))
+    rows_pad = -(-rows // block_r) * block_r
+    nr = rows_pad // block_r
+
+    # Group-major query rows: row r of kv head g is (c = r // rep,
+    # head = g*rep + r % rep). Only q (tiny) is reshaped — never the
+    # cache.
+    qg = q.reshape(b, c, g, rep, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(b, g, rows, d)
+    pos_rows = jnp.repeat(q_positions.astype(jnp.int32), rep, axis=1)
+    if rows_pad != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows_pad - rows), (0, 0)))
+        pos_rows = jnp.pad(pos_rows, ((0, 0), (0, rows_pad - rows)),
+                           constant_values=-1)
+
+    def _pages(ln):
+        return jnp.maximum(pl.cdiv(ln, bs), 1)
+
+    def q_map(b_, g_, r_, t_, bt, ln):
+        return (b_, g_, r_, 0)
+
+    def pos_map(b_, g_, r_, t_, bt, ln):
+        return (b_, r_)
+
+    def kv_map(b_, g_, r_, t_, bt, ln):
+        # slots past the live pages revisit the last live page: the
+        # unchanged block index issues no fresh DMA
+        tt = jnp.minimum(t_, _pages(ln[b_]) - 1)
+        return (bt[b_, tt], 0, g_, 0)
+
+    grid = (b, g, nr, t)
+    compiler_params = None
+    if pltpu is not None and not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_r, d), q_map),
+            pl.BlockSpec((1, block_r), pos_map),
+            pl.BlockSpec((1, bs, 1, d), kv_map),
+            pl.BlockSpec((1, bs, 1, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_r, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((block_r, 128), jnp.float32),   # running max m
+            pltpu.VMEM((block_r, 128), jnp.float32),   # running denom l
+            pltpu.VMEM((block_r, d), jnp.float32),     # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, bs=bs, sm_scale=float(sm_scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, g, rows_pad, d), q.dtype),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lens.astype(jnp.int32),
+      qg, pos_rows, k_cache, v_cache)
+    out = out[:, :, :rows, :].reshape(b, g, c, rep, d) \
+        .transpose(0, 2, 1, 3, 4).reshape(b, c, h, d)
+    return out
+
+
+# --------------------------------------------------- block-size selection
+def _round8(n: int) -> int:
+    return max(8, -(-n // 8) * 8)
+
+
+def default_paged_block_r(rows: int, head_dim: int,
+                          chip: Optional[str] = None) -> int:
+    """Chip-aware default query-row block for the paged kernel.
+
+    Rows = C·(heads per kv head) — tiny for batched decode (one token
+    per sequence), up to a few hundred for chunked prefill. Small on
+    CPU interpret (grid overhead dominates), wider on TPU so the
+    row-block matmuls fill MXU tiles; large head dims halve the block
+    to keep the f32 (rows, bs) score tile + accumulators in VMEM.
+    """
+    if chip is None:
+        try:
+            from ray_tpu.parallel.mesh import chip_spec
+            chip = chip_spec().name
+        except Exception:  # jax backend not initializable — be safe
+            chip = "cpu"
+    cap = 128 if chip == "cpu" else (128 if head_dim >= 256 else 256)
+    return min(_round8(rows), cap)
+
+
+# Winner cache: (chip, block_size, table_len, rows, head_dim) -> block_r.
+_PAGED_AUTOTUNE_CACHE: dict = {}
+
+_PAGED_CANDIDATES = (8, 16, 32, 64, 128, 256)
+
+
+def _paged_disk_key(key: tuple) -> str:
+    chip, bs, t, rows, head_dim = key
+    return f"paged|{chip}|{jax.__version__}|{bs}|{t}|{rows}|{head_dim}"
+
+
+def autotune_paged_block_r(block_size: int, table_len: int, rows: int,
+                           head_dim: int, *,
+                           batch: int = 8,
+                           dtype=jnp.bfloat16,
+                           candidates=None,
+                           iters: int = 5,
+                           timer=None,
+                           chip: Optional[str] = None) -> int:
+    """One-shot row-block autotune for the paged kernel: time a small
+    candidate grid once and cache the winner per
+    ``(chip, block_size, table_len, rows, head_dim)``; timed winners
+    persist through the SAME on-disk JSON as the flash autotuner
+    (``$RAY_TPU_FLASH_CACHE_DIR/flash_autotune.json``, keys prefixed
+    ``paged|``), so serving replicas never re-time on process start.
+
+    Off-TPU (without an injected ``timer``) returns the chip-aware
+    default without running anything. ``timer`` is injectable for
+    tests: a callable ``(block_r) -> seconds``.
+    """
+    if chip is None:
+        try:
+            from ray_tpu.parallel.mesh import chip_spec
+            chip = chip_spec().name
+        except Exception:
+            chip = "cpu"
+    key = (chip, int(block_size), int(table_len), int(rows),
+           int(head_dim))
+    if key in _PAGED_AUTOTUNE_CACHE:
+        return _PAGED_AUTOTUNE_CACHE[key]
+    persisted = load_cached_blocks(_paged_disk_key(key))
+    if persisted is not None:
+        _PAGED_AUTOTUNE_CACHE[key] = int(persisted[0])
+        return _PAGED_AUTOTUNE_CACHE[key]
+
+    default = default_paged_block_r(rows, head_dim, chip=chip)
+    cands = sorted({min(c, _round8(rows))
+                    for c in (candidates or _PAGED_CANDIDATES)})
+    if default not in cands:
+        cands.insert(0, default)
+    if timer is None:
+        if jax.default_backend() != "tpu" or len(cands) <= 1:
+            _PAGED_AUTOTUNE_CACHE[key] = default
+            return default
+        timer = _paged_block_timer(batch, block_size, table_len, rows,
+                                   head_dim, dtype, iters)
+    best, best_t = default, float("inf")
+    for br in cands:
+        try:
+            tt = timer(br)
+        except Exception:  # a candidate may not fit VMEM — skip it
+            continue
+        if tt < best_t:
+            best, best_t = br, tt
+    _PAGED_AUTOTUNE_CACHE[key] = best
+    persist_cached_blocks(_paged_disk_key(key), (best, best))
+    return best
+
+
+def _paged_block_timer(batch, block_size, table_len, rows, head_dim,
+                       dtype, iters: int):
+    """Build a timer(block_r) -> seconds over a synthetic full-length
+    paged batch (the worst-case decode shape)."""
+    import time
+
+    n_blocks = 1 + batch * table_len
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    kc = jax.random.normal(ks[0], (n_blocks, block_size, 1, head_dim),
+                           dtype)
+    vc = jax.random.normal(ks[1], (n_blocks, block_size, 1, head_dim),
+                           dtype)
+    q = jax.random.normal(ks[2], (batch, rows, 1, head_dim), dtype)
+    bt = jnp.arange(1, n_blocks, dtype=jnp.int32).reshape(
+        batch, table_len)
+    lens = jnp.full((batch,), table_len * block_size, jnp.int32)
+    pos = jnp.full((batch, rows), table_len * block_size - 1, jnp.int32)
+
+    def timer(block_r: int) -> float:
+        fn = jax.jit(functools.partial(
+            paged_flash_attention, block_r=block_r))
+        r = fn(q, kc, vc, bt, pos, lens)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(q, kc, vc, bt, pos, lens)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / iters
+
+    return timer

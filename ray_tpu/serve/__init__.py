@@ -27,7 +27,7 @@ from ray_tpu.serve._private.replica import get_multiplexed_model_id
 from ray_tpu.serve.llm_engine import (
     EngineConfig, EngineDeadError, LLMEngine, LLMServer,
     RequestTooLargeError)
-from ray_tpu.serve.prefix_cache import PrefixBlockPool
+from ray_tpu.serve.prefix_cache import PrefixBlockPool, prefix_fingerprint
 
 __all__ = [
     "Application",
@@ -42,6 +42,7 @@ __all__ = [
     "LLMServer",
     "PrefixBlockPool",
     "RequestTooLargeError",
+    "prefix_fingerprint",
     "batch",
     "delete",
     "deployment",

@@ -59,8 +59,12 @@ class _DeploymentInfo:
         self.replicas: List[Any] = []
         self.version = 0
         self.replica_counter = 0
-        self._last_scale_up = 0.0
-        self._last_scale_down = 0.0
+        # delay-gate from DEPLOY time: an epoch-zero stamp would let
+        # the first scale decision bypass upscale/downscale_delay_s
+        # entirely (observed as a mid-run replica kill the instant
+        # engine pressure cleared, ActorDiedError for its streams)
+        self._last_scale_up = time.time()
+        self._last_scale_down = time.time()
 
 
 class ServeController:

@@ -156,6 +156,10 @@ class _Router:
     gauge_refresh_s = 0.5
     #: direct-probe silence after which the fleet plane backfills
     gauge_stale_s = 3.0
+    #: gauge-score bonus for a replica whose radix trie already holds a
+    #: first-turn request's prefix (worth ~a free-slot fraction — a
+    #: warm prefix beats marginal capacity, but never a dead replica)
+    prefix_match_bonus = 1.5
 
     def __init__(self, deployment_name: str, controller):
         self.deployment_name = deployment_name
@@ -307,8 +311,14 @@ class _Router:
 
     def pick(self, model_id: Optional[str],
              session_id: Optional[str] = None,
-             policy: Optional[str] = None):
-        """Returns (replica, stable_key)."""
+             policy: Optional[str] = None,
+             prefix_fp: Optional[int] = None):
+        """Returns (replica, stable_key). ``prefix_fp`` (a
+        ``prefix_cache.prefix_fingerprint`` of the request's leading KV
+        block — typically its system prompt) steers a FIRST-turn
+        request toward the replica whose radix trie already caches that
+        prefix; once a session is pinned, affinity wins and the
+        fingerprint is moot."""
         n = len(self.replicas)
         by_key = {self._key(r): r for r in self.replicas}
         policy = policy or self.policy
@@ -333,7 +343,18 @@ class _Router:
         elif policy == "gauge":
             self._poll_gauges()
             fresh = self._fresh_gauges()
-            scored = [(gauge_score(fresh[self._key(r)]), i, r)
+
+            def score(g):
+                s = gauge_score(g)
+                if prefix_fp is not None and prefix_fp in \
+                        (g.get("prefix_fingerprints") or ()):
+                    # cold-session placement: the replica's trie
+                    # already holds this request's prefix blocks —
+                    # prefill there skips them instead of recomputing
+                    s += self.prefix_match_bonus
+                return s
+
+            scored = [(score(fresh[self._key(r)]), i, r)
                       for i, r in enumerate(self.replicas)
                       if self._key(r) in fresh]
             if scored:
@@ -380,7 +401,8 @@ class DeploymentHandle:
                  app_name: str = "default", _router: Optional[_Router] = None,
                  _stream: bool = False, _model_id: Optional[str] = None,
                  _session_id: Optional[str] = None,
-                 _routing_policy: Optional[str] = None):
+                 _routing_policy: Optional[str] = None,
+                 _prefix_fingerprint: Optional[int] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._controller = controller
@@ -389,6 +411,7 @@ class DeploymentHandle:
         self._model_id = _model_id
         self._session_id = _session_id
         self._routing_policy = _routing_policy
+        self._prefix_fingerprint = _prefix_fingerprint
 
     # -- routing ------------------------------------------------------
     def _route(self, method: str, args, kwargs):
@@ -405,7 +428,8 @@ class DeploymentHandle:
                       if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
         replica, rkey = r.pick(self._model_id, self._session_id,
-                               self._routing_policy)
+                               self._routing_policy,
+                               prefix_fp=self._prefix_fingerprint)
         if self._stream:
             # core streaming generator task: the replica method's items
             # arrive as first-class objects with backpressure and the
@@ -450,11 +474,15 @@ class DeploymentHandle:
                 multiplexed_model_id: Optional[str] = None,
                 session_id: Optional[str] = None,
                 routing_policy: Optional[str] = None,
+                prefix_fingerprint: Optional[int] = None,
                 **kwargs) -> "DeploymentHandle":
         """Configured copy of this handle (reference: handle.options).
         ``session_id`` pins every call to one replica while it lives
         (multi-turn prefix-cache affinity); ``routing_policy`` selects
-        "gauge" (default) / "pow2" / "round_robin". Unknown options
+        "gauge" (default) / "pow2" / "round_robin";
+        ``prefix_fingerprint`` (``serve.prefix_fingerprint(tokens,
+        kv_block_size)``) steers a first-turn request to the replica
+        whose radix cache already holds that prefix. Unknown options
         raise rather than silently no-op."""
         if kwargs:
             raise TypeError(
@@ -466,7 +494,8 @@ class DeploymentHandle:
             self.deployment_name, self._controller, self.app_name,
             _router=self._router, _stream=stream,
             _model_id=multiplexed_model_id, _session_id=session_id,
-            _routing_policy=routing_policy)
+            _routing_policy=routing_policy,
+            _prefix_fingerprint=prefix_fingerprint)
 
     def __reduce__(self):
         # options survive pickling; router state is rebuilt on the far
@@ -474,12 +503,14 @@ class DeploymentHandle:
         return (_rebuild_handle,
                 (self.deployment_name, self._controller, self.app_name,
                  self._stream, self._model_id, self._session_id,
-                 self._routing_policy))
+                 self._routing_policy, self._prefix_fingerprint))
 
 
 def _rebuild_handle(deployment_name, controller, app_name, stream,
-                    model_id, session_id=None, routing_policy=None):
+                    model_id, session_id=None, routing_policy=None,
+                    prefix_fingerprint=None):
     return DeploymentHandle(deployment_name, controller, app_name,
                             _stream=stream, _model_id=model_id,
                             _session_id=session_id,
-                            _routing_policy=routing_policy)
+                            _routing_policy=routing_policy,
+                            _prefix_fingerprint=prefix_fingerprint)

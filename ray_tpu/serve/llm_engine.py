@@ -135,7 +135,7 @@ class _Request:
                  "out", "state", "slot", "blocks", "prefill_pos",
                  "seq_len", "generated", "cancelled", "t_submit",
                  "t_first_token", "history", "hit_blocks", "trie_node",
-                 "trie_cursor", "spec_ewma", "spec_disabled")
+                 "trie_cursor", "spec_ewma", "spec_disabled", "warmup")
 
     def __init__(self, rid: int, prompt: List[int], max_new_tokens: int,
                  eos_token_id: Optional[int]):
@@ -151,6 +151,7 @@ class _Request:
         self.seq_len = 0              # cache positions written
         self.generated = 0            # tokens emitted
         self.cancelled = False
+        self.warmup = False       # compile-only request: no telemetry
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
         # -- prefix sharing (prefix_cache.PrefixBlockPool)
@@ -270,10 +271,22 @@ class LLMEngine:
         self._stop = False
         self._dead: Optional[BaseException] = None
 
+        self._jax = jax
+
         # -- stats / metrics -------------------------------------------
         self._tokens_total = 0
         self._decode_steps = 0
         self._prefill_chunks = 0
+        # device-wall split (the kernel-vs-reference bench reads these):
+        # decode wall includes the result sync the step loop does anyway
+        self._decode_wall_s = 0.0
+        self._prefill_wall_s = 0.0
+        # length-aware work accounting: pages a lens-skipping kernel
+        # touches per decode step vs the full table window — FLOPs are
+        # proportional to pages, so live/window IS the measured
+        # work fraction of the paged fast path (any backend)
+        self._decode_pages_live = 0
+        self._decode_pages_window = 0
         self._prompt_blocks_total = 0   # full prompt blocks seen
         self._cow_copies = 0
         self._spec_drafted = 0
@@ -313,7 +326,8 @@ class LLMEngine:
     # ------------------------------------------------------- public API
     def submit(self, prompt_ids: Sequence[int],
                max_new_tokens: Optional[int] = None,
-               eos_token_id: Optional[int] = None) -> _Request:
+               eos_token_id: Optional[int] = None,
+               _warmup: bool = False) -> _Request:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("empty prompt")
@@ -331,6 +345,7 @@ class LLMEngine:
                     f"engine step loop died: {self._dead!r}")
             self._rid += 1
             req = _Request(self._rid, prompt, max(1, int(mnt)), eos)
+            req.warmup = _warmup
             self._pending.append(req)
             self._work.notify_all()
         return req
@@ -396,6 +411,41 @@ class LLMEngine:
         finally:
             self.cancel(req)
 
+    def warmup(self, timeout_s: float = 600.0) -> None:
+        """Compile every jitted program (one tiny end-to-end generate)
+        and reset the session counters it skewed: the TTFT EWMA would
+        otherwise carry the compile wall into the gauge router's
+        scoring and starve a freshly-scaled-up replica of traffic."""
+        req = self.submit([2, 3], 2, _warmup=True)
+        deadline = time.monotonic() + timeout_s
+        try:
+            while True:
+                try:
+                    item = req.out.get(timeout=0.2)
+                except queue.Empty:
+                    if self._dead is not None:
+                        raise EngineDeadError(
+                            f"engine step loop died: {self._dead!r}")
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("warmup timed out")
+                    continue
+                if item is _DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+        finally:
+            self.cancel(req)
+        with self._lock:
+            self._ttft_ewma = None
+            self._t_start = time.monotonic()
+            self._tokens_total = 0
+            self._decode_steps = 0
+            self._prefill_chunks = 0
+            self._decode_wall_s = self._prefill_wall_s = 0.0
+            self._decode_pages_live = self._decode_pages_window = 0
+            self._prompt_blocks_total = 0
+            self._occupancy.clear()
+
     def stats(self) -> Dict[str, Any]:
         """Scheduler counters (the autoscaling signal surface): queue
         depth, batch occupancy histogram, tokens/s, leak-check views of
@@ -428,6 +478,25 @@ class LLMEngine:
                 "tokens_per_s": round(self._tokens_total / elapsed, 2),
                 "decode_steps": self._decode_steps,
                 "prefill_chunks": self._prefill_chunks,
+                # device-wall split + length-aware work fraction (the
+                # paged-kernel bench legs and perf gate read these)
+                "decode_wall_s": round(self._decode_wall_s, 4),
+                "prefill_wall_s": round(self._prefill_wall_s, 4),
+                "decode_pages_live": self._decode_pages_live,
+                "decode_pages_window": self._decode_pages_window,
+                "decode_block_work_frac": (
+                    round(self._decode_pages_live
+                          / self._decode_pages_window, 4)
+                    if self._decode_pages_window else None),
+                "kv_block_size": self.config.kv_block_size,
+                "paged_impl": getattr(self.model_config, "paged_impl",
+                                      "auto"),
+                # trie-root fingerprints: the router's prefix-aware
+                # COLD-session placement signal (first-turn requests
+                # land where their system prompt's KV already lives)
+                "prefix_fingerprints": (
+                    self._pool.root_fingerprints()
+                    if self.config.enable_prefix_sharing else []),
                 "occupancy_hist": dict(self._occupancy),
                 "ttft_ewma_s": (round(self._ttft_ewma, 6)
                                 if self._ttft_ewma is not None else None),
@@ -596,11 +665,14 @@ class LLMEngine:
         n = min(C, len(req.prompt) - start)
         chunk = np.zeros((1, C), np.int32)
         chunk[0, :n] = req.prompt[start:start + n]
+        t0 = time.monotonic()
         tok, self._cache = self._jit_prefill(
             self._params, jnp.asarray(chunk), self._cache,
             jnp.asarray(self._block_tables[req.slot:req.slot + 1]),
             jnp.full((1,), start, jnp.int32),
             jnp.full((1,), n, jnp.int32))
+        self._jax.block_until_ready(tok)
+        self._prefill_wall_s += time.monotonic() - t0
         req.prefill_pos += n
         self._prefill_chunks += 1
         # index newly-completed FULL prompt blocks in the radix trie so
@@ -646,6 +718,20 @@ class LLMEngine:
             self._last_tok[req.slot] = first
             self._seq_lens[req.slot] = req.seq_len
 
+    def _account_decode_pages(self, live_lens) -> None:
+        """Book one decode step's length-aware work: pages the paged
+        kernel touches (``max(ceil(live/bs), 1)`` per slot — idle slots
+        run their one trash page) vs the full table window the XLA
+        reference gathers. Host-side numpy over the slot arrays the
+        step already copied — no device work."""
+        from ray_tpu.ops.paged_flash import paged_work_pages
+        ec = self.config
+        pages = paged_work_pages(
+            self._np.asarray(live_lens, self._np.int64),
+            ec.kv_block_size)
+        self._decode_pages_live += int(pages.sum())
+        self._decode_pages_window += ec.decode_slots * ec.blocks_per_seq
+
     def _decode_once(self) -> None:
         if self.config.spec_tokens > 0:
             self._decode_speculative()
@@ -666,11 +752,14 @@ class LLMEngine:
             toks = self._last_tok.copy()
             lens = self._seq_lens.copy()
             bt = self._block_tables.copy()
+        self._account_decode_pages(lens + 1)
         jnp = self._jnp
+        t0 = time.monotonic()
         out, self._cache = self._jit_decode(
             self._params, jnp.asarray(toks), self._cache,
             jnp.asarray(bt), jnp.asarray(lens))
         out = self._np.asarray(out)
+        self._decode_wall_s += time.monotonic() - t0
         produced = 0
         with self._lock:
             for req in active:
@@ -767,11 +856,14 @@ class LLMEngine:
                 starts[s] = req.seq_len
                 drafts[s] = d
             bt = self._block_tables.copy()
+        self._account_decode_pages(starts + lens)
         jnp = self._jnp
+        t0 = time.monotonic()
         preds, self._cache = self._jit_verify(
             self._params, jnp.asarray(toks), self._cache,
             jnp.asarray(bt), jnp.asarray(starts), jnp.asarray(lens))
         preds = np.asarray(preds)
+        self._decode_wall_s += time.monotonic() - t0
         produced = 0
         with self._lock:
             for req in active:
@@ -849,6 +941,10 @@ class LLMEngine:
 
     # ------------------------------------------------ metrics / events
     def _record_ttft(self, req: _Request) -> None:
+        if getattr(req, "warmup", False):
+            # compile-only traffic: its TTFT is the jit wall, noise for
+            # both the router's EWMA and the flight recorder
+            return
         ttft = req.t_first_token - req.t_submit
         self._ttft_ewma = ttft if self._ttft_ewma is None \
             else 0.8 * self._ttft_ewma + 0.2 * ttft
@@ -931,7 +1027,7 @@ class LLMServer:
 
     def __init__(self, model: Optional[Dict[str, Any]] = None,
                  engine: Optional[Dict[str, Any]] = None,
-                 seed: int = 0):
+                 seed: int = 0, warmup: bool = True):
         from ray_tpu.models import TransformerConfig
         model = dict(model or {})
         if "dtype" in model:
@@ -942,6 +1038,15 @@ class LLMServer:
         self.engine = LLMEngine(self.model_config, self.engine_config,
                                 seed=seed,
                                 replica_tag=f"pid:{os.getpid()}")
+        if warmup:
+            # compile prefill + decode BEFORE the replica enters
+            # rotation: actor calls queue behind __init__, so a
+            # replica the autoscaler adds mid-load serves its first
+            # request hot instead of charging users the jit wall
+            try:
+                self.engine.warmup()
+            except Exception:
+                pass
 
     async def generate(self, prompt_ids: Sequence[int],
                        max_new_tokens: Optional[int] = None,

@@ -33,7 +33,23 @@ thread).
 from __future__ import annotations
 
 import collections
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def prefix_fingerprint(tokens: Sequence[int],
+                       block_size: int) -> Optional[int]:
+    """Stable fingerprint of a prompt's FIRST full KV-block chunk
+    (crc32 of the token bytes — deterministic across processes, unlike
+    ``hash``). ``None`` when the prompt has no full block. Routers
+    compare this against :meth:`PrefixBlockPool.root_fingerprints` to
+    place a COLD session on the replica whose radix trie already holds
+    its prefix."""
+    if block_size < 1 or len(tokens) < block_size:
+        return None
+    data = b"".join(int(t).to_bytes(8, "little", signed=True)
+                    for t in tokens[:block_size])
+    return zlib.crc32(data)
 
 
 class _TrieNode:
@@ -202,6 +218,21 @@ class PrefixBlockPool:
         return node, True
 
     # -------------------------------------------------------- introspection
+    def root_fingerprints(self, limit: int = 64) -> List[int]:
+        """Fingerprints of the trie ROOT's children — the first-block
+        chunks this pool holds warm. O(root fan-out), capped at
+        ``limit`` (most-recently-touched first): cheap enough for every
+        ``Replica.stats()`` probe, rich enough for a router to place a
+        cold session where its system prompt already lives."""
+        kids = sorted(self._root.children.values(),
+                      key=lambda n: -n.touch)[:limit]
+        out = []
+        for node in kids:
+            fp = prefix_fingerprint(node.key, self.block_size)
+            if fp is not None:
+                out.append(fp)
+        return out
+
     def stats(self) -> Dict[str, int]:
         cached = sum(1 for b in self._node_of if b not in self._ref)
         shared = sum(1 for b, r in self._ref.items() if r > 1)

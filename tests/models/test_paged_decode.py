@@ -132,6 +132,86 @@ def test_paged_attention_matches_reference():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
 
 
+@pytest.mark.parametrize("style,kv_heads", [
+    pytest.param("gptj", None, marks=pytest.mark.slow),  # tier-1 keeps
+    ("llama", 2),                                        # the GQA case
+])
+def test_prefill_decode_parity_kernel_impl(style, kv_heads):
+    """The full vertical with the Pallas kernel forced (interpret mode
+    on CPU): chunked prefill + decode through ``paged_impl="kernel"``
+    must reproduce apply() exactly like the reference path — uneven
+    last block and GQA included."""
+    cfg = _cfg(block_style=style, n_kv_heads=kv_heads,
+               paged_impl="kernel")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    _run_paged.params = params
+    B, prompt, n_dec = 2, 7, 5
+    ids = jax.random.randint(jax.random.PRNGKey(1), (B, prompt + n_dec),
+                             0, cfg.vocab_size)
+    full = np.asarray(apply(cfg, params, ids))
+    pre, dec = _run_paged(cfg, ids, prompt, block_size=4, table_len=8)
+    np.testing.assert_allclose(pre, full[:, :prompt], **TOL)
+    np.testing.assert_allclose(dec, full[:, prompt:], **TOL)
+
+
+def test_gqa_reference_read_parity_with_repeat_formulation():
+    """Regression for the reshape-einsum GQA read: decode logits under
+    a GQA config must be identical whether the paged reference gathers
+    grouped heads (the new path) or a materialized ``jnp.repeat`` cache
+    copy (the old one, reconstructed here)."""
+    import math
+    rng = np.random.default_rng(2)
+    B, H, KVH, D, bs, T = 2, 8, 2, 8, 4, 3
+    kc = rng.normal(size=(1 + B * T, bs, KVH, D)).astype(np.float32)
+    vc = rng.normal(size=(1 + B * T, bs, KVH, D)).astype(np.float32)
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    bt = np.arange(1, 1 + B * T, dtype=np.int32).reshape(B, T)
+    pos = np.array([[7], [10]], np.int32)
+    new = paged_attention(q, kc, vc, bt, jnp.asarray(pos),
+                          impl="reference")
+    k = jnp.repeat(jnp.take(jnp.asarray(kc), jnp.asarray(bt), axis=0)
+                   .reshape(B, T * bs, KVH, D), H // KVH, axis=2)
+    v = jnp.repeat(jnp.take(jnp.asarray(vc), jnp.asarray(bt), axis=0)
+                   .reshape(B, T * bs, KVH, D), H // KVH, axis=2)
+    mask = np.arange(T * bs)[None, None, :] <= pos[:, :, None]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / math.sqrt(D))
+    s = jnp.where(jnp.asarray(mask)[:, None], s, -1e30)
+    old = jnp.einsum("bhqk,bkhd->bqhd",
+                     jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(new), np.asarray(old),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _engine_tokens(cfg_kw, engine_kw, prompts):
+    from ray_tpu.serve.llm_engine import EngineConfig, LLMEngine
+    cfg = _cfg(**cfg_kw)
+    eng = LLMEngine(cfg, EngineConfig(**engine_kw),
+                    params=init_params(cfg, jax.random.PRNGKey(0)))
+    try:
+        return [list(eng.generate_sync(p, 8)) for p in prompts]
+    finally:
+        eng.shutdown()
+
+
+def test_engine_greedy_decode_bitwise_stable_kernel_vs_reference():
+    """Interpret-mode kernel vs XLA reference through the FULL
+    LLMEngine: greedy token streams must be identical — and with
+    prompt-lookup speculation on top of the kernel too (the spec-decode
+    bit-exactness pin composes with the kernel dispatch)."""
+    ekw = dict(decode_slots=2, kv_block_size=4, max_seq_len=32,
+               prefill_chunk=8, max_new_tokens=8)
+    prompts = [[5, 9, 2, 7, 11, 3], [4, 4, 8, 4, 4, 8, 4, 4]]
+    ref = _engine_tokens(dict(block_style="llama", n_kv_heads=2),
+                         ekw, prompts)
+    ker = _engine_tokens(dict(block_style="llama", n_kv_heads=2,
+                              paged_impl="kernel"), ekw, prompts)
+    assert ref == ker
+    spec = _engine_tokens(dict(block_style="llama", n_kv_heads=2,
+                               paged_impl="kernel"),
+                          dict(ekw, spec_tokens=3), prompts)
+    assert ref == spec
+
+
 def test_gqa_cache_stores_kv_heads_only():
     cfg = _cfg(block_style="llama", n_kv_heads=2)
     cache = init_kv_cache(cfg, num_blocks=5, block_size=4)

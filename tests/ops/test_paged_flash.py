@@ -1,0 +1,316 @@
+"""Pallas paged-attention kernel parity suite (interpret mode on CPU).
+
+The kernel is the serving decode fast path: every case here pins its
+contract against the XLA gather reference at fp32-softmax tolerance —
+GQA grouping, uneven last blocks, chunked-prefill row shapes, the
+engine's block-0 trash slot, ``lens = 0`` idle slots — plus the
+length-skipping semantics themselves (content of dead blocks must be
+unreachable) and the autotune/persisted-cache machinery it shares with
+the flash kernel."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops import (attention_reference, autotune_paged_block_r,
+                         default_paged_block_r, paged_attention,
+                         paged_work_pages)
+from ray_tpu.ops.paged_flash import paged_flash_attention
+
+pytestmark = pytest.mark.serve_llm
+
+TOL = dict(rtol=2e-5, atol=2e-5)
+
+
+def _paged_case(seed, B, S, H, KVH, D, bs, T, shuffle=True):
+    """Random sequences scattered into a paged pool (block 0 reserved
+    as the engine's trash slot, filled with junk to prove it is only
+    read when a sequence's table actually points at it)."""
+    rng = np.random.default_rng(seed)
+    k_seq = rng.normal(size=(B, T * bs, KVH, D)).astype(np.float32)
+    v_seq = rng.normal(size=(B, T * bs, KVH, D)).astype(np.float32)
+    n_blocks = 1 + B * T
+    kc = rng.normal(size=(n_blocks, bs, KVH, D)).astype(np.float32)
+    vc = rng.normal(size=(n_blocks, bs, KVH, D)).astype(np.float32)
+    order = rng.permutation(np.arange(1, n_blocks)) if shuffle \
+        else np.arange(1, n_blocks)
+    bt = order.astype(np.int32).reshape(B, T)
+    for b in range(B):
+        for t in range(T):
+            kc[bt[b, t]] = k_seq[b, t * bs:(t + 1) * bs]
+            vc[bt[b, t]] = v_seq[b, t * bs:(t + 1) * bs]
+    return k_seq, v_seq, kc, vc, bt
+
+
+def _both(q, kc, vc, bt, pos, lens):
+    ref = paged_attention(q, kc, vc, bt, pos, impl="reference")
+    ker = paged_attention(q, kc, vc, bt, pos,
+                          lens=jnp.asarray(np.asarray(lens, np.int32)),
+                          impl="kernel")
+    return np.asarray(ref), np.asarray(ker)
+
+
+@pytest.mark.parametrize("H,KVH", [(4, 4), (8, 2)])
+def test_decode_parity_mixed_uneven_lens(H, KVH):
+    """Batched single-token decode over mixed lengths, none of them
+    block-aligned — the kernel must match the reference on every live
+    row while touching only live pages."""
+    B, D, bs, T = 3, 16, 4, 6
+    _, _, kc, vc, bt = _paged_case(0, B, 24, H, KVH, D, bs, T)
+    rng = np.random.default_rng(1)
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    lens = np.array([5, 23, 9], np.int32)      # uneven last blocks
+    pos = (lens - 1)[:, None]
+    ref, ker = _both(q, kc, vc, bt, jnp.asarray(pos), lens)
+    np.testing.assert_allclose(ker, ref, **TOL)
+
+
+def test_chunked_prefill_parity_and_shape_duality():
+    """The SAME kernel serves (B, 1) decode and (B, C) chunked prefill:
+    a C-row chunk's valid rows must match both the reference and C
+    independent single-row calls at the same positions."""
+    B, C, H, KVH, D, bs, T = 2, 5, 4, 2, 8, 4, 4
+    _, _, kc, vc, bt = _paged_case(2, B, 16, H, KVH, D, bs, T)
+    rng = np.random.default_rng(3)
+    q = rng.normal(size=(B, C, H, D)).astype(np.float32)
+    lens = np.array([11, 14], np.int32)
+    pos = np.stack([np.arange(C, dtype=np.int32) + (l - C)
+                    for l in lens])
+    ref, ker = _both(q, kc, vc, bt, jnp.asarray(pos), lens)
+    np.testing.assert_allclose(ker, ref, **TOL)
+    # shape duality: each chunk row == a one-token decode call
+    for c in range(C):
+        _, one = _both(q[:, c:c + 1], kc, vc, bt,
+                       jnp.asarray(pos[:, c:c + 1]), pos[:, c] + 1)
+        np.testing.assert_allclose(one[:, 0], ker[:, c], **TOL)
+
+
+def test_length_skipping_ignores_dead_blocks():
+    """The headline semantics: junk written into table slots past
+    ``ceil(lens/bs)`` must be bit-invisible — work is proportional to
+    live tokens, not the serving window."""
+    B, H, KVH, D, bs, T = 2, 4, 4, 8, 4, 8
+    _, _, kc, vc, bt = _paged_case(4, B, 32, H, KVH, D, bs, T)
+    rng = np.random.default_rng(5)
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    lens = np.array([9, 13], np.int32)
+    pos = (lens - 1)[:, None]
+    _, ker = _both(q, kc, vc, bt, jnp.asarray(pos), lens)
+    kc2, vc2 = kc.copy(), vc.copy()
+    for b in range(B):
+        dead = -(-int(lens[b]) // bs)
+        kc2[bt[b, dead:]] = 1e3
+        vc2[bt[b, dead:]] = -1e3
+    _, ker2 = _both(q, kc2, vc2, bt, jnp.asarray(pos), lens)
+    np.testing.assert_array_equal(ker, ker2)
+
+
+def test_lens_zero_idle_slot_is_finite_and_matches_reference():
+    """The engine's idle decode slots: block table all-zeros (the trash
+    block), ``lens = 0``, position 0. The kernel clamps to one page and
+    must produce the same (discarded) numerics as the reference — and
+    never a NaN that could poison a donated buffer."""
+    B, H, KVH, D, bs, T = 2, 4, 2, 8, 4, 3
+    rng = np.random.default_rng(6)
+    kc = rng.normal(size=(1 + B * T, bs, KVH, D)).astype(np.float32)
+    vc = rng.normal(size=(1 + B * T, bs, KVH, D)).astype(np.float32)
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    bt = np.zeros((B, T), np.int32)            # all slots -> trash block
+    pos = np.zeros((B, 1), np.int32)
+    ref, ker = _both(q, kc, vc, bt, jnp.asarray(pos),
+                     np.zeros((B,), np.int32))
+    assert np.all(np.isfinite(ker))
+    np.testing.assert_allclose(ker, ref, **TOL)
+
+
+def test_block_size_not_dividing_sequence():
+    """lens and positions falling mid-block everywhere (block_size 5,
+    live lengths 7/11/3): masking inside the last live page must be
+    exact."""
+    B, H, KVH, D, bs, T = 3, 2, 2, 8, 5, 4
+    _, _, kc, vc, bt = _paged_case(7, B, 20, H, KVH, D, bs, T)
+    rng = np.random.default_rng(8)
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    lens = np.array([7, 11, 3], np.int32)
+    pos = (lens - 1)[:, None]
+    ref, ker = _both(q, kc, vc, bt, jnp.asarray(pos), lens)
+    np.testing.assert_allclose(ker, ref, **TOL)
+
+
+def test_matches_dense_attention_over_ordered_sequence():
+    """End-to-end sanity vs plain dense attention: a paged read of an
+    ordered sequence == attention_reference over its first ``lens``
+    positions."""
+    B, H, KVH, D, bs, T = 2, 4, 4, 8, 4, 3
+    k_seq, v_seq, kc, vc, bt = _paged_case(9, B, 12, H, KVH, D, bs, T)
+    rng = np.random.default_rng(10)
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    lens = np.array([10, 10], np.int32)
+    pos = (lens - 1)[:, None]
+    _, ker = _both(q, kc, vc, bt, jnp.asarray(pos), lens)
+    ref = attention_reference(
+        jnp.asarray(q), jnp.asarray(k_seq[:, :10]),
+        jnp.asarray(v_seq[:, :10]), causal=False)
+    np.testing.assert_allclose(ker, np.asarray(ref), **TOL)
+
+
+def test_jit_stable_across_lens_values():
+    """lens is a traced operand: different live lengths must reuse ONE
+    compiled program (the engine jits decode exactly once)."""
+    import functools
+    B, H, KVH, D, bs, T = 2, 4, 2, 8, 4, 4
+    _, _, kc, vc, bt = _paged_case(11, B, 16, H, KVH, D, bs, T)
+    q = np.zeros((B, 1, H, D), np.float32)
+    f = jax.jit(functools.partial(paged_attention, impl="kernel"))
+    for ln in ([4, 9], [16, 1], [2, 2]):
+        lens = np.asarray(ln, np.int32)
+        f(q, kc, vc, bt, jnp.asarray((lens - 1).clip(0)[:, None]),
+          lens=lens)
+    assert f._cache_size() == 1
+
+
+def test_lens_none_derives_bound_from_positions():
+    B, H, KVH, D, bs, T = 2, 2, 2, 8, 4, 4
+    _, _, kc, vc, bt = _paged_case(12, B, 16, H, KVH, D, bs, T)
+    rng = np.random.default_rng(13)
+    q = rng.normal(size=(B, 1, H, D)).astype(np.float32)
+    pos = np.array([[6], [13]], np.int32)
+    ref = paged_attention(q, kc, vc, bt, jnp.asarray(pos),
+                          impl="reference")
+    ker = paged_attention(q, kc, vc, bt, jnp.asarray(pos),
+                          impl="kernel")       # lens derived: pos + 1
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref), **TOL)
+
+
+def test_explicit_block_r_and_row_padding():
+    """block_r smaller than the row count exercises the row-block grid
+    axis; block_r larger exercises padded rows (position −1, masked to
+    zero and dropped on unpack)."""
+    B, C, H, KVH, D, bs, T = 1, 3, 8, 2, 8, 4, 3
+    _, _, kc, vc, bt = _paged_case(14, B, 12, H, KVH, D, bs, T)
+    rng = np.random.default_rng(15)
+    q = rng.normal(size=(B, C, H, D)).astype(np.float32)
+    lens = np.array([11], np.int32)
+    pos = np.arange(C, dtype=np.int32)[None, :] + (11 - C)
+    ref = paged_attention(q, kc, vc, bt, jnp.asarray(pos),
+                          impl="reference")
+    for br in (8, 64):   # rows = C * rep = 12 -> split and padded
+        ker = paged_flash_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(bt), jnp.asarray(pos), jnp.asarray(lens),
+            block_r=br, interpret=True)
+        np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                                   **TOL)
+
+
+def test_paged_work_pages_accounting():
+    lens = np.array([0, 1, 4, 5, 16], np.int32)
+    pages = paged_work_pages(lens, 4)
+    np.testing.assert_array_equal(pages, [1, 1, 1, 2, 4])
+    assert paged_work_pages(0, 4) == 1
+    assert paged_work_pages(9, 4) == 3
+
+
+def test_gqa_reference_has_no_materialized_repeat():
+    """The satellite regression: the reference path's GQA read must not
+    materialize an h/kvh-times-larger cache copy. jaxpr-level check —
+    no broadcast of a gathered [*, H, D] tensor — plus value parity
+    with an explicit jnp.repeat formulation."""
+    import math
+    B, C, H, KVH, D, bs, T = 2, 2, 8, 2, 8, 4, 3
+    _, _, kc, vc, bt = _paged_case(16, B, 12, H, KVH, D, bs, T)
+    rng = np.random.default_rng(17)
+    q = rng.normal(size=(B, C, H, D)).astype(np.float32)
+    pos = np.array([[8, 9], [8, 9]], np.int32)
+
+    ref = paged_attention(q, kc, vc, bt, jnp.asarray(pos),
+                          impl="reference")
+    k = jnp.take(jnp.asarray(kc), jnp.asarray(bt), axis=0) \
+        .reshape(B, T * bs, KVH, D)
+    v = jnp.take(jnp.asarray(vc), jnp.asarray(bt), axis=0) \
+        .reshape(B, T * bs, KVH, D)
+    kr = jnp.repeat(k, H // KVH, axis=2)
+    vr = jnp.repeat(v, H // KVH, axis=2)
+    key_pos = np.arange(T * bs)
+    mask = key_pos[None, None, :] <= pos[:, :, None]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kr) * (1.0 / math.sqrt(D))
+    s = jnp.where(jnp.asarray(mask)[:, None], s, -1e30)
+    old = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), vr)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(old),
+                               rtol=1e-5, atol=1e-5)
+    # the grouped-einsum path never materializes a [B, K, H, D] cache
+    jaxpr = str(jax.make_jaxpr(
+        lambda *a: paged_attention(*a, impl="reference"))(
+            q, kc, vc, bt, pos))
+    assert f"({B}, {T * bs}, {H}, {D})" not in jaxpr
+
+
+# ------------------------------------------------ autotune / disk cache
+def test_default_paged_block_r_shapes():
+    assert default_paged_block_r(2, 32, chip="cpu") == 8
+    assert default_paged_block_r(100, 32, chip="cpu") == 104
+    assert default_paged_block_r(1000, 32, chip="cpu") == 128
+    assert default_paged_block_r(1000, 128, chip="v4") == 256
+    assert default_paged_block_r(1000, 256, chip="v4") == 128
+
+
+def test_autotune_paged_block_r_times_and_persists(tmp_path,
+                                                   monkeypatch):
+    """Injected timer picks the fastest candidate; the winner lands in
+    the SAME on-disk JSON as the flash autotuner (``paged|`` keys) and
+    a fresh process (cleared in-memory cache) reloads it without
+    re-timing."""
+    import json
+    import ray_tpu.ops.paged_flash as pf
+
+    monkeypatch.setenv("RAY_TPU_FLASH_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(pf, "_PAGED_AUTOTUNE_CACHE", {})
+    calls = []
+
+    def timer(br):
+        calls.append(br)
+        return abs(br - 32) + 1.0     # 32 wins
+
+    win = autotune_paged_block_r(16, 8, 256, 64, timer=timer,
+                                 chip="v5e")
+    assert win == 32 and calls
+    path = tmp_path / "flash_autotune.json"
+    data = json.loads(path.read_text())
+    paged_keys = [k for k in data if k.startswith("paged|v5e|")]
+    assert paged_keys and data[paged_keys[0]][0] == 32
+    # fresh process: in-memory cache empty, disk hit, timer NOT called
+    monkeypatch.setattr(pf, "_PAGED_AUTOTUNE_CACHE", {})
+    calls.clear()
+    assert autotune_paged_block_r(16, 8, 256, 64, timer=timer,
+                                  chip="v5e") == 32
+    assert not calls
+
+
+def test_autotune_off_tpu_returns_default_without_running(monkeypatch):
+    import ray_tpu.ops.paged_flash as pf
+    monkeypatch.setattr(pf, "_PAGED_AUTOTUNE_CACHE", {})
+    monkeypatch.setenv("RAY_TPU_FLASH_AUTOTUNE_CACHE", "0")
+    assert autotune_paged_block_r(16, 16, 8, 32, chip="cpu") == \
+        default_paged_block_r(8, 32, chip="cpu")
+
+
+def test_flash_disk_cache_ignores_foreign_paged_keys(tmp_path,
+                                                     monkeypatch):
+    """The flash loader's bulk merge must skip paged| entries (and vice
+    versa the paged lookup is exact-key, so flash keys never collide)."""
+    import importlib
+    import json
+    fa = importlib.import_module("ray_tpu.ops.flash_attention")
+
+    monkeypatch.setenv("RAY_TPU_FLASH_CACHE_DIR", str(tmp_path))
+    path = tmp_path / "flash_autotune.json"
+    path.write_text(json.dumps({
+        f"paged|cpu|{jax.__version__}|16|8|256|64": [32, 32],
+        f"cpu|{jax.__version__}|128|64|1": [256, 512],
+    }))
+    monkeypatch.setattr(fa, "_DISK_CACHE_LOADED", False)
+    monkeypatch.setattr(fa, "_AUTOTUNE_CACHE", {})
+    fa._load_disk_cache()
+    assert fa._AUTOTUNE_CACHE == {("cpu", 128, 64, True): (256, 512)}

@@ -337,6 +337,64 @@ def test_compile_once_with_sharing_and_speculation(engine_spec):
     _assert_clean(engine_spec, 4)
 
 
+def test_stats_decode_wall_split_and_page_accounting(engine4):
+    """PR-15 stat surface: the prefill/decode device-wall split and the
+    length-aware page accounting (live pages / window pages < 1 for
+    short sequences in a wide window) that the bench's mixed-length leg
+    and the paged kernel's FLOP claim read."""
+    list(engine4.generate_sync([3, 1, 4, 1, 5], max_new_tokens=6))
+    s = engine4.stats()
+    assert s["decode_wall_s"] > 0 and s["prefill_wall_s"] > 0
+    assert s["decode_pages_window"] > 0
+    assert 0 < s["decode_pages_live"] <= s["decode_pages_window"]
+    frac = s["decode_block_work_frac"]
+    assert frac == pytest.approx(
+        s["decode_pages_live"] / s["decode_pages_window"], abs=1e-3)
+    # short sequences in a 12-block window: most pages are skippable
+    assert frac < 0.5
+    assert s["kv_block_size"] == 4
+    assert s["paged_impl"] == "auto"
+    _assert_clean(engine4, 4)
+
+
+def test_stats_expose_trie_root_fingerprints(engine4, engine_off):
+    """The router's cold-session placement signal: after serving a
+    block-long prompt the trie root's first-chunk fingerprint shows up
+    in stats, and matches what a client computes from the same
+    tokens. Sharing-off engines expose none."""
+    from ray_tpu.serve import prefix_fingerprint
+    prompt = list(range(2, 14))                      # 3 full blocks
+    list(engine4.generate_sync(prompt, max_new_tokens=4))
+    fps = engine4.stats()["prefix_fingerprints"]
+    assert prefix_fingerprint(prompt, 4) in fps
+    list(engine_off.generate_sync(prompt, max_new_tokens=4))
+    assert engine_off.stats()["prefix_fingerprints"] == []
+    _assert_clean(engine4, 4)
+
+
+def test_warmup_compiles_then_resets_session_stats():
+    """LLMServer warms its engine inside __init__ so a replica the
+    autoscaler adds mid-load serves its first request hot; the warmup
+    must not leak its compile wall into the TTFT EWMA the gauge router
+    scores (a poisoned EWMA starves the new replica of traffic)."""
+    eng = _engine(decode_slots=2)
+    try:
+        eng.warmup()
+        s = eng.stats()
+        assert s["ttft_ewma_s"] is None
+        assert s["tokens_total"] == 0
+        assert s["decode_wall_s"] == 0.0
+        assert eng._jit_prefill._cache_size() == 1
+        assert eng._jit_decode._cache_size() == 1
+        # warm: the next request compiles nothing
+        list(eng.generate_sync([7, 7, 7], max_new_tokens=3))
+        assert eng._jit_prefill._cache_size() == 1
+        assert eng._jit_decode._cache_size() == 1
+        assert eng.stats()["ttft_ewma_s"] is not None
+    finally:
+        eng.shutdown()
+
+
 def test_kv_block_math():
     cfg = TransformerConfig(**MODEL_KW)
     ec = EngineConfig(decode_slots=4, kv_block_size=4, max_seq_len=48)

@@ -141,7 +141,65 @@ def test_fleet_backfill_maps_rows_by_pid(monkeypatch):
     assert r.pick(None)[1] == bytes([1])
 
 
-def test_handle_options_validates_routing_policy():
+def test_prefix_fingerprint_helper_is_stable_and_gated():
+    from ray_tpu.serve.prefix_cache import prefix_fingerprint
+    fp = prefix_fingerprint([1, 2, 3, 4], 4)
+    assert fp == prefix_fingerprint([1, 2, 3, 4, 99, 100], 4)
+    assert fp != prefix_fingerprint([1, 2, 3, 5], 4)
+    assert prefix_fingerprint([1, 2, 3], 4) is None  # no full block
+    assert isinstance(fp, int)
+
+
+def test_cold_session_routes_to_prefix_holder():
+    """First-turn placement: equal capacity everywhere, but replica 2's
+    trie already holds the request's system-prompt block — the
+    fingerprint bonus must send the cold session there."""
+    r = _router(3)
+    g0, g1, g2 = _gauge(), _gauge(), _gauge()
+    g2["prefix_fingerprints"] = [0xBEEF, 0xCAFE]
+    r.gauges = {bytes([0]): g0, bytes([1]): g1, bytes([2]): g2}
+    assert r.pick(None, session_id="cold", prefix_fp=0xCAFE)[1] \
+        == bytes([2])
+    # ... and the first pick pinned the session: later turns stick
+    # even without the fingerprint
+    assert r.pick(None, session_id="cold")[1] == bytes([2])
+
+
+def test_prefix_bonus_does_not_override_session_affinity():
+    """A PINNED session stays put even if another replica now holds a
+    matching prefix — affinity is where THIS session's KV lives."""
+    r = _router(2)
+    r.gauges = {bytes([0]): _gauge(), bytes([1]): _gauge()}
+    r.session_affinity["alice"] = bytes([0])
+    g1 = r.gauges[bytes([1])]
+    g1["prefix_fingerprints"] = [7]
+    assert r.pick(None, session_id="alice", prefix_fp=7)[1] == bytes([0])
+
+
+def test_prefix_bonus_loses_to_overloaded_holder():
+    """The bonus is a tiebreaker, not a mandate: a prefix-holding
+    replica that is saturated (no slots, deep queue) still loses to an
+    idle one — recomputing a prefix beats queueing behind a backlog."""
+    r = _router(2)
+    busy = _gauge(free_slots=0, active=8, queue=9, ttft=1.9)
+    busy["prefix_fingerprints"] = [42]
+    r.gauges = {bytes([0]): busy, bytes([1]): _gauge()}
+    assert r.pick(None, prefix_fp=42)[1] == bytes([1])
+
+
+def test_no_fingerprint_or_no_match_is_pure_gauge_routing():
+    r = _router(2)
+    g0 = _gauge(free_slots=1, active=3)
+    g1 = _gauge()
+    g1["prefix_fingerprints"] = [1, 2, 3]
+    r.gauges = {bytes([0]): g0, bytes([1]): g1}
+    # no fingerprint: plain gauge pick (replica 1, more slots)
+    assert r.pick(None)[1] == bytes([1])
+    # fingerprint matching nothing: same
+    assert r.pick(None, prefix_fp=999)[1] == bytes([1])
+
+
+def test_handle_options_plumbs_prefix_fingerprint():
     from ray_tpu.serve.handle import DeploymentHandle
     h = DeploymentHandle.__new__(DeploymentHandle)
     h.deployment_name = "d"
@@ -152,9 +210,12 @@ def test_handle_options_validates_routing_policy():
     h._model_id = None
     h._session_id = None
     h._routing_policy = None
+    h._prefix_fingerprint = None
     with pytest.raises(ValueError):
         h.options(routing_policy="fastest")
-    h2 = h.options(routing_policy="round_robin", session_id="x")
+    h2 = h.options(routing_policy="round_robin", session_id="x",
+                   prefix_fingerprint=123)
     assert h2._routing_policy == "round_robin"
     assert h2._session_id == "x"
+    assert h2._prefix_fingerprint == 123
     assert h2._router is h._router       # shared router state

@@ -53,6 +53,25 @@ def test_bench_serve_smoke_subprocess():
     assert fleet["baseline"]["prefix_hit_rate"] in (0, 0.0), fleet
     assert fleet["spec_drafted"] > 0
     assert fleet["baseline"]["routing"] == "round_robin"
+    # paged-kernel legs: exact parity at fp32-softmax tolerance and a
+    # real mixed-length work reduction (FLOPs proportional to live
+    # tokens, not the serving window)
+    pk = d["paged_kernel"]
+    assert pk["parity_max_abs"] < 1e-4
+    assert 0 < pk["work_reduction"] < 1
+    assert pk["pages_live"] < pk["pages_window"]
+    ml = d["mixed_len"]
+    assert ml["errors"] == []
+    assert ml["work_reduction"] > 0.3, ml
+    assert ml["decode_wall_s"] > 0 and ml["prefill_wall_s"] > 0
+    # autoscaling under load: the fleet scaled up MID-RUN and the gauge
+    # router actually sent traffic to the new replica
+    su = d["scale_up"]
+    assert su["errors"] == []
+    assert su["scaled_up"] is True, su
+    assert su["new_replica_tokens"] > 0, su
+    assert su["replicas_end"] == 2
+    assert su["ttft_recovery"] is not None
     # the record feeds the gate, fleet rows included
     from tools.perf_gate import extract_serve_metrics, parse_bench_record
     m = extract_serve_metrics(parse_bench_record(rec))
@@ -60,6 +79,10 @@ def test_bench_serve_smoke_subprocess():
     assert m["serve/fleet_tokens_per_s_chip"] == \
         fleet["tokens_per_s_chip"]
     assert m["serve/fleet_prefix_hit_rate"] == fleet["prefix_hit_rate"]
+    assert m["serve/mixed_len_work_reduction"] == ml["work_reduction"]
+    assert m["serve/scaleup_new_replica_share"] == \
+        su["new_replica_share"]
+    assert "serve/paged_kernel_speedup" not in m   # CPU: no kernel wall
 
 
 def test_workload_is_seeded_and_stable():
@@ -83,3 +106,36 @@ def test_workload_shared_system_prompt_prefixes_every_request():
     # the fleet tail sampling is part of the same seeded schedule
     assert w == make_workload(8, 4, seed=3, mean_interarrival_s=0.01,
                               prompt_rng=(2, 6), system_prompt=sys_p)
+
+
+def test_mixed_workload_is_seeded_and_bimodal():
+    from bench_serve import make_mixed_workload
+    engine = {"max_seq_len": 64}
+    a = make_mixed_workload(12, 4, 7, engine)
+    assert a == make_mixed_workload(12, 4, 7, engine)
+    longs = [r for r in a if r["long"]]
+    shorts = [r for r in a if not r["long"]]
+    assert len(longs) == 6 and len(shorts) == 6
+    # long requests decode out to the window; short ones stop early
+    assert all(len(r["prompt"]) + r["max_new_tokens"] >= 50
+               for r in longs)
+    assert all(r["max_new_tokens"] <= 8 for r in shorts)
+
+
+def test_bench_paged_kernel_cpu_leg_shape():
+    """The op-level kernel leg must run standalone on CPU: parity at
+    fp32-softmax tolerance, live pages counted from the mixed lens, no
+    wall-clock claim without a compiled kernel."""
+    from bench_serve import bench_paged_kernel
+    out = bench_paged_kernel(on_tpu=False, seed=3)
+    assert out["parity_max_abs"] < 1e-4
+    assert out["kernel_mode"] == "interpret"
+    assert out["pages_live"] < out["pages_window"]
+    assert 0 < out["work_reduction"] < 1
+    assert "kernel_speedup" not in out
+    # work accounting agrees with the shared pages helper
+    import numpy as np
+    from ray_tpu.ops import paged_work_pages
+    lens = np.asarray(out["lens"], np.int64)
+    assert out["pages_live"] == \
+        int(paged_work_pages(lens, out["block_size"]).sum())

@@ -270,6 +270,48 @@ def test_serve_fleet_rows_bootstrap_skip_vs_prefleet_baseline():
                                                                msgs)
 
 
+def _kernel_record(work_red=0.6, speedup=None, share=0.3, **kw):
+    rec = _fleet_record(**kw)
+    rec["detail"]["mixed_len"] = {"work_reduction": work_red,
+                                  "decode_block_work_frac":
+                                      round(1 - work_red, 4)}
+    rec["detail"]["paged_kernel"] = {"parity_max_abs": 1e-7,
+                                     "work_reduction": 0.5}
+    if speedup is not None:
+        rec["detail"]["paged_kernel"]["kernel_speedup"] = speedup
+    rec["detail"]["scale_up"] = {"scaled_up": True,
+                                 "new_replica_share": share,
+                                 "ttft_recovery": 0.9}
+    return rec
+
+
+def test_serve_paged_kernel_rows_extracted():
+    m = extract_serve_metrics(_kernel_record(speedup=2.5))
+    assert m["serve/mixed_len_work_reduction"] == 0.6
+    assert m["serve/paged_kernel_speedup"] == 2.5
+    assert m["serve/scaleup_new_replica_share"] == 0.3
+    # CPU records (interpret-mode kernel) carry no speedup row at all
+    m = extract_serve_metrics(_kernel_record())
+    assert "serve/paged_kernel_speedup" not in m
+
+
+def test_serve_paged_rows_bootstrap_skip_and_regress():
+    """New rows skip against a pre-kernel baseline (r02 shape) but gate
+    once both records carry them."""
+    ok, msgs = compare(_kernel_record(), _fleet_record(), metric="serve")
+    assert ok
+    for row in ("mixed_len_work_reduction", "scaleup_new_replica_share"):
+        assert any(row in m and "skipped" in m for m in msgs), row
+    base = _kernel_record(work_red=0.6)
+    ok, _ = compare(_kernel_record(work_red=0.55), base, metric="serve")
+    assert ok                      # -8% inside the 15% tolerance
+    ok, msgs = compare(_kernel_record(work_red=0.3), base,
+                       metric="serve")
+    assert not ok                  # losing half the skipping FAILS
+    assert any("mixed_len_work_reduction" in m and "FAIL" in m
+               for m in msgs)
+
+
 def test_checked_in_r02_fleet_acceptance():
     """The acceptance criteria, locked in by the checked-in record:
     prefix hit rate >= 0.5 under the shared system prompt and fleet
@@ -289,6 +331,30 @@ def test_checked_in_r02_fleet_acceptance():
     m = extract_serve_metrics(rec)
     assert m["serve/fleet_tokens_per_s_chip"] == \
         fleet["tokens_per_s_chip"]
+
+
+def test_checked_in_r03_paged_kernel_acceptance():
+    """The PR-15 acceptance criteria, locked by the checked-in record:
+    kernel exact-parity at fp32-softmax tolerance, a real mixed-length
+    work reduction, the autoscaled replica actually serving traffic,
+    and every new row extractable for the gate."""
+    with open(os.path.join(REPO, "SERVE_r03.json")) as f:
+        rec = parse_bench_record(json.load(f))
+    d = rec["detail"]
+    assert d["paged_kernel"]["parity_max_abs"] < 1e-4
+    assert d["paged_kernel"]["pages_live"] < \
+        d["paged_kernel"]["pages_window"]
+    assert d["mixed_len"]["work_reduction"] > 0.3
+    assert d["scale_up"]["scaled_up"] is True
+    assert d["scale_up"]["new_replica_share"] > 0
+    m = extract_serve_metrics(rec)
+    assert m["serve/mixed_len_work_reduction"] == \
+        d["mixed_len"]["work_reduction"]
+    assert m["serve/scaleup_new_replica_share"] == \
+        d["scale_up"]["new_replica_share"]
+    # CPU record: interpret-mode kernel, no wall-clock speedup row
+    if d["backend"] == "cpu":
+        assert "serve/paged_kernel_speedup" not in m
 
 
 def test_serve_baseline_backend_matching(tmp_path):

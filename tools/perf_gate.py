@@ -22,7 +22,10 @@ Three gated series (``--metric``):
   (``detail.fleet``): fleet tokens/s/chip, fleet p99 TTFT (lower is
   better — gated as its inverse 1000/p99_ms), prefix-cache hit rate
   and speculation acceptance; pre-fleet baselines skip those rows
-  (bootstrap). Baselines: ``SERVE_r*.json``; like ``multichip``, an
+  (bootstrap). Paged-kernel-era records additionally gate the
+  mixed-length decode work reduction, the TPU kernel-vs-reference
+  speedup and the autoscaling leg's new-replica traffic share.
+  Baselines: ``SERVE_r*.json``; like ``multichip``, an
   empty/unparseable series bootstrap-passes.
 - ``pipeline`` — the MPMD pipeline headline from ``bench.py
   --pipeline`` (1F1B tokens/s), plus the SPMD-GPipe tokens/s, the
@@ -146,11 +149,22 @@ def extract_serve_metrics(rec: dict) -> dict:
     comparison is higher-is-better), the aggregate prefix-cache hit
     rate and the speculation acceptance rate. Pre-fleet baselines
     (SERVE_r01) carry none of these, so the fleet rows bootstrap-skip
-    against them."""
+    against them.
+
+    Paged-kernel-era records (PR 15) add: the mixed-length decode
+    work reduction (``detail.mixed_len.work_reduction`` — the FLOP
+    fraction length-aware block skipping removes, backend-independent),
+    the compiled kernel-vs-reference speedup (``detail.paged_kernel.
+    kernel_speedup``, TPU records only — interpret-mode CPU wall is
+    interpreter overhead, not kernel cost) and the autoscaling leg's
+    new-replica traffic share (``detail.scale_up.new_replica_share`` —
+    proof the gauge router reaches a mid-run replica). Earlier
+    baselines bootstrap-skip all three."""
     out = {"serve_tokens_per_s_chip": float(rec["value"])}
     vs = rec.get("vs_serial")
     out["serve_vs_serial"] = float(vs) if vs is not None else None
-    fleet = (rec.get("detail") or {}).get("fleet") or {}
+    detail = rec.get("detail") or {}
+    fleet = detail.get("fleet") or {}
     if isinstance(fleet, dict):
         if fleet.get("tokens_per_s_chip") is not None:
             out["serve/fleet_tokens_per_s_chip"] = \
@@ -165,6 +179,19 @@ def extract_serve_metrics(rec: dict) -> dict:
         if fleet.get("spec_acceptance") is not None:
             out["serve/fleet_spec_acceptance"] = \
                 float(fleet["spec_acceptance"])
+    mixed = detail.get("mixed_len") or {}
+    if isinstance(mixed, dict) and \
+            mixed.get("work_reduction") is not None:
+        out["serve/mixed_len_work_reduction"] = \
+            float(mixed["work_reduction"])
+    pk = detail.get("paged_kernel") or {}
+    if isinstance(pk, dict) and pk.get("kernel_speedup") is not None:
+        out["serve/paged_kernel_speedup"] = float(pk["kernel_speedup"])
+    su = detail.get("scale_up") or {}
+    if isinstance(su, dict) and \
+            su.get("new_replica_share") is not None:
+        out["serve/scaleup_new_replica_share"] = \
+            float(su["new_replica_share"])
     return out
 
 
