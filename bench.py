@@ -1113,11 +1113,202 @@ def _flash_bwd_compare(jax, jnp, seq: int = 4096) -> dict:
     return out
 
 
+# -------------------------------------------------------------- ELASTIC
+# `python bench.py --elastic` measures the ELASTIC metric: an
+# ElasticTrainer driven through the full recovery gauntlet — a seeded
+# stage-actor kill mid-train-step (failure path: snapshot rollback +
+# replay, steps-lost ≤ 1), then a chaos-scheduled maintenance notice
+# that drains the only slice (notice path: live in-memory snapshot →
+# fold pp→spmd, 0 steps lost), then a scale-up regrow back to the
+# pipeline grid — with step-for-step loss-trajectory parity against an
+# uninterrupted SPMD run the whole way. Gated by
+# `tools/perf_gate.py --metric elastic` (ELASTIC_r*.json).
+
+
+class _ElasticStubScheduler:
+    def __init__(self):
+        self.draining = {}
+
+    def set_draining(self, node_id, flag):
+        self.draining[node_id.binary()] = flag
+
+
+class _ElasticStubController:
+    """Clusterless SliceManager backing for the bench: the fake slices
+    are synthetic capacity signals — the real local cluster only hosts
+    the stage actors."""
+
+    def __init__(self):
+        from ray_tpu.core.events import FlightRecorder
+        self.scheduler = _ElasticStubScheduler()
+        self.rescheduled = []
+        self.recorder = FlightRecorder("bench", capacity=4096)
+
+    def call_on_loop(self, fn, timeout=None):
+        return fn()
+
+    def _reschedule_pgs_on_nodes(self, node_bs):
+        self.rescheduled.append(set(node_bs))
+        return 1
+
+    def _maybe_schedule(self, force=False):
+        pass
+
+
+def elastic_main(smoke: bool = False) -> None:
+    import random
+    import threading
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("RAY_TPU_JAX_PLATFORM",
+                          os.environ.get("JAX_PLATFORMS", ""))
+
+    import numpy as np
+
+    import jax
+    import ray_tpu
+    from ray_tpu.autoscaler.node_provider import FakeSliceProvider
+    from ray_tpu.autoscaler.slices import SliceManager, SliceTypeConfig
+    from ray_tpu.core.chaos import ChaosConfig
+    from ray_tpu.parallel.elastic import ElasticTrainer
+    from ray_tpu.parallel.mesh import chip_spec
+    from ray_tpu.parallel.plan import ParallelPlan
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg, batch, seq, M, S, _ = _pipeline_config(on_tpu, smoke)
+    pre_steps, post_steps = (2, 5) if smoke else (3, 20)
+    ids = np.array(jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size))
+    batch_d = {"input_ids": ids,
+               "loss_mask": np.ones((batch, seq), np.float32)}
+
+    # the schedule's delay is past the slice-UP reconcile (which runs
+    # immediately) but well inside phase 1's compile wall, so the
+    # notice fires at the phase-2 update — deterministically
+    rng = random.Random(101)
+    chaos = ChaosConfig(seed=101, maintenance=[
+        {"after_s": 2.0, "slice_index": 0}])
+    os.environ.update(chaos.env())
+
+    ray_tpu.init(num_cpus=8, _num_initial_workers=4)
+    try:
+        ctrl = _ElasticStubController()
+        provider = FakeSliceProvider(provider_config={"max_slices": 1})
+        mgr = SliceManager(
+            ctrl, provider,
+            [SliceTypeConfig("pod", "2x4", {"CPU": 1})],
+            idle_timeout_s=3600.0, drain_deadline_s=1.0)
+        sid = mgr.acquire_slice("pod")
+        host_ids = provider.internal_ids(sid)
+
+        def snap():
+            return {"demand": [], "slice_demand": [],
+                    "busy_nodes": set(host_ids),
+                    "alive_nodes": set(host_ids)}
+
+        mgr.update(snap())
+
+        trainer = ElasticTrainer(
+            ParallelPlan(pp=S, n_microbatches=M), cfg,
+            learning_rate=1e-3, slice_manager=mgr)
+        losses = []
+
+        # --- phase 1: warm steps (step 0 compiles), then a seeded
+        # stage-actor kill landing mid-train-step: failure path
+        for _ in range(pre_steps):
+            losses.append(trainer.step(batch_d).loss)
+        victim = trainer.program.pipeline.stages[
+            rng.randrange(S)]
+        threading.Timer(0.05, lambda: ray_tpu.kill(victim)).start()
+        losses.append(trainer.step(batch_d).loss)  # absorbs the kill
+        kill_reports = list(trainer.recoveries)
+        steps_lost_kill = sum(r.steps_lost for r in kill_reports)
+        kill_recovery_s = sum(r.total_s for r in kill_reports)
+
+        # --- phase 2: provider maintenance notice drains the only
+        # slice -> capacity 0 -> fold pp -> spmd from a live snapshot
+        mgr.update(snap())     # chaos schedule fires, drain -> notice
+        t_notice = time.perf_counter()
+        for _ in range(post_steps):
+            losses.append(trainer.step(batch_d).loss)
+        notice_wall_s = time.perf_counter() - t_notice
+        notice_reports = trainer.recoveries[len(kill_reports):]
+        assert notice_reports, "maintenance notice never consumed"
+        recovery_s = sum(r.total_s for r in notice_reports)
+        steps_lost_notice = sum(r.steps_lost for r in notice_reports)
+        folded_plan = trainer.plan.describe()
+        assert trainer.plan.lowering == "spmd", trainer.plan
+
+        # --- phase 3: capacity comes back -> regrow the grid
+        deadline = time.monotonic() + 30
+        while mgr.slices[sid].state != "RELEASED":
+            assert time.monotonic() < deadline, "drain never released"
+            time.sleep(0.2)
+            mgr.update(snap())     # past drain_deadline_s -> release
+        sid2 = mgr.acquire_slice("pod")
+        assert sid2, "released capacity not re-acquirable"
+        host_ids = provider.internal_ids(sid2)
+        mgr.update(snap())
+        trainer.regrow()
+        regrow_report = trainer.recoveries[-1]
+        assert trainer.plan.pp == S
+        for _ in range(2):
+            losses.append(trainer.step(batch_d).loss)
+
+        # --- parity: the whole trajectory, interruptions and all,
+        # matches an uninterrupted single-program run step for step
+        ref_losses = _train_reference_losses(cfg, batch_d, len(losses))
+        parity_all = max(abs(a - b)
+                         for a, b in zip(losses, ref_losses))
+        parity_post = max(
+            abs(a - b) for a, b in zip(losses[-(post_steps + 2):],
+                                       ref_losses[-(post_steps + 2):]))
+        mgr.shutdown()
+        provider.shutdown()
+        trainer.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+    detail = {
+        "backend": jax.default_backend(),
+        "chip": chip_spec().name,
+        "n_stages": S,
+        "n_microbatches": M,
+        "model_params": cfg.num_params,
+        "steps_total": len(losses),
+        "parity_steps": post_steps,
+        "loss_parity_abs": round(parity_post, 9),
+        "loss_parity_all_abs": round(parity_all, 9),
+        "steps_lost_kill": steps_lost_kill,
+        "steps_lost_notice": steps_lost_notice,
+        "steps_lost_max": max(steps_lost_kill, steps_lost_notice),
+        "kill_recovery_s": round(kill_recovery_s, 4),
+        "notice_recovery_s": round(recovery_s, 4),
+        "notice_window_wall_s": round(notice_wall_s, 4),
+        "regrow_s": round(regrow_report.total_s, 4),
+        "folded_plan": folded_plan,
+        "recoveries": [r.asdict() for r in
+                       (kill_reports + notice_reports
+                        + [regrow_report])],
+    }
+    print(json.dumps({
+        "metric": "elastic_recovery_s",
+        "value": round(recovery_s, 4),
+        "unit": "s",
+        "detail": detail,
+    }))
+
+
 if __name__ == "__main__":
     import sys
     if "--pipeline" in sys.argv:
         pipeline_main(smoke="--smoke" in sys.argv)
     elif "--data" in sys.argv:
         data_main(smoke="--smoke" in sys.argv)
+    elif "--elastic" in sys.argv:
+        elastic_main(smoke="--smoke" in sys.argv)
     else:
         main()

@@ -448,7 +448,9 @@ class GCETPUNodeProvider(NodeProvider):
         body (``upcomingMaintenance``) and self-repair as the
         REPAIRING state — either one means the slice's hosts are about
         to bounce, so the SliceManager drains proactively. Each
-        (slice, notice) pair is reported once."""
+        (slice, notice) pair is reported once; the parsed window
+        fields (:func:`parse_upcoming_maintenance`) ride on the event
+        so a trainer can decide how urgently to quiesce."""
         out: List[dict] = []
         for n in self._list_cluster_nodes():
             nid = n.get("labels", {}).get(LABEL_NODE_ID)
@@ -465,9 +467,35 @@ class GCETPUNodeProvider(NodeProvider):
                 if key in self._maintenance_seen:
                     continue
                 self._maintenance_seen.add(key)
-            out.append({"slice_id": nid, "kind": "maintenance",
-                        "event_id": f"gce-{len(self._maintenance_seen)}"})
+            ev = {"slice_id": nid, "kind": "maintenance",
+                  "event_id": f"gce-{len(self._maintenance_seen)}"}
+            if isinstance(notice, dict):
+                ev.update(parse_upcoming_maintenance(notice))
+            out.append(ev)
         return out
+
+
+def parse_upcoming_maintenance(notice: dict) -> dict:
+    """Flatten a TPU-API ``upcomingMaintenance`` body into the fields
+    the drain path keys on. The API spells these camelCase
+    (``windowStartTime``/``canReschedule``/...); a rename or type drift
+    here would silently disable preemption notices, so the shape is
+    pinned by a recorded-response fixture test. Missing fields are
+    simply omitted — the event stays a valid drain notice either way.
+    """
+    out: dict = {}
+    if notice.get("type") is not None:
+        out["maintenance_type"] = str(notice["type"])
+    if notice.get("maintenanceStatus") is not None:
+        out["maintenance_status"] = str(notice["maintenanceStatus"])
+    if notice.get("canReschedule") is not None:
+        out["can_reschedule"] = bool(notice["canReschedule"])
+    for src, dst in (("windowStartTime", "window_start"),
+                     ("windowEndTime", "window_end"),
+                     ("latestWindowStartTime", "latest_window_start")):
+        if notice.get(src) is not None:
+            out[dst] = str(notice[src])
+    return out
 
 
 def state_resolver(provider_node_label: str = LABEL_NODE_ID):

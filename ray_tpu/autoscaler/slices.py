@@ -109,6 +109,21 @@ class SliceTypeConfig:
         return hosts_for_topology(self.topology)
 
 
+@dataclass(frozen=True)
+class DrainNotice:
+    """One drain notice, delivered to ``on_drain`` callbacks exactly
+    once per slice drain (the DRAINING state guard makes a second
+    notice for the same drain a no-op). ``deadline_s`` is the
+    manager's ``drain_deadline_s`` — the longest a consumer can count
+    on the slice's hosts staying up before the forced release."""
+    slice_id: str
+    reason: str
+    hosts: int
+    type: str
+    deadline_s: float
+    ts: float = field(default_factory=time.monotonic)
+
+
 @dataclass
 class SliceInfo:
     """Tracked lifecycle of one acquired slice."""
@@ -223,9 +238,32 @@ class SliceManager:
         self.drain_deadline_s = drain_deadline_s
         self.slices: Dict[str, SliceInfo] = {}
         self._idle_since: Dict[str, float] = {}
+        self._drain_callbacks: List[Any] = []
         self._recorder = recorder if recorder is not None \
             else getattr(controller, "recorder", None)
         self.adopt_existing()
+
+    # ----------------------------------------------------- drain hook
+    def register_on_drain(self, callback) -> Any:
+        """Register ``callback(notice: DrainNotice)`` to run when a
+        slice flips to DRAINING — fired exactly once per notice (the
+        DRAINING/RELEASED guard in :meth:`drain_slice` dedupes), AFTER
+        the slice's placement groups were re-queued and BEFORE the
+        release, so an elastic trainer can snapshot from the still-live
+        hosts. Callbacks run synchronously on the draining thread;
+        exceptions are logged and swallowed, and a callback that never
+        consumes its notice cannot block the ``drain_deadline_s``
+        release path — release is driven by :meth:`_finish_drains`,
+        not by callback completion. Returns the callback (decorator
+        friendly)."""
+        self._drain_callbacks.append(callback)
+        return callback
+
+    def unregister_on_drain(self, callback) -> None:
+        try:
+            self._drain_callbacks.remove(callback)
+        except ValueError:
+            pass
 
     def adopt_existing(self) -> None:
         """Adopt slices the provider already tracks but this manager
@@ -363,6 +401,15 @@ class SliceManager:
                             "off %s", moved, slice_id)
         except Exception:
             logger.exception("slice drain hook failed for %s", slice_id)
+        notice = DrainNotice(
+            slice_id=slice_id, reason=reason, hosts=info.num_hosts,
+            type=info.type, deadline_s=self.drain_deadline_s)
+        for cb in list(self._drain_callbacks):
+            try:
+                cb(notice)
+            except Exception:
+                logger.exception("on_drain callback failed for %s",
+                                 slice_id)
         self._update_gauges()
 
     def _release(self, slice_id: str) -> None:

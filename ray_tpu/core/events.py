@@ -58,6 +58,19 @@ Event taxonomy (the ``ev`` field):
                    ``dur_s`` = notice-to-release drain time, so the
                    drain window renders as a duration slice on
                    ``/timeline`` (the preemption postmortem)
+``ELASTIC_NOTICE`` elastic trainer consumed a drain notice
+                   (``slice``/``reason``) — recovery begins here
+``ELASTIC_SNAPSHOT`` in-memory state snapshot for recovery completed;
+                   ``dur_s`` = gather wall, ``live`` whether the state
+                   was streamed from the running program (0 steps
+                   lost) or fell back to the last periodic snapshot
+``ELASTIC_RELOWER`` the plan was re-lowered onto the surviving
+                   capacity (``from_plan``/``to_plan``, ``dur_s`` =
+                   teardown + rebuild + reload wall)
+``ELASTIC_RESUME`` training resumed; ``dur_s`` = the full
+                   notice/failure-to-resume recovery window (rendered
+                   as a duration slice — the recovery postmortem) and
+                   ``steps_lost`` = re-executed steps
 =================  =====================================================
 """
 
@@ -86,6 +99,10 @@ STAGE_TICK = "STAGE_TICK"
 SLICE_UP = "SLICE_UP"
 SLICE_DRAIN = "SLICE_DRAIN"
 SLICE_DOWN = "SLICE_DOWN"
+ELASTIC_NOTICE = "ELASTIC_NOTICE"
+ELASTIC_SNAPSHOT = "ELASTIC_SNAPSHOT"
+ELASTIC_RELOWER = "ELASTIC_RELOWER"
+ELASTIC_RESUME = "ELASTIC_RESUME"
 
 #: lifecycle events a task timeline is built from (exporter slice pairs)
 LIFECYCLE = (SUBMITTED, LEASED, DISPATCHED, RUNNING, YIELDED,

@@ -26,6 +26,7 @@ from ray_tpu.parallel import quantization
 __all__ = [
     "mpmd_pipeline",
     "ParallelPlan",
+    "ElasticTrainer",
     "MeshSpec",
     "build_mesh",
     "local_mesh",
@@ -51,4 +52,7 @@ def __getattr__(name):
     if name == "ParallelPlan":
         from ray_tpu.parallel.plan import ParallelPlan
         return ParallelPlan
+    if name == "ElasticTrainer":
+        from ray_tpu.parallel.elastic import ElasticTrainer
+        return ElasticTrainer
     raise AttributeError(name)

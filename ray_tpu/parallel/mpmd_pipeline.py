@@ -807,9 +807,23 @@ class PipelineStage:
 
     def abort(self) -> None:
         """Unblock any pending mailbox take with a typed error (driver
-        cleanup after a neighbor stage died)."""
+        cleanup after a neighbor stage died) AND drain every queued
+        mailbox item. Mailbox keys are ``(chunk, microbatch)`` and
+        repeat every step, so an item stranded by an aborted step would
+        otherwise be silently consumed by the NEXT step's matching op —
+        stale activations in, and the op that should have produced them
+        starving into the mailbox deadline. Draining here makes an
+        aborted stage immediately reusable."""
         with self._cond:
             self._abort = True
+            self._acts.clear()
+            self._grads_in.clear()
+            self._targets.clear()
+            self._vjps.clear()
+            self._inputs.clear()
+            self._grads = {}
+            self._red_cache = None
+            self._mbx_report_locked()
             self._cond.notify_all()
 
     def _take(self, box: Dict, key):
@@ -1100,6 +1114,52 @@ class PipelineStage:
                 self.opt_state = self._place_params(opt)
         self._step_count = int(part.get("step", 0))
 
+    def stream_checkpoint(self):
+        """:meth:`stage_checkpoint` as a stream: one block per param
+        chunk, then one meta block carrying the (canonicalized) opt
+        state and step count. Each block is its own stream item —
+        exactly-once over the reliable layer — so the driver can
+        forward a chunk's ref to its new owner while later chunks are
+        still being host-copied, and the bytes move worker-to-worker
+        (:meth:`load_state_blocks`) instead of round-tripping through
+        the driver."""
+        import numpy as np
+
+        import jax
+
+        host = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
+        chunks: Dict[int, Any] = {}
+        for c in self.chunks:
+            chunks[c] = host(self.params[c])
+            yield {"block": "params", "stage": self.stage, "chunk": c,
+                   "params": chunks[c]}
+        opt = None
+        if self.opt_state is not None:
+            opt = host(self.opt_state)
+            if self._opt_flat:
+                from ray_tpu.parallel.sharding import unflatten_like
+                opt = _map_param_subtrees(
+                    opt, jax.tree.structure(chunks),
+                    lambda sub: unflatten_like(chunks, sub))
+        yield {"block": "meta", "stage": self.stage,
+               "n_stages": self.n_stages, "n_virtual": self.n_virtual,
+               "opt_state": opt, "step": self._step_count}
+
+    def load_state_blocks(self, *blocks) -> None:
+        """Assemble a stage part from :meth:`stream_checkpoint` blocks
+        and load it. The blocks arrive as actor-call object args, so
+        when the driver passes the REFS a peer stage streamed, the
+        payload is pulled worker-to-worker — the driver never
+        materializes the bytes (the elastic same-grid reload path)."""
+        part: Dict[str, Any] = {"params": {}}
+        for b in blocks:
+            if b.get("block") == "params":
+                part["params"][int(b["chunk"])] = b["params"]
+            else:
+                part["opt_state"] = b.get("opt_state")
+                part["step"] = b.get("step", 0)
+        self.load_state(part)
+
     # ------------------------------------- serial (unpipelined) path
     def forward_one(self, chunk: int, i: int, x, input_ids=None,
                     loss_mask=None):
@@ -1133,6 +1193,8 @@ class PipelineStage:
     def reset_step(self) -> None:
         """Serial-path step reset (the streaming ``run`` resets
         itself)."""
+        with self._cond:
+            self._abort = False
         self._vjps.clear()
         self._inputs.clear()
         self._grads = {}
@@ -1549,18 +1611,114 @@ class MPMDPipeline:
              for a, p in zip(self.stages, parts)],
             timeout=self.step_timeout_s)
 
+    def stream_checkpoint_refs(self, timeout_s: Optional[float] = None
+                               ) -> List[List[Any]]:
+        """Per-stage block-ref lists from
+        :meth:`PipelineStage.stream_checkpoint`, gathered over the
+        streaming layer with a bounded overall deadline. The refs can
+        be forwarded straight into another pipeline's
+        ``load_state_blocks`` calls (worker-to-worker byte movement) or
+        fetched and merged via :func:`merge_stage_checkpoints`. A stage
+        actor dying mid-stream surfaces the streaming layer's typed
+        error here — never a hang."""
+        from ray_tpu.core import streaming
+
+        timeout_s = timeout_s if timeout_s is not None \
+            else self.step_timeout_s
+        gens = [a.stream_checkpoint.options(
+            num_returns="streaming").remote() for a in self.stages]
+        blocks: List[List[Any]] = [[] for _ in self.stages]
+        by_gen = {id(g): s for s, g in enumerate(gens)}
+        active = list(gens)
+        deadline = time.monotonic() + timeout_s
+        try:
+            while active:
+                ready, _ = streaming.wait_any(
+                    active,
+                    timeout=max(deadline - time.monotonic(), 0.0))
+                if not ready:
+                    raise TimeoutError(
+                        f"checkpoint stream stalled: no stage produced "
+                        f"a block within {timeout_s}s")
+                for g in ready:
+                    try:
+                        ref = g.next_ref(timeout=1.0)
+                    except StopIteration:
+                        active.remove(g)
+                        continue
+                    blocks[by_gen[id(g)]].append(ref)
+        except BaseException:
+            for g in gens:
+                try:
+                    g.close()
+                except Exception:
+                    pass
+            raise
+        return blocks
+
+    def save_checkpoint_streaming(self,
+                                  timeout_s: Optional[float] = None,
+                                  refs: Optional[List[List[Any]]] = None
+                                  ) -> Dict[str, Any]:
+        """The canonical checkpoint via the streaming gather — same
+        result as :meth:`save_checkpoint`, but each stage's state
+        arrives as per-chunk blocks (exactly-once stream items) instead
+        of one monolithic unary return. Pass ``refs`` from an earlier
+        :meth:`stream_checkpoint_refs` call to merge without streaming
+        the stages a second time (the elastic path forwards the same
+        refs peer-to-peer AND keeps a driver-side merged copy)."""
+        import ray_tpu
+
+        timeout_s = timeout_s if timeout_s is not None \
+            else self.step_timeout_s
+        if refs is None:
+            refs = self.stream_checkpoint_refs(timeout_s)
+        parts = []
+        for stage_refs in refs:
+            items = ray_tpu.get(stage_refs, timeout=timeout_s)
+            part: Dict[str, Any] = {"chunks": {}}
+            for b in items:
+                if b.get("block") == "params":
+                    part["chunks"][int(b["chunk"])] = b["params"]
+                else:
+                    part.update(
+                        stage=b["stage"], n_stages=b["n_stages"],
+                        n_virtual=b["n_virtual"],
+                        opt_state=b.get("opt_state"),
+                        step=b.get("step", 0))
+            parts.append(part)
+        return merge_stage_checkpoints(self.config, parts)
+
     # -------------------------------------------------------- cleanup
+    def abort(self) -> None:
+        """Quiesce every stage: unblock pending mailbox takes with a
+        typed error and drain queued items, waiting (bounded) for the
+        acks — the elastic re-plan entry point. After this the stages
+        are idle and immediately reusable; nothing is left to trip the
+        mailbox take-deadline."""
+        self._cleanup([])
+
     def _cleanup(self, gens) -> None:
-        """Failure path: unblock every stage, then drop all stream
-        state — typed error out, no hang, no leaked stream refs."""
+        """Failure path: unblock + drain every stage mailbox, then
+        drop all stream state — typed error out, no hang, no leaked
+        stream refs. The abort acks are awaited (bounded, dead actors
+        skipped) so a fire-and-forget abort cannot land inside the
+        NEXT step's freshly-started ``run`` and kill it spuriously."""
+        import ray_tpu
+        refs = []
         for a in self.stages:
             try:
-                a.abort.remote()
+                refs.append(a.abort.remote())
             except Exception:
                 pass
         for g in gens:
             try:
                 g.close()
+            except Exception:
+                pass
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=5.0)
             except Exception:
                 pass
 

@@ -162,3 +162,67 @@ def test_wait_operation_error_includes_metadata():
     assert "quota exceeded" in msg
     assert "target=nodes/ray-x" in msg
     assert "verb=create" in msg
+
+
+# ------------------------------------------------- upcomingMaintenance
+# Field-shape pin against a recorded real-API response: the TPU v2 API
+# spells the maintenance window camelCase on the node body, and a silent
+# rename would disable preemption notices without failing anything else.
+
+def _fixture_nodes():
+    import pathlib
+    p = (pathlib.Path(__file__).parent / "fixtures" /
+         "gce_upcoming_maintenance.json")
+    return json.loads(p.read_text())
+
+
+def _fixture_provider():
+    from ray_tpu.autoscaler.gce import GCETPUNodeProvider
+    body = _fixture_nodes()
+
+    def request_fn(method, url, payload):
+        assert method == "GET" and url.endswith("/nodes")
+        return body
+
+    api = TPUApiClient("my-project", "us-central2-b",
+                       request_fn=request_fn)
+    return GCETPUNodeProvider(
+        {"project": "my-project", "zone": "us-central2-b",
+         "cluster_name": "testclus", "list_cache_ttl_s": 0.0},
+        api=api)
+
+
+def test_upcoming_maintenance_fixture_shape():
+    """The recorded response still carries every field the parser
+    keys on, and the parser maps them through."""
+    notice = _fixture_nodes()["nodes"][0]["upcomingMaintenance"]
+    from ray_tpu.autoscaler.gce import parse_upcoming_maintenance
+    parsed = parse_upcoming_maintenance(notice)
+    assert parsed["maintenance_type"] == "SCHEDULED"
+    assert parsed["maintenance_status"] == "PENDING"
+    assert parsed["can_reschedule"] is True
+    assert parsed["window_start"] == "2026-08-18T03:00:00.000000Z"
+    assert parsed["window_end"] == "2026-08-18T07:00:00.000000Z"
+    assert parsed["latest_window_start"] == \
+        "2026-08-18T03:00:00.000000Z"
+
+
+def test_maintenance_events_carry_window_fields():
+    provider = _fixture_provider()
+    events = provider.maintenance_events()
+    assert len(events) == 1  # only the slice with the notice
+    ev = events[0]
+    assert ev["slice_id"] == "raytpu-testclus-v5e16-0001"
+    assert ev["kind"] == "maintenance"
+    assert ev["maintenance_type"] == "SCHEDULED"
+    assert ev["maintenance_status"] == "PENDING"
+    assert ev["window_start"].startswith("2026-08-18T03")
+    # one-shot: the same notice is not re-reported
+    assert provider.maintenance_events() == []
+
+
+def test_parse_upcoming_maintenance_tolerates_missing_fields():
+    from ray_tpu.autoscaler.gce import parse_upcoming_maintenance
+    assert parse_upcoming_maintenance({}) == {}
+    assert parse_upcoming_maintenance(
+        {"type": "UNSCHEDULED"}) == {"maintenance_type": "UNSCHEDULED"}

@@ -12,8 +12,9 @@ import pytest
 from ray_tpu.autoscaler.node_provider import (
     FakeSliceProvider, SliceCapacityError)
 from ray_tpu.autoscaler.slices import (
-    DRAINING, RELEASED, REQUESTED, UP, SliceInfo, SliceManager,
-    SliceTypeConfig, hosts_for_topology, plan_slice_scaling)
+    DRAINING, RELEASED, REQUESTED, UP, DrainNotice, SliceInfo,
+    SliceManager, SliceTypeConfig, hosts_for_topology,
+    plan_slice_scaling)
 from ray_tpu.core.events import FlightRecorder
 from ray_tpu.core.ids import NodeID, PlacementGroupID
 from ray_tpu.core.scheduler import (
@@ -469,3 +470,110 @@ def test_autoscaler_monitor_backs_off_on_failures_and_stops_promptly():
     t0 = _time.monotonic()
     slow.stop()
     assert _time.monotonic() - t0 < 2.0
+
+
+# -------------------------------------------------- on_drain callbacks
+def test_on_drain_callback_fires_between_reschedule_and_release():
+    """notice → callback → release ordering: the callback observes the
+    slice DRAINING with its gangs already re-queued (SLICE_DRAIN
+    recorded, SLICE_DOWN not yet), and carries the typed notice."""
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "4x4", {"CPU": 1})],
+        drain_deadline_s=0.0)
+    seen = []
+
+    @mgr.register_on_drain
+    def on_drain(notice):
+        evs = [e["ev"] for e in _events(ctrl)]
+        seen.append({
+            "notice": notice,
+            "state": mgr.slices[notice.slice_id].state,
+            "rescheduled": list(ctrl.rescheduled),
+            "drain_recorded": "SLICE_DRAIN" in evs,
+            "released": "SLICE_DOWN" in evs,
+        })
+
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    p.inject_maintenance(sid)
+    mgr.update(_snap(alive=ids, busy=ids[:1]))
+    assert mgr.slices[sid].state == RELEASED
+    assert len(seen) == 1
+    s = seen[0]
+    n = s["notice"]
+    assert isinstance(n, DrainNotice)
+    assert n.slice_id == sid and n.reason == "maintenance"
+    assert n.hosts == 4 and n.type == "pod"
+    assert n.deadline_s == 0.0
+    # ordering: gangs re-queued and DRAINING visible at callback time,
+    # release strictly after
+    assert s["state"] == DRAINING
+    assert s["rescheduled"] == [set(ids)]
+    assert s["drain_recorded"] and not s["released"]
+    assert "SLICE_DOWN" in [e["ev"] for e in _events(ctrl)]
+
+
+def test_on_drain_callback_never_blocks_deadline_release():
+    """A raising (or never-consuming) callback must not stall the
+    drain_deadline_s release path — release is driven by
+    _finish_drains, not by callback completion."""
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "4x4", {"CPU": 1})],
+        drain_deadline_s=0.0)
+    calls = []
+
+    def bad(notice):
+        calls.append(notice)
+        raise RuntimeError("trainer busy")
+
+    mgr.register_on_drain(bad)
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    p.inject_maintenance(sid)
+    mgr.update(_snap(alive=ids, busy=ids))  # all hosts busy
+    assert calls  # callback ran (and raised)
+    assert mgr.slices[sid].state == RELEASED
+    assert sid not in p.non_terminated_nodes()
+
+
+def test_on_drain_callback_one_shot_per_notice():
+    """A second drain of an already-DRAINING slice is a no-op: the
+    DRAINING state guard makes the notice one-shot."""
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "4x4", {"CPU": 1})],
+        drain_deadline_s=3600.0)
+    notices = []
+    mgr.register_on_drain(notices.append)
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    mgr.drain_slice(sid, "maintenance")
+    assert mgr.slices[sid].state == DRAINING  # busy -> holds to deadline
+    mgr.drain_slice(sid, "maintenance")   # duplicate notice
+    mgr.drain_slice(sid, "host-death")    # different reason, same drain
+    assert len(notices) == 1
+
+
+def test_on_drain_unregister_stops_delivery():
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "4x4", {"CPU": 1})],
+        drain_deadline_s=0.0)
+    notices = []
+    cb = mgr.register_on_drain(notices.append)
+    mgr.unregister_on_drain(cb)
+    mgr.unregister_on_drain(cb)  # second unregister is a no-op
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    mgr.drain_slice(sid, "maintenance")
+    assert notices == []

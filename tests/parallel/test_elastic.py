@@ -1,0 +1,430 @@
+"""ElasticTrainer: drain → re-lower → resume.
+
+Fast clusterless units first (fold ladder, mailbox drain on abort,
+typed snapshot failures, failure-replay and notice-fold trajectory
+parity on the SPMD lowering), then the live-cluster peer-to-peer
+reload path and the seeded maintenance soak that tools/chaos_matrix.sh
+drives (slow + chaos)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.parallel.elastic import (ElasticRecoveryError,
+                                      ElasticSnapshotError,
+                                      ElasticTrainer, fold_plan)
+from ray_tpu.parallel.plan import ParallelPlan
+
+pytestmark = pytest.mark.elastic
+
+
+def tiny_config(**kw):
+    import jax.numpy as jnp
+    base = dict(vocab_size=128, d_model=32, n_layers=4, n_heads=2,
+                head_dim=16, d_ff=64, max_seq_len=32, rotary_dim=8,
+                block_style="gptj", dtype=jnp.float32, remat=False,
+                ce_chunk_size=8)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _batch(cfg, b=8, s=16, seed=1):
+    ids = np.array(jax.random.randint(jax.random.PRNGKey(seed), (b, s),
+                                      0, cfg.vocab_size))
+    return {"input_ids": ids, "loss_mask": np.ones((b, s), np.float32)}
+
+
+class _Boom(RayTpuError):
+    """Stand-in for a typed runtime failure (actor death etc.)."""
+
+
+# ------------------------------------------------------- fold ladder
+def test_fold_plan_ladder():
+    """dp halves first, then pp folds chunk-count-preserving
+    (pp/2 × 2v), then collapses to SPMD, then fsdp, then None."""
+    p = ParallelPlan(pp=2, dp=4, n_microbatches=2)
+    p = fold_plan(p)
+    assert (p.dp, p.pp) == (2, 2)
+    p = fold_plan(p)
+    assert (p.dp, p.pp) == (1, 2)
+    p4 = fold_plan(ParallelPlan(pp=4, virtual=2, n_microbatches=2))
+    assert (p4.pp, p4.virtual) == (2, 4)  # chunk count preserved
+    p1 = fold_plan(ParallelPlan(pp=2, virtual=4, n_microbatches=2))
+    assert (p1.pp, p1.virtual) == (1, 1) and p1.lowering == "spmd"
+    pf = fold_plan(ParallelPlan(fsdp=2))
+    assert pf.fsdp == 1
+    assert fold_plan(ParallelPlan()) is None
+
+
+# ------------------------------------------- stage abort drains boxes
+def test_stage_abort_drains_mailboxes_and_stage_is_reusable():
+    """Mailbox keys repeat every step, so an item stranded by an
+    aborted step must NOT be consumed by the next step's matching op:
+    abort drains the queues (the fresh run starves typed at the
+    deadline instead of computing on stale activations), and a re-fed
+    stage runs normally."""
+    from ray_tpu.parallel.mpmd_pipeline import PipelineStage
+    cfg = tiny_config(n_layers=2)
+    st = PipelineStage(cfg, 0, 2, mailbox_deadline_s=0.3)
+    x = np.asarray(_batch(cfg, b=2)["input_ids"])
+    st.put_activation(0, 0, x)
+    st.abort()
+    assert st._acts == {} and st._grads_in == {} and st._targets == {}
+    # the stale (chunk=0, mb=0) item is gone: a new step starves typed
+    with pytest.raises(TimeoutError,
+                       match="pipeline_mailbox_deadline_s"):
+        next(st.run(1))
+    # and the stage is immediately reusable once fed fresh input
+    st.reset_step()
+    st.put_activation(0, 0, x)
+    out = next(st.run(1))
+    assert out is not None
+
+
+# -------------------------------------------------- typed snapshot
+def test_snapshot_failure_is_typed_not_a_hang():
+    """A stage actor dying mid-stage_checkpoint must surface as
+    ElasticSnapshotError at the trainer (cause chained), never a
+    hang."""
+    cfg = tiny_config()
+    t = ElasticTrainer(ParallelPlan(), cfg, learning_rate=1e-3,
+                       telemetry_interval_s=0)
+    try:
+
+        def die():
+            raise _Boom("stage actor died mid-checkpoint")
+
+        t.program.save_checkpoint = die
+        with pytest.raises(ElasticSnapshotError) as ei:
+            t.snapshot()
+        assert isinstance(ei.value.__cause__, _Boom)
+        assert isinstance(ei.value, RayTpuError)  # typed, catchable
+    finally:
+        del t.program.save_checkpoint
+        t.shutdown()
+
+
+# ------------------------------------------------ failure-path replay
+def test_failure_recovery_replays_exact_trajectory():
+    """A typed mid-step failure rolls back to the last in-memory
+    snapshot, rebuilds, replays — losing exactly 1 step (the in-flight
+    attempt, snapshot_interval=1) and continuing the uninterrupted
+    trajectory step for step."""
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    t = ElasticTrainer(ParallelPlan(), cfg, learning_rate=1e-3,
+                       telemetry_interval_s=0)
+    ref = ParallelPlan().build(cfg, learning_rate=1e-3,
+                               telemetry_interval_s=0)
+    try:
+        for _ in range(2):
+            a, b = t.step(batch), ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-6
+        broken = t.program
+
+        def boom(_):
+            raise _Boom("slice preempted mid-step")
+
+        broken.step = boom
+        a, b = t.step(batch), ref.step(batch)   # recovers in-line
+        assert abs(a.loss - b.loss) <= 1e-6
+        assert t.program is not broken
+        assert len(t.recoveries) == 1
+        rep = t.recoveries[0]
+        assert rep.trigger == "failure" and rep.steps_lost == 1
+        assert rep.from_plan == rep.to_plan  # no capacity signal: same grid
+        for _ in range(3):
+            a, b = t.step(batch), ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-6
+        assert t.steps_lost_total == 1
+    finally:
+        t.shutdown()
+        ref.shutdown()
+
+
+def test_unrecoverable_error_propagates_untouched():
+    cfg = tiny_config()
+    t = ElasticTrainer(ParallelPlan(), cfg, learning_rate=1e-3,
+                       telemetry_interval_s=0)
+    try:
+
+        def bad(_):
+            raise ValueError("malformed batch")
+
+        t.program.step = bad
+        with pytest.raises(ValueError, match="malformed batch"):
+            t.step(_batch(tiny_config()))
+        assert t.recoveries == []
+    finally:
+        t.shutdown()
+
+
+def test_recovery_budget_exhaustion_is_typed():
+    cfg = tiny_config()
+    t = ElasticTrainer(ParallelPlan(), cfg, learning_rate=1e-3,
+                       telemetry_interval_s=0, max_recoveries=2)
+    try:
+        calls = {"n": 0}
+        real_build = t._build
+
+        def poisoned_build(plan):
+            prog = real_build(plan)
+
+            def boom(_):
+                calls["n"] += 1
+                raise _Boom("still dying")
+
+            prog.step = boom
+            return prog
+
+        t.program.step = lambda _: (_ for _ in ()).throw(
+            _Boom("first death"))
+        t._build = poisoned_build
+        with pytest.raises(ElasticRecoveryError):
+            t.step(_batch(cfg))
+        assert calls["n"] == 2  # retried exactly max_recoveries times
+    finally:
+        t._build = real_build
+        t.shutdown()
+
+
+# --------------------------------------------------- notice-path fold
+def test_drain_notice_folds_dp_and_continues_trajectory():
+    """A maintenance notice with no surviving capacity folds dp=2 →
+    dp=1 live: 0 steps lost, exact trajectory continuation (dp is
+    replication — the math is identical)."""
+    from ray_tpu.autoscaler.slices import DrainNotice
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    t = ElasticTrainer(ParallelPlan(dp=2), cfg, learning_rate=1e-3,
+                       telemetry_interval_s=0)
+    ref = ParallelPlan(dp=2).build(cfg, learning_rate=1e-3,
+                                   telemetry_interval_s=0)
+    try:
+        for _ in range(2):
+            a, b = t.step(batch), ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-6
+        t._on_drain(DrainNotice(
+            slice_id="slice-0", reason="maintenance", hosts=4,
+            type="pod", deadline_s=4.0))
+        for i in range(4):
+            a, b = t.step(batch), ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-5, f"step {i}"
+        assert t.plan.dp == 1 and t.target_plan.dp == 2
+        assert len(t.recoveries) == 1
+        rep = t.recoveries[0]
+        assert rep.trigger == "notice" and rep.steps_lost == 0
+        assert "slice-0" in rep.reason
+    finally:
+        t.shutdown()
+        ref.shutdown()
+
+
+# --------------------------------------- live cluster: p2p + regrow
+@pytest.mark.slow
+@pytest.mark.pipeline
+def test_pipeline_same_grid_relower_streams_peer_to_peer(
+        ray_start_regular):
+    """Same-grid re-lower (capacity survived): stage state moves as
+    streamed block refs from old stage actors straight into the new
+    gang — trajectory continues exactly, ELASTIC_* events land in the
+    flight recorder."""
+    from ray_tpu.util.state import list_task_events
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    t = ElasticTrainer(ParallelPlan(pp=2, n_microbatches=2), cfg,
+                       learning_rate=1e-3)
+    ref = ParallelPlan().build(cfg, learning_rate=1e-3,
+                               telemetry_interval_s=0)
+    try:
+        for _ in range(2):
+            a, b = t.step(batch), ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-5
+        old_pipe = t.program.pipeline
+        t._relower(t.plan, trigger="notice", reason="test-p2p",
+                   live=True)
+        assert t.program.pipeline is not old_pipe
+        rep = t.recoveries[-1]
+        assert rep.steps_lost == 0 and rep.live_snapshot
+        for _ in range(3):
+            a, b = t.step(batch), ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-5
+        evs = [e["ev"] for e in list_task_events(limit=100_000)]
+        for name in ("ELASTIC_SNAPSHOT", "ELASTIC_RELOWER",
+                     "ELASTIC_RESUME"):
+            assert name in evs, (name, set(evs))
+        resume = [e for e in list_task_events(
+            filters=[("ev", "=", "ELASTIC_RESUME")])][-1]
+        assert resume["dur_s"] > 0 and resume["steps_lost"] == 0
+    finally:
+        t.shutdown()
+        ref.shutdown()
+
+
+# ------------------------------------------------- chaos soak (leg)
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_maintenance_soak():
+    """tools/chaos_matrix.sh elastic leg: a seeded stage-actor kill
+    lands mid-train-step AND a chaos-scheduled maintenance notice
+    drains the slice — the trainer recovers from both (typed errors
+    only, no hangs), folds pp=2 → spmd when capacity hits zero, and
+    the post-recovery trajectory tracks the uninterrupted run. No
+    stage actors or provider slices leak."""
+    seeds = [int(s) for s in os.environ.get(
+        "RAY_TPU_CHAOS_SOAK_SEEDS", "6606").split()]
+    for seed in seeds:
+        _run_elastic_soak(seed)
+
+
+class _StubScheduler:
+    def __init__(self):
+        self.draining = {}
+
+    def set_draining(self, node_id, flag):
+        self.draining[node_id.binary()] = flag
+
+
+class _StubController:
+    """Clusterless SliceManager backing: the fake slices are synthetic
+    (the real cluster only hosts the stage actors)."""
+
+    def __init__(self):
+        from ray_tpu.core.events import FlightRecorder
+        self.scheduler = _StubScheduler()
+        self.rescheduled = []
+        self.recorder = FlightRecorder("test", capacity=4096)
+
+    def call_on_loop(self, fn, timeout=None):
+        return fn()
+
+    def _reschedule_pgs_on_nodes(self, node_bs):
+        self.rescheduled.append(set(node_bs))
+        return 1
+
+    def _maybe_schedule(self, force=False):
+        pass
+
+
+def _run_elastic_soak(seed: int) -> None:
+    import random
+
+    import ray_tpu
+    from ray_tpu.autoscaler.node_provider import FakeSliceProvider
+    from ray_tpu.autoscaler.slices import (SliceManager,
+                                           SliceTypeConfig)
+    from ray_tpu.core.chaos import ChaosConfig
+
+    rng = random.Random(f"{seed}:elastic-soak")
+    notice_after = 2.0 + rng.random() * 2.0
+    kill_at_step = rng.randint(1, 3)
+    chaos = ChaosConfig(seed=seed, maintenance=[
+        {"after_s": notice_after, "slice_index": 0}])
+    env_before = {k: os.environ.get(k) for k in chaos.env()}
+    os.environ.update(chaos.env())
+    ray_tpu.init(num_cpus=8, _num_initial_workers=4,
+                 ignore_reinit_error=True)
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    ctrl = _StubController()
+    provider = FakeSliceProvider(provider_config={"max_slices": 1})
+    mgr = SliceManager(
+        ctrl, provider, [SliceTypeConfig("pod", "2x4", {"CPU": 1})],
+        idle_timeout_s=3600.0, drain_deadline_s=1.0)
+    trainer = None
+    try:
+        sid = mgr.acquire_slice("pod")
+        ids = provider.internal_ids(sid)
+
+        def snap(busy=()):
+            return {"demand": [], "slice_demand": [],
+                    "busy_nodes": set(busy), "alive_nodes": set(ids)}
+
+        mgr.update(snap())
+        assert mgr.slices[sid].state == "UP"
+        trainer = ElasticTrainer(
+            ParallelPlan(pp=2, n_microbatches=2), cfg,
+            learning_rate=1e-3, slice_manager=mgr)
+        ref = ParallelPlan().build(cfg, learning_rate=1e-3,
+                                   telemetry_interval_s=0)
+        deadline = time.monotonic() + 300
+        killed = False
+        for step in range(12):
+            assert time.monotonic() < deadline, \
+                f"seed {seed}: hang at step {step}"
+            # pump the manager: chaos maintenance -> drain -> notice
+            mgr.update(snap(busy=ids))
+            if step == kill_at_step and not killed:
+                killed = True
+                pipe = getattr(trainer.program, "pipeline", None)
+                if pipe is not None:
+                    victim = pipe.stages[rng.randrange(
+                        len(pipe.stages))]
+                    threading.Timer(
+                        0.05, lambda: ray_tpu.kill(victim)).start()
+            a = trainer.step(batch)      # absorbs typed failures
+            b = ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-5, \
+                f"seed {seed}: trajectory diverged at step {step}: " \
+                f"{a.loss} vs {b.loss}"
+        # the scheduled notice has long fired: capacity went to zero
+        # and the plan folded off the pipeline
+        assert mgr.slices[sid].state == "RELEASED", \
+            f"seed {seed}: slice never drained"
+        assert trainer.plan.lowering == "spmd", \
+            f"seed {seed}: plan never folded: {trainer.plan}"
+        assert trainer.recoveries, f"seed {seed}: no recovery ran"
+        assert trainer.steps_lost_total <= 2  # kill ≤1 + notice 0 (+1 slack)
+        assert provider.non_terminated_nodes() == [], \
+            f"seed {seed}: slices leaked"
+        ref.shutdown()
+        trainer.shutdown()
+        trainer = None
+        # no leaked stage actors on the real cluster
+        from ray_tpu.util.state import list_actors
+        alive = [a for a in list_actors(
+            filters=[("state", "=", "ALIVE")])
+            if "PipelineStage" in str(a)]
+        assert alive == [], f"seed {seed}: leaked stage actors {alive}"
+    except Exception:
+        _dump_postmortem(seed)
+        raise
+    finally:
+        try:
+            if trainer is not None:
+                trainer.shutdown()
+            mgr.shutdown()
+            provider.shutdown()
+        finally:
+            ray_tpu.shutdown()
+            for k, v in env_before.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+
+def _dump_postmortem(seed) -> None:
+    path = os.environ.get("RAY_TPU_CHAOS_POSTMORTEM_FILE")
+    if not path:
+        return
+    try:
+        from ray_tpu.util.state import list_task_events
+        events = list_task_events(limit=100_000)
+        with open(path, "w") as f:
+            json.dump({"seed": seed, "events": events}, f)
+    except Exception as e:
+        try:
+            with open(path, "w") as f:
+                json.dump({"seed": seed, "events": [],
+                           "error": f"postmortem dump failed: {e}"}, f)
+        except Exception:
+            pass

@@ -282,3 +282,80 @@ def test_checkpoint_round_trip_across_lowerings():
         lf, _ = _inprocess_train_step(fresh_stages, batch, S, v, M)
         assert abs(l3 - ls) <= 1e-5
         assert abs(l3 - lf) <= 1e-5
+
+
+# ------------------------- re-slicing edge cases the elastic path leans on
+def test_dp_shrink_reslices_uneven_flat_opt_shards():
+    """dp=2 → dp=1 shrink under shard_weight_update: the flat 1/N
+    optimizer shards carry per-leaf zero padding (flat_pad_len) that
+    is NOT a multiple-free round trip — the canonical checkpoint must
+    drop it exactly, and the re-sliced dp=1 program must continue the
+    trajectory."""
+    from ray_tpu.parallel.mpmd_pipeline import (
+        merge_stage_checkpoints, split_train_state)
+    from ray_tpu.parallel.sharding import flat_pad_len
+
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    S, v, M = 2, 1, 2
+    wide = _make_stages(cfg, S, v, dp=2, shard_weight_update=True)
+    # the padding is genuinely uneven for this config: at least one
+    # leaf's flat shard is zero-padded
+    st0 = wide[0]
+    pads = [flat_pad_len(np.asarray(x).size, st0.n_model,
+                         st0.quant_block_size) - np.asarray(x).size
+            for x in jax.tree.leaves(st0.params)]
+    assert any(p > 0 for p in pads)
+    for _ in range(2):
+        _inprocess_train_step(wide, batch, S, v, M)
+
+    ck = merge_stage_checkpoints(
+        cfg, [st.stage_checkpoint() for st in wide])
+    narrow = _make_stages(cfg, S, v, dp=1, shard_weight_update=False)
+    for st, part in zip(narrow, split_train_state(cfg, ck, S, v)):
+        st.load_state(part)
+    # exact value + treedef parity through the pad/unpad round trip
+    ck1 = merge_stage_checkpoints(
+        cfg, [st.stage_checkpoint() for st in narrow])
+    assert ck1["step"] == ck["step"] == 2
+    assert jax.tree.structure(ck1) == jax.tree.structure(ck)
+    for a, b in zip(jax.tree.leaves(ck1), jax.tree.leaves(ck)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the shrunk program continues the same trajectory
+    for _ in range(2):
+        lw, _ = _inprocess_train_step(wide, batch, S, v, M)
+        ln, _ = _inprocess_train_step(narrow, batch, S, v, M)
+        assert abs(lw - ln) <= 1e-5
+
+
+def test_virtual_fold_to_v1_under_int8_grad_transport():
+    """v=2 → v=1 fold (the elastic ladder's pp/2 × 2v inverse) with
+    int8 grad transport live on the dp mesh: the canonical checkpoint
+    re-slices to the coarser chunking with exact value + treedef
+    parity, and both chunkings continue the same int8 trajectory (the
+    quantization grid is per-leaf, not per-chunk)."""
+    from ray_tpu.parallel.mpmd_pipeline import (
+        merge_stage_checkpoints, split_train_state)
+
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    S, M = 2, 2
+    fine = _make_stages(cfg, S, 2, dp=2, grad_transport="int8")
+    for _ in range(2):
+        _inprocess_train_step(fine, batch, S, 2, M)
+
+    ck = merge_stage_checkpoints(
+        cfg, [st.stage_checkpoint() for st in fine])
+    folded = _make_stages(cfg, S, 1, dp=2, grad_transport="int8")
+    for st, part in zip(folded, split_train_state(cfg, ck, S, 1)):
+        st.load_state(part)
+    ckf = merge_stage_checkpoints(
+        cfg, [st.stage_checkpoint() for st in folded])
+    assert ckf["step"] == ck["step"] == 2
+    assert jax.tree.structure(ckf) == jax.tree.structure(ck)
+    for a, b in zip(jax.tree.leaves(ckf), jax.tree.leaves(ck)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for _ in range(2):
+        lf, _ = _inprocess_train_step(fine, batch, S, 2, M)
+        lc, _ = _inprocess_train_step(folded, batch, S, 1, M)
+        assert abs(lf - lc) <= 1e-5

@@ -632,3 +632,62 @@ def test_data_gate_bootstrap_and_backend_matching(tmp_path):
         tmp_path, metric="data", prefer_backend="tpu")
     assert path.endswith("DATA_r01.json")
     assert record_backend(rec) == "tpu"
+
+
+# ------------------------------------------------------------- elastic
+def _elastic_record(recovery_s=2.0, steps_lost=1, parity=5e-7,
+                    regrow_s=5.0):
+    return {"metric": "elastic_recovery_s", "value": recovery_s,
+            "unit": "s",
+            "detail": {"backend": "cpu", "steps_lost_max": steps_lost,
+                       "loss_parity_abs": parity,
+                       "regrow_s": regrow_s, "parity_steps": 20}}
+
+
+def test_elastic_extractor_inverts_and_gates_binaries():
+    from tools.perf_gate import extract_elastic_metrics
+    m = extract_elastic_metrics(_elastic_record())
+    assert m["elastic/recovery_inv"] == pytest.approx(0.5)
+    assert m["elastic/regrow_inv"] == pytest.approx(0.2)
+    assert m["elastic/steps_lost_ok"] == 1.0
+    assert m["elastic/parity_ok"] == 1.0
+    # acceptance binaries flip to 0.0 past the thresholds
+    bad = extract_elastic_metrics(
+        _elastic_record(steps_lost=2, parity=1e-3))
+    assert bad["elastic/steps_lost_ok"] == 0.0
+    assert bad["elastic/parity_ok"] == 0.0
+    sparse = extract_elastic_metrics(
+        {"metric": "elastic_recovery_s", "value": 4.0, "detail": {}})
+    assert sparse["elastic/recovery_inv"] == pytest.approx(0.25)
+    assert sparse["elastic/steps_lost_ok"] is None
+    assert sparse["elastic/regrow_inv"] is None
+
+
+def test_elastic_compare_is_relative_and_binaries_are_hard():
+    base = _elastic_record(recovery_s=2.0)
+    ok, _ = compare(_elastic_record(recovery_s=2.4), base,
+                    metric="elastic")
+    assert ok   # 20% slower recovery within the 30% tolerance
+    ok, msgs = compare(_elastic_record(recovery_s=4.0), base,
+                       metric="elastic")
+    assert not ok, msgs  # 2x slower fails
+    # a binary acceptance regression is a -100% drop: fails at ANY
+    # tolerance
+    ok, msgs = compare(_elastic_record(steps_lost=3), base,
+                       metric="elastic")
+    assert not ok, msgs
+    ok, msgs = compare(_elastic_record(parity=1e-2), base,
+                       metric="elastic")
+    assert not ok, msgs
+
+
+def test_elastic_gate_against_checked_in_baseline():
+    from tools.perf_gate import extract_elastic_metrics
+    path, rec = latest_baseline(REPO, metric="elastic")
+    m = extract_elastic_metrics(rec)
+    assert m["elastic/recovery_inv"] > 0
+    # the recorded acceptance run holds the issue's criteria
+    assert m["elastic/steps_lost_ok"] == 1.0, path
+    assert m["elastic/parity_ok"] == 1.0, path
+    ok, msgs = compare(rec, rec, metric="elastic")
+    assert ok, msgs

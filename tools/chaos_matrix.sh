@@ -175,6 +175,28 @@ for seed in "${seeds[@]}"; do
     fi
 done
 
+# ---- elastic soak leg: an ElasticTrainer (ParallelPlan pp=2) takes a
+# seeded stage-actor SIGKILL mid-train-step AND a chaos-scheduled
+# maintenance drain of its only slice; invariants: typed errors only,
+# no hangs, the plan folds pp→spmd when capacity hits zero, the
+# post-recovery loss trajectory tracks the uninterrupted run step for
+# step, no leaked stage actors or provider slices
+# (tests/parallel/test_elastic.py::test_elastic_maintenance_soak)
+for seed in "${seeds[@]}"; do
+    echo "=== elastic soak: seed=$seed ==="
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        RAY_TPU_CHAOS_POSTMORTEM_FILE="$postmortem_dir/elastic_postmortem_$seed.json" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/parallel/test_elastic.py::test_elastic_maintenance_soak" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== elastic seed=$seed PASSED ==="
+        rm -f "$postmortem_dir/elastic_postmortem_$seed.json"
+    else
+        echo "=== elastic seed=$seed FAILED ==="
+        failed+=("elastic:$seed")
+    fi
+done
+
 if [ "${#failed[@]}" -gt 0 ]; then
     echo
     echo "FAILING SEEDS: ${failed[*]}"
@@ -209,6 +231,19 @@ if [ "${#failed[@]}" -gt 0 ]; then
             echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
                  "tests/autoscaler/test_slice_e2e.py::test_slice_preemption_soak -q"
             pm="$postmortem_dir/slice_postmortem_$s.json"
+            if [ -f "$pm" ]; then
+                echo "  flight recorder: $pm" \
+                     "(python tools/timeline.py --input $pm)"
+            fi
+            continue
+            ;;
+        elastic:*)
+            s="${seed#elastic:}"
+            echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
+                 "tests/parallel/test_elastic.py::test_elastic_maintenance_soak -q"
+            # the ELASTIC_* recovery window renders as a duration
+            # slice in the Perfetto export — the preemption postmortem
+            pm="$postmortem_dir/elastic_postmortem_$s.json"
             if [ -f "$pm" ]; then
                 echo "  flight recorder: $pm" \
                      "(python tools/timeline.py --input $pm)"

@@ -75,14 +75,19 @@ BASELINE_GLOBS = {"bench": "BENCH_r*.json",
                   "multichip": "MULTICHIP_r*.json",
                   "serve": "SERVE_r*.json",
                   "pipeline": "PIPELINE_r*.json",
-                  "data": "DATA_r*.json"}
+                  "data": "DATA_r*.json",
+                  "elastic": "ELASTIC_r*.json"}
 #: metrics compared RELATIVELY (tolerance is an allowed % drop, not
 #: absolute points — tokens/s scales with the chip, MFU doesn't)
-RELATIVE_METRICS = {"serve", "pipeline", "data"}
+RELATIVE_METRICS = {"serve", "pipeline", "data", "elastic"}
 DEFAULT_TOLERANCES = {"bench": 2.0, "multichip": 2.0, "serve": 15.0,
-                      "pipeline": 15.0, "data": 15.0}
+                      "pipeline": 15.0, "data": 15.0,
+                      # recovery wall-clock is teardown+rebuild+reload
+                      # dominated — noisy on shared CI hosts
+                      "elastic": 30.0}
 #: series whose early records may predate any parseable baseline
-BOOTSTRAP_METRICS = {"multichip", "serve", "pipeline", "data"}
+BOOTSTRAP_METRICS = {"multichip", "serve", "pipeline", "data",
+                     "elastic"}
 
 
 def parse_bench_record(obj: dict) -> dict:
@@ -264,11 +269,36 @@ def extract_data_metrics(rec: dict) -> dict:
     return out
 
 
+def extract_elastic_metrics(rec: dict) -> dict:
+    """The elastic recovery headline, inverted to the shared
+    higher-is-better comparison (1/recovery-seconds), plus two binary
+    acceptance rows: steps-lost ≤ 1 per kill and post-recovery loss
+    trajectory parity ≤ 1e-5 — a regression on either binary is a
+    −100% relative drop, an automatic FAIL at any tolerance."""
+    detail = rec.get("detail") or {}
+    out = {"elastic/recovery_inv": round(
+        1.0 / max(float(rec["value"]), 1e-9), 6),
+        "elastic/steps_lost_ok": None,
+        "elastic/parity_ok": None,
+        "elastic/regrow_inv": None}
+    if "steps_lost_max" in detail:
+        out["elastic/steps_lost_ok"] = (
+            1.0 if int(detail["steps_lost_max"]) <= 1 else 0.0)
+    if "loss_parity_abs" in detail:
+        out["elastic/parity_ok"] = (
+            1.0 if float(detail["loss_parity_abs"]) <= 1e-5 else 0.0)
+    if "regrow_s" in detail:
+        out["elastic/regrow_inv"] = round(
+            1.0 / max(float(detail["regrow_s"]), 1e-9), 6)
+    return out
+
+
 EXTRACTORS = {"bench": extract_metrics,
               "multichip": extract_multichip_metrics,
               "serve": extract_serve_metrics,
               "pipeline": extract_pipeline_metrics,
-              "data": extract_data_metrics}
+              "data": extract_data_metrics,
+              "elastic": extract_elastic_metrics}
 
 
 def latest_baseline(root: str = REPO_ROOT, metric: str = "bench",
