@@ -46,6 +46,7 @@ from ray_tpu.exceptions import (
     RpcTimeoutError,
     DeliveryFailedError,
     StreamCancelledError,
+    AdmissionRejectedError,
 )
 from ray_tpu.runtime_context import RuntimeContext
 
@@ -88,6 +89,7 @@ __all__ = [
     "OutOfMemoryError",
     "GetTimeoutError",
     "RpcTimeoutError",
+    "AdmissionRejectedError",
     "DeliveryFailedError",
     "StreamCancelledError",
 ]

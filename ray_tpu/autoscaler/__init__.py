@@ -7,6 +7,8 @@ multi-host slices acquired for SLICE_PACK/SLICE_SPREAD placement
 groups, drained preemption-aware on maintenance events, released whole.
 """
 
+from ray_tpu.autoscaler.arbiter import (
+    ArbiterPolicy, SliceArbiter, SliceClaim)
 from ray_tpu.autoscaler.autoscaler import (
     AutoscalerMonitor, NodeTypeConfig, StandardAutoscaler)
 from ray_tpu.autoscaler.node_provider import (
@@ -17,13 +19,16 @@ from ray_tpu.autoscaler.slices import (
 from ray_tpu.autoscaler.v2 import AutoscalerV2
 
 __all__ = [
+    "ArbiterPolicy",
     "AutoscalerMonitor",
     "AutoscalerV2",
     "FakeNodeProvider",
     "FakeSliceProvider",
     "NodeProvider",
     "NodeTypeConfig",
+    "SliceArbiter",
     "SliceCapacityError",
+    "SliceClaim",
     "SliceInfo",
     "SliceManager",
     "SliceTypeConfig",

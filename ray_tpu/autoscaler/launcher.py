@@ -148,6 +148,25 @@ def validate_cluster_config(cfg: Dict[str, Any]) -> Dict[str, Any]:
                     f"bundles exceed the {n_hosts} host VM(s) of "
                     f"topology {topo!r} (SLICE_SPREAD needs one "
                     f"distinct host per bundle)")
+    # ---- arbiter: train+serve slice arbitration policy knobs
+    # (autoscaler/arbiter.py) the head monitor drives next to the
+    # SliceManager
+    arbiter = cfg.get("arbiter")
+    if arbiter is not None:
+        if not isinstance(arbiter, dict):
+            raise ConfigError("'arbiter' must be a mapping")
+        import dataclasses as _dc
+
+        from ray_tpu.autoscaler.arbiter import ArbiterPolicy
+        known = {f.name for f in _dc.fields(ArbiterPolicy)}
+        for k, v in arbiter.items():
+            if k not in known:
+                raise ConfigError(
+                    f"'arbiter.{k}' is not a policy knob "
+                    f"(one of {sorted(known)})")
+            if not isinstance(v, (int, float)) or v < 0:
+                raise ConfigError(
+                    f"'arbiter.{k}' must be a non-negative number")
     cfg.setdefault("max_workers", 8)
     cfg.setdefault("setup_commands", [])
     cfg.setdefault("head_start_commands", [])
@@ -275,6 +294,25 @@ def build_slice_manager(controller, cfg: Dict[str, Any],
     return SliceManager(controller, provider, types,
                         idle_timeout_s=idle_timeout_s,
                         drain_deadline_s=drain_deadline_s)
+
+
+def build_slice_arbiter(manager, cfg: Dict[str, Any]):
+    """Construct the head's :class:`~ray_tpu.autoscaler.arbiter.
+    SliceArbiter` over an already-built SliceManager when the config
+    has an ``arbiter:`` section. The arbiter drives the manager's
+    reconcile pass itself (``drive_manager=True``), so the head hands
+    the ARBITER — not the manager — to its ``AutoscalerMonitor`` and
+    one loop does both. Returns None when the config names no arbiter
+    (the manager stays the monitor's target, wiring unchanged)."""
+    section = cfg.get("arbiter")
+    if manager is None or section is None:
+        return None
+    from ray_tpu.autoscaler.arbiter import ArbiterPolicy, SliceArbiter
+    int_knobs = ("min_train_slices", "max_borrowed")
+    policy = ArbiterPolicy(**{
+        k: (int(v) if k in int_knobs else float(v))
+        for k, v in section.items()})
+    return SliceArbiter(manager, policy=policy, drive_manager=True)
 
 
 def node_type_configs(cfg: Dict[str, Any]) -> List[NodeTypeConfig]:

@@ -250,12 +250,20 @@ class SliceManager:
         DRAINING/RELEASED guard in :meth:`drain_slice` dedupes), AFTER
         the slice's placement groups were re-queued and BEFORE the
         release, so an elastic trainer can snapshot from the still-live
-        hosts. Callbacks run synchronously on the draining thread;
-        exceptions are logged and swallowed, and a callback that never
-        consumes its notice cannot block the ``drain_deadline_s``
-        release path — release is driven by :meth:`_finish_drains`,
-        not by callback completion. Returns the callback (decorator
-        friendly)."""
+        hosts.
+
+        The hook is MULTI-SUBSCRIBER: every registered callback
+        observes every notice (an arbiter and an ``ElasticTrainer``
+        both see the same drain without stealing it from each other).
+        Dispatch order is registration order (FIFO), and a callback
+        unregistered while a dispatch is in flight — including by an
+        earlier callback of the SAME dispatch — is skipped rather than
+        fired against a subscriber that believes it already detached.
+        Callbacks run synchronously on the draining thread; exceptions
+        are logged and swallowed, and a callback that never consumes
+        its notice cannot block the ``drain_deadline_s`` release path —
+        release is driven by :meth:`_finish_drains`, not by callback
+        completion. Returns the callback (decorator friendly)."""
         self._drain_callbacks.append(callback)
         return callback
 
@@ -264,6 +272,24 @@ class SliceManager:
             self._drain_callbacks.remove(callback)
         except ValueError:
             pass
+
+    def _dispatch_drain_notice(self, notice: "DrainNotice") -> int:
+        """Fan one notice out to every live subscriber in registration
+        order. The snapshot fixes the order; the membership check at
+        call time honors unregister-during-dispatch (a subscriber
+        removed by an earlier callback in this same dispatch must not
+        fire). Returns the number of callbacks actually invoked."""
+        fired = 0
+        for cb in list(self._drain_callbacks):
+            if cb not in self._drain_callbacks:
+                continue
+            fired += 1
+            try:
+                cb(notice)
+            except Exception:
+                logger.exception("on_drain callback failed for %s",
+                                 notice.slice_id)
+        return fired
 
     def adopt_existing(self) -> None:
         """Adopt slices the provider already tracks but this manager
@@ -404,12 +430,7 @@ class SliceManager:
         notice = DrainNotice(
             slice_id=slice_id, reason=reason, hosts=info.num_hosts,
             type=info.type, deadline_s=self.drain_deadline_s)
-        for cb in list(self._drain_callbacks):
-            try:
-                cb(notice)
-            except Exception:
-                logger.exception("on_drain callback failed for %s",
-                                 slice_id)
+        self._dispatch_drain_notice(notice)
         self._update_gauges()
 
     def _release(self, slice_id: str) -> None:

@@ -71,6 +71,17 @@ Event taxonomy (the ``ev`` field):
                    notice/failure-to-resume recovery window (rendered
                    as a duration slice — the recovery postmortem) and
                    ``steps_lost`` = re-executed steps
+``ARBITER_PREEMPT`` the slice arbiter drained a training slice for the
+                   serve fleet (``slice``/``reason``; ``dur_s`` = how
+                   long serve pressure was sustained before the
+                   arbiter acted — renders as the pressure window)
+``ARBITER_RETURN`` serve pressure ebbed past hysteresis and the
+                   arbiter returned capacity to training
+                   (``reason``; ``dur_s`` = the whole borrow window,
+                   preempt-to-return — the colocation postmortem)
+``ARBITER_REJECT`` SLO-aware admission shed a request before it could
+                   wedge a replica queue (``tenant``/``priority``/
+                   ``reason``)
 =================  =====================================================
 """
 
@@ -103,6 +114,9 @@ ELASTIC_NOTICE = "ELASTIC_NOTICE"
 ELASTIC_SNAPSHOT = "ELASTIC_SNAPSHOT"
 ELASTIC_RELOWER = "ELASTIC_RELOWER"
 ELASTIC_RESUME = "ELASTIC_RESUME"
+ARBITER_PREEMPT = "ARBITER_PREEMPT"
+ARBITER_RETURN = "ARBITER_RETURN"
+ARBITER_REJECT = "ARBITER_REJECT"
 
 #: lifecycle events a task timeline is built from (exporter slice pairs)
 LIFECYCLE = (SUBMITTED, LEASED, DISPATCHED, RUNNING, YIELDED,

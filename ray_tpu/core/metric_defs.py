@@ -188,6 +188,20 @@ class RuntimeMetrics:
             "Notice-to-release drain duration per slice (maintenance "
             "or idle scale-down)",
             boundaries=[0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120])
+        # -- slice arbitration (autoscaler/arbiter.py) + SLO admission
+        # (serve/handle.py): train+serve colocation signals
+        self.arbiter_preemptions = Counter(
+            "autoscaler_arbiter_preemptions_total",
+            "Training slices drained by the slice arbiter for the "
+            "serve fleet", tag_keys=("reason",))
+        self.arbiter_returns = Counter(
+            "autoscaler_arbiter_returns_total",
+            "Borrowed slices handed back to training after serve "
+            "pressure ebbed past hysteresis", tag_keys=("reason",))
+        self.admission_rejected = Counter(
+            "serve_admission_rejected_total",
+            "Requests shed by SLO-aware admission before reaching a "
+            "replica queue", tag_keys=("tenant", "priority"))
         # -- memory / health (reference: memory_manager worker kills)
         self.oom_worker_kills = Counter(
             "runtime_oom_worker_kills_total",

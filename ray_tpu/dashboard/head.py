@@ -236,6 +236,17 @@ def _make_handler(head: DashboardHead):
                         .chrome_counters()))
                 elif path == "/api/jobs":
                     self._json(head.job_manager.list_jobs())
+                elif path == "/api/v0/arbiter":
+                    # live slice-arbitration table (who owns which
+                    # slice and why); present only when the head runs
+                    # with an arbiter: config section
+                    arb = getattr(head.controller, "slice_arbiter",
+                                  None)
+                    if arb is None:
+                        self._json({"error": "no slice arbiter "
+                                    "configured"}, 404)
+                    else:
+                        self._json(arb.status())
                 elif path == "/api/version":
                     from ray_tpu import __version__
                     self._json({"version": __version__,
@@ -305,7 +316,9 @@ def _make_handler(head: DashboardHead):
                         entrypoint=body["entrypoint"],
                         submission_id=body.get("submission_id"),
                         metadata=body.get("metadata"),
-                        runtime_env=body.get("runtime_env"))
+                        runtime_env=body.get("runtime_env"),
+                        priority=body.get("priority") or "normal",
+                        elastic=bool(body.get("elastic")))
                     self._json({"submission_id": jid})
                 elif path.startswith("/api/jobs/") and path.endswith("/stop"):
                     jid = self._job_id_from(path)

@@ -35,11 +35,18 @@ class JobStatus:
 class JobInfo:
     def __init__(self, submission_id: str, entrypoint: str,
                  metadata: Optional[Dict[str, str]] = None,
-                 runtime_env: Optional[Dict[str, Any]] = None):
+                 runtime_env: Optional[Dict[str, Any]] = None,
+                 priority: str = "normal", elastic: bool = False):
         self.submission_id = submission_id
         self.entrypoint = entrypoint
         self.metadata = metadata or {}
         self.runtime_env = runtime_env or {}
+        # arbitration hints: priority orders preemption victims (the
+        # SliceArbiter drains the lowest-priority training job's slice
+        # first); elastic declares the driver survives losing a slice
+        # mid-run (ElasticTrainer re-lowers instead of dying)
+        self.priority = priority
+        self.elastic = bool(elastic)
         self.status = JobStatus.PENDING
         self.message = ""
         self.start_time = time.time()
@@ -55,6 +62,8 @@ class JobInfo:
             "metadata": self.metadata,
             "runtime_env": {k: v for k, v in self.runtime_env.items()
                             if k != "env_vars"} if self.runtime_env else {},
+            "priority": self.priority,
+            "elastic": self.elastic,
             "start_time": self.start_time,
             "end_time": self.end_time,
             "driver_exit_code": self.driver_exit_code,
@@ -74,12 +83,19 @@ class JobManager:
     def submit_job(self, *, entrypoint: str,
                    submission_id: Optional[str] = None,
                    metadata: Optional[Dict[str, str]] = None,
-                   runtime_env: Optional[Dict[str, Any]] = None) -> str:
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   priority: str = "normal",
+                   elastic: bool = False) -> str:
         submission_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:12]}"
+        if priority not in ("low", "normal", "high"):
+            raise ValueError(
+                f"priority must be low/normal/high, got {priority!r}")
         with self._lock:
             if submission_id in self._jobs:
                 raise ValueError(f"job {submission_id!r} already exists")
-            info = JobInfo(submission_id, entrypoint, metadata, runtime_env)
+            info = JobInfo(submission_id, entrypoint, metadata,
+                           runtime_env, priority=priority,
+                           elastic=elastic)
             self._jobs[submission_id] = info
         t = threading.Thread(target=self._supervise, args=(info,),
                              name=f"job-supervisor-{submission_id}",
@@ -94,6 +110,11 @@ class JobManager:
         env = dict(os.environ)
         env["RAY_TPU_ADDRESS"] = self.session_dir
         env["RAY_TPU_JOB_SUBMISSION_ID"] = info.submission_id
+        # drivers read these to claim their slices with the arbiter at
+        # the right priority (and to decide whether to wrap training in
+        # ElasticTrainer)
+        env["RAY_TPU_JOB_PRIORITY"] = info.priority
+        env["RAY_TPU_JOB_ELASTIC"] = "1" if info.elastic else "0"
         # the entrypoint's driver must find ray_tpu even when the package
         # is run from a source tree (same propagation the node manager
         # does for workers)

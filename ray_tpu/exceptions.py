@@ -194,6 +194,32 @@ class StreamCancelledError(RayTpuError):
         return (StreamCancelledError, (self.task_id,))
 
 
+class AdmissionRejectedError(RayTpuError):
+    """SLO-aware admission shed this request at the router before it
+    reached a replica queue (``serve/admission.py``): the tenant is
+    over its token budget, or the serve fleet is overloaded and the
+    request's priority class is below the shed line. Retry later, with
+    a higher priority class, or under a different tenant budget — the
+    HTTP proxy maps this to 429 Too Many Requests.
+    """
+
+    def __init__(self, tenant: str = "default",
+                 priority: str = "normal", reason: str = "overload",
+                 detail: str = ""):
+        self.tenant = tenant
+        self.priority = priority
+        self.reason = reason
+        self.detail = detail
+        super().__init__(
+            f"request shed at admission ({reason}): tenant "
+            f"{tenant!r}, priority {priority!r}"
+            + (f" — {detail}" if detail else ""))
+
+    def __reduce__(self):
+        return (AdmissionRejectedError,
+                (self.tenant, self.priority, self.reason, self.detail))
+
+
 class ObjectStoreFullError(RayTpuError):
     """Shared-memory store is full and eviction/spill could not make room."""
 

@@ -61,11 +61,24 @@ class JobSubmissionClient:
     def submit_job(self, *, entrypoint: str,
                    submission_id: Optional[str] = None,
                    metadata: Optional[Dict[str, str]] = None,
-                   runtime_env: Optional[Dict[str, Any]] = None) -> str:
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   priority: str = "normal",
+                   elastic: bool = False) -> str:
+        """``priority`` (low/normal/high) orders this job in slice
+        arbitration — under sustained serve pressure the SliceArbiter
+        preempts the LOWEST-priority training job's slice first;
+        ``elastic=True`` declares the driver survives that (it wraps
+        training in ElasticTrainer and resumes on the shrunken mesh)."""
         out = self._request("POST", "/api/jobs/", {
             "entrypoint": entrypoint, "submission_id": submission_id,
-            "metadata": metadata, "runtime_env": runtime_env})
+            "metadata": metadata, "runtime_env": runtime_env,
+            "priority": priority, "elastic": elastic})
         return out["submission_id"]
+
+    def get_arbiter_status(self) -> Dict[str, Any]:
+        """Live slice-arbitration table: who owns which slice and why
+        (borrowed-by-serve rows carry the preemption reason)."""
+        return self._request("GET", "/api/v0/arbiter")
 
     def list_jobs(self) -> List[Dict[str, Any]]:
         return self._request("GET", "/api/jobs/")

@@ -156,7 +156,12 @@ class ElasticTrainer(TrainProgram):
     step/checkpoint/shutdown surface; ``slice_manager`` (optional)
     wires provider maintenance notices in via
     :meth:`~ray_tpu.autoscaler.slices.SliceManager.register_on_drain`.
-    Every build kwarg (``actor_options``, ``step_timeout_s``,
+    ``slice_filter`` (a ``slice_id -> bool`` predicate) scopes the
+    trainer to the slices it OWNS on a shared train+serve pool: drain
+    notices for foreign slices are ignored and capacity/regrow
+    decisions count only owned slices — without it, a colocated serve
+    fleet's UP slice would convince a preempted trainer it still has
+    capacity. Every build kwarg (``actor_options``, ``step_timeout_s``,
     ``placement_bundle``, ...) is forwarded to each (re-)lowering."""
 
     def __init__(self, plan: ParallelPlan, config, *,
@@ -165,6 +170,7 @@ class ElasticTrainer(TrainProgram):
                  clip_norm: Optional[float] = 1.0,
                  seed: int = 0,
                  slice_manager=None,
+                 slice_filter=None,
                  snapshot_interval: int = 1,
                  snapshot_timeout_s: float = 60.0,
                  max_recoveries: int = 8,
@@ -178,6 +184,7 @@ class ElasticTrainer(TrainProgram):
         self.plan = plan
         self.config = config
         self.slice_manager = slice_manager
+        self.slice_filter = slice_filter
         self.snapshot_interval = snapshot_interval
         self.snapshot_timeout_s = snapshot_timeout_s
         self.max_recoveries = max_recoveries
@@ -212,9 +219,16 @@ class ElasticTrainer(TrainProgram):
     def _on_drain(self, notice) -> None:
         """SliceManager callback — may run on the monitor thread, so
         it only enqueues; the notice is consumed at the next step
-        boundary (the quiesce point)."""
+        boundary (the quiesce point). A foreign slice's drain (e.g.
+        the colocated serve fleet shrinking) is not our loss."""
+        if self.slice_filter is not None and \
+                not self.slice_filter(notice.slice_id):
+            return
         with self._lock:
             self._notices.append(notice)
+
+    def _owned(self, slice_id) -> bool:
+        return self.slice_filter is None or self.slice_filter(slice_id)
 
     def _pop_notices(self) -> List[Any]:
         with self._lock:
@@ -223,13 +237,13 @@ class ElasticTrainer(TrainProgram):
         return out
 
     def _capacity(self) -> Optional[int]:
-        """Usable slices by the manager's books (None without a
+        """Usable OWNED slices by the manager's books (None without a
         manager): REQUESTED/UP and not draining."""
         if self.slice_manager is None:
             return None
         from ray_tpu.autoscaler.slices import REQUESTED, UP
-        return sum(1 for s in self.slice_manager.slices.values()
-                   if s.state in (REQUESTED, UP))
+        return sum(1 for sid, s in self.slice_manager.slices.items()
+                   if s.state in (REQUESTED, UP) and self._owned(sid))
 
     def _choose_plan(self, slice_lost: bool) -> ParallelPlan:
         cap = self._capacity()
@@ -327,8 +341,8 @@ class ElasticTrainer(TrainProgram):
         if self.plan == self.target_plan:
             return
         from ray_tpu.autoscaler.slices import UP
-        cap = sum(1 for s in self.slice_manager.slices.values()
-                  if s.state == UP)
+        cap = sum(1 for sid, s in self.slice_manager.slices.items()
+                  if s.state == UP and self._owned(sid))
         if cap >= 1:
             self.regrow()
 

@@ -211,7 +211,8 @@ def cmd_job(args) -> None:
             # arguments with spaces/quotes)
             entrypoint=shlex.join(args.entrypoint),
             runtime_env=json.loads(args.runtime_env)
-            if args.runtime_env else None)
+            if args.runtime_env else None,
+            priority=args.priority, elastic=args.elastic)
         print(jid)
         if not args.no_wait:
             try:
@@ -226,6 +227,19 @@ def cmd_job(args) -> None:
             raise SystemExit(0 if status == "SUCCEEDED" else 1)
     elif args.job_cmd == "list":
         print(json.dumps(client.list_jobs(), indent=2, default=str))
+        try:
+            arb = client.get_arbiter_status()
+        except RuntimeError:
+            arb = None   # head runs without an arbiter: section
+        if arb and arb.get("rows"):
+            print("-- slice arbitration "
+                  f"(pressure={'yes' if arb.get('pressure') else 'no'},"
+                  f" preemptions={arb.get('preemptions', 0)},"
+                  f" returns={arb.get('returns', 0)}) --")
+            for r in arb["rows"]:
+                print(f"  {r['slice_id']}  {r['kind']:<5}  "
+                      f"prio={r['priority']:<3} {r['state']:<9} "
+                      f"owner={r['owner']}  {r['why']}")
     elif args.job_cmd == "status":
         print(client.get_job_status(args.submission_id))
     elif args.job_cmd == "logs":
@@ -374,6 +388,14 @@ def main() -> None:
     jp.add_argument("--address", default=None)
     jp.add_argument("--runtime-env", default=None,
                     help='JSON, e.g. {"env_vars": {"K": "V"}}')
+    jp.add_argument("--priority", default="normal",
+                    choices=["low", "normal", "high"],
+                    help="slice-arbitration priority: under serve "
+                    "pressure the lowest-priority training job's "
+                    "slice is preempted first")
+    jp.add_argument("--elastic", action="store_true",
+                    help="driver survives losing a slice mid-run "
+                    "(ElasticTrainer re-lowers instead of dying)")
     jp.add_argument("--no-wait", action="store_true")
     jp.add_argument("--timeout", type=float, default=600.0)
     for name in ("status", "logs", "stop"):

@@ -27,21 +27,30 @@ import uuid
 
 def _start_slice_monitor(config_path: str, interval_s: float):
     """Build the SliceManager from the cluster config and start its
-    monitor loop. Returns (monitor, manager) or (None, None) when the
-    config has no slices section."""
+    monitor loop. When the config also has an ``arbiter:`` section the
+    monitor drives the SliceArbiter instead — it reconciles the
+    manager first each tick, then arbitrates slices between the serve
+    fleet and training off the metrics plane's fleet gauges. Returns
+    (monitor, manager) or (None, None) when the config has no slices
+    section."""
     import ray_tpu.api as api
     from ray_tpu.autoscaler.autoscaler import AutoscalerMonitor
     from ray_tpu.autoscaler.launcher import (
-        build_slice_manager, load_cluster_config)
+        build_slice_arbiter, build_slice_manager, load_cluster_config)
 
     cfg = load_cluster_config(config_path)
     mgr = build_slice_manager(api._head.controller, cfg)
     if mgr is None:
         return None, None
-    monitor = AutoscalerMonitor(mgr, interval_s=interval_s)
+    arbiter = build_slice_arbiter(mgr, cfg)
+    if arbiter is not None:
+        api._head.controller.slice_arbiter = arbiter
+    monitor = AutoscalerMonitor(arbiter if arbiter is not None
+                                else mgr, interval_s=interval_s)
     monitor.start()
     print(f"ray_tpu head: slice monitor up "
-          f"({', '.join(sorted(mgr.slice_types))})")
+          f"({', '.join(sorted(mgr.slice_types))})"
+          + (" + arbiter" if arbiter is not None else ""))
     sys.stdout.flush()
     return monitor, mgr
 

@@ -17,6 +17,8 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
+from ray_tpu.serve.admission import (
+    AdmissionController, AdmissionPolicy, PRIORITY_CLASSES)
 from ray_tpu.serve.http import Request, Response, ingress
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import (
@@ -30,8 +32,11 @@ from ray_tpu.serve.llm_engine import (
 from ray_tpu.serve.prefix_cache import PrefixBlockPool, prefix_fingerprint
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionPolicy",
     "Application",
     "AutoscalingConfig",
+    "PRIORITY_CLASSES",
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",

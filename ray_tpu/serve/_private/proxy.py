@@ -211,8 +211,15 @@ class HTTPProxy:
         # load/affinity state spans all sessions)
         if req is not None:
             sid = req.header("x-session-id")
-            if sid:
-                h = h.options(stream=stream, session_id=sid)
+            tenant = req.header("x-tenant")
+            priority = req.header("x-priority")
+            if sid or tenant or priority:
+                try:
+                    h = h.options(stream=stream, session_id=sid,
+                                  tenant=tenant, priority=priority)
+                except ValueError:
+                    raise _HTTPError(
+                        400, f"unknown x-priority {priority!r}")
         return h
 
     # ---------------------------------------------------------- dispatch
@@ -247,7 +254,7 @@ class HTTPProxy:
         try:
             result = await loop.run_in_executor(self._pool, call)
         except Exception as e:  # noqa: BLE001
-            await self._write_simple(writer, 500, {"error": str(e)})
+            await self._write_error(writer, e)
             return
         if isinstance(result, Response):
             await self._write_head(writer, result.status, result.headers
@@ -276,7 +283,9 @@ class HTTPProxy:
             first = await loop.run_in_executor(
                 self._pool, next, it, _END)
         except Exception as e:  # noqa: BLE001
-            await self._write_simple(writer, 500, {"error": str(e)})
+            # admission sheds before headers go out, so a 429 is still
+            # expressible here (unlike mid-stream failures below)
+            await self._write_error(writer, e)
             return
         await self._write_head(
             writer, 200,
@@ -346,10 +355,24 @@ class HTTPProxy:
                     writer, 500, {"error": "stream failed"})
 
     # ------------------------------------------------------------ output
+    async def _write_error(self, writer, e: BaseException) -> None:
+        """Typed error mapping: an admission shed is the CLIENT's
+        signal to back off (429 + tenant/priority/reason so it can
+        retry with a higher class), not a server fault."""
+        from ray_tpu.exceptions import AdmissionRejectedError
+        if isinstance(e, AdmissionRejectedError):
+            await self._write_simple(
+                writer, 429,
+                {"error": str(e), "tenant": e.tenant,
+                 "priority": e.priority, "reason": e.reason})
+            return
+        await self._write_simple(writer, 500, {"error": str(e)})
+
     @staticmethod
     async def _write_head(writer, status: int,
                           headers) -> None:
-        reason = {200: "OK", 404: "Not Found",
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  429: "Too Many Requests",
                   500: "Internal Server Error"}.get(status, "")
         out = [f"HTTP/1.1 {status} {reason}".encode()]
         seen_ct = False

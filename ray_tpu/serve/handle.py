@@ -183,6 +183,10 @@ class _Router:
         self._pids: Dict[int, bytes] = {}         # replica pid -> rkey
         self._last_probe = 0.0
         self._rr_next = 0
+        # SLO-aware admission (serve/admission.py); shared across
+        # options() copies like the rest of the router so per-tenant
+        # budget accounting spans them. None = admit everything.
+        self.admission = None
 
     @staticmethod
     def _key(replica) -> bytes:
@@ -402,7 +406,9 @@ class DeploymentHandle:
                  _stream: bool = False, _model_id: Optional[str] = None,
                  _session_id: Optional[str] = None,
                  _routing_policy: Optional[str] = None,
-                 _prefix_fingerprint: Optional[int] = None):
+                 _prefix_fingerprint: Optional[int] = None,
+                 _tenant: Optional[str] = None,
+                 _priority=None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._controller = controller
@@ -412,6 +418,23 @@ class DeploymentHandle:
         self._session_id = _session_id
         self._routing_policy = _routing_policy
         self._prefix_fingerprint = _prefix_fingerprint
+        self._tenant = _tenant
+        self._priority = _priority
+
+    # -- admission ----------------------------------------------------
+    def enable_admission(self, policy=None):
+        """Attach SLO-aware admission (``serve/admission.py``) to this
+        handle's shared router: subsequent calls through this handle or
+        any ``options()`` copy pass through per-tenant token budgets
+        and priority shedding, raising
+        :class:`~ray_tpu.exceptions.AdmissionRejectedError` when shed.
+        Returns the :class:`~ray_tpu.serve.admission.
+        AdmissionController` (for ``stats()``)."""
+        from ray_tpu.serve.admission import AdmissionController
+        if not isinstance(policy, AdmissionController):
+            policy = AdmissionController(policy)
+        self._router.admission = policy
+        return policy
 
     # -- routing ------------------------------------------------------
     def _route(self, method: str, args, kwargs):
@@ -420,6 +443,14 @@ class DeploymentHandle:
         if not r.replicas:
             raise RuntimeError(
                 f"Deployment {self.deployment_name!r} has no replicas")
+        if r.admission is not None:
+            # Shed BEFORE pick: a rejected request must never touch a
+            # replica queue (that queue depth is exactly what the shed
+            # is protecting). Freshest engine gauges decide overload.
+            r._poll_gauges()
+            r.admission.admit(
+                self._tenant, self._priority, r._fresh_gauges(),
+                tokens=kwargs.get("max_tokens"))
         # Unwrap chained responses so downstream gets values, not
         # wrapper objects (reference: DeploymentResponse passing).
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
@@ -475,6 +506,8 @@ class DeploymentHandle:
                 session_id: Optional[str] = None,
                 routing_policy: Optional[str] = None,
                 prefix_fingerprint: Optional[int] = None,
+                tenant: Optional[str] = None,
+                priority=None,
                 **kwargs) -> "DeploymentHandle":
         """Configured copy of this handle (reference: handle.options).
         ``session_id`` pins every call to one replica while it lives
@@ -482,20 +515,26 @@ class DeploymentHandle:
         "gauge" (default) / "pow2" / "round_robin";
         ``prefix_fingerprint`` (``serve.prefix_fingerprint(tokens,
         kv_block_size)``) steers a first-turn request to the replica
-        whose radix cache already holds that prefix. Unknown options
-        raise rather than silently no-op."""
+        whose radix cache already holds that prefix; ``tenant`` /
+        ``priority`` ("low"/"normal"/"high" or int) tag calls for
+        SLO-aware admission when :meth:`enable_admission` is on.
+        Unknown options raise rather than silently no-op."""
         if kwargs:
             raise TypeError(
                 f"unsupported handle options: {sorted(kwargs)}")
         if routing_policy not in (None, "gauge", "pow2", "round_robin"):
             raise ValueError(
                 f"unknown routing_policy {routing_policy!r}")
+        if priority is not None:
+            from ray_tpu.serve.admission import priority_value
+            priority_value(priority)   # raises ValueError on unknown
         return DeploymentHandle(
             self.deployment_name, self._controller, self.app_name,
             _router=self._router, _stream=stream,
             _model_id=multiplexed_model_id, _session_id=session_id,
             _routing_policy=routing_policy,
-            _prefix_fingerprint=prefix_fingerprint)
+            _prefix_fingerprint=prefix_fingerprint,
+            _tenant=tenant, _priority=priority)
 
     def __reduce__(self):
         # options survive pickling; router state is rebuilt on the far
@@ -503,14 +542,17 @@ class DeploymentHandle:
         return (_rebuild_handle,
                 (self.deployment_name, self._controller, self.app_name,
                  self._stream, self._model_id, self._session_id,
-                 self._routing_policy, self._prefix_fingerprint))
+                 self._routing_policy, self._prefix_fingerprint,
+                 self._tenant, self._priority))
 
 
 def _rebuild_handle(deployment_name, controller, app_name, stream,
                     model_id, session_id=None, routing_policy=None,
-                    prefix_fingerprint=None):
+                    prefix_fingerprint=None, tenant=None,
+                    priority=None):
     return DeploymentHandle(deployment_name, controller, app_name,
                             _stream=stream, _model_id=model_id,
                             _session_id=session_id,
                             _routing_policy=routing_policy,
-                            _prefix_fingerprint=prefix_fingerprint)
+                            _prefix_fingerprint=prefix_fingerprint,
+                            _tenant=tenant, _priority=priority)

@@ -1,0 +1,301 @@
+"""SliceArbiter units — all clusterless: a SliceManager over the
+in-memory FakeSliceProvider, an injected gauge feed and a fake clock.
+The live colocation e2e (serve spike → preempt → ElasticTrainer
+absorbs → ebb → return + regrow) lives in
+tests/autoscaler/test_colocation_e2e.py (slow)."""
+
+import pytest
+
+from ray_tpu.autoscaler.arbiter import ArbiterPolicy, SliceArbiter
+from ray_tpu.autoscaler.node_provider import FakeSliceProvider
+from ray_tpu.autoscaler.slices import (
+    DRAINING, RELEASED, UP, SliceManager, SliceTypeConfig)
+from ray_tpu.core.events import FlightRecorder
+
+
+class _StubScheduler:
+    def __init__(self):
+        self.draining = {}
+
+    def set_draining(self, node_id, flag):
+        self.draining[node_id.binary()] = flag
+
+
+class _StubController:
+    def __init__(self):
+        self.scheduler = _StubScheduler()
+        self.rescheduled = []
+        self.recorder = FlightRecorder("test", capacity=1024)
+        self.events = []
+
+    def call_on_loop(self, fn, timeout=None):
+        return fn()
+
+    def _reschedule_pgs_on_nodes(self, node_bs):
+        self.rescheduled.append(set(node_bs))
+        return 1
+
+    def _maybe_schedule(self, force=False):
+        pass
+
+
+def _events(ctrl):
+    ctrl.events.extend(ctrl.recorder.drain())
+    return ctrl.events
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class _Gauges:
+    """Mutable gauge feed standing in for the metrics plane."""
+
+    def __init__(self):
+        self.queue_depth = 0.0
+        self.ttft_p99_ms = 100.0
+
+    def __call__(self):
+        return {"queue_depth": self.queue_depth,
+                "ttft_p99_ms": self.ttft_p99_ms}
+
+
+def _rig(n_train=2, n_serve=1, policy=None, max_slices=8):
+    """(arbiter, mgr, provider, ctrl, clock, gauges) with n_train train
+    slices (priorities 0..n-1) and n_serve serve slices, all UP."""
+    ctrl = _StubController()
+    p = FakeSliceProvider(provider_config={"max_slices": max_slices})
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "2x4", {"CPU": 1})],
+        idle_timeout_s=3600.0, drain_deadline_s=0.0)
+    clock = _Clock()
+    gauges = _Gauges()
+    arb = SliceArbiter(
+        mgr, policy=policy or ArbiterPolicy(
+            queue_high=4.0, queue_low=1.0, ttft_p99_high_ms=2000.0,
+            ttft_p99_low_ms=1000.0, sustain_s=2.0, ebb_s=4.0),
+        gauges_fn=gauges, now_fn=clock)
+    sids = []
+    for i in range(n_train):
+        sid = mgr.acquire_slice("pod")
+        arb.claim(sid, owner=f"train-job-{i}", kind="train",
+                  priority=i)
+        clock.advance(0.1)
+        sids.append(sid)
+    for i in range(n_serve):
+        sid = mgr.acquire_slice("pod")
+        arb.claim(sid, owner="serve-fleet", kind="serve", priority=10)
+        sids.append(sid)
+    alive = [h for sid in sids for h in p.internal_ids(sid)]
+    mgr.update({"demand": [], "slice_demand": [],
+                "busy_nodes": set(alive), "alive_nodes": set(alive)})
+    assert all(mgr.slices[s].state == UP for s in sids)
+    return arb, mgr, p, ctrl, clock, gauges
+
+
+def test_sustained_pressure_preempts_lowest_priority_train_slice():
+    arb, mgr, p, ctrl, clock, gauges = _rig(n_train=2)
+    low = next(s for s, c in arb.claims.items() if c.priority == 0)
+    gauges.queue_depth = 8.0
+    out = arb.update()          # pressure starts, nothing yet
+    assert out["pressure"] and out["actions"] == []
+    clock.advance(2.5)          # past sustain_s
+    out = arb.update()
+    assert out["actions"] == [f"preempt:{low}"]
+    assert mgr.slices[low].state in (DRAINING, RELEASED)
+    assert low not in arb.claims
+    assert len(arb.borrowed) == 1
+    evs = [e for e in _events(ctrl) if e["ev"] == "ARBITER_PREEMPT"]
+    assert len(evs) == 1
+    assert evs[0]["slice"] == low
+    assert evs[0]["reason"] == "queue-depth"
+    assert evs[0]["owner"] == "train-job-0"
+    assert evs[0]["dur_s"] >= 2.0
+
+
+def test_pressure_blip_below_sustain_never_preempts():
+    arb, _mgr, _p, _ctrl, clock, gauges = _rig()
+    gauges.queue_depth = 8.0
+    arb.update()
+    clock.advance(1.0)          # below sustain_s
+    gauges.queue_depth = 0.0    # blip over
+    out = arb.update()
+    assert not out["pressure"] and out["actions"] == []
+    # a NEW spike starts a fresh clock — old partial credit is gone
+    gauges.queue_depth = 8.0
+    arb.update()
+    clock.advance(1.0)
+    assert arb.update()["actions"] == []
+    assert arb.preemptions == 0
+
+
+def test_ttft_pressure_reason_and_counter():
+    from ray_tpu.core.metric_defs import runtime_metrics
+    arb, _mgr, _p, ctrl, clock, gauges = _rig()
+    gauges.ttft_p99_ms = 5000.0
+    arb.update()
+    clock.advance(3.0)
+    out = arb.update()
+    assert len(out["actions"]) == 1
+    ev = [e for e in _events(ctrl)
+          if e["ev"] == "ARBITER_PREEMPT"][0]
+    assert ev["reason"] == "ttft-p99"
+    snap = runtime_metrics().arbiter_preemptions.snapshot()
+    assert any(dict(s[0]).get("reason") == "ttft-p99" and s[1] >= 1
+               for s in snap["samples"])
+
+
+def test_serve_claims_and_min_train_floor_never_preempted():
+    arb, _mgr, _p, _ctrl, clock, gauges = _rig(
+        n_train=1, n_serve=1,
+        policy=ArbiterPolicy(sustain_s=0.0, min_train_slices=1))
+    gauges.queue_depth = 100.0
+    clock.advance(1.0)
+    out = arb.update()
+    # the only train slice is at the floor; serve is untouchable
+    assert out["actions"] == []
+    assert arb.preemptions == 0
+
+
+def test_max_borrowed_caps_consecutive_preemptions():
+    arb, _mgr, _p, _ctrl, clock, gauges = _rig(
+        n_train=3,
+        policy=ArbiterPolicy(sustain_s=0.0, max_borrowed=1))
+    gauges.queue_depth = 100.0
+    clock.advance(1.0)
+    assert len(arb.update()["actions"]) == 1
+    clock.advance(10.0)         # pressure still on, cap holds
+    assert arb.update()["actions"] == []
+    assert arb.preemptions == 1
+
+
+def test_second_preemption_needs_fresh_sustain_window():
+    arb, _mgr, _p, _ctrl, clock, gauges = _rig(
+        n_train=3,
+        policy=ArbiterPolicy(sustain_s=2.0, max_borrowed=2))
+    gauges.queue_depth = 100.0
+    arb.update()
+    clock.advance(2.5)
+    assert len(arb.update()["actions"]) == 1
+    clock.advance(1.0)          # < sustain_s since the first preempt
+    assert arb.update()["actions"] == []
+    clock.advance(1.5)          # fresh window elapsed
+    assert len(arb.update()["actions"]) == 1
+    assert arb.preemptions == 2
+
+
+def test_ebb_past_hysteresis_returns_slice_and_fires_on_return():
+    arb, mgr, p, ctrl, clock, gauges = _rig(n_train=2, max_slices=3)
+    gauges.queue_depth = 8.0
+    arb.update()
+    clock.advance(2.5)
+    arb.update()
+    assert len(arb.borrowed) == 1
+    # release completes so provider capacity frees up for the return
+    alive = [h for s, i in mgr.slices.items() if i.state == UP
+             for h in p.internal_ids(s)]
+    mgr.update({"demand": [], "slice_demand": [], "busy_nodes": set(),
+                "alive_nodes": set(alive)})
+    returned = []
+    arb.register_on_return(returned.append)
+    # mid-band values (above queue_low) are NOT calm: no return
+    gauges.queue_depth = 2.0
+    clock.advance(10.0)
+    assert arb.update()["actions"] == []
+    gauges.queue_depth = 0.5    # genuinely calm now
+    arb.update()                # calm clock starts
+    clock.advance(2.0)          # below ebb_s
+    assert arb.update()["actions"] == []
+    clock.advance(2.5)          # past ebb_s
+    out = arb.update()
+    assert out["actions"] == ["return"]
+    assert arb.borrowed == [] and arb.returns == 1
+    assert len(returned) == 1
+    info = returned[0]
+    assert info["owner"] == "train-job-0"
+    assert info["type"] == "pod"
+    assert info["borrowed_s"] > 0
+    new_sid = info["slice_id"]
+    assert arb.claims[new_sid].kind == "train"
+    assert arb.claims[new_sid].priority == 0
+    evs = [e for e in _events(ctrl) if e["ev"] == "ARBITER_RETURN"]
+    assert len(evs) == 1 and evs[0]["slice"] == new_sid
+    assert evs[0]["dur_s"] > 0  # the whole borrow window
+
+
+def test_return_stockout_keeps_borrow_and_retries():
+    # max_slices=3: all capacity taken while the drained slice is
+    # still DRAINING-held → acquire stockouts, the borrow stays
+    arb, mgr, p, _ctrl, clock, gauges = _rig(
+        n_train=2, n_serve=0, max_slices=2)
+    gauges.queue_depth = 8.0
+    arb.update()
+    clock.advance(2.5)
+    arb.update()
+    assert len(arb.borrowed) == 1
+    p.max_slices = 0
+    gauges.queue_depth = 0.0
+    arb.update()
+    clock.advance(5.0)
+    out = arb.update()
+    assert out["actions"] == []          # stockout: retried later
+    assert len(arb.borrowed) == 1
+    p.max_slices = 8
+    clock.advance(1.0)
+    assert arb.update()["actions"] == ["return"]
+
+
+def test_fleet_summary_payload_normalizes():
+    arb, _mgr, _p, _ctrl, _clock, _g = _rig()
+    arb._gauges_fn = lambda: {
+        "rows": [
+            {"queue_depth": 2.0, "ttft_p99_ms": 900.0},
+            {"queue_depth": 7.0, "ttft_p99_ms": 1500.0},
+            {"queue_depth": None, "ttft_p99_ms": None},
+        ],
+        "fleet": {"tokens_per_s": 123.0, "train_tokens_per_s": 456.0},
+    }
+    g = arb._gauges()
+    assert g["queue_depth"] == 7.0       # max across replicas
+    assert g["ttft_p99_ms"] == 1500.0
+    assert g["serve_tokens_per_s"] == 123.0
+    assert g["train_tokens_per_s"] == 456.0
+
+
+def test_status_rows_show_ownership_and_borrows():
+    arb, _mgr, _p, _ctrl, clock, gauges = _rig(n_train=1, n_serve=1)
+    st = arb.status()
+    assert {r["kind"] for r in st["rows"]} == {"train", "serve"}
+    assert all(r["state"] == UP for r in st["rows"])
+    gauges.queue_depth = 50.0
+    arb.update()
+    clock.advance(3.0)
+    arb.update()
+    st = arb.status()
+    borrowed = [r for r in st["rows"]
+                if r["why"].startswith("borrowed-by-serve")]
+    assert len(borrowed) == 1
+    assert borrowed[0]["owner"] == "train-job-0"
+    assert st["borrowed"] == 1 and st["preemptions"] == 1
+    assert st["policy"]["queue_high"] == 4.0
+
+
+def test_claim_validates_kind_and_released_claims_drop():
+    arb, mgr, p, _ctrl, _clock, _gauges = _rig(n_train=1, n_serve=0)
+    with pytest.raises(ValueError):
+        arb.claim("s", "x", kind="batch")
+    sid = next(iter(arb.claims))
+    mgr.drain_slice(sid, "maintenance")
+    alive = p.internal_ids(sid)
+    mgr.update({"demand": [], "slice_demand": [], "busy_nodes": set(),
+                "alive_nodes": set(alive)})
+    assert mgr.slices[sid].state == RELEASED
+    arb.update()
+    assert sid not in arb.claims

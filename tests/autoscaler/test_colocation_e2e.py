@@ -1,0 +1,381 @@
+"""Train+serve colocation end-to-end: serve spike → SliceArbiter
+preempts the training slice → ElasticTrainer folds and keeps the
+trajectory → ebb → slice returned → regrow. Plus the seeded
+arbitration soak leg tools/chaos_matrix.sh drives (a host SIGKILL
+lands inside the preemption window).
+
+Live-cluster, slow-marked; the clusterless arbiter units live in
+test_arbiter.py."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import ray_tpu
+from ray_tpu.autoscaler.arbiter import ArbiterPolicy, SliceArbiter
+from ray_tpu.autoscaler.node_provider import FakeSliceProvider
+from ray_tpu.autoscaler.slices import (RELEASED, UP, SliceManager,
+                                       SliceTypeConfig)
+from ray_tpu.core.events import FlightRecorder
+from ray_tpu.exceptions import AdmissionRejectedError
+from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.parallel.elastic import ElasticTrainer
+from ray_tpu.parallel.plan import ParallelPlan
+
+pytestmark = [pytest.mark.slow, pytest.mark.elastic]
+
+
+def tiny_config(**kw):
+    import jax.numpy as jnp
+    base = dict(vocab_size=128, d_model=32, n_layers=4, n_heads=2,
+                head_dim=16, d_ff=64, max_seq_len=32, rotary_dim=8,
+                block_style="gptj", dtype=jnp.float32, remat=False,
+                ce_chunk_size=8)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _batch(cfg, b=8, s=16, seed=1):
+    ids = np.array(jax.random.randint(jax.random.PRNGKey(seed), (b, s),
+                                      0, cfg.vocab_size))
+    return {"input_ids": ids, "loss_mask": np.ones((b, s), np.float32)}
+
+
+class _StubScheduler:
+    def __init__(self):
+        self.draining = {}
+
+    def set_draining(self, node_id, flag):
+        self.draining[node_id.binary()] = flag
+
+
+class _StubController:
+    def __init__(self):
+        self.scheduler = _StubScheduler()
+        self.rescheduled = []
+        self.recorder = FlightRecorder("test", capacity=4096)
+
+    def call_on_loop(self, fn, timeout=None):
+        return fn()
+
+    def _reschedule_pgs_on_nodes(self, node_bs):
+        self.rescheduled.append(set(node_bs))
+        return 1
+
+    def _maybe_schedule(self, force=False):
+        pass
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class _Gauges:
+    def __init__(self):
+        self.queue_depth = 0.0
+        self.ttft_p99_ms = 100.0
+
+    def __call__(self):
+        return {"queue_depth": self.queue_depth,
+                "ttft_p99_ms": self.ttft_p99_ms}
+
+
+class _Rig:
+    """Shared train+serve pool: one train slice (owned by the
+    trainer), one serve slice, an arbiter over injected gauges."""
+
+    def __init__(self, drain_deadline_s=0.0):
+        self.ctrl = _StubController()
+        self.provider = FakeSliceProvider(
+            provider_config={"max_slices": 2})
+        self.mgr = SliceManager(
+            self.ctrl, self.provider,
+            [SliceTypeConfig("pod", "2x4", {"CPU": 1})],
+            idle_timeout_s=3600.0, drain_deadline_s=drain_deadline_s)
+        self.clock = _Clock()
+        self.gauges = _Gauges()
+        self.arbiter = SliceArbiter(
+            self.mgr,
+            policy=ArbiterPolicy(
+                queue_high=4.0, queue_low=1.0,
+                ttft_p99_high_ms=2000.0, ttft_p99_low_ms=1000.0,
+                sustain_s=2.0, ebb_s=4.0),
+            gauges_fn=self.gauges, now_fn=self.clock)
+        self.train_sid = self.mgr.acquire_slice("pod")
+        self.arbiter.claim(self.train_sid, owner="train-job",
+                           kind="train", priority=0)
+        self.clock.advance(0.1)
+        self.serve_sid = self.mgr.acquire_slice("pod")
+        self.arbiter.claim(self.serve_sid, owner="serve-fleet",
+                           kind="serve", priority=10)
+        #: slices the trainer owns; the arbiter's return callback
+        #: re-points it at the replacement slice
+        self.owned = {self.train_sid}
+        self.arbiter.register_on_return(self._on_return)
+        self.pump(busy=True)
+        assert self.mgr.slices[self.train_sid].state == UP
+        assert self.mgr.slices[self.serve_sid].state == UP
+
+    def _on_return(self, info):
+        if info["owner"] == "train-job":
+            self.owned.add(info["slice_id"])
+
+    def _alive(self):
+        return [h for sid, i in self.mgr.slices.items()
+                if i.state != RELEASED
+                for h in self.provider.internal_ids(sid)]
+
+    def pump(self, busy=True):
+        alive = self._alive()
+        self.mgr.update({
+            "demand": [], "slice_demand": [],
+            "busy_nodes": set(alive) if busy else set(),
+            "alive_nodes": set(alive)})
+
+    def events(self, name):
+        evs = self.ctrl.recorder.drain()
+        self._events = getattr(self, "_events", []) + evs
+        return [e for e in self._events if e["ev"] == name]
+
+    def shutdown(self):
+        self.mgr.shutdown()
+        self.provider.shutdown()
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=8, _num_initial_workers=4,
+                 ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_colocation_preempt_fold_return_regrow(cluster):
+    """The acceptance path: sustained serve pressure preempts the
+    training slice (ElasticTrainer folds dp=2 → dp=1, ≤1 step lost,
+    trajectory parity ≤1e-5), pressure ebbs past hysteresis, the slice
+    is returned and the plan regrows — parity holds through it all.
+    Over-budget low-priority traffic sheds typed the whole time."""
+    rig = _Rig()
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    trainer = ElasticTrainer(
+        ParallelPlan(dp=2), cfg, learning_rate=1e-3,
+        telemetry_interval_s=0, slice_manager=rig.mgr,
+        slice_filter=lambda sid: sid in rig.owned)
+    ref = ParallelPlan(dp=2).build(cfg, learning_rate=1e-3,
+                                   telemetry_interval_s=0)
+    try:
+        for _ in range(2):
+            a, b = trainer.step(batch), ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-5
+
+        # ---- diurnal spike: sustained pressure → preempt ----
+        rig.gauges.queue_depth = 9.0
+        rig.arbiter.update()               # pressure clock starts
+        rig.clock.advance(2.5)
+        out = rig.arbiter.update()
+        assert out["actions"] == [f"preempt:{rig.train_sid}"]
+        ev = rig.events("ARBITER_PREEMPT")[-1]
+        assert ev["slice"] == rig.train_sid
+        assert ev["reason"] == "queue-depth"
+
+        # admission degrades the serve edge gracefully meanwhile:
+        # over-budget low-priority sheds typed, high-priority admits
+        from ray_tpu.serve.admission import (AdmissionController,
+                                             AdmissionPolicy)
+        adm = AdmissionController(AdmissionPolicy(
+            tenant_budgets={"batch": 0.0}))
+        with pytest.raises(AdmissionRejectedError) as ei:
+            adm.admit("batch", "low", {}, tokens=64)
+        assert ei.value.reason == "over-budget"
+        adm.admit("batch", "high", {}, tokens=64)
+
+        # the trainer consumes the drain notice at the next step
+        # boundary: fold dp=2 → dp=1, trajectory continues exactly
+        for _ in range(3):
+            a, b = trainer.step(batch), ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-5
+        assert trainer.plan.dp == 1
+        assert trainer.target_plan.dp == 2
+        rep = trainer.recoveries[0]
+        assert rep.trigger == "notice" and rep.steps_lost <= 1
+        assert "arbiter-preempt" in rep.reason
+
+        # drain completes (hosts quiesce) → slice released, capacity
+        # frees for the eventual return
+        rig.pump(busy=False)
+        assert rig.mgr.slices[rig.train_sid].state == RELEASED
+
+        # ---- ebb past hysteresis: slice returned, plan regrown ----
+        rig.gauges.queue_depth = 0.2
+        rig.arbiter.update()               # calm clock starts
+        rig.clock.advance(4.5)
+        out = rig.arbiter.update()
+        assert out["actions"] == ["return"]
+        new_sid = next(iter(rig.owned - {rig.train_sid}))
+        rig.pump(busy=True)                # replacement slice comes UP
+        assert rig.mgr.slices[new_sid].state == UP
+        ev = rig.events("ARBITER_RETURN")[-1]
+        assert ev["slice"] == new_sid and ev["dur_s"] > 0
+
+        # next step boundary auto-regrows to the target grid; the
+        # trajectory STILL tracks the uninterrupted run
+        for _ in range(3):
+            a, b = trainer.step(batch), ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-5
+        assert trainer.plan.dp == 2
+        assert any(r.trigger == "regrow" for r in trainer.recoveries)
+        assert trainer.steps_lost_total <= 1
+        assert rig.arbiter.preemptions == 1
+        assert rig.arbiter.returns == 1
+        # pools audit: exactly the serve slice + the regrown train
+        # slice survive — nothing leaked, nothing double-freed
+        live = {sid for sid, i in rig.mgr.slices.items()
+                if i.state == UP}
+        assert live == {rig.serve_sid, new_sid}
+    finally:
+        trainer.shutdown()
+        ref.shutdown()
+        rig.shutdown()
+
+
+# ------------------------------------------------- chaos soak (leg)
+@pytest.mark.chaos
+def test_arbitration_soak():
+    """tools/chaos_matrix.sh arbitration leg: a seeded serve spike
+    lands mid-train AND a stage-actor SIGKILL lands inside the
+    preemption window — typed errors only, no hangs, no slice leaks,
+    training resumes (fold then regrow) and the trajectory tracks the
+    uninterrupted run."""
+    seeds = [int(s) for s in os.environ.get(
+        "RAY_TPU_CHAOS_SOAK_SEEDS", "7707").split()]
+    for seed in seeds:
+        _run_arbitration_soak(seed)
+
+
+def _run_arbitration_soak(seed: int) -> None:
+    import random
+
+    rng = random.Random(f"{seed}:arbitration-soak")
+    spike_step = rng.randint(1, 3)
+    kill_delay_s = 0.02 + rng.random() * 0.1
+    ray_tpu.init(num_cpus=8, _num_initial_workers=4,
+                 ignore_reinit_error=True)
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    rig = _Rig(drain_deadline_s=1.0)
+    trainer = ref = None
+    try:
+        trainer = ElasticTrainer(
+            ParallelPlan(pp=2, n_microbatches=2), cfg,
+            learning_rate=1e-3, slice_manager=rig.mgr,
+            slice_filter=lambda sid: sid in rig.owned)
+        ref = ParallelPlan().build(cfg, learning_rate=1e-3,
+                                   telemetry_interval_s=0)
+        deadline = time.monotonic() + 300
+        killed = returned = False
+        for step in range(14):
+            assert time.monotonic() < deadline, \
+                f"seed {seed}: hang at step {step}"
+            rig.pump(busy=not rig.arbiter.borrowed)
+            if step == spike_step:
+                rig.gauges.queue_depth = 50.0
+                rig.arbiter.update()
+                rig.clock.advance(2.5)
+            out = rig.arbiter.update()
+            if any(a.startswith("preempt") for a in out["actions"]) \
+                    and not killed:
+                # SIGKILL a stage actor INSIDE the preemption window:
+                # the fold and the death race, both must be absorbed
+                killed = True
+                pipe = getattr(trainer.program, "pipeline", None)
+                if pipe is not None:
+                    victim = pipe.stages[rng.randrange(
+                        len(pipe.stages))]
+                    threading.Timer(
+                        kill_delay_s,
+                        lambda: ray_tpu.kill(victim)).start()
+            if rig.arbiter.borrowed and not returned \
+                    and step >= spike_step + 3:
+                # spike over: calm long enough to trigger the return
+                rig.gauges.queue_depth = 0.1
+                rig.arbiter.update()
+                rig.clock.advance(4.5)
+                if rig.arbiter.update()["actions"] == ["return"]:
+                    returned = True
+            a = trainer.step(batch)      # absorbs typed failures only
+            b = ref.step(batch)
+            assert abs(a.loss - b.loss) <= 1e-5, \
+                f"seed {seed}: trajectory diverged at step {step}: " \
+                f"{a.loss} vs {b.loss}"
+        assert rig.arbiter.preemptions >= 1, \
+            f"seed {seed}: spike never preempted"
+        assert rig.arbiter.returns >= 1, \
+            f"seed {seed}: slice never returned"
+        assert trainer.recoveries, f"seed {seed}: no recovery ran"
+        assert trainer.steps_lost_total <= 2
+        # training resumed on the regrown grid
+        assert trainer.plan == trainer.target_plan, \
+            f"seed {seed}: never regrew: {trainer.plan}"
+        # pools audit clean: every non-RELEASED slice is claimed, no
+        # borrow outstanding, provider inventory matches the books
+        assert rig.arbiter.borrowed == []
+        live = {sid for sid, i in rig.mgr.slices.items()
+                if i.state == UP}
+        assert live == set(rig.arbiter.claims), \
+            f"seed {seed}: books diverged: {live} vs " \
+            f"{set(rig.arbiter.claims)}"
+        assert set(rig.provider.non_terminated_nodes()) == live, \
+            f"seed {seed}: provider leaked slices"
+        ref.shutdown()
+        trainer.shutdown()
+        trainer = ref = None
+        from ray_tpu.util.state import list_actors
+        alive = [a for a in list_actors(
+            filters=[("state", "=", "ALIVE")])
+            if "PipelineStage" in str(a)]
+        assert alive == [], f"seed {seed}: leaked stage actors {alive}"
+    except Exception:
+        _dump_postmortem(seed)
+        raise
+    finally:
+        try:
+            if trainer is not None:
+                trainer.shutdown()
+            if ref is not None:
+                ref.shutdown()
+            rig.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
+def _dump_postmortem(seed) -> None:
+    path = os.environ.get("RAY_TPU_CHAOS_POSTMORTEM_FILE")
+    if not path:
+        return
+    try:
+        from ray_tpu.util.state import list_task_events
+        events = list_task_events(limit=100_000)
+        with open(path, "w") as f:
+            json.dump({"seed": seed, "events": events}, f)
+    except Exception as e:
+        try:
+            with open(path, "w") as f:
+                json.dump({"seed": seed, "events": [],
+                           "error": f"postmortem dump failed: {e}"}, f)
+        except Exception:
+            pass

@@ -252,3 +252,86 @@ def test_gang_demand_scales_workergroup_and_drains_down():
     assert drained == set(ids)
     mock.reconcile()
     assert provider.non_terminated_nodes() == []
+
+
+# --------------------------------------------- maintenance annotations
+# Field-shape pin against a recorded real-API pods-list response
+# (mirrors tests/autoscaler/test_gce_transport.py's upcomingMaintenance
+# fixture): the drain path keys on the ray-tpu/maintenance annotation
+# and the ray-tpu/node-id label, and a silent rename in either would
+# disable preemption notices without failing anything else.
+
+def _pods_fixture():
+    import json
+    import pathlib
+    p = (pathlib.Path(__file__).parent / "fixtures" /
+         "gke_maintenance_pods.json")
+    return json.loads(p.read_text())
+
+
+def _fixture_provider(body=None):
+    body = body or _pods_fixture()
+
+    def request_fn(method, path, payload):
+        assert method == "GET" and "/pods" in path
+        assert f"{LABEL_CLUSTER}=testclus" in path
+        return body
+
+    api = K8sApiClient("ray-tpu", request_fn=request_fn)
+    return GKETPUNodeProvider(
+        {"namespace": "ray-tpu", "cluster_name": "testclus",
+         "groups": {"v5litepod-16": "v5e-16-group"},
+         "pods_cache_ttl_s": 0.0},
+        api=api)
+
+
+def test_gke_maintenance_fixture_shape():
+    """The recorded response still carries every field the parser
+    keys on: list framing, node-id labels, and the annotation."""
+    body = _pods_fixture()
+    assert body["kind"] == "PodList" and body["items"]
+    annotated = [p for p in body["items"]
+                 if "ray-tpu/maintenance"
+                 in (p["metadata"].get("annotations") or {})
+                 and LABEL_NODE_ID in p["metadata"].get("labels", {})]
+    assert len(annotated) == 2      # both hosts of the flagged slice
+    assert {p["metadata"]["labels"][LABEL_NODE_ID]
+            for p in annotated} == {"raytpu-testclus-v5e16-0007"}
+
+
+def test_gke_maintenance_events_from_fixture():
+    provider = _fixture_provider()
+    events = provider.maintenance_events()
+    # one event per (slice, notice) even though BOTH host pods carry
+    # the annotation; the un-annotated slice and the operator pod
+    # (annotation but no node-id label) report nothing
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["slice_id"] == "raytpu-testclus-v5e16-0007"
+    assert ev["kind"] == "maintenance"
+    assert ev["event_id"].startswith("gke-")
+    # one-shot: the same notice is not re-reported
+    assert provider.maintenance_events() == []
+
+
+def test_gke_changed_annotation_reports_again():
+    body = _pods_fixture()
+    provider = _fixture_provider(body)
+    assert len(provider.maintenance_events()) == 1
+    for p in body["items"]:
+        ann = p["metadata"].get("annotations") or {}
+        if "ray-tpu/maintenance" in ann and \
+                LABEL_NODE_ID in p["metadata"].get("labels", {}):
+            ann["ray-tpu/maintenance"] = \
+                "scheduled window=2026-09-01T03:00:00Z"
+    events = provider.maintenance_events()
+    assert [e["slice_id"] for e in events] == \
+        ["raytpu-testclus-v5e16-0007"]
+
+
+def test_gke_maintenance_tolerates_sparse_metadata():
+    provider = _fixture_provider({"kind": "PodList", "items": [
+        {"metadata": {"labels": {LABEL_CLUSTER: "testclus"}}},
+        {"metadata": {}},
+    ]})
+    assert provider.maintenance_events() == []

@@ -577,3 +577,88 @@ def test_on_drain_unregister_stops_delivery():
     mgr.update(_snap(alive=ids))
     mgr.drain_slice(sid, "maintenance")
     assert notices == []
+
+
+def test_on_drain_multi_subscriber_fifo_order():
+    """Arbiter + ElasticTrainer both observe the SAME notice, in
+    registration order, neither stealing it from the other."""
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "4x4", {"CPU": 1})],
+        drain_deadline_s=0.0)
+    order = []
+    mgr.register_on_drain(lambda n: order.append(("arbiter", n)))
+    mgr.register_on_drain(lambda n: order.append(("trainer", n)))
+    mgr.register_on_drain(lambda n: order.append(("third", n)))
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    mgr.drain_slice(sid, "arbiter-preempt")
+    assert [name for name, _ in order] == ["arbiter", "trainer",
+                                           "third"]
+    # one shared notice object: nobody got a stale or distinct copy
+    assert len({id(n) for _, n in order}) == 1
+    assert order[0][1].slice_id == sid
+    assert order[0][1].reason == "arbiter-preempt"
+
+
+def test_on_drain_unregister_during_dispatch_skips_victim():
+    """A callback unregistered mid-dispatch — by an EARLIER callback of
+    the same dispatch — must not fire: membership is checked at call
+    time, not snapshot time."""
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "4x4", {"CPU": 1})],
+        drain_deadline_s=0.0)
+    fired = []
+
+    def victim(notice):
+        fired.append("victim")
+
+    def first(notice):
+        fired.append("first")
+        mgr.unregister_on_drain(victim)
+
+    mgr.register_on_drain(first)
+    mgr.register_on_drain(victim)
+    sid = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid)
+    mgr.update(_snap(alive=ids))
+    mgr.drain_slice(sid, "maintenance")
+    assert fired == ["first"]
+
+
+def test_on_drain_self_unregister_still_delivers_to_later_subscriber():
+    """A one-shot subscriber that unregisters ITSELF inside its own
+    callback doesn't disturb delivery to subscribers after it, and a
+    subscriber registered during dispatch waits for the next notice."""
+    ctrl = _StubController()
+    p = FakeSliceProvider()
+    mgr = SliceManager(
+        ctrl, p, [SliceTypeConfig("pod", "4x4", {"CPU": 1})],
+        drain_deadline_s=3600.0)
+    fired = []
+
+    def late(notice):
+        fired.append(("late", notice.slice_id))
+
+    def one_shot(notice):
+        fired.append(("one_shot", notice.slice_id))
+        mgr.unregister_on_drain(one_shot)
+        mgr.register_on_drain(late)  # joins from the NEXT notice on
+
+    def steady(notice):
+        fired.append(("steady", notice.slice_id))
+
+    mgr.register_on_drain(one_shot)
+    mgr.register_on_drain(steady)
+    sid_a = mgr.acquire_slice("pod")
+    sid_b = mgr.acquire_slice("pod")
+    ids = p.internal_ids(sid_a) + p.internal_ids(sid_b)
+    mgr.update(_snap(alive=ids, busy=ids))
+    mgr.drain_slice(sid_a, "maintenance")
+    mgr.drain_slice(sid_b, "maintenance")
+    assert fired == [("one_shot", sid_a), ("steady", sid_a),
+                     ("steady", sid_b), ("late", sid_b)]

@@ -300,6 +300,7 @@ def _events_by_task(events):
 
 
 @pytest.mark.observability
+@pytest.mark.slow
 def test_trace_propagation_and_aggregation(ray_start_regular):
     from ray_tpu.util.state import list_task_events, \
         summarize_task_latency
@@ -343,6 +344,7 @@ def test_trace_propagation_and_aggregation(ray_start_regular):
 
 
 @pytest.mark.observability
+@pytest.mark.slow
 def test_trace_propagation_exactly_once_under_drops():
     """5% drops over the widened droppable set (TEV flushes included):
     lifecycle events still arrive exactly-once-effect — no task shows
@@ -481,6 +483,7 @@ def test_stream_replay_prefix_visible_in_task_events():
 
 @pytest.mark.observability
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_e2e_three_node_timeline_with_retransmit():
     """Acceptance demo: a 3-node cluster runs a streaming task plus a
     task fan-out while STREAM_ITEM drops force retransmits; the

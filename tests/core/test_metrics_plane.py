@@ -302,6 +302,7 @@ def _dashboard_address():
         return json.load(f)["address"]
 
 
+@pytest.mark.slow
 def test_e2e_fleet_metrics_three_pids_and_tokens_series():
     """Acceptance demo: during serving + task load, the dashboard
     `/metrics` endpoint serves aggregated samples from >=3 distinct

@@ -75,6 +75,45 @@ def test_job_failure_and_stop(cluster, tmp_path):
         client.get_job_info("nope")
 
 
+def test_job_priority_and_elastic_fields(cluster):
+    """Arbitration hints ride the job API end to end: stored on the
+    job record, surfaced by list/info, exported to the driver's env
+    (so it can claim slices at the right priority), and the arbiter
+    status route answers 404 without / JSON with an arbiter."""
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+    client = JobSubmissionClient(_dashboard_address(cluster))
+
+    jid = client.submit_job(
+        entrypoint="python -c \"import os; print('prio:',"
+                   " os.environ['RAY_TPU_JOB_PRIORITY'],"
+                   " os.environ['RAY_TPU_JOB_ELASTIC'])\"",
+        priority="low", elastic=True)
+    assert client.wait_until_status(jid, timeout_s=60) \
+        == JobStatus.SUCCEEDED
+    info = client.get_job_info(jid)
+    assert info["priority"] == "low" and info["elastic"] is True
+    assert "prio: low 1" in client.get_job_logs(jid)
+    # defaults: normal / not elastic
+    jid2 = client.submit_job(entrypoint="python -c pass")
+    info2 = client.get_job_info(jid2)
+    assert info2["priority"] == "normal" and info2["elastic"] is False
+    with pytest.raises(RuntimeError):
+        client.submit_job(entrypoint="true", priority="urgent")
+
+    # no arbiter configured on this head: typed 404
+    with pytest.raises(RuntimeError):
+        client.get_arbiter_status()
+    import types as _types
+    import ray_tpu.api as _api
+    ctrl = _api._head.controller
+    ctrl.slice_arbiter = _types.SimpleNamespace(
+        status=lambda: {"rows": [], "borrowed": 0})
+    try:
+        assert client.get_arbiter_status()["borrowed"] == 0
+    finally:
+        del ctrl.slice_arbiter
+
+
 def test_cluster_status_endpoint(cluster):
     addr = _dashboard_address(cluster)
     with urllib.request.urlopen(addr + "/api/cluster_status",

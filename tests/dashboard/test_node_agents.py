@@ -33,6 +33,7 @@ def _dashboard_address(info):
         return json.load(f)["address"]
 
 
+@pytest.mark.slow
 def test_node_process_stats_flow_to_state_api(cluster):
     pytest.importorskip("psutil")
     addr = _dashboard_address(cluster)

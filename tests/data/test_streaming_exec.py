@@ -76,6 +76,7 @@ def test_ordered_unordered_parity(data_cluster, ctx):
     assert got == expect, "unordered run lost/duplicated blocks"
 
 
+@pytest.mark.slow
 def test_unordered_single_stage_parity(data_cluster, ctx):
     ctx.preserve_order = False
     ds = rd.range(500, parallelism=5).map_batches(

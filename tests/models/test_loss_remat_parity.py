@@ -78,7 +78,8 @@ def test_fused_ce_n_tokens_and_no_bias():
     np.testing.assert_allclose(float(loss_f), float(loss_r), rtol=1e-6)
 
 
-@pytest.mark.parametrize("name", ["gptj-tiny", "llama2-tiny"])
+@pytest.mark.parametrize("name", [
+    pytest.param("gptj-tiny", marks=pytest.mark.slow), "llama2-tiny"])
 def test_lm_loss_fused_matches_materialized(name):
     """Model-level wiring: ce_chunk_size>0 (fused, with chunk padding)
     vs ce_chunk_size=0 (reference logits path) — loss and param grads."""

@@ -70,8 +70,9 @@ def _run_paged(cfg, ids, prompt_len, block_size, table_len,
     return pre, np.stack(dec, axis=1) if dec else None
 
 
-@pytest.mark.parametrize("style,kv_heads", [("gptj", None),
-                                            ("llama", 2)])
+@pytest.mark.parametrize("style,kv_heads", [
+    pytest.param("gptj", None, marks=pytest.mark.slow),
+    ("llama", 2)])
 def test_prefill_decode_parity_vs_full_forward(style, kv_heads):
     """prompt=7 with block_size=4: the last block is UNEVEN (3 tokens);
     chunked prefill (3+3+1) and 9 decode steps must match apply()."""

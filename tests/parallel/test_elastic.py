@@ -113,6 +113,7 @@ def test_snapshot_failure_is_typed_not_a_hang():
 
 
 # ------------------------------------------------ failure-path replay
+@pytest.mark.slow
 def test_failure_recovery_replays_exact_trajectory():
     """A typed mid-step failure rolls back to the last in-memory
     snapshot, rebuilds, replays — losing exactly 1 step (the in-flight
@@ -226,6 +227,33 @@ def test_drain_notice_folds_dp_and_continues_trajectory():
     finally:
         t.shutdown()
         ref.shutdown()
+
+
+def test_slice_filter_ignores_foreign_drains():
+    """On a shared train+serve pool the trainer only reacts to ITS
+    slices: a foreign (serve) slice draining is not a capacity loss —
+    no notice is enqueued, no fold happens."""
+    from ray_tpu.autoscaler.slices import DrainNotice
+    cfg = tiny_config()
+    batch = _batch(cfg)
+    t = ElasticTrainer(ParallelPlan(dp=2), cfg, learning_rate=1e-3,
+                       telemetry_interval_s=0,
+                       slice_filter=lambda sid: sid.startswith("train"))
+    try:
+        t.step(batch)
+        t._on_drain(DrainNotice(
+            slice_id="serve-slice-3", reason="arbiter-preempt",
+            hosts=4, type="pod", deadline_s=4.0))
+        t.step(batch)
+        assert t.plan.dp == 2 and t.recoveries == []
+        # our own slice draining still folds
+        t._on_drain(DrainNotice(
+            slice_id="train-slice-0", reason="arbiter-preempt",
+            hosts=4, type="pod", deadline_s=4.0))
+        t.step(batch)
+        assert t.plan.dp == 1 and len(t.recoveries) == 1
+    finally:
+        t.shutdown()
 
 
 # --------------------------------------- live cluster: p2p + regrow

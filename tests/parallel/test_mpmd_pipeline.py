@@ -371,7 +371,8 @@ def ref_bundle():
     return make_train_step(tiny_config(), mesh, learning_rate=1e-3)
 
 
-@pytest.mark.parametrize("n_virtual", [1, 2])
+@pytest.mark.parametrize(
+    "n_virtual", [1, pytest.param(2, marks=pytest.mark.slow)])
 def test_per_stage_optimizer_matches_train_step(n_virtual, ref_bundle):
     """Acceptance numerics, clusterless: the per-stage fused optimizer
     (grad accumulation + driver-reduced global clip + per-slice adamw)

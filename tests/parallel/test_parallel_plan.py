@@ -165,6 +165,7 @@ def test_nested_stage_mesh_matches_spmd_short():
         assert abs(gn - r.grad_norm) <= 1e-4
 
 
+@pytest.mark.slow
 def test_nested_int8_stage_wire_is_quantized_and_tracks_fp32():
     """int8 grad transport on the stage mesh: the reduction program's
     compiled HLO moves REAL s8 payloads (not in-graph error
@@ -205,6 +206,7 @@ def test_nested_int8_stage_wire_is_quantized_and_tracks_fp32():
                      r"all-gather", txt) or "s8[" in txt
 
 
+@pytest.mark.slow
 def test_sharded_update_flat_opt_state_checkpoints_param_shaped():
     """shard_weight_update=True keeps the stage's optimizer state in
     flat 1/N shards over the mesh, but stage_checkpoint converts back
@@ -285,6 +287,7 @@ def test_checkpoint_round_trip_across_lowerings():
 
 
 # ------------------------- re-slicing edge cases the elastic path leans on
+@pytest.mark.slow
 def test_dp_shrink_reslices_uneven_flat_opt_shards():
     """dp=2 → dp=1 shrink under shard_weight_update: the flat 1/N
     optimizer shards carry per-leaf zero padding (flat_pad_len) that
@@ -328,6 +331,7 @@ def test_dp_shrink_reslices_uneven_flat_opt_shards():
         assert abs(lw - ln) <= 1e-5
 
 
+@pytest.mark.slow
 def test_virtual_fold_to_v1_under_int8_grad_transport():
     """v=2 → v=1 fold (the elastic ladder's pp/2 × 2v inverse) with
     int8 grad transport live on the dp mesh: the canonical checkpoint

@@ -76,6 +76,7 @@ def test_ppo_pendulum_one_iteration(ray_session):
         algo.cleanup()
 
 
+@pytest.mark.slow
 def test_sac_pendulum_one_iteration(ray_session):
     """Continuous SAC: twin Q(s, a), squashed-Gaussian actor, learned
     temperature — one train step with finite metrics."""

@@ -35,6 +35,7 @@ def test_ddpg_pendulum_one_iteration(ray_session):
         algo.cleanup()
 
 
+@pytest.mark.slow
 def test_td3_uses_twin_and_delay(ray_session):
     config = (TD3Config()
               .environment("Pendulum-v1")

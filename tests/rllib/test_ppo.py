@@ -43,6 +43,7 @@ def test_learner_update_reduces_loss():
     assert last["vf_loss"] < first["vf_loss"]
 
 
+@pytest.mark.slow
 def test_ppo_config_fluent_and_build(ray_session):
     config = (PPOConfig()
               .environment("CartPole-v1")
@@ -137,6 +138,7 @@ def test_multi_learner_group_matches_local(ray_session):
         group.shutdown()
 
 
+@pytest.mark.slow
 def test_pg_runs(ray_session):
     config = (PGConfig().environment("CartPole-v1")
               .env_runners(num_env_runners=1)

@@ -1,0 +1,183 @@
+"""SLO-aware admission units: token budgets, priority shedding,
+best-replica overload semantics, and the handle plumbing — pure host
+logic over a fake clock and hand-built gauges (no cluster)."""
+
+import pickle
+import time
+import types
+
+import pytest
+
+from ray_tpu.exceptions import AdmissionRejectedError
+from ray_tpu.serve.admission import (
+    AdmissionController, AdmissionPolicy, priority_name,
+    priority_value)
+from ray_tpu.serve.handle import DeploymentHandle
+
+pytestmark = pytest.mark.serve_llm
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _ctl(clock=None, **policy):
+    return AdmissionController(AdmissionPolicy(**policy),
+                               now_fn=clock or _Clock())
+
+
+def _saturated(queue=20.0, ttft=10.0):
+    return {b"r0": {"queue_depth": queue, "ttft_ewma_s": ttft}}
+
+
+def test_priority_classes_order_and_validation():
+    assert priority_value("low") < priority_value("normal") \
+        < priority_value("high")
+    assert priority_value(None) == priority_value("normal")
+    assert priority_value(7) == 7
+    assert priority_name("high") == "high"
+    assert priority_name(2) == "high"
+    with pytest.raises(ValueError):
+        priority_value("urgent")
+    with pytest.raises(ValueError):
+        priority_value(3.5)
+
+
+def test_over_budget_tenant_sheds_typed():
+    clock = _Clock()
+    a = _ctl(clock, tenant_budgets={"t1": 10.0}, budget_window_s=10.0)
+    a.admit("t1", "normal", {}, tokens=60)      # 6 tok/s: fine
+    with pytest.raises(AdmissionRejectedError) as ei:
+        a.admit("t1", "normal", {}, tokens=60)  # would be 12 tok/s
+    e = ei.value
+    assert e.reason == "over-budget"
+    assert e.tenant == "t1" and e.priority == "normal"
+    assert a.admitted == 1 and a.rejected == 1
+    # an un-budgeted tenant is never budget-shed
+    a.admit("t2", "normal", {}, tokens=10_000)
+
+
+def test_budget_window_slides():
+    clock = _Clock()
+    a = _ctl(clock, tenant_budgets={"t1": 10.0}, budget_window_s=10.0)
+    a.admit("t1", "normal", {}, tokens=90)
+    with pytest.raises(AdmissionRejectedError):
+        a.admit("t1", "normal", {}, tokens=90)
+    clock.advance(11.0)           # earlier spend aged out
+    a.admit("t1", "normal", {}, tokens=90)
+    assert a.admitted == 2
+
+
+def test_high_priority_exempt_from_budget():
+    a = _ctl(tenant_budgets={"t1": 1.0})
+    a.admit("t1", "high", {}, tokens=10_000)
+    a.admit("t1", "high", {}, tokens=10_000)
+    assert a.rejected == 0
+
+
+def test_overload_sheds_low_priority_only():
+    a = _ctl()
+    with pytest.raises(AdmissionRejectedError) as ei:
+        a.admit("t1", "low", _saturated())
+    assert ei.value.reason == "overload"
+    # normal/high ride through the spike (their TTFT is what the
+    # shed is protecting)
+    a.admit("t1", "normal", _saturated())
+    a.admit("t1", "high", _saturated())
+    assert a.admitted == 2 and a.rejected == 1
+
+
+def test_one_idle_replica_means_not_overloaded():
+    a = _ctl()
+    gauges = dict(_saturated())
+    gauges[b"r1"] = {"queue_depth": 0.0, "ttft_ewma_s": 0.1}
+    a.admit("t1", "low", gauges)   # routing can still absorb it
+    assert a.rejected == 0
+    assert not a.overloaded(gauges)
+
+
+def test_no_gauges_admits():
+    a = _ctl()
+    a.admit("t1", "low", {})
+    assert a.admitted == 1
+
+
+def test_shed_increments_counter_and_records_event():
+    from ray_tpu.core.events import FlightRecorder
+    from ray_tpu.core.metric_defs import runtime_metrics
+    rec = FlightRecorder("test", capacity=64)
+    a = AdmissionController(AdmissionPolicy(), recorder=rec,
+                            now_fn=_Clock())
+    with pytest.raises(AdmissionRejectedError):
+        a.admit("acme", "low", _saturated())
+    evs = [e for e in rec.drain() if e["ev"] == "ARBITER_REJECT"]
+    assert len(evs) == 1
+    assert evs[0]["tenant"] == "acme"
+    assert evs[0]["priority"] == "low"
+    assert evs[0]["reason"] == "overload"
+    snap = runtime_metrics().admission_rejected.snapshot()
+    assert any(dict(s[0]) == {"tenant": "acme", "priority": "low"}
+               and s[1] >= 1 for s in snap["samples"])
+
+
+def test_rejection_error_pickles_with_fields():
+    e = AdmissionRejectedError("t", "low", "over-budget", "detail")
+    e2 = pickle.loads(pickle.dumps(e))
+    assert (e2.tenant, e2.priority, e2.reason) == \
+        ("t", "low", "over-budget")
+
+
+# -- handle plumbing --------------------------------------------------
+
+class _BombReplica:
+    """A replica that must never be reached by a shed request."""
+
+    _actor_id = types.SimpleNamespace(binary=lambda: b"\x01")
+
+    def __getattr__(self, name):
+        raise AssertionError("shed request reached the replica")
+
+
+def _handle_with_admission(**policy):
+    h = DeploymentHandle("d", controller=None)
+    r = h._router
+    r.refresh = lambda force=False: None
+    r._poll_gauges = lambda: None
+    r.replicas = [_BombReplica()]
+    now = time.monotonic()
+    r.gauges = {b"\x01": {"queue_depth": 50.0, "ttft_ewma_s": 9.0,
+                          "t": now}}
+    h.enable_admission(AdmissionPolicy(**policy))
+    return h
+
+
+def test_route_sheds_before_touching_replica():
+    h = _handle_with_admission()
+    with pytest.raises(AdmissionRejectedError):
+        h.options(tenant="t", priority="low").remote()
+
+
+def test_admission_shared_across_options_copies():
+    h = _handle_with_admission(tenant_budgets={"t": 0.0},
+                               budget_window_s=1.0)
+    h2 = h.options(tenant="t", priority="normal")
+    assert h2._router.admission is h._router.admission
+    with pytest.raises(AdmissionRejectedError) as ei:
+        h2.remote()
+    assert ei.value.reason == "over-budget"
+
+
+def test_options_validates_priority_and_reduce_roundtrips():
+    h = DeploymentHandle("d", controller=None)
+    with pytest.raises(ValueError):
+        h.options(priority="urgent")
+    h2 = h.options(tenant="acme", priority="high")
+    h3 = pickle.loads(pickle.dumps(h2))
+    assert h3._tenant == "acme" and h3._priority == "high"

@@ -406,6 +406,7 @@ def test_kv_block_math():
 
 
 # ---------------------------------------------------------------- serve
+@pytest.mark.slow
 def test_serve_streaming_integration(serve_session):
     from ray_tpu import serve
 
@@ -582,6 +583,7 @@ def test_serve_fleet_chaos_soak(seed):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_mid_decode_replica_sigkill_fails_typed(serve_session):
     """Chaos regression: SIGKILL the replica worker mid-decode; the
     consumer's stream must fail with a TYPED error (or complete, if the

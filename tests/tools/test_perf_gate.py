@@ -691,3 +691,93 @@ def test_elastic_gate_against_checked_in_baseline():
     assert m["elastic/parity_ok"] == 1.0, path
     ok, msgs = compare(rec, rec, metric="elastic")
     assert ok, msgs
+
+
+# ------------------------------------------------------------ colocate
+def _colocate_record(p99_ms=15000.0, improvement=3.0, steps_lost=0,
+                     parity=1e-6, fold_s=1.4, regrow_s=1.5,
+                     full=3000.0, folded=2800.0):
+    return {"metric": "colocate_spike_ttft_p99_ms", "value": p99_ms,
+            "unit": "ms",
+            "detail": {"backend": "cpu",
+                       "ttft_p99_improvement": improvement,
+                       "steps_lost": steps_lost,
+                       "loss_parity_abs": parity,
+                       "fold_recovery_s": fold_s,
+                       "regrow_s": regrow_s,
+                       "train_tokens_per_s_full": full,
+                       "train_tokens_per_s_folded": folded}}
+
+
+def test_colocate_extractor_inverts_and_gates_binaries():
+    from tools.perf_gate import extract_colocate_metrics
+    m = extract_colocate_metrics(_colocate_record())
+    assert m["colocate/spike_ttft_p99_inv"] == pytest.approx(
+        1000.0 / 15000.0, rel=1e-4)
+    assert m["colocate/beats_static"] == 1.0
+    assert m["colocate/ttft_improvement"] == 3.0
+    assert m["colocate/steps_lost_ok"] == 1.0
+    assert m["colocate/parity_ok"] == 1.0
+    assert m["colocate/fold_recovery_inv"] == pytest.approx(
+        1 / 1.4, rel=1e-4)
+    assert m["colocate/regrow_inv"] == pytest.approx(
+        1 / 1.5, rel=1e-4)
+    assert m["colocate/train_tokens_per_s_full"] == 3000.0
+    # losing to the static partition flips the binary
+    worse = extract_colocate_metrics(
+        _colocate_record(improvement=0.8, steps_lost=2, parity=1e-3))
+    assert worse["colocate/beats_static"] == 0.0
+    assert worse["colocate/steps_lost_ok"] == 0.0
+    assert worse["colocate/parity_ok"] == 0.0
+    sparse = extract_colocate_metrics(
+        {"metric": "colocate_spike_ttft_p99_ms", "value": 2000.0,
+         "detail": {}})
+    assert sparse["colocate/spike_ttft_p99_inv"] == pytest.approx(0.5)
+    assert sparse["colocate/beats_static"] is None
+    assert sparse["colocate/steps_lost_ok"] is None
+
+
+def test_colocate_compare_is_relative_and_binaries_are_hard():
+    base = _colocate_record()
+    # 20% worse spike p99 stays inside the 30% tolerance
+    ok, _ = compare(_colocate_record(p99_ms=18000.0), base,
+                    metric="colocate")
+    assert ok
+    # 2x worse p99 fails
+    ok, msgs = compare(_colocate_record(p99_ms=30000.0), base,
+                       metric="colocate")
+    assert not ok, msgs
+    # losing to the static partition is a -100% binary drop: fails at
+    # any tolerance even when every other row holds
+    ok, msgs = compare(_colocate_record(improvement=0.9), base,
+                       metric="colocate")
+    assert not ok, msgs
+    ok, msgs = compare(_colocate_record(steps_lost=2), base,
+                       metric="colocate")
+    assert not ok, msgs
+
+
+def test_colocate_gate_against_checked_in_baseline():
+    from tools.perf_gate import extract_colocate_metrics
+    path, rec = latest_baseline(REPO, metric="colocate")
+    m = extract_colocate_metrics(rec)
+    # the recorded acceptance run holds the issue's criteria: the
+    # arbitrated spike beats the static partition, <=1 step lost,
+    # trajectory parity <=1e-5
+    assert m["colocate/beats_static"] == 1.0, path
+    assert m["colocate/ttft_improvement"] > 1.0, path
+    assert m["colocate/steps_lost_ok"] == 1.0, path
+    assert m["colocate/parity_ok"] == 1.0, path
+    assert m["colocate/spike_ttft_p99_inv"] > 0
+    ok, msgs = compare(rec, rec, metric="colocate")
+    assert ok, msgs
+
+
+def test_colocate_gate_cli_passes_on_checked_in_record(tmp_path):
+    path, _rec = latest_baseline(REPO, metric="colocate")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "--fresh", path, "--metric", "colocate"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout

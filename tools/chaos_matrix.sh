@@ -197,6 +197,29 @@ for seed in "${seeds[@]}"; do
     fi
 done
 
+# ---- arbitration soak leg: a train+serve shared pool where a seeded
+# serve spike mid-train makes the SliceArbiter preempt the training
+# slice AND a stage-actor SIGKILL lands inside the preemption window;
+# invariants: typed errors only, no hangs, the ElasticTrainer folds
+# then regrows when the slice is returned, the trajectory tracks the
+# uninterrupted run, no slice leaks, arbiter books match the provider
+# inventory (tests/autoscaler/test_colocation_e2e.py::
+# test_arbitration_soak)
+for seed in "${seeds[@]}"; do
+    echo "=== arbitration soak: seed=$seed ==="
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        RAY_TPU_CHAOS_POSTMORTEM_FILE="$postmortem_dir/arbiter_postmortem_$seed.json" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/autoscaler/test_colocation_e2e.py::test_arbitration_soak" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== arbiter seed=$seed PASSED ==="
+        rm -f "$postmortem_dir/arbiter_postmortem_$seed.json"
+    else
+        echo "=== arbiter seed=$seed FAILED ==="
+        failed+=("arbiter:$seed")
+    fi
+done
+
 if [ "${#failed[@]}" -gt 0 ]; then
     echo
     echo "FAILING SEEDS: ${failed[*]}"
@@ -231,6 +254,19 @@ if [ "${#failed[@]}" -gt 0 ]; then
             echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
                  "tests/autoscaler/test_slice_e2e.py::test_slice_preemption_soak -q"
             pm="$postmortem_dir/slice_postmortem_$s.json"
+            if [ -f "$pm" ]; then
+                echo "  flight recorder: $pm" \
+                     "(python tools/timeline.py --input $pm)"
+            fi
+            continue
+            ;;
+        arbiter:*)
+            s="${seed#arbiter:}"
+            echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
+                 "tests/autoscaler/test_colocation_e2e.py::test_arbitration_soak -q"
+            # ARBITER_PREEMPT/RETURN + ELASTIC_* events render the
+            # whole borrow window as duration slices in Perfetto
+            pm="$postmortem_dir/arbiter_postmortem_$s.json"
             if [ -f "$pm" ]; then
                 echo "  flight recorder: $pm" \
                      "(python tools/timeline.py --input $pm)"

@@ -40,6 +40,12 @@ Three gated series (``--metric``):
   rollout→train consumer utilization (1 − streaming bubble) when the
   records carry them. Gated RELATIVELY like ``serve``; baselines
   ``DATA_r*.json``, bootstrap-passes.
+- ``colocate`` — the train+serve colocation record from ``bench.py
+  --colocate``: arbitrated spike p99 TTFT (lower is better — gated as
+  ``1000/p99_ms``), a binary beats-the-static-partition row, full/
+  folded training tokens/s, fold/regrow recovery inverses and the
+  steps-lost/parity binaries. Gated RELATIVELY; baselines
+  ``COLOCATE_r*.json``, bootstrap-passes.
 
 Baselines are matched to the fresh record's backend (``detail.backend``:
 "tpu"/"cpu") when possible, so a CPU smoke record checked in between TPU
@@ -76,18 +82,22 @@ BASELINE_GLOBS = {"bench": "BENCH_r*.json",
                   "serve": "SERVE_r*.json",
                   "pipeline": "PIPELINE_r*.json",
                   "data": "DATA_r*.json",
-                  "elastic": "ELASTIC_r*.json"}
+                  "elastic": "ELASTIC_r*.json",
+                  "colocate": "COLOCATE_r*.json"}
 #: metrics compared RELATIVELY (tolerance is an allowed % drop, not
 #: absolute points — tokens/s scales with the chip, MFU doesn't)
-RELATIVE_METRICS = {"serve", "pipeline", "data", "elastic"}
+RELATIVE_METRICS = {"serve", "pipeline", "data", "elastic", "colocate"}
 DEFAULT_TOLERANCES = {"bench": 2.0, "multichip": 2.0, "serve": 15.0,
                       "pipeline": 15.0, "data": 15.0,
                       # recovery wall-clock is teardown+rebuild+reload
                       # dominated — noisy on shared CI hosts
-                      "elastic": 30.0}
+                      "elastic": 30.0,
+                      # same teardown+rebuild noise in the fold/regrow
+                      # rows; the TTFT rows are deterministic sim
+                      "colocate": 30.0}
 #: series whose early records may predate any parseable baseline
 BOOTSTRAP_METRICS = {"multichip", "serve", "pipeline", "data",
-                     "elastic"}
+                     "elastic", "colocate"}
 
 
 def parse_bench_record(obj: dict) -> dict:
@@ -293,12 +303,59 @@ def extract_elastic_metrics(rec: dict) -> dict:
     return out
 
 
+def extract_colocate_metrics(rec: dict) -> dict:
+    """The train+serve colocation record (``bench.py --colocate``):
+    the arbitrated spike p99 TTFT headline inverted to the shared
+    higher-is-better comparison (1000/p99_ms), the improvement over
+    the static-partition baseline (must stay ≥ 1 — a binary
+    beats-static row makes losing to static an automatic FAIL), the
+    training tokens/s on the full and the folded (borrowed-window)
+    grid, the fold/regrow recovery inverses, and two binary acceptance
+    rows shared with the elastic series: zero-or-one steps lost and
+    loss-trajectory parity ≤ 1e-5."""
+    detail = rec.get("detail") or {}
+    out = {"colocate/spike_ttft_p99_inv": round(
+        1000.0 / max(float(rec["value"]), 1e-9), 6),
+        "colocate/beats_static": None,
+        "colocate/ttft_improvement": None,
+        "colocate/train_tokens_per_s_full": None,
+        "colocate/train_tokens_per_s_folded": None,
+        "colocate/fold_recovery_inv": None,
+        "colocate/regrow_inv": None,
+        "colocate/steps_lost_ok": None,
+        "colocate/parity_ok": None}
+    if detail.get("ttft_p99_improvement") is not None:
+        imp = float(detail["ttft_p99_improvement"])
+        out["colocate/ttft_improvement"] = imp
+        out["colocate/beats_static"] = 1.0 if imp >= 1.0 else 0.0
+    if detail.get("train_tokens_per_s_full") is not None:
+        out["colocate/train_tokens_per_s_full"] = \
+            float(detail["train_tokens_per_s_full"])
+    if detail.get("train_tokens_per_s_folded") is not None:
+        out["colocate/train_tokens_per_s_folded"] = \
+            float(detail["train_tokens_per_s_folded"])
+    if detail.get("fold_recovery_s") is not None:
+        out["colocate/fold_recovery_inv"] = round(
+            1.0 / max(float(detail["fold_recovery_s"]), 1e-9), 6)
+    if detail.get("regrow_s") is not None:
+        out["colocate/regrow_inv"] = round(
+            1.0 / max(float(detail["regrow_s"]), 1e-9), 6)
+    if detail.get("steps_lost") is not None:
+        out["colocate/steps_lost_ok"] = (
+            1.0 if int(detail["steps_lost"]) <= 1 else 0.0)
+    if detail.get("loss_parity_abs") is not None:
+        out["colocate/parity_ok"] = (
+            1.0 if float(detail["loss_parity_abs"]) <= 1e-5 else 0.0)
+    return out
+
+
 EXTRACTORS = {"bench": extract_metrics,
               "multichip": extract_multichip_metrics,
               "serve": extract_serve_metrics,
               "pipeline": extract_pipeline_metrics,
               "data": extract_data_metrics,
-              "elastic": extract_elastic_metrics}
+              "elastic": extract_elastic_metrics,
+              "colocate": extract_colocate_metrics}
 
 
 def latest_baseline(root: str = REPO_ROOT, metric: str = "bench",
