@@ -1560,6 +1560,103 @@ def colocate_main(smoke: bool = False) -> None:
     }))
 
 
+def rl_main(smoke: bool = False) -> None:
+    """Closed-loop RLHF record (``--rl``): N PPO rounds of serve-engine
+    rollouts feeding a 2-learner sharded streaming group with in-flight
+    int8 weight republish after every gradient round. Headline: rollout
+    tokens/s through the closed loop. The detail rows the gate reads:
+    learner rounds/s, weight-sync staleness p50/p99 (policy-version lag
+    observed at rollout admission), the rollout prefix-cache hit rate
+    (every request shares the system prompt — the radix trie must keep
+    paying), int8 wire compression, and ``decode_stall_s`` which must
+    be EXACTLY 0 — the swap is a step-boundary pointer exchange, never
+    a drain."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("RAY_TPU_JAX_PLATFORM",
+                          os.environ.get("JAX_PLATFORMS", ""))
+
+    import jax
+    import ray_tpu
+    from ray_tpu.parallel.mesh import chip_spec
+    from ray_tpu.rlhf import RLHFConfig, RLHFTrainer
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model = dict(vocab_size=2048, d_model=256, n_layers=4,
+                     n_heads=8, head_dim=32, d_ff=1024,
+                     max_seq_len=256, rotary_dim=32,
+                     dtype="bfloat16", remat_policy="none")
+    else:
+        model = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                     head_dim=16, d_ff=128, max_seq_len=128,
+                     rotary_dim=16, dtype="float32",
+                     remat_policy="none")
+    rounds = 2 if smoke else 4
+    cfg = RLHFConfig(
+        placement="anakin",
+        num_learners=2,
+        num_engines=1 if smoke else 2,
+        rollouts_per_round=6 if smoke else 12,
+        max_new_tokens=8 if smoke else 16,
+        system_prompt=tuple(range(2, 50)),
+        prompt_len=64,
+        minibatch_size=2,
+        sync_every_updates=1,
+        model=model,
+        engine=dict(decode_slots=4, kv_block_size=4, prefill_chunk=16))
+
+    ray_tpu.init(num_cpus=8, _num_initial_workers=4)
+    try:
+        trainer = RLHFTrainer(cfg)
+        trainer.train_round()     # warm the jit caches off the record
+        t0 = time.perf_counter()
+        history = trainer.train(rounds)
+        wall = time.perf_counter() - t0
+        rstats = trainer.rollout.stats()
+        pstats = trainer.publisher.stats()
+        warm_tokens = trainer.history[0]["rollout_tokens"]
+        tokens = rstats["tokens_total"] - warm_tokens
+        updates = sum(m.get("stream_updates", 0.0) for m in history)
+        last = history[-1]
+        trainer.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+    detail = {
+        "backend": jax.default_backend(),
+        "chip": chip_spec().name,
+        "placement": cfg.placement,
+        "slice_strategy": cfg.slice_strategy,
+        "num_learners": cfg.num_learners,
+        "num_engines": cfg.num_engines,
+        "rounds": rounds,
+        "trajectories": rstats["trajectories"],
+        "rollout_tokens": tokens,
+        "learner_steps_per_s": round(updates / wall, 3),
+        "learners_used": last.get("learners_used"),
+        "weight_syncs": pstats["publishes"],
+        "weight_version": rstats["weight_version"],
+        "wire_compression": pstats["compression"],
+        "staleness_p50": rstats["staleness_p50"],
+        "staleness_p99": rstats["staleness_p99"],
+        "staleness_max": rstats["staleness_max"],
+        "decode_stall_s": rstats["sync_stall_s"],
+        "weight_swap_wall_s": rstats["weight_swap_wall_s"],
+        "prefix_hit_rate": rstats["prefix_hit_rate"],
+        "total_loss": last.get("total_loss"),
+        "approx_kl": last.get("approx_kl"),
+    }
+    print(json.dumps({
+        "metric": "rl_rollout_tokens_per_s",
+        "value": round(tokens / wall, 2),
+        "unit": "tokens/s",
+        "detail": detail,
+    }))
+
+
 if __name__ == "__main__":
     import sys
     if "--pipeline" in sys.argv:
@@ -1570,5 +1667,7 @@ if __name__ == "__main__":
         elastic_main(smoke="--smoke" in sys.argv)
     elif "--colocate" in sys.argv:
         colocate_main(smoke="--smoke" in sys.argv)
+    elif "--rl" in sys.argv:
+        rl_main(smoke="--smoke" in sys.argv)
     else:
         main()

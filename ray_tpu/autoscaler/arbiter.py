@@ -161,17 +161,29 @@ class SliceArbiter:
 
     # -------------------------------------------------------- gauges
     def _gauges(self) -> Dict[str, Any]:
-        """Serve-pressure signals, normalized. From an injected
-        ``gauges_fn`` (tests, the colocate bench) or the controller's
-        metrics plane (``fleet_summary`` rows)."""
+        """Serve-pressure signals, normalized. Sources, in order: an
+        injected ``gauges_fn`` (tests, the colocate bench), the
+        controller's in-process metrics plane (``fleet_summary`` rows),
+        or — when the arbiter runs in a driver/monitor process with no
+        direct controller reference — the live metrics plane over the
+        state API (``fleet_metrics`` query), so an
+        ``AutoscalerMonitor``-driven arbiter needs no injection at
+        all."""
         if self._gauges_fn is not None:
             raw = self._gauges_fn() or {}
         else:
             plane = getattr(getattr(self.manager, "controller", None),
                             "metrics_plane", None)
-            if plane is None:
-                return {}
-            raw = plane.fleet_summary(window_s=self.policy.window_s)
+            if plane is not None:
+                raw = plane.fleet_summary(
+                    window_s=self.policy.window_s)
+            else:
+                try:
+                    from ray_tpu.util.state import fleet_metrics
+                    raw = fleet_metrics(
+                        window_s=self.policy.window_s) or {}
+                except Exception:
+                    return {}
         if "rows" in raw:        # fleet_summary payload → normalize
             rows = raw.get("rows") or []
             depths = [r["queue_depth"] for r in rows

@@ -82,6 +82,15 @@ Event taxonomy (the ``ev`` field):
 ``ARBITER_REJECT`` SLO-aware admission shed a request before it could
                    wedge a replica queue (``tenant``/``priority``/
                    ``reason``)
+``RLHF_SYNC``      an in-flight weight refresh landed in a serving
+                   engine between decode steps (``version``/
+                   ``swap_s``/``active_slots`` — the MindSpeed-RL
+                   no-drain swap; ``active_slots > 0`` proves decode
+                   kept running through the refresh)
+``RLHF_ROLLOUT``   a rollout round closed (``round``/``trajectories``/
+                   ``tokens``/``policy_versions`` — which policies
+                   generated this round's trajectories, the staleness
+                   record PPO importance weights are computed against)
 =================  =====================================================
 """
 
@@ -117,6 +126,8 @@ ELASTIC_RESUME = "ELASTIC_RESUME"
 ARBITER_PREEMPT = "ARBITER_PREEMPT"
 ARBITER_RETURN = "ARBITER_RETURN"
 ARBITER_REJECT = "ARBITER_REJECT"
+RLHF_SYNC = "RLHF_SYNC"
+RLHF_ROLLOUT = "RLHF_ROLLOUT"
 
 #: lifecycle events a task timeline is built from (exporter slice pairs)
 LIFECYCLE = (SUBMITTED, LEASED, DISPATCHED, RUNNING, YIELDED,

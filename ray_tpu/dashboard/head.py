@@ -53,6 +53,19 @@ class DashboardHead:
         except Exception:
             pass
 
+    # ----------------------------------------------------------- serve
+    def serve_controller(self):
+        """Handle to the named serve controller actor, or None when
+        serve was never started. The head process is the driver, so
+        its global worker resolves named actors directly."""
+        try:
+            import ray_tpu
+            from ray_tpu.serve._private.controller import \
+                CONTROLLER_NAME
+            return ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            return None
+
     # ----------------------------------------------------- cluster state
     def state(self, what: str, limit: int = 1000):
         """Live state rows for the UI (same snapshot the wire state API
@@ -236,6 +249,16 @@ def _make_handler(head: DashboardHead):
                         .chrome_counters()))
                 elif path == "/api/jobs":
                     self._json(head.job_manager.list_jobs())
+                elif path == "/api/v0/admission/policy":
+                    ctrl = head.serve_controller()
+                    if ctrl is None:
+                        self._json({"error": "no serve controller"},
+                                   404)
+                        return
+                    import ray_tpu
+                    seq, policy = ray_tpu.get(
+                        ctrl.get_admission_policy.remote())
+                    self._json({"seq": seq, "policy": policy})
                 elif path == "/api/v0/arbiter":
                     # live slice-arbitration table (who owns which
                     # slice and why); present only when the head runs
@@ -327,6 +350,27 @@ def _make_handler(head: DashboardHead):
                         self._json({"stopped": stopped})
                     except KeyError:
                         self._json({"error": f"job {jid!r} not found"}, 404)
+                elif path == "/api/v0/admission/policy":
+                    # fleet-wide admission budget refresh: validate
+                    # here (bad knobs -> 400 via the ValueError
+                    # handler below, nothing stored), then push to
+                    # the serve controller's config plane; routers
+                    # with admission enabled pick it up on their next
+                    # rate-limited poll
+                    from ray_tpu.serve.admission import AdmissionPolicy
+                    body = self._body()
+                    policy = AdmissionPolicy.from_dict(body)
+                    ctrl = head.serve_controller()
+                    if ctrl is None:
+                        self._json({"error": "no serve controller "
+                                    "(serve not started)"}, 404)
+                        return
+                    import ray_tpu
+                    seq = ray_tpu.get(
+                        ctrl.set_admission_policy.remote(
+                            policy.to_dict()))
+                    self._json({"seq": seq,
+                                "policy": policy.to_dict()})
                 else:
                     self._json({"error": "not found"}, 404)
             except ValueError as e:

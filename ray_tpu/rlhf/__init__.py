@@ -1,0 +1,37 @@
+"""Disaggregated RLHF: serve-engine rollouts, multi-learner streams,
+in-flight int8 weight sync (ROADMAP item 1 — the flagship composition).
+
+The closed loop, wired through every existing layer:
+
+- :mod:`ray_tpu.rlhf.config` — ``RLHFConfig`` names the Podracer
+  placement (``anakin`` colocated / ``sebulba`` disaggregated,
+  arXiv:2104.06272) and lowers it to SLICE_PACK / SLICE_SPREAD through
+  ``ParallelPlan`` / ``SliceManager``.
+- :mod:`ray_tpu.rlhf.rollout` — the serving engine as the PPO rollout
+  backend: shared-system-prompt requests ride the radix-trie prefix
+  cache, completions stream back as trajectory blocks carrying
+  ``(token, policy_version, logprob)``.
+- :mod:`ray_tpu.rlhf.weight_sync` — learner→engine parameter refresh
+  over the int8 blockwise wire (``parallel.quantization``), applied
+  between decode steps by a double-buffered pointer swap: decode never
+  drains (MindSpeed-RL's headline trick, arXiv:2507.19017).
+- :mod:`ray_tpu.rlhf.trainer` — ``RLHFTrainer`` closes the loop:
+  rollout rounds feed a multi-learner ``LearnerGroup`` through sharded
+  streaming epoch-1 updates, with weights republished in flight under
+  a ``max_weight_lag`` staleness bound on rollout admission.
+"""
+
+from ray_tpu.rlhf.config import RLHFConfig, RLHFPlacement
+from ray_tpu.rlhf.rollout import (LocalBlockStream, RolloutEngine,
+                                  make_rlhf_rollout_streams,
+                                  rlhf_rollout_blocks)
+from ray_tpu.rlhf.trainer import PolicyLearner, RLHFTrainer
+from ray_tpu.rlhf.weight_sync import (WeightPublisher, pack_weights,
+                                      packed_wire_bytes, unpack_weights)
+
+__all__ = [
+    "RLHFConfig", "RLHFPlacement", "RolloutEngine", "LocalBlockStream",
+    "rlhf_rollout_blocks", "make_rlhf_rollout_streams", "RLHFTrainer",
+    "PolicyLearner", "WeightPublisher", "pack_weights",
+    "unpack_weights", "packed_wire_bytes",
+]

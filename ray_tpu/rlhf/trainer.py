@@ -1,0 +1,293 @@
+"""The closed PPO-RLHF loop: rollout rounds → sharded multi-learner
+streaming updates → in-flight weight republish, under a staleness bound.
+
+Round anatomy (one ``train_round`` call):
+
+1. Deterministic per-round prompt suffixes are appended to the shared
+   system prompt and admitted to the rollout engines under the
+   ``max_weight_lag`` gate.
+2. Trajectory blocks stream back in completion order;
+   ``LearnerGroup.update_from_stream_sharded`` re-chunks them
+   deterministically across ALL learners and closes synchronous
+   gradient rounds as shards fill.
+3. After every ``sync_every_updates`` applied rounds the ``on_round``
+   hook packs the fresh learner weights over the int8 wire and stages
+   them on every engine — **while those engines are still decoding the
+   round's remaining trajectories**. The engine step thread pointer-
+   swaps between decode steps; tokens emitted after the swap carry the
+   new policy version, so one trajectory's ``versions`` row can
+   legitimately read ``[3 3 3 4 4 …]`` — that is the in-flight refresh
+   observable the chaos tests pin down.
+
+The staleness gate cannot deadlock: ``publish`` stages synchronously,
+and ``LLMEngine.weight_version`` reports a *staged* version
+immediately, so the learner-side version and the engine-side version
+never diverge by more than the one publish that is mid-stage.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rlhf.config import RLHFConfig
+from ray_tpu.rlhf.rollout import RolloutEngine
+from ray_tpu.rlhf.weight_sync import WeightPublisher
+
+
+class PolicyLearner:
+    """Token-level PPO learner over the serving stack's transformer.
+
+    Implements the :class:`ray_tpu.rllib.learner.Learner` protocol
+    (``compute_gradients`` / ``apply_gradients`` / ``get_weights`` /
+    ``set_weights`` / ``update_from_batch``) so ``LearnerGroup`` can
+    run it locally or as remote data-parallel replicas unchanged.
+
+    The loss is exact PPO, not an approximation: the rollout batch's
+    ``logprobs`` column was captured by the engine from the *behavior*
+    policy's own forward pass (the quantized weights that actually
+    generated each token), so ``exp(new_lp - logprobs)`` is the true
+    importance ratio, and the ``versions`` column tells you which
+    policy that was.
+    """
+
+    def __init__(self, model: Dict[str, Any],
+                 learning_rate: float = 1e-3,
+                 clip_eps: float = 0.2, grad_clip: float = 1.0,
+                 seed: int = 0):
+        import jax
+        import optax
+        from ray_tpu.models import TransformerConfig, init_params
+        from ray_tpu.serve.llm_engine import _resolve_dtype
+        model = dict(model)
+        model["dtype"] = _resolve_dtype(model.get("dtype", "float32"))
+        self.config = TransformerConfig(**model)
+        self._clip_eps = float(clip_eps)
+        tx = [optax.clip_by_global_norm(grad_clip)] if grad_clip else []
+        tx.append(optax.adam(learning_rate))
+        self._opt = optax.chain(*tx)
+        params = init_params(self.config, jax.random.PRNGKey(seed))
+        self._state = {"params": params,
+                       "opt_state": self._opt.init(params)}
+        self._jit_grads = jax.jit(self._grads)
+
+    # ------------------------------------------------------ jitted core
+    def _loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.models.transformer import apply
+        prompt = batch["prompt"]
+        tokens = batch["tokens"]
+        P = prompt.shape[1]
+        # Teacher-force the whole trajectory in one forward: position
+        # P-1+j of the concatenated input predicts generated token j.
+        inputs = jnp.concatenate([prompt, tokens[:, :-1]], axis=1)
+        logits = apply(self.config, params, inputs)
+        gen = logits[:, P - 1:, :].astype(jnp.float32)
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(gen, axis=-1),
+            tokens[..., None], axis=-1)[..., 0]
+        behavior_lp = batch["logprobs"]
+        ratio = jnp.exp(lp - behavior_lp)
+        adv = batch["advantages"][:, None]
+        clipped = jnp.clip(ratio, 1.0 - self._clip_eps,
+                           1.0 + self._clip_eps)
+        loss = -jnp.mean(jnp.minimum(ratio * adv, clipped * adv))
+        return loss, {"approx_kl": jnp.mean(behavior_lp - lp),
+                      "ratio_mean": jnp.mean(ratio)}
+
+    def _grads(self, params, batch):
+        import jax
+        import optax
+        (loss, metrics), grads = jax.value_and_grad(
+            self._loss, has_aux=True)(params, batch)
+        metrics = dict(metrics, total_loss=loss)
+        return grads, metrics, optax.global_norm(grads)
+
+    # --------------------------------------------- Learner protocol
+    def compute_gradients(self, batch: Dict[str, np.ndarray]):
+        import jax.numpy as jnp
+        jbatch = {
+            "prompt": jnp.asarray(batch["prompt"], jnp.int32),
+            "tokens": jnp.asarray(batch["tokens"], jnp.int32),
+            "logprobs": jnp.asarray(batch["logprobs"], jnp.float32),
+            "advantages": jnp.asarray(batch["advantages"],
+                                      jnp.float32),
+        }
+        grads, metrics, gnorm = self._jit_grads(
+            self._state["params"], jbatch)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["grad_norm"] = float(gnorm)
+        return grads, out
+
+    def apply_gradients(self, grads) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+        grads = jax.tree.map(jnp.asarray, grads)
+        updates, opt_state = self._opt.update(
+            grads, self._state["opt_state"], self._state["params"])
+        self._state = {
+            "params": optax.apply_updates(self._state["params"],
+                                          updates),
+            "opt_state": opt_state}
+
+    def get_weights(self):
+        import jax
+        return jax.tree.map(np.asarray, self._state["params"])
+
+    def set_weights(self, params) -> None:
+        import jax
+        import jax.numpy as jnp
+        self._state["params"] = jax.tree.map(jnp.asarray, params)
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]
+                          ) -> Dict[str, float]:
+        grads, metrics = self.compute_gradients(batch)
+        self.apply_gradients(grads)
+        return metrics
+
+
+class RLHFTrainer:
+    """Owns the whole loop: placement → learners → rollout engines →
+    weight publisher. One ``train_round()`` call is one PPO round with
+    in-flight weight refresh; ``train(n)`` runs n of them."""
+
+    def __init__(self, config: RLHFConfig, slice_manager=None,
+                 recorder=None):
+        from ray_tpu.rllib.learner import LearnerGroup
+        self.config = config
+        self.placement = config.lower()
+        self._slice_manager = slice_manager
+        if slice_manager is not None:
+            self.placement.reserve(slice_manager)
+        if recorder is None:
+            try:
+                from ray_tpu.core.global_state import try_global_worker
+                w = try_global_worker()
+                recorder = getattr(w, "recorder", None)
+            except Exception:
+                recorder = None
+        self._recorder = recorder
+        model = config.model_config()
+        lr, eps, seed = (config.learning_rate, config.clip_eps,
+                         config.seed)
+
+        def make_learner():
+            return PolicyLearner(model, learning_rate=lr,
+                                 clip_eps=eps, seed=seed)
+
+        self.learners = LearnerGroup(
+            make_learner,
+            num_learners=(config.num_learners
+                          if config.num_learners >= 2 else 0),
+            seed=config.seed)
+        w0 = self.learners.get_weights()
+        # Engines start from the learners' exact initial policy: the
+        # version-0 rollouts really are on-policy.
+        self.rollout = RolloutEngine(config, params=w0,
+                                     recorder=recorder)
+        self.publisher = WeightPublisher(
+            self.rollout.engines, block_size=config.quant_block_size,
+            recorder=recorder)
+        self._version = 0       # latest PUBLISHED learner version
+        self._version_lock = threading.Lock()
+        self._round = 0
+        self.history: List[Dict[str, Any]] = []
+
+    # -------------------------------------------------------- prompts
+    def round_suffixes(self, round_index: Optional[int] = None
+                       ) -> List[List[int]]:
+        """Deterministic per-round prompt suffixes (seeded by config
+        seed + round): reproducible rollouts without threading prompt
+        datasets through every test."""
+        cfg = self.config
+        rnd = self._round if round_index is None else round_index
+        rng = np.random.default_rng(cfg.seed * 1_000_003 + rnd)
+        sfx_len = cfg.prompt_len - len(cfg.system_prompt)
+        hi = min(1000, int(cfg.model_config().get("vocab_size", 50400)))
+        return [rng.integers(2, hi, size=sfx_len,
+                             dtype=np.int64).tolist()
+                for _ in range(cfg.rollouts_per_round)]
+
+    def _learner_version(self) -> int:
+        with self._version_lock:
+            return self._version
+
+    # ---------------------------------------------------------- rounds
+    def train_round(self, suffixes: Optional[List[List[int]]] = None
+                    ) -> Dict[str, Any]:
+        cfg = self.config
+        self._round += 1
+        if suffixes is None:
+            suffixes = self.round_suffixes()
+        stream = self.rollout.stream_round(
+            suffixes, learner_version_fn=self._learner_version,
+            collect=True)
+        publishes_before = self.publisher.stats()["publishes"]
+
+        def on_round(n_rounds: int, _metrics: Dict[str, float]
+                     ) -> None:
+            # In-flight republish: engines are still decoding this
+            # round's remaining trajectories when this stages weights.
+            if n_rounds % cfg.sync_every_updates == 0:
+                w = self.learners.get_weights()
+                v = self.publisher.publish(w)
+                with self._version_lock:
+                    self._version = v
+
+        metrics = self.learners.update_from_stream_sharded(
+            stream, minibatch_size=cfg.minibatch_size,
+            num_epochs=cfg.num_epochs, on_round=on_round)
+        if self.publisher.stats()["publishes"] == publishes_before:
+            # Single/local-learner fallback path has no on_round hook:
+            # still publish once per round so the loop stays closed.
+            w = self.learners.get_weights()
+            v = self.publisher.publish(w)
+            with self._version_lock:
+                self._version = v
+        rstats = self.rollout.stats()
+        pstats = self.publisher.stats()
+        out = dict(metrics)
+        out.update({
+            "round": self._round,
+            "trajectories": len(stream.infos),
+            "rollout_tokens": rstats["tokens_total"],
+            "prefix_hit_rate": rstats["prefix_hit_rate"],
+            "weight_version": rstats["weight_version"],
+            "weight_syncs": pstats["publishes"],
+            "wire_compression": pstats["compression"],
+            "sync_stall_s": rstats["sync_stall_s"],
+            "staleness_p50": rstats["staleness_p50"],
+            "staleness_p99": rstats["staleness_p99"],
+            "staleness_max": rstats["staleness_max"],
+        })
+        self.history.append(out)
+        return out
+
+    def train(self, num_rounds: int) -> List[Dict[str, Any]]:
+        return [self.train_round() for _ in range(num_rounds)]
+
+    # ----------------------------------------------------------- audit
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "rounds": self._round,
+            "placement": self.placement.placement,
+            "slice_strategy": self.placement.slice_strategy,
+            "rollout": self.rollout.stats(),
+            "publisher": self.publisher.stats(),
+        }
+
+    def shutdown(self) -> None:
+        try:
+            self.rollout.shutdown()
+        except Exception:
+            pass
+        try:
+            self.learners.shutdown()
+        except Exception:
+            pass
+        if self._slice_manager is not None:
+            self.placement.release(self._slice_manager)

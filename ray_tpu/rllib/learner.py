@@ -12,6 +12,7 @@ dp-sharded mesh instead.
 
 from __future__ import annotations
 
+import collections
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -20,6 +21,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.rollout_stream import _concat_batches, _nrows
 
 
 class Learner:
@@ -126,7 +128,7 @@ class LearnerGroup:
                           minibatch_size: Optional[int] = None,
                           num_epochs: int = 1) -> Dict[str, float]:
         metrics: Dict[str, float] = {}
-        n = len(batch["obs"])
+        n = _nrows(batch)
         mb = minibatch_size or n
         for _ in range(num_epochs):
             perm = self._rng.permutation(n)
@@ -140,7 +142,7 @@ class LearnerGroup:
         if self._local is not None:
             return self._local.update_from_batch(batch)
         # shard batch across learners; average gradients
-        shards = np.array_split(np.arange(len(batch["obs"])), self._num)
+        shards = np.array_split(np.arange(_nrows(batch)), self._num)
         futs = [a.compute_gradients.remote(
             {k: v[idx] for k, v in batch.items()})
             for a, idx in zip(self._remote, shards) if len(idx)]
@@ -179,6 +181,103 @@ class LearnerGroup:
                 num_epochs=num_epochs - 1)
         metrics = dict(metrics)
         metrics["stream_updates"] = float(n_updates)
+        return metrics
+
+    def update_from_stream_sharded(self, stream,
+                                   minibatch_size: Optional[int] = None,
+                                   num_epochs: int = 1,
+                                   on_round: Optional[
+                                       Callable[[int, Dict[str, float]],
+                                                None]] = None
+                                   ) -> Dict[str, float]:
+        """Multi-learner streaming epoch: the FIRST epoch trains on ALL
+        learners as blocks arrive (today's ``update_from_stream`` feeds
+        one update at a time through the group barrier). Each arriving
+        block is assigned to a learner shard deterministically — by
+        ``worker_index mod num_learners``, so a lineage-replayed block
+        re-chunks onto the SAME learner and, when the runner count
+        divides the learner count, every shard's minibatch sequence is
+        reproducible regardless of cross-runner arrival order. Each
+        learner computes gradients on its own shard concurrently; a
+        synchronous round closes once every learner holds a gradient,
+        and the round average applies to ALL learners, keeping replicas
+        identical. Ragged tails average over the learners that have
+        data. Epochs 2+ run the usual shuffled passes over the
+        collected full batch. ``on_round`` fires after each applied
+        round — the RLHF trainer's in-flight weight-publish hook (the
+        engines are still decoding when it runs). Falls back to
+        ``update_from_stream`` for the local/single-learner group."""
+        if self._local is not None or self._num < 2:
+            return self.update_from_stream(stream, minibatch_size,
+                                           num_epochs)
+        stream._collect = True
+        n = self._num
+        per = max(1, minibatch_size // n) if minibatch_size else None
+        buffers: List[List[Dict[str, np.ndarray]]] = \
+            [[] for _ in range(n)]
+        rows = [0] * n
+        futs: List[collections.deque] = \
+            [collections.deque() for _ in range(n)]
+        self.shard_rows = [0] * n
+        self.shard_uids: List[List[int]] = [[] for _ in range(n)]
+        metrics: Dict[str, float] = {}
+        n_rounds = 0
+
+        def launch(i: int, take: int) -> None:
+            merged = _concat_batches(buffers[i])
+            sub = {k: v[:take] for k, v in merged.items()}
+            rest = _nrows(merged) - take
+            buffers[i] = [{k: v[take:] for k, v in merged.items()}] \
+                if rest else []
+            rows[i] = rest
+            futs[i].append(
+                self._remote[i].compute_gradients.remote(sub))
+            self.shard_rows[i] += take
+
+        def close_round(require_all: bool) -> bool:
+            nonlocal metrics, n_rounds
+            have = [i for i in range(n) if futs[i]]
+            if not have or (require_all and len(have) < n):
+                return False
+            results = ray_tpu.get([futs[i].popleft() for i in have])
+            grads = jax.tree.map(
+                lambda *gs: np.mean(np.stack(gs), axis=0),
+                *[g for g, _ in results])
+            ray_tpu.get([a.apply_gradients.remote(grads)
+                         for a in self._remote])
+            metrics = results[0][1]
+            n_rounds += 1
+            if on_round is not None:
+                on_round(n_rounds, metrics)
+            return True
+
+        for batch, info in stream.iter_blocks():
+            i = int(info.get("shard_key",
+                             info.get("worker_index",
+                                      info.get("uid", 0)))) % n
+            self.shard_uids[i].append(int(info.get("uid", -1)))
+            buffers[i].append(batch)
+            rows[i] += _nrows(batch)
+            target = per if per is not None else rows[i]
+            while target > 0 and rows[i] >= target:
+                launch(i, target)
+                if per is None:
+                    break
+            while close_round(require_all=True):
+                pass
+        for i in range(n):          # ragged shard tails
+            if rows[i]:
+                launch(i, rows[i])
+        while close_round(require_all=False):
+            pass
+        if stream.blocks and num_epochs > 1:
+            metrics = self.update_from_batch(
+                stream.full_batch(), minibatch_size=minibatch_size,
+                num_epochs=num_epochs - 1)
+        metrics = dict(metrics)
+        metrics["stream_updates"] = float(n_rounds)
+        metrics["learners_used"] = float(
+            sum(1 for r in self.shard_rows if r))
         return metrics
 
     def update_ordered(self, batch: Dict[str, np.ndarray]

@@ -156,6 +156,15 @@ def _concat_batches(batches: List[Dict[str, np.ndarray]]
             for k in batches[0]}
 
 
+def _nrows(batch: Dict[str, np.ndarray]) -> int:
+    """Row count of a sample batch: every column shares the leading
+    axis, so any column works — env batches key their rows by ``obs``,
+    RLHF trajectory batches by ``tokens``."""
+    if "obs" in batch:
+        return len(batch["obs"])
+    return len(next(iter(batch.values())))
+
+
 class RolloutBlockStream:
     """Fan-in over N rollout streams: completion-order block iteration
     via ``wait_any``, minibatch re-chunking, and consumer-idle (bubble)
@@ -205,7 +214,7 @@ class RolloutBlockStream:
                 t0 = time.perf_counter()
                 batch, info = ray_tpu.get(ref)
                 self._wait_s += time.perf_counter() - t0
-                self._rows += len(batch["obs"])
+                self._rows += _nrows(batch)
                 if self._collect:
                     self.blocks.append(batch)
                 self.infos.append(info)
@@ -227,10 +236,10 @@ class RolloutBlockStream:
                 yield batch
                 continue
             carry.append(batch)
-            carry_rows += len(batch["obs"])
+            carry_rows += _nrows(batch)
             while carry_rows >= batch_size:
                 merged = _concat_batches(carry)
-                n = len(merged["obs"])
+                n = _nrows(merged)
                 yield {k: v[:batch_size] for k, v in merged.items()}
                 rest = {k: v[batch_size:] for k, v in merged.items()}
                 carry = [rest] if n > batch_size else []

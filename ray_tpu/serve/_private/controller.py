@@ -73,6 +73,10 @@ class ServeController:
         self._routes: Dict[str, str] = {}  # route_prefix -> deployment
         self._apps: Dict[str, str] = {}    # app name -> ingress deploy
         self._lock = threading.RLock()
+        # admission config plane: routers poll (seq, policy dict);
+        # the dashboard POST endpoint bumps seq on every accepted write
+        self._admission_policy: Optional[Dict[str, Any]] = None
+        self._admission_policy_seq = 0
         self._stop = threading.Event()
         self._loop = threading.Thread(
             target=self._control_loop, name="serve_control", daemon=True)
@@ -160,6 +164,25 @@ class ServeController:
                 out[prefix] = {"name": name, "asgi": asgi,
                                "streaming": streaming}
             return out
+
+    # -- admission config plane ---------------------------------------
+    def set_admission_policy(self, policy: Dict[str, Any]) -> int:
+        """Validate and store a fleet-wide admission policy; routers
+        with admission enabled pick it up on their next poll. Returns
+        the new seq so callers can confirm propagation."""
+        from ray_tpu.serve.admission import AdmissionPolicy
+        p = AdmissionPolicy.from_dict(policy)  # ValueError on bad knobs
+        with self._lock:
+            self._admission_policy = p.to_dict()
+            self._admission_policy_seq += 1
+            return self._admission_policy_seq
+
+    def get_admission_policy(self):
+        """(seq, policy dict | None); seq 0 = never configured."""
+        with self._lock:
+            d = self._admission_policy
+            return self._admission_policy_seq, \
+                dict(d) if d is not None else None
 
     def get_app_ingress(self, app_name: str) -> Optional[str]:
         with self._lock:

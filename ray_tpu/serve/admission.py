@@ -96,6 +96,46 @@ class AdmissionPolicy:
     #: token estimate for requests that don't carry ``max_tokens``
     default_request_tokens: int = 32
 
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-safe round-trip form (the dashboard config endpoint's
+        wire format)."""
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AdmissionPolicy":
+        """Build + validate a policy from a config payload (the
+        ``POST /api/v0/admission/policy`` body). Unknown keys are a
+        hard error — a typo'd knob must not silently admit
+        everything."""
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"admission policy must be an object, got {type(d)}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown admission policy keys {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        p = cls(**d)
+        if p.budget_window_s <= 0:
+            raise ValueError("budget_window_s must be > 0")
+        if p.default_request_tokens <= 0:
+            raise ValueError("default_request_tokens must be > 0")
+        if p.tenant_budgets is not None:
+            if not isinstance(p.tenant_budgets, dict):
+                raise ValueError("tenant_budgets must be a mapping of "
+                                 "tenant -> tokens/s")
+            for t, b in p.tenant_budgets.items():
+                if not isinstance(b, (int, float)) or \
+                        isinstance(b, bool) or b < 0:
+                    raise ValueError(
+                        f"budget for tenant {t!r} must be a "
+                        f"non-negative number, got {b!r}")
+        # both priority knobs must resolve now, not at admit time
+        priority_value(p.budget_exempt_priority)
+        priority_value(p.shed_below_priority)
+        return p
+
 
 class AdmissionController:
     """One per router (shared across ``options()`` copies, like the
@@ -111,6 +151,17 @@ class AdmissionController:
         self._spend: Dict[str, collections.deque] = {}
         self.admitted = 0
         self.rejected = 0
+        #: seq of the last policy applied via the config plane
+        self.policy_seq = 0
+
+    def set_policy(self, policy: AdmissionPolicy,
+                   seq: Optional[int] = None) -> None:
+        """Swap the shed rules in place, keeping the per-tenant spend
+        windows — a budget refresh must not amnesty tenants that are
+        already over their (new) budget."""
+        self.policy = policy
+        if seq is not None:
+            self.policy_seq = seq
 
     # ------------------------------------------------------- budgets
     def _rate(self, tenant: str, now: float) -> float:

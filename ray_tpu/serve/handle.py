@@ -160,6 +160,8 @@ class _Router:
     #: first-turn request's prefix (worth ~a free-slot fraction — a
     #: warm prefix beats marginal capacity, but never a dead replica)
     prefix_match_bonus = 1.5
+    #: seconds between admission-policy refreshes from the controller
+    admission_policy_poll_s = 2.0
 
     def __init__(self, deployment_name: str, controller):
         self.deployment_name = deployment_name
@@ -187,6 +189,7 @@ class _Router:
         # options() copies like the rest of the router so per-tenant
         # budget accounting spans them. None = admit everything.
         self.admission = None
+        self._last_policy_poll = 0.0
 
     @staticmethod
     def _key(replica) -> bytes:
@@ -269,6 +272,31 @@ class _Router:
                         self._gauge_refs[k] = r.stats.remote()
                     except Exception:
                         pass
+
+    def _poll_admission_policy(self) -> None:
+        """Refresh the admission controller's shed rules from the
+        serve controller's config plane (fed by the dashboard's
+        ``POST /api/v0/admission/policy``). Rate-limited; a newer seq
+        swaps the policy in place, keeping budget spend windows."""
+        if self.admission is None:
+            return
+        now = time.monotonic()
+        if now - self._last_policy_poll < self.admission_policy_poll_s:
+            return
+        self._last_policy_poll = now
+        try:
+            seq, d = ray_tpu.get(
+                self.controller.get_admission_policy.remote())
+        except Exception:
+            return
+        if d is None or seq <= self.admission.policy_seq:
+            return
+        from ray_tpu.serve.admission import AdmissionPolicy
+        try:
+            self.admission.set_policy(AdmissionPolicy.from_dict(d),
+                                      seq=seq)
+        except ValueError:
+            pass  # controller validated on write; never fail a route
 
     def _fleet_backfill(self) -> None:
         """Direct probes gone quiet (replica event loops saturated):
@@ -447,6 +475,7 @@ class DeploymentHandle:
             # Shed BEFORE pick: a rejected request must never touch a
             # replica queue (that queue depth is exactly what the shed
             # is protecting). Freshest engine gauges decide overload.
+            r._poll_admission_policy()
             r._poll_gauges()
             r.admission.admit(
                 self._tenant, self._priority, r._fresh_gauges(),

@@ -93,6 +93,11 @@ class EngineConfig:
     - ``spec_ngram``: longest history n-gram tried by the draft lookup.
     - ``spec_min_acceptance``: per-slot acceptance-EWMA floor below
       which drafting is disabled for that sequence.
+    - ``capture_logprobs``: the jitted prefill/decode programs also
+      return the log-probability of each selected token, so ``detailed``
+      streams carry ``(token, policy_version, logprob)`` — the RLHF
+      rollout payload. Mutually exclusive with ``spec_tokens > 0``
+      (the verify path re-scores positions out of emission order).
     """
     decode_slots: int = 8
     kv_block_size: int = 16
@@ -105,6 +110,7 @@ class EngineConfig:
     spec_tokens: int = 0
     spec_ngram: int = 3
     spec_min_acceptance: float = 0.1
+    capture_logprobs: bool = False
 
     @property
     def blocks_per_seq(self) -> int:
@@ -135,7 +141,8 @@ class _Request:
                  "out", "state", "slot", "blocks", "prefill_pos",
                  "seq_len", "generated", "cancelled", "t_submit",
                  "t_first_token", "history", "hit_blocks", "trie_node",
-                 "trie_cursor", "spec_ewma", "spec_disabled", "warmup")
+                 "trie_cursor", "spec_ewma", "spec_disabled", "warmup",
+                 "detailed")
 
     def __init__(self, rid: int, prompt: List[int], max_new_tokens: int,
                  eos_token_id: Optional[int]):
@@ -152,6 +159,7 @@ class _Request:
         self.generated = 0            # tokens emitted
         self.cancelled = False
         self.warmup = False       # compile-only request: no telemetry
+        self.detailed = False     # stream (tok, version, logprob) tuples
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
         # -- prefix sharing (prefix_cache.PrefixBlockPool)
@@ -191,6 +199,11 @@ class LLMEngine:
         if ec.spec_tokens < 0 or ec.spec_ngram < 1:
             raise ValueError("spec_tokens must be >= 0 and spec_ngram "
                              ">= 1")
+        if ec.capture_logprobs and ec.spec_tokens > 0:
+            raise ValueError(
+                "capture_logprobs is incompatible with speculative "
+                "decode (spec_tokens > 0): the verify path scores "
+                "positions out of emission order")
 
         self._params = params if params is not None \
             else init_params(model_config, jax.random.PRNGKey(seed))
@@ -217,18 +230,35 @@ class LLMEngine:
                                      ec.kv_block_size, reserved=(0,))
 
         # jit once at the fixed shapes; caches are donated so XLA
-        # updates them in place step over step.
+        # updates them in place step over step. With capture_logprobs
+        # the same programs also return the selected token's logprob
+        # (greedy argmax is unchanged — the extra output is the RLHF
+        # rollout payload, not a sampling change).
+        capture = ec.capture_logprobs
+
         def _prefill_fn(params, tokens, cache, bt, start, lens):
             logits, cache = prefill(model_config, params, tokens, cache,
                                     bt, start, lens)
             last = jnp.take_along_axis(
                 logits, (lens - 1)[:, None, None], axis=1)[:, 0]
-            return jnp.argmax(last, axis=-1).astype(jnp.int32), cache
+            tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            if capture:
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(last, axis=-1), tok[:, None],
+                    axis=-1)[:, 0]
+                return tok, lp, cache
+            return tok, cache
 
         def _decode_fn(params, toks, cache, bt, seq_lens):
             logits, cache = decode_step(model_config, params, toks,
                                         cache, bt, seq_lens)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if capture:
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits, axis=-1), tok[:, None],
+                    axis=-1)[:, 0]
+                return tok, lp, cache
+            return tok, cache
 
         self._jit_prefill = jax.jit(_prefill_fn, donate_argnums=(2,))
         self._jit_decode = jax.jit(_decode_fn, donate_argnums=(2,))
@@ -272,6 +302,17 @@ class LLMEngine:
         self._dead: Optional[BaseException] = None
 
         self._jax = jax
+
+        # -- in-flight weight refresh (MindSpeed-RL style): the learner
+        # stages a fresh param tree + version; the step thread swaps the
+        # pointer between decode steps. Slots are NEVER drained, so the
+        # sync stall is structurally zero — _sync_stall_s exists to
+        # PROVE that (any drain path would have to charge it).
+        self._staged_weights: Optional[tuple] = None
+        self._weight_version = 0
+        self._weight_swaps = 0
+        self._weight_swap_wall_s = 0.0
+        self._sync_stall_s = 0.0
 
         # -- stats / metrics -------------------------------------------
         self._tokens_total = 0
@@ -324,9 +365,34 @@ class LLMEngine:
         self._thread.start()
 
     # ------------------------------------------------------- public API
+    def stage_weights(self, params, version: int) -> None:
+        """Stage a fresh parameter tree for an in-flight refresh. The
+        step thread swaps it in at the next step boundary (between
+        decode calls) — in-flight sequences finish their current step
+        on the old policy and continue on the new one, with every
+        emitted token stamped by the version that actually produced it.
+        Staging twice before a swap keeps only the newest tree (the
+        double buffer holds one pending refresh). Safe from any thread;
+        dequantize on the caller's thread, not here."""
+        with self._work:
+            if self._dead is not None:
+                raise EngineDeadError(
+                    f"engine step loop died: {self._dead!r}")
+            self._staged_weights = (params, int(version))
+            self._work.notify_all()
+
+    @property
+    def weight_version(self) -> int:
+        """Version of the policy the NEXT decode step will run."""
+        with self._lock:
+            staged = self._staged_weights
+            return staged[1] if staged is not None \
+                else self._weight_version
+
     def submit(self, prompt_ids: Sequence[int],
                max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
+               detailed: bool = False,
                _warmup: bool = False) -> _Request:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
@@ -346,6 +412,7 @@ class LLMEngine:
             self._rid += 1
             req = _Request(self._rid, prompt, max(1, int(mnt)), eos)
             req.warmup = _warmup
+            req.detailed = detailed
             self._pending.append(req)
             self._work.notify_all()
         return req
@@ -388,9 +455,11 @@ class LLMEngine:
     def generate_sync(self, prompt_ids: Sequence[int],
                       max_new_tokens: Optional[int] = None,
                       eos_token_id: Optional[int] = None,
-                      timeout_s: float = 120.0):
+                      timeout_s: float = 120.0,
+                      detailed: bool = False):
         """Blocking token stream (tests / direct embedding)."""
-        req = self.submit(prompt_ids, max_new_tokens, eos_token_id)
+        req = self.submit(prompt_ids, max_new_tokens, eos_token_id,
+                          detailed=detailed)
         deadline = time.monotonic() + timeout_s
         try:
             while True:
@@ -500,6 +569,15 @@ class LLMEngine:
                 "occupancy_hist": dict(self._occupancy),
                 "ttft_ewma_s": (round(self._ttft_ewma, 6)
                                 if self._ttft_ewma is not None else None),
+                # in-flight weight refresh accounting (RLHF rollout
+                # backend): swaps are pointer flips between decode
+                # steps, so sync_stall_s — decode time lost waiting on
+                # a refresh — must stay 0.0 (the bench gates on it)
+                "weight_version": self._weight_version,
+                "weight_swaps": self._weight_swaps,
+                "weight_swap_wall_s": round(self._weight_swap_wall_s,
+                                            6),
+                "sync_stall_s": round(self._sync_stall_s, 6),
                 "dead": repr(self._dead) if self._dead else None,
             }
             if self.config.spec_tokens > 0:
@@ -542,6 +620,7 @@ class LLMEngine:
 
     def _has_work_locked(self) -> bool:
         return bool(self._pending) or bool(self._prefilling) \
+            or self._staged_weights is not None \
             or any(r is not None for r in self._slots)
 
     def _on_dead(self, e: BaseException) -> None:
@@ -556,13 +635,45 @@ class LLMEngine:
         for r in set(reqs):
             r.out.put(err)
 
-    # one engine step: reap -> admit -> one prefill chunk -> one decode
+    # one engine step: swap staged weights -> reap -> admit -> one
+    # prefill chunk -> one decode
     def _step(self) -> None:
+        self._maybe_swap_weights()
         self._reap_cancelled()
         self._admit()
         self._prefill_one_chunk()
         self._decode_once()
         self._emit_stats()
+
+    def _maybe_swap_weights(self) -> None:
+        """Apply a staged weight refresh between decode steps: a pure
+        pointer swap on the step thread (the only device-state owner),
+        so in-flight decode slots are never drained and no request
+        waits. The swap wall is the full cost of the refresh as seen by
+        decode — booked separately from _sync_stall_s, which stays 0
+        because no slot ever blocks on it."""
+        with self._lock:
+            staged = self._staged_weights
+            if staged is None:
+                return
+            self._staged_weights = None
+            active = sum(1 for r in self._slots if r is not None)
+        t0 = time.monotonic()
+        params, version = staged
+        self._params = params
+        swap_s = time.monotonic() - t0
+        with self._lock:
+            self._weight_version = version
+            self._weight_swaps += 1
+            self._weight_swap_wall_s += swap_s
+        if self._recorder is not None:
+            try:
+                self._recorder.record(
+                    "RLHF_SYNC", replica=self.replica_tag,
+                    version=version, swap_s=round(swap_s, 6),
+                    active_slots=active)
+            except Exception:
+                pass
 
     def _reap_cancelled(self) -> None:
         with self._lock:
@@ -666,11 +777,16 @@ class LLMEngine:
         chunk = np.zeros((1, C), np.int32)
         chunk[0, :n] = req.prompt[start:start + n]
         t0 = time.monotonic()
-        tok, self._cache = self._jit_prefill(
+        out = self._jit_prefill(
             self._params, jnp.asarray(chunk), self._cache,
             jnp.asarray(self._block_tables[req.slot:req.slot + 1]),
             jnp.full((1,), start, jnp.int32),
             jnp.full((1,), n, jnp.int32))
+        if self.config.capture_logprobs:
+            tok, lp, self._cache = out
+        else:
+            tok, self._cache = out
+            lp = None
         self._jax.block_until_ready(tok)
         self._prefill_wall_s += time.monotonic() - t0
         req.prefill_pos += n
@@ -708,7 +824,9 @@ class LLMEngine:
                 self._release_locked(req)
                 return
             req.generated = 1
-            req.out.put(first)
+            req.out.put(self._item(req, first,
+                                   None if lp is None
+                                   else float(lp[0])))
             req.history.append(first)
             self._tokens_total += 1
             if req.generated >= req.max_new_tokens:
@@ -755,9 +873,15 @@ class LLMEngine:
         self._account_decode_pages(lens + 1)
         jnp = self._jnp
         t0 = time.monotonic()
-        out, self._cache = self._jit_decode(
+        res = self._jit_decode(
             self._params, jnp.asarray(toks), self._cache,
             jnp.asarray(bt), jnp.asarray(lens))
+        if self.config.capture_logprobs:
+            out, lps, self._cache = res
+            lps = self._np.asarray(lps)
+        else:
+            out, self._cache = res
+            lps = None
         out = self._np.asarray(out)
         self._decode_wall_s += time.monotonic() - t0
         produced = 0
@@ -773,7 +897,9 @@ class LLMEngine:
                     self._release_locked(req)
                     continue
                 req.generated += 1
-                req.out.put(tok)
+                req.out.put(self._item(req, tok,
+                                       None if lps is None
+                                       else float(lps[req.slot])))
                 req.history.append(tok)
                 self._tokens_total += 1
                 produced += 1
@@ -881,7 +1007,7 @@ class LLMEngine:
                         self._release_locked(req)
                         break
                     req.generated += 1
-                    req.out.put(tok)
+                    req.out.put(self._item(req, tok, None))
                     req.history.append(tok)
                     self._tokens_total += 1
                     produced += 1
@@ -917,6 +1043,16 @@ class LLMEngine:
                 self._metrics.serve_tokens.inc(produced)
             except Exception:
                 pass
+
+    def _item(self, req: _Request, tok: int, logprob):
+        """Shape one stream item: plain int for serving consumers,
+        ``(token, policy_version, logprob)`` for ``detailed`` RLHF
+        streams. Called from the step thread, where _weight_version is
+        constant for the whole step — the stamp is exactly the policy
+        that computed this token's logits."""
+        if not req.detailed:
+            return tok
+        return (tok, self._weight_version, logprob)
 
     def _release_locked(self, req: _Request,
                         err: Optional[BaseException] = None) -> None:
@@ -1063,6 +1199,17 @@ class LLMServer:
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
+
+    def sync_weights(self, packed: Dict[str, Any]) -> int:
+        """Apply an int8-packed weight refresh (the
+        :mod:`ray_tpu.rlhf.weight_sync` wire format) in-flight:
+        dequantize on this actor-call thread, stage for the step
+        thread's between-steps pointer swap. Returns the staged
+        version. Decode never drains."""
+        from ray_tpu.rlhf.weight_sync import unpack_weights
+        params, version = unpack_weights(packed)
+        self.engine.stage_weights(params, version)
+        return version
 
     def pool_audit(self) -> List[str]:
         return self.engine.pool_audit()

@@ -299,3 +299,60 @@ def test_claim_validates_kind_and_released_claims_drop():
     assert mgr.slices[sid].state == RELEASED
     arb.update()
     assert sid not in arb.claims
+
+
+def test_gauges_fall_back_to_live_metrics_plane():
+    """No injected ``gauges_fn`` and no direct ``controller.
+    metrics_plane`` reference (the SliceManager here wraps a stub):
+    the arbiter reads the LIVE metrics plane over the state API
+    (``fleet_metrics``), so an AutoscalerMonitor-driven deployment
+    needs no gauge injection — serve replicas publish queue depth /
+    TTFT through their normal metrics reporter and the arbiter sees
+    them fleet-wide. The full pressure path runs against the live
+    plane: a sustained queue spike published as a real gauge preempts
+    the train slice."""
+    import ray_tpu
+    from ray_tpu.core.metric_defs import runtime_metrics
+
+    ray_tpu.init(num_cpus=4, _num_initial_workers=1,
+                 ignore_reinit_error=True)
+    try:
+        ctrl = _StubController()
+        p = FakeSliceProvider(provider_config={"max_slices": 2})
+        mgr = SliceManager(
+            ctrl, p, [SliceTypeConfig("pod", "2x4", {"CPU": 1})],
+            idle_timeout_s=3600.0, drain_deadline_s=0.0)
+        clock = _Clock()
+        arb = SliceArbiter(
+            mgr, policy=ArbiterPolicy(
+                queue_high=4.0, queue_low=1.0, ttft_p99_high_ms=2000.0,
+                ttft_p99_low_ms=1000.0, sustain_s=2.0, ebb_s=4.0),
+            now_fn=clock)
+        assert arb._gauges_fn is None
+        assert getattr(ctrl, "metrics_plane", None) is None
+
+        m = runtime_metrics()
+        m.serve_queue_depth.set(9.0)
+        g = arb._gauges()
+        assert g.get("queue_depth") == 9.0
+
+        sid = mgr.acquire_slice("pod")
+        arb.claim(sid, owner="train-job", kind="train", priority=0)
+        alive = set(p.internal_ids(sid))
+        mgr.update({"demand": [], "slice_demand": [],
+                    "busy_nodes": alive, "alive_nodes": alive})
+        assert mgr.slices[sid].state == UP
+        out = arb.update()
+        assert out["pressure"] and out["actions"] == []
+        clock.advance(2.5)
+        out = arb.update()
+        assert out["actions"] == [f"preempt:{sid}"]
+        assert arb._last_gauges["queue_depth"] == 9.0
+
+        m.serve_queue_depth.set(0.0)
+        g = arb._gauges()
+        assert g.get("queue_depth") == 0.0
+        mgr.shutdown()
+        p.shutdown()
+    finally:
+        ray_tpu.shutdown()

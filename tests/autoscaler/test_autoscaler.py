@@ -24,6 +24,7 @@ def _controller():
     return api._head.controller
 
 
+@pytest.mark.slow
 def test_scale_up_on_demand_and_down_when_idle(head):
     provider = FakeNodeProvider(head["session_dir"])
     scaler = StandardAutoscaler(

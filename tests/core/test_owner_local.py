@@ -78,6 +78,7 @@ def test_borrower_resolves_unpublished_ref_via_owner_fetch(cluster):
     assert ray_tpu.get(inner, timeout=30) == {"deep": 123}
 
 
+@pytest.mark.slow
 def test_task_returns_stay_owner_local_until_consumed(cluster):
     @ray_tpu.remote
     def f(x):

@@ -193,6 +193,7 @@ def test_stream_actor_methods(ray_start_regular):
 # ------------------------------------------- backpressure (acceptance)
 
 
+@pytest.mark.slow
 def test_stream_500_items_bounded_inflight(ray_start_regular):
     """A 500-item stream is fully consumed while produced-minus-consumed
     never exceeds the backpressure window (plus the one item a credit
@@ -305,6 +306,7 @@ def test_stream_under_latency_skewed_link():
 # ------------------------------------- cancellation/refs (acceptance)
 
 
+@pytest.mark.slow
 def test_stream_early_termination_no_leaked_refs(ray_start_regular):
     """Closing the generator early cancels the producer (it stops
     yielding) and drops every buffered item ref — the driver's

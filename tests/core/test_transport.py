@@ -102,6 +102,7 @@ def test_deep_nesting(ray_start_shared):
     assert ray_tpu.get(level.remote(4), timeout=60) == 4
 
 
+@pytest.mark.slow
 def test_cancel_queued_on_worker(ray_start_shared):
     """Cancel must reach tasks already pipelined onto a worker's local
     queue, without interrupting the running neighbour."""

@@ -19,6 +19,7 @@ def test_range_count_take(ray_session):
     assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
 
 
+@pytest.mark.slow
 def test_map_batches_numpy(ray_session):
     ds = rd.range(32, parallelism=2).map_batches(
         lambda b: {"x": b["id"] * 2}, batch_format="numpy")

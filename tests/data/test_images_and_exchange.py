@@ -96,6 +96,7 @@ def test_sort_and_repartition_streaming(ray_session):
     assert sorted(r["id"] for r in rp.take_all()) == list(range(1000))
 
 
+@pytest.mark.slow
 def test_put_get_beyond_store_budget(tmp_path):
     """Deterministic spill engagement: fill the store well past its
     budget with puts, then read everything back exactly — the

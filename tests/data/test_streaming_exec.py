@@ -85,6 +85,7 @@ def test_unordered_single_stage_parity(data_cluster, ctx):
         i + 7 for i in range(500)]
 
 
+@pytest.mark.slow
 def test_staged_mode_still_works(data_cluster, ctx):
     ctx.execution_mode = "staged"
     got = sorted(r["x"] for r in _two_stage(600, 3, 2).take_all())

@@ -43,6 +43,7 @@ def test_record_framing_detects_corruption(tmp_path):
         list(tfr.read_records(p))
 
 
+@pytest.mark.slow
 def test_read_tfrecords_dataset(ray_session, tmp_path):
     for shard in range(2):
         rows = [tfr.encode_example(

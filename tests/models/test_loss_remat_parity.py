@@ -79,7 +79,8 @@ def test_fused_ce_n_tokens_and_no_bias():
 
 
 @pytest.mark.parametrize("name", [
-    pytest.param("gptj-tiny", marks=pytest.mark.slow), "llama2-tiny"])
+    pytest.param("gptj-tiny", marks=pytest.mark.slow),
+    pytest.param("llama2-tiny", marks=pytest.mark.slow)])
 def test_lm_loss_fused_matches_materialized(name):
     """Model-level wiring: ce_chunk_size>0 (fused, with chunk padding)
     vs ce_chunk_size=0 (reference logits path) — loss and param grads."""

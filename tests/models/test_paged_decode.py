@@ -72,7 +72,7 @@ def _run_paged(cfg, ids, prompt_len, block_size, table_len,
 
 @pytest.mark.parametrize("style,kv_heads", [
     pytest.param("gptj", None, marks=pytest.mark.slow),
-    ("llama", 2)])
+    pytest.param("llama", 2, marks=pytest.mark.slow)])
 def test_prefill_decode_parity_vs_full_forward(style, kv_heads):
     """prompt=7 with block_size=4: the last block is UNEVEN (3 tokens);
     chunked prefill (3+3+1) and 9 decode steps must match apply()."""
@@ -134,8 +134,8 @@ def test_paged_attention_matches_reference():
 
 
 @pytest.mark.parametrize("style,kv_heads", [
-    pytest.param("gptj", None, marks=pytest.mark.slow),  # tier-1 keeps
-    ("llama", 2),                                        # the GQA case
+    pytest.param("gptj", None, marks=pytest.mark.slow),
+    pytest.param("llama", 2, marks=pytest.mark.slow),
 ])
 def test_prefill_decode_parity_kernel_impl(style, kv_heads):
     """The full vertical with the Pallas kernel forced (interpret mode

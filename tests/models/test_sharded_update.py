@@ -50,6 +50,7 @@ def parity_runs(cpu_mesh_devices):
     }
 
 
+@pytest.mark.slow
 def test_sharded_update_matches_replicated_exactly(parity_runs):
     # reduce-scatter + 1/N update + all-gather is the same arithmetic as
     # the replicated update, just laid out differently: losses agree to
@@ -59,6 +60,7 @@ def test_sharded_update_matches_replicated_exactly(parity_runs):
     np.testing.assert_allclose(l_shard, l_base, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_int8_sharded_loss_parity_bound(parity_runs):
     # acceptance bound: int8 grad transport + sharded update stays
     # within |dloss| < 1e-2 of the fp32 replicated baseline at step 20
@@ -70,6 +72,7 @@ def test_int8_sharded_loss_parity_bound(parity_runs):
     assert b.grad_transport == "int8" and b.shard_weight_update
 
 
+@pytest.mark.slow
 def test_sharded_opt_state_is_flat_and_data_sharded(parity_runs):
     bundle, state, _ = parity_runs["sharded"]
     mu = jax.tree.leaves(state["opt_state"])

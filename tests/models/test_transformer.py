@@ -38,7 +38,8 @@ def test_num_params_matches_tree():
 @pytest.mark.parametrize("spec,rules", [
     (MeshSpec(dp=2, fsdp=2, tp=2), FSDP_RULES),
     (MeshSpec(dp=4, tp=2), DDP_RULES),
-    (MeshSpec(fsdp=2, sp=2, tp=2), FSDP_RULES),   # ring attention path
+    pytest.param(MeshSpec(fsdp=2, sp=2, tp=2), FSDP_RULES,
+                 marks=pytest.mark.slow),          # ring attention path
 ])
 def test_sharded_train_step(cpu_mesh_devices, spec, rules):
     cfg = get_config("gptj-tiny")

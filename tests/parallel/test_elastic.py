@@ -198,6 +198,7 @@ def test_recovery_budget_exhaustion_is_typed():
 
 
 # --------------------------------------------------- notice-path fold
+@pytest.mark.slow
 def test_drain_notice_folds_dp_and_continues_trajectory():
     """A maintenance notice with no surviving capacity folds dp=2 →
     dp=1 live: 0 steps lost, exact trajectory continuation (dp is

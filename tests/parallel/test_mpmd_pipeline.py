@@ -265,6 +265,7 @@ def test_two_stage_split_matches_single_program_loss():
     assert abs(tot_l / tot_n - ref) <= 1e-5
 
 
+@pytest.mark.slow
 def test_vjp_two_program_grad_parity():
     """The stage actor's two jitted programs — forward-with-vjp and
     backward-from-saved-residuals — accumulated over microbatches with
@@ -372,7 +373,8 @@ def ref_bundle():
 
 
 @pytest.mark.parametrize(
-    "n_virtual", [1, pytest.param(2, marks=pytest.mark.slow)])
+    "n_virtual", [pytest.param(1, marks=pytest.mark.slow),
+                  pytest.param(2, marks=pytest.mark.slow)])
 def test_per_stage_optimizer_matches_train_step(n_virtual, ref_bundle):
     """Acceptance numerics, clusterless: the per-stage fused optimizer
     (grad accumulation + driver-reduced global clip + per-slice adamw)

@@ -72,6 +72,7 @@ def test_plan_validation():
 
 
 # --------------------------------------------------- SPMD lowering
+@pytest.mark.slow
 def test_spmd_program_step_and_canonical_checkpoint():
     """pp=1 lowers to make_train_step behind the uniform TrainProgram
     interface; its checkpoint is the CANONICAL layout (plain AdamW
@@ -146,6 +147,7 @@ def _inprocess_train_step(stages, batch, S, v, M):
             mets[0]["grad_norm"])
 
 
+@pytest.mark.slow
 def test_nested_stage_mesh_matches_spmd_short():
     """The shard_map'd dp=2 stage programs (recompute backward, psum'd
     grads, fused opt) reproduce the SPMD lowering's loss trajectory —

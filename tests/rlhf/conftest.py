@@ -1,0 +1,10 @@
+import pytest
+
+
+@pytest.fixture
+def rlhf_cluster():
+    import ray_tpu
+    info = ray_tpu.init(num_cpus=8, _num_initial_workers=4,
+                        ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()

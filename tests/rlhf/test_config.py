@@ -1,0 +1,194 @@
+"""RLHFConfig lowering units (clusterless) + the live placement
+reserve/release e2e over a FakeSliceProvider cluster (slow)."""
+
+import pytest
+
+from ray_tpu.rlhf.config import RLHFConfig, RLHFPlacement
+
+pytestmark = pytest.mark.rlhf
+
+
+def test_anakin_lowers_to_one_packed_shared_slice():
+    cfg = RLHFConfig(placement="anakin", num_learners=2, num_engines=3)
+    assert cfg.slice_strategy == "SLICE_PACK"
+    p = cfg.lower()
+    assert p.num_slices == 1
+    assert p.groups == [{"role": "shared", "engines": 3,
+                         "learners": 2}]
+    assert p.slice_strategy == "SLICE_PACK"
+    assert cfg.learner_plan().dp == 2
+    assert cfg.learner_plan().slice_strategy == "SLICE_PACK"
+
+
+def test_sebulba_lowers_to_spread_rollout_and_train_slices():
+    cfg = RLHFConfig(placement="sebulba", num_learners=4,
+                     num_engines=2)
+    assert cfg.slice_strategy == "SLICE_SPREAD"
+    p = cfg.lower()
+    assert p.num_slices == 2
+    roles = {g["role"]: g for g in p.groups}
+    assert roles["rollout"] == {"role": "rollout", "engines": 2,
+                                "learners": 0}
+    assert roles["train"] == {"role": "train", "engines": 0,
+                              "learners": 4}
+    assert cfg.learner_plan().slice_strategy == "SLICE_SPREAD"
+
+
+def test_engine_config_folds_in_rlhf_invariants():
+    cfg = RLHFConfig(prompt_len=56, max_new_tokens=16,
+                     engine=dict(capture_logprobs=False, spec_tokens=4,
+                                 max_seq_len=8, decode_slots=2))
+    ec = cfg.engine_config()
+    # the rollout payload needs logprobs; speculation is incompatible
+    assert ec["capture_logprobs"] is True
+    assert ec["spec_tokens"] == 0
+    assert ec["enable_prefix_sharing"] is True
+    assert ec["max_seq_len"] >= 56 + 16 + 2   # user's 8 was too small
+    assert ec["decode_slots"] == 2            # user knobs survive
+    # a user window that already fits is kept verbatim
+    big = RLHFConfig(engine=dict(max_seq_len=512)).engine_config()
+    assert big["max_seq_len"] == 512
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="placement"):
+        RLHFConfig(placement="jango")
+    with pytest.raises(ValueError, match=">= 1"):
+        RLHFConfig(num_learners=0)
+    with pytest.raises(ValueError, match="max_weight_lag"):
+        RLHFConfig(max_weight_lag=-1)
+    with pytest.raises(ValueError, match="system_prompt"):
+        RLHFConfig(system_prompt=())
+    with pytest.raises(ValueError, match="prompt_len"):
+        RLHFConfig(system_prompt=tuple(range(2, 50)), prompt_len=48)
+
+
+class _StubManager:
+    """Scripted SliceManager facade for the rollback unit."""
+
+    def __init__(self, grants):
+        self._grants = list(grants)   # None = acquisition failure
+        self.drained = []
+        self._n = 0
+
+    def acquire_slice(self, slice_type):
+        self._n += 1
+        return self._grants.pop(0) if self._grants else None
+
+    def wait_until_up(self, sid, timeout_s=60.0):
+        return sid is not None
+
+    def drain_slice(self, sid, reason=""):
+        self.drained.append((sid, reason))
+
+
+def test_reserve_is_all_or_nothing_with_rollback():
+    cfg = RLHFConfig(placement="sebulba")
+    p = cfg.lower()
+    mgr = _StubManager(["s-rollout"])      # second acquire fails
+    with pytest.raises(RuntimeError, match="could not reserve 2"):
+        p.reserve(mgr)
+    # the half-acquired slice was handed back, nothing retained
+    assert [s for s, _ in mgr.drained] == ["s-rollout"]
+    assert p.slice_ids == []
+
+    mgr2 = _StubManager(["s-a", "s-b"])
+    assert p.reserve(mgr2) == ["s-a", "s-b"]
+    assert [g["slice_id"] for g in p.groups] == ["s-a", "s-b"]
+    p.release(mgr2)
+    assert [s for s, _ in mgr2.drained] == ["s-a", "s-b"]
+    assert p.slice_ids == []
+
+
+class _StubScheduler:
+    def set_draining(self, node_id, draining):
+        pass
+
+
+class _StubController:
+    """Just enough controller surface for SliceManager's own snapshot
+    path (``collect_demand_snapshot``) to run clusterless: no demand,
+    no leases, and every fake-provider host reports alive."""
+
+    def __init__(self, provider):
+        import types as _t
+
+        from ray_tpu.core.events import FlightRecorder
+        self._provider = provider
+        self._ns = _t.SimpleNamespace
+        self.scheduler = _StubScheduler()
+        self.recorder = FlightRecorder("test", capacity=1024)
+        self.ready_queues = {}
+        self.tasks = {}
+        self.pending_pgs = []
+        self.leases = {}
+        self.actors = {}
+
+    @property
+    def nodes(self):
+        return {h: self._ns(alive=True)
+                for sid in self._provider.non_terminated_nodes()
+                for h in self._provider.internal_ids(sid)}
+
+    def call_on_loop(self, fn, timeout=None):
+        return fn()
+
+    def _reschedule_pgs_on_nodes(self, node_bs):
+        return 0
+
+    def _maybe_schedule(self, force=False):
+        pass
+
+
+def test_placement_reserve_release_against_live_slice_manager():
+    """Both placements against a real SliceManager over the in-memory
+    FakeSliceProvider: anakin reserves ONE packed slice, sebulba TWO
+    spread slices; stockout (max_slices=1) rolls sebulba's first
+    acquisition back; release drains everything so the provider
+    inventory returns to zero — no leaked slices."""
+    import time
+
+    from ray_tpu.autoscaler import (FakeSliceProvider, SliceManager,
+                                    SliceTypeConfig)
+
+    def _mgr(max_slices):
+        provider = FakeSliceProvider(
+            provider_config={"max_slices": max_slices})
+        mgr = SliceManager(
+            _StubController(provider), provider,
+            [SliceTypeConfig("pod", "2x2", {"CPU": 1})],
+            idle_timeout_s=3600.0, drain_deadline_s=0.0)
+        return provider, mgr
+
+    def _pump(provider, mgr):
+        alive = {h for sid in provider.non_terminated_nodes()
+                 for h in provider.internal_ids(sid)}
+        mgr.update({"demand": [], "slice_demand": [],
+                    "busy_nodes": set(), "alive_nodes": alive})
+
+    def _drain_all(provider, mgr):
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                provider.non_terminated_nodes():
+            _pump(provider, mgr)
+            time.sleep(0.05)
+        assert not provider.non_terminated_nodes(), "leaked slices"
+
+    for placement, want in (("anakin", 1), ("sebulba", 2)):
+        provider, mgr = _mgr(max_slices=2)
+        p = RLHFConfig(placement=placement).lower()
+        sids = p.reserve(mgr, timeout_s=60.0)
+        assert len(sids) == len(set(sids)) == want, (placement, sids)
+        up = {s for s, i in mgr.slices.items() if i.state == "UP"}
+        assert set(sids) <= up
+        p.release(mgr)
+        _drain_all(provider, mgr)
+
+    # stockout mid-reserve: sebulba needs 2 slices, provider has 1 —
+    # all-or-nothing means the acquired slice is drained back
+    provider, mgr = _mgr(max_slices=1)
+    p = RLHFConfig(placement="sebulba").lower()
+    with pytest.raises(RuntimeError, match="could not reserve 2"):
+        p.reserve(mgr, timeout_s=60.0)
+    assert p.slice_ids == []
+    _drain_all(provider, mgr)

@@ -91,6 +91,7 @@ def _make_offline_pendulum(tmp_path, n=512, seed=0):
     return str(tmp_path / "data")
 
 
+@pytest.mark.slow
 def test_cql_trains_from_offline_dataset(tmp_path):
     from ray_tpu.rllib import CQLConfig
     path = _make_offline_pendulum(tmp_path)

@@ -49,6 +49,7 @@ def test_dqn_learns_cartpole(ray_session):
         algo.cleanup()
 
 
+@pytest.mark.slow
 def test_dqn_checkpoint_roundtrip(ray_session, tmp_path):
     config = (DQNConfig().environment("CartPole-v1")
               .env_runners(num_env_runners=1)

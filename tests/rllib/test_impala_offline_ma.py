@@ -97,6 +97,7 @@ def test_json_reader_roundtrip(cartpole_offline_data):
     assert set(np.unique(batch["actions"])) <= {0, 1}
 
 
+@pytest.mark.slow
 def test_bc_clones_expert(ray_session, cartpole_offline_data):
     config = (BCConfig().environment("CartPole-v1")
               .training(lr=3e-3, train_batch_size=512)

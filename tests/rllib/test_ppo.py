@@ -84,6 +84,7 @@ def test_ppo_learns_cartpole(ray_session):
         algo.cleanup()
 
 
+@pytest.mark.slow
 def test_ppo_checkpoint_roundtrip(ray_session, tmp_path):
     config = (PPOConfig().environment("CartPole-v1")
               .env_runners(num_env_runners=1)

@@ -53,6 +53,7 @@ def test_rollout_stream_local_generator_deterministic():
             assert np.allclose(x[k], y[k]), f"nondeterministic {k}"
 
 
+@pytest.mark.slow
 def test_rollout_block_stream_fanin_and_batches(ray_session):
     spec = RLModuleSpec(observation_dim=4, num_actions=2, hiddens=(8,))
     import jax

@@ -40,6 +40,7 @@ def _raw_get(addr, path, timeout=60.0):
     return chunks
 
 
+@pytest.mark.slow
 def test_http_streams_generator_deployment(serve_session):
     """A generator deployment's tokens reach the HTTP client as they
     are produced (chunk arrival is spread over the generation time, not
@@ -177,6 +178,7 @@ def test_unary_json_back_compat(serve_session):
     assert body == b"made it"
 
 
+@pytest.mark.slow
 def test_proxy_per_node(serve_session):
     """serve.start() brings up one proxy per alive node; every proxy
     serves every route (reference: proxy-per-node + ProxyRouter)."""

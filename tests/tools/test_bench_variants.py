@@ -31,6 +31,7 @@ def test_measure_mfu_emits_step_time_and_variant_fields():
     assert r["loss"] == r["loss"]          # finite
 
 
+@pytest.mark.slow
 def test_measure_multichip_matrix_and_comm_split(cpu_mesh_devices,
                                                  monkeypatch):
     # restrict to one cheap variant; the full matrix runs in bench.py

@@ -781,3 +781,93 @@ def test_colocate_gate_cli_passes_on_checked_in_record(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "PASS" in out.stdout
+
+
+# ------------------------------------------------------------------ rl
+def _rl_record(tokens_per_s=300.0, steps_per_s=10.0, hit=0.65,
+               p99=0.0, stall=0.0, compression=3.9):
+    return {"metric": "rl_rollout_tokens_per_s", "value": tokens_per_s,
+            "unit": "tokens/s",
+            "detail": {"backend": "cpu",
+                       "learner_steps_per_s": steps_per_s,
+                       "prefix_hit_rate": hit,
+                       "staleness_p50": 0.0,
+                       "staleness_p99": p99,
+                       "decode_stall_s": stall,
+                       "wire_compression": compression}}
+
+
+def test_rl_extractor_inverts_staleness_and_gates_stall():
+    from tools.perf_gate import extract_rl_metrics
+    m = extract_rl_metrics(_rl_record())
+    assert m["rl_rollout_tokens_per_s"] == 300.0
+    assert m["rl/learner_steps_per_s"] == 10.0
+    assert m["rl/prefix_hit_rate"] == 0.65
+    # staleness is lower-is-better: p99=0 (perfectly fresh) maps to
+    # the 1/(1+p99) maximum of 1.0; p99=1 maps to 0.5
+    assert m["rl/staleness_p99_inv"] == 1.0
+    assert extract_rl_metrics(
+        _rl_record(p99=1.0))["rl/staleness_p99_inv"] == \
+        pytest.approx(0.5)
+    assert m["rl/wire_compression"] == pytest.approx(3.9)
+    # the zero-stall binary: ANY stall flips it
+    assert m["rl/decode_stall_ok"] == 1.0
+    assert extract_rl_metrics(
+        _rl_record(stall=0.01))["rl/decode_stall_ok"] == 0.0
+    sparse = extract_rl_metrics(
+        {"metric": "rl_rollout_tokens_per_s", "value": 100.0,
+         "detail": {}})
+    assert sparse["rl_rollout_tokens_per_s"] == 100.0
+    assert sparse["rl/learner_steps_per_s"] is None
+    assert sparse["rl/decode_stall_ok"] is None
+
+
+def test_rl_compare_is_relative_and_stall_binary_is_hard():
+    base = _rl_record()
+    # 20% slower rollouts stays inside the 30% tolerance
+    ok, _ = compare(_rl_record(tokens_per_s=240.0), base, metric="rl")
+    assert ok
+    # 2x slower fails
+    ok, msgs = compare(_rl_record(tokens_per_s=150.0), base,
+                       metric="rl")
+    assert not ok, msgs
+    # any decode stall during a weight swap is a -100% binary drop:
+    # fails at any tolerance even when every other row improves
+    ok, msgs = compare(_rl_record(tokens_per_s=900.0, stall=0.2),
+                       base, metric="rl")
+    assert not ok, msgs
+    # staleness regressing from fresh (p99=0) to lagged (p99=1) is a
+    # -50% drop on the inverse: fails at the 30% tolerance
+    ok, msgs = compare(_rl_record(p99=1.0), base, metric="rl")
+    assert not ok, msgs
+
+
+def test_rl_gate_against_checked_in_baseline():
+    from tools.perf_gate import extract_rl_metrics
+    path, rec = latest_baseline(REPO, metric="rl")
+    m = extract_rl_metrics(rec)
+    # the recorded acceptance run holds the issue's criteria: shared
+    # system prompt pays (>0.5 hit rate), zero decode stall through
+    # every in-flight sync, bounded staleness
+    assert m["rl/prefix_hit_rate"] > 0.5, path
+    assert m["rl/decode_stall_ok"] == 1.0, path
+    assert m["rl/staleness_p99_inv"] > 0.3, path
+    assert m["rl/wire_compression"] > 2.0, path
+    ok, msgs = compare(rec, rec, metric="rl")
+    assert ok, msgs
+
+
+def test_rl_gate_cli_passes_and_bootstraps(tmp_path):
+    path, _rec = latest_baseline(REPO, metric="rl")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "--fresh", path, "--metric", "rl"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    # empty series bootstrap-passes (first RL record has no baseline)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "--fresh", path, "--metric", "rl", "--root", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout

@@ -1,9 +1,12 @@
 """Multi-node simulation test (reference ray_start_cluster fixture,
 ``python/ray/tests/conftest.py:492``)."""
 
+import pytest
+
 import ray_tpu
 
 
+@pytest.mark.slow
 def test_cluster_utils_multi_node():
     """Multi-node-on-one-machine (reference ray_start_cluster)."""
     from ray_tpu.cluster_utils import Cluster
@@ -27,6 +30,7 @@ def test_cluster_utils_multi_node():
         cluster.shutdown()
 
 
+@pytest.mark.slow
 def test_p2p_object_transfer_bypasses_controller():
     """A large object produced on one node and consumed on another moves
     peer-to-peer over the nodes' direct channels (reference:

@@ -115,6 +115,26 @@ for seed in "${seeds[@]}"; do
     fi
 done
 
+# ---- rlhf soak leg: a 2-worker rollout fleet streams version-stamped
+# trajectory blocks under 5% message drops/dups/delays while a seeded-
+# random worker is SIGKILLed at a seeded-random block after its
+# in-flight int8 weight sync; invariants: lineage replay delivers every
+# block exactly once with tokens AND per-token policy-version stamps
+# bit-identical to a fault-free reference run
+# (tests/rlhf/test_rlhf_chaos.py::test_rlhf_rollout_chaos_soak)
+for seed in "${seeds[@]}"; do
+    echo "=== rlhf soak: seed=$seed ==="
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/rlhf/test_rlhf_chaos.py::test_rlhf_rollout_chaos_soak" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== rlhf seed=$seed PASSED ==="
+    else
+        echo "=== rlhf seed=$seed FAILED ==="
+        failed+=("rlhf:$seed")
+    fi
+done
+
 # ---- pipeline soak leg: SIGKILL a seeded-random stage actor mid-
 # interleaved-TRAIN-step (fwd+bwd+fused per-stage opt) → typed failure
 # at the driver, no hang, no leaked stream refs, cluster stays usable
@@ -241,6 +261,12 @@ if [ "${#failed[@]}" -gt 0 ]; then
             s="${seed#serve:}"
             echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
                  "tests/serve/test_llm_engine.py::test_serve_fleet_chaos_soak -q"
+            continue
+            ;;
+        rlhf:*)
+            s="${seed#rlhf:}"
+            echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
+                 "tests/rlhf/test_rlhf_chaos.py::test_rlhf_rollout_chaos_soak -q"
             continue
             ;;
         3d:*)

@@ -46,6 +46,14 @@ Three gated series (``--metric``):
   folded training tokens/s, fold/regrow recovery inverses and the
   steps-lost/parity binaries. Gated RELATIVELY; baselines
   ``COLOCATE_r*.json``, bootstrap-passes.
+- ``rl`` — the closed-loop RLHF record from ``bench.py --rl``:
+  rollout tokens/s headline, learner gradient rounds/s, the rollout
+  prefix-cache hit rate (the shared system prompt must keep paying),
+  weight-sync staleness p99 gated lower-is-better as its inverse
+  ``1/(1+p99)``, the int8 weight-wire compression, and a binary
+  zero-decode-stall row (``decode_stall_s`` must be exactly 0 — any
+  drain during an in-flight weight swap is an automatic FAIL). Gated
+  RELATIVELY; baselines ``RL_r*.json``, bootstrap-passes.
 
 Baselines are matched to the fresh record's backend (``detail.backend``:
 "tpu"/"cpu") when possible, so a CPU smoke record checked in between TPU
@@ -83,10 +91,12 @@ BASELINE_GLOBS = {"bench": "BENCH_r*.json",
                   "pipeline": "PIPELINE_r*.json",
                   "data": "DATA_r*.json",
                   "elastic": "ELASTIC_r*.json",
-                  "colocate": "COLOCATE_r*.json"}
+                  "colocate": "COLOCATE_r*.json",
+                  "rl": "RL_r*.json"}
 #: metrics compared RELATIVELY (tolerance is an allowed % drop, not
 #: absolute points — tokens/s scales with the chip, MFU doesn't)
-RELATIVE_METRICS = {"serve", "pipeline", "data", "elastic", "colocate"}
+RELATIVE_METRICS = {"serve", "pipeline", "data", "elastic", "colocate",
+                    "rl"}
 DEFAULT_TOLERANCES = {"bench": 2.0, "multichip": 2.0, "serve": 15.0,
                       "pipeline": 15.0, "data": 15.0,
                       # recovery wall-clock is teardown+rebuild+reload
@@ -94,10 +104,13 @@ DEFAULT_TOLERANCES = {"bench": 2.0, "multichip": 2.0, "serve": 15.0,
                       "elastic": 30.0,
                       # same teardown+rebuild noise in the fold/regrow
                       # rows; the TTFT rows are deterministic sim
-                      "colocate": 30.0}
+                      "colocate": 30.0,
+                      # rollout wall is actor-scheduling dominated on
+                      # CI hosts; the binary stall row is exact anyway
+                      "rl": 30.0}
 #: series whose early records may predate any parseable baseline
 BOOTSTRAP_METRICS = {"multichip", "serve", "pipeline", "data",
-                     "elastic", "colocate"}
+                     "elastic", "colocate", "rl"}
 
 
 def parse_bench_record(obj: dict) -> dict:
@@ -349,13 +362,47 @@ def extract_colocate_metrics(rec: dict) -> dict:
     return out
 
 
+def extract_rl_metrics(rec: dict) -> dict:
+    """The closed-loop RLHF record (``bench.py --rl``): rollout
+    tokens/s headline, learner gradient rounds/s, the rollout prefix
+    hit rate, weight-sync staleness p99 inverted lower-is-better as
+    ``1/(1+p99)`` (p99 == 0, fully fresh, maps to 1.0; +1 keeps the
+    perfect case finite), the int8 weight-wire compression ratio, and
+    the binary zero-decode-stall row: ``decode_stall_s`` must be
+    EXACTLY 0 — the in-flight swap never drains a decode slot, and any
+    nonzero stall is a −100% drop on the binary, an automatic FAIL at
+    any tolerance."""
+    detail = rec.get("detail") or {}
+    out = {"rl_rollout_tokens_per_s": float(rec["value"]),
+           "rl/learner_steps_per_s": None,
+           "rl/prefix_hit_rate": None,
+           "rl/staleness_p99_inv": None,
+           "rl/wire_compression": None,
+           "rl/decode_stall_ok": None}
+    if detail.get("learner_steps_per_s") is not None:
+        out["rl/learner_steps_per_s"] = \
+            float(detail["learner_steps_per_s"])
+    if detail.get("prefix_hit_rate") is not None:
+        out["rl/prefix_hit_rate"] = float(detail["prefix_hit_rate"])
+    if detail.get("staleness_p99") is not None:
+        out["rl/staleness_p99_inv"] = round(
+            1.0 / (1.0 + float(detail["staleness_p99"])), 6)
+    if detail.get("wire_compression") is not None:
+        out["rl/wire_compression"] = float(detail["wire_compression"])
+    if detail.get("decode_stall_s") is not None:
+        out["rl/decode_stall_ok"] = (
+            1.0 if float(detail["decode_stall_s"]) == 0.0 else 0.0)
+    return out
+
+
 EXTRACTORS = {"bench": extract_metrics,
               "multichip": extract_multichip_metrics,
               "serve": extract_serve_metrics,
               "pipeline": extract_pipeline_metrics,
               "data": extract_data_metrics,
               "elastic": extract_elastic_metrics,
-              "colocate": extract_colocate_metrics}
+              "colocate": extract_colocate_metrics,
+              "rl": extract_rl_metrics}
 
 
 def latest_baseline(root: str = REPO_ROOT, metric: str = "bench",
