@@ -294,6 +294,72 @@ def bench_mixed_lengths(model: Dict, engine: Dict, seed: int,
     }
 
 
+def bench_trace_overhead(model: Dict, engine: Dict, seed: int,
+                         requests: int = 16, clients: int = 4) -> Dict:
+    """Per-request tracing overhead guard: the SAME seeded schedule
+    replays against one engine with request tracing forced ON (every
+    request records spans; tail sampling still decides shipping) and
+    one with it OFF — tokens/s with tracing on must stay within 2% of
+    off for the SERVE gate's claim that observability rides free. Also
+    microbenches the span-record hot path itself (one dict build + one
+    append at the per-request cap, the worst case) against its <=20µs
+    bound. Wall-clock ratios on a noisy shared CPU are recorded, not
+    hard-failed; the span bound is deterministic enough to gate."""
+    from ray_tpu.models import TransformerConfig
+    from ray_tpu.serve.llm_engine import (EngineConfig, LLMEngine,
+                                          _resolve_dtype)
+    from ray_tpu.serve.request_trace import RequestTrace
+
+    mconf = dict(model)
+    if "dtype" in mconf:
+        mconf["dtype"] = _resolve_dtype(mconf["dtype"])
+    workload = make_workload(requests, clients, seed,
+                             mean_interarrival_s=0.002,
+                             prompt_rng=(4, 12), out_rng=(8, 16))
+    runs: Dict[str, Dict] = {}
+    for label, on in (("on", True), ("off", False)):
+        eng = LLMEngine(TransformerConfig(**mconf),
+                        EngineConfig(**dict(engine, enable_trace=on)),
+                        seed=seed)
+        try:
+            list(eng.generate_sync([3, 5, 7], 2))   # warm the jits
+            # best of two replays: at these wall times thread-spawn
+            # jitter rivals the effect being measured
+            load = min((run_engine_load(eng, workload)
+                        for _ in range(2)),
+                       key=lambda r: r["wall_s"])
+        finally:
+            eng.shutdown()
+        runs[label] = {
+            "tokens_total": load["tokens_total"],
+            "wall_s": load["wall_s"],
+            "tokens_per_s": round(
+                load["tokens_total"] / max(load["wall_s"], 1e-9), 2),
+            "errors": load["errors"],
+        }
+    on_tps = runs["on"]["tokens_per_s"]
+    off_tps = runs["off"]["tokens_per_s"]
+    # span-record microbench at the per-request cap (drop-oldest is the
+    # steady state of a long decode — the worst case of the hot path)
+    tr = RequestTrace("req-bench-span")
+    iters = 20_000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        tr.span("DECODE", 1.0, 2.0, tokens=16)
+    span_us = (time.perf_counter() - t0) / iters * 1e6
+    return {
+        "requests": requests,
+        "tracing_on": runs["on"],
+        "tracing_off": runs["off"],
+        "overhead_pct": (round(100.0 * (off_tps - on_tps) / off_tps, 2)
+                         if off_tps else None),
+        "within_2pct": (off_tps > 0
+                        and on_tps >= 0.98 * off_tps),
+        "span_record_us": round(span_us, 3),
+        "span_budget_us": 20.0,
+    }
+
+
 def bench_paged_kernel(on_tpu: bool, seed: int = 0) -> Dict:
     """Kernel-vs-reference leg at the op level: one mixed-length paged
     batch (half the sequences near-empty, half filling the window).
@@ -578,6 +644,7 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                         prompt_rng=(2, 6), out_rng=(6, 10),
                         mean_interarrival_s=0.02, timeout_s=120.0)
         mixed_kw = dict(requests=10, clients=4)
+        trace_kw = dict(requests=8, clients=4)
         scale_kw = dict(clients=8, requests=40,
                         mean_interarrival_s=0.06, timeout_s=150.0)
     elif on_tpu:
@@ -597,6 +664,7 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                         prompt_rng=(16, 128), out_rng=(32, 128),
                         mean_interarrival_s=0.02)
         mixed_kw = dict(requests=64, clients=32)
+        trace_kw = dict(requests=48, clients=16)
         scale_kw = dict(clients=64, requests=128,
                         mean_interarrival_s=0.005)
     else:
@@ -622,6 +690,7 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                         prompt_rng=(4, 16), out_rng=(16, 32),
                         mean_interarrival_s=0.01)
         mixed_kw = dict(requests=24, clients=8)
+        trace_kw = dict(requests=16, clients=4)
         scale_kw = dict(clients=12, requests=100,
                         mean_interarrival_s=0.06)
 
@@ -629,6 +698,7 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
     # mixed-length engine run need a device, not the cluster
     paged = bench_paged_kernel(on_tpu, seed=seed)
     mixed = bench_mixed_lengths(model, engine, seed=seed, **mixed_kw)
+    trace = bench_trace_overhead(model, engine, seed=seed, **trace_kw)
 
     ray_tpu.init(num_cpus=max(8, clients + 4,
                               fleet_kw["clients"] // 2 + 6),
@@ -701,6 +771,7 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
             "fleet": fleet,
             "paged_kernel": paged,
             "mixed_len": mixed,
+            "trace_overhead": trace,
             "scale_up": scale,
         },
     }

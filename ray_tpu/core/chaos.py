@@ -99,9 +99,13 @@ PROTECTED_TYPES = frozenset({"REG", "REGR", "BYE", "RPL", "ERR", "RCN"})
 #: MRT is the fleet metric snapshot (core/metrics_plane.py): same
 #: contract as TEV, plus reporter-side supersede (drop-oldest) so a
 #: sustained 100% drop window bounds the retransmit backlog.
+#: RSP is the per-request trace span batch (serve/request_trace.py):
+#: same contract as TEV, plus controller-side dedup by
+#: (request_id, part, seq) so a dup never yields a double waterfall.
 DEFAULT_DROPPABLE = frozenset({"RES", "PUT", "PNG", "HBT",
                                "DSP", "ACL", "ASG", "DON",
-                               "SIT", "SEF", "SCR", "TEV", "MRT"})
+                               "SIT", "SEF", "SCR", "TEV", "MRT",
+                               "RSP"})
 
 
 @dataclass

@@ -205,6 +205,27 @@ class Config:
     #: counted in the runtime_events_dropped_total metric.
     task_events_ring_size: int = 4096
 
+    # --- per-request tracing (serve/request_trace.py, serve/slo.py) ---
+    #: Per-request span recording on the serve path. Disabling turns
+    #: the request plane dark (waterfalls, /api/v0/requests,
+    #: `ray-tpu trace` all empty); aggregate serve metrics keep working.
+    enable_request_trace: bool = True
+    #: Tail sampling: 1-in-N requests ship their spans to the
+    #: controller even when fast and healthy (seeded per-router, so a
+    #: fixed seed gives a deterministic sample). Slow (SLO-tripped),
+    #: failed, and shed requests ALWAYS ship. 0 disables the baseline
+    #: sample (only slow/failed/shed ship).
+    trace_sample_n: int = 100
+    #: Completed request traces retained at the controller (drop-oldest).
+    request_trace_max: int = 512
+    #: SLO budgets evaluated per phase by the serve/slo.py watchdog.
+    #: Tripping any budget flips the request to always-ship and
+    #: increments serve_slo_violations_total{phase}. <=0 disables that
+    #: budget.
+    slo_queue_s: float = 1.0
+    slo_ttft_s: float = 5.0
+    slo_inter_token_p99_s: float = 1.0
+
     # --- fleet metrics plane (core/metrics_plane.py) ---
     #: Per-process periodic METRIC_REPORT snapshots to the controller.
     #: RAY_TPU_ENABLE_METRICS_REPORT=0 turns the fleet plane dark

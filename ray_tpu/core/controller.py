@@ -128,6 +128,13 @@ class Controller:
         from ray_tpu.core.metrics_plane import MetricsPlane
         from ray_tpu.util import metrics as MX
         self.metrics_plane = MetricsPlane.from_config(config)
+        # per-request trace store (serve/request_trace.py): replicas /
+        # routers ship tail-sampled REQUEST_SPANS batches here.
+        # Internally locked like the metrics plane — the dashboard's
+        # HTTP threads read it directly.
+        from ray_tpu.serve.request_trace import RequestTraceStore
+        self.request_traces = RequestTraceStore(
+            max_requests=getattr(config, "request_trace_max", 512))
         self.metrics_reporter = MX.make_reporter(
             self.metrics_plane.ingest,
             {"node": "head", "pid": os.getpid(), "role": "controller"},
@@ -2635,6 +2642,14 @@ class Controller:
         if what == "metrics_latest":
             return self.metrics_plane.latest_samples(
                 (params or {}).get("name", ""))
+        # request-trace views only touch the internally-locked
+        # RequestTraceStore — safe from any thread, like metrics*.
+        if what == "requests":
+            return self.request_traces.rows(limit=limit or 50)
+        if what == "request_trace":
+            w = self.request_traces.waterfall(
+                (params or {}).get("request_id", ""))
+            return [w] if w is not None else []
         m = {"limit": limit} if limit else {}
         if what == "nodes":
             rows = [{
@@ -2761,6 +2776,12 @@ class Controller:
         reliable layer's dedup window)."""
         self.metrics_plane.ingest(m)
 
+    def _h_request_spans(self, identity: bytes, m: dict) -> None:
+        """Per-request trace ingest: one tail-sampled span batch.
+        (request_id, part, seq)-deduped in the store, so a retransmit
+        or chaos dup never doubles a waterfall."""
+        self.request_traces.ingest(m)
+
     def _h_subscribe(self, identity: bytes, m: dict) -> None:
         self.subs[m["channel"]].add(identity)
 
@@ -2815,6 +2836,7 @@ class Controller:
         P.TIMELINE_EVENTS: _h_timeline,
         P.TASK_EVENTS: _h_task_events,
         P.METRIC_REPORT: _h_metric_report,
+        P.REQUEST_SPANS: _h_request_spans,
         P.SUBSCRIBE: _h_subscribe,
         P.PUBSUB: _h_pubsub,
         P.MSG_ACK: _h_msg_ack,

@@ -323,7 +323,8 @@ def _flow_id(span: str) -> int:
 
 
 def build_chrome_trace(events: List[dict],
-                       counters: Optional[List[dict]] = None) -> dict:
+                       counters: Optional[List[dict]] = None,
+                       requests: Optional[List[dict]] = None) -> dict:
     """Render merged flight-recorder events as Chrome-trace/Perfetto
     JSON: one track (pid) per recording process, ``X`` slices for each
     RUNNING→FINISHED/FAILED execution attempt, instants for the other
@@ -337,7 +338,16 @@ def build_chrome_trace(events: List[dict],
     (``metrics_plane.MetricsPlane.chrome_counters``): each carries a
     ``proc`` key naming its origin process and is re-homed onto that
     process's track, so tokens/s / queue-depth / occupancy curves
-    render alongside the spans they explain."""
+    render alongside the spans they explain.
+
+    ``requests`` (optional) are request-trace waterfalls
+    (``RequestTraceStore.waterfall`` shape): each renders as an async
+    track of ``b``/``e`` pairs keyed by its request_id — one lane per
+    request on a dedicated "requests" process — with a flow arrow from
+    the waterfall into the producing engine process's slices (the
+    ``procs`` map shipped with each span batch names the anchor track),
+    so a slow request can be followed from its QUEUED lane straight
+    into the engine/stage ticks that explain it."""
     procs: Dict[str, int] = {}
     trace_events: List[dict] = []
 
@@ -466,6 +476,49 @@ def build_chrome_trace(events: List[dict],
         if proc is not None:
             e["pid"] = pid_for(proc)
         trace_events.append(e)
+
+    # request waterfalls: one async lane per request id on a shared
+    # "requests" process track
+    for w in requests or ():
+        if not isinstance(w, dict) or not w.get("request_id"):
+            continue
+        rid = w["request_id"]
+        spans = [s for s in (w.get("spans") or ())
+                 if isinstance(s, dict)]
+        if not spans:
+            continue
+        rpid = pid_for("requests")
+        for s in spans:
+            t0_us = s.get("t0", 0.0) * 1e6
+            t1_us = max(s.get("t1", 0.0) * 1e6, t0_us + 1.0)
+            args = dict(s.get("attrs") or {}, request_id=rid)
+            phase = s.get("phase", "?")
+            trace_events.append({
+                "name": phase, "cat": "request", "ph": "b",
+                "id": rid, "ts": t0_us, "pid": rpid, "tid": 0,
+                "args": args})
+            trace_events.append({
+                "name": phase, "cat": "request", "ph": "e",
+                "id": rid, "ts": t1_us, "pid": rpid, "tid": 0})
+        # flow arrow into the engine process's slices: source at the
+        # waterfall's first engine-side span, target on the engine
+        # track at the same instant (lands on whatever ENGINE_STATS /
+        # stage-tick slice is active there)
+        engine_proc = (w.get("procs") or {}).get("engine")
+        anchor = next((s for s in spans
+                       if s.get("phase") in ("ADMITTED", "PREFILL",
+                                             "DECODE", "FIRST_TOKEN")),
+                      None)
+        if engine_proc and anchor is not None:
+            fid = _flow_id(rid.rpartition("-")[2])
+            ts_us = anchor.get("t0", 0.0) * 1e6
+            trace_events.append({
+                "name": "request", "cat": "flow", "ph": "s",
+                "id": fid, "ts": ts_us + 1, "pid": rpid, "tid": 0})
+            trace_events.append({
+                "name": "request", "cat": "flow", "ph": "f",
+                "bp": "e", "id": fid, "ts": ts_us + 2,
+                "pid": pid_for(engine_proc), "tid": 0})
 
     return {"traceEvents": trace_events, "displayTimeUnit": "ms",
             "otherData": {"source": "ray_tpu flight recorder",

@@ -202,6 +202,16 @@ class RuntimeMetrics:
             "serve_admission_rejected_total",
             "Requests shed by SLO-aware admission before reaching a "
             "replica queue", tag_keys=("tenant", "priority"))
+        # -- per-request tracing (serve/request_trace.py, serve/slo.py)
+        self.serve_slo_violations = Counter(
+            "serve_slo_violations_total",
+            "Per-phase SLO budget trips flagged by the serve SLO "
+            "watchdog; each trip flips its request's trace to "
+            "always-ship", tag_keys=("phase",))
+        self.request_spans_shipped = Counter(
+            "serve_request_spans_shipped_total",
+            "Request-trace span batches shipped to the controller "
+            "under tail sampling (slow, failed/shed, or 1-in-N)")
         # -- memory / health (reference: memory_manager worker kills)
         self.oom_worker_kills = Counter(
             "runtime_oom_worker_kills_total",

@@ -135,6 +135,12 @@ METRIC_REPORT = b"MRT"       # any->controller {origin, seq, ts,
                              # TEV, fire-and-forget for the producer;
                              # stale in-flight reports are superseded
                              # (drop-oldest, counted).
+REQUEST_SPANS = b"RSP"       # any->controller {request_id, part, seq,
+                             # spans: [...]}: per-request trace span
+                             # batch (serve/request_trace.py). Reliable
+                             # like TEV, fire-and-forget for the
+                             # producer; tail-sampled at the source so
+                             # only slow/failed/1-in-N requests ship.
 PUBSUB = b"PUB"              # {channel, data} fanout
 SUBSCRIBE = b"SSC"           # {channel}
 GENERIC_REPLY = b"RPL"

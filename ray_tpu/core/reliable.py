@@ -85,6 +85,12 @@ RELIABLE_TYPES = frozenset({
               # for the producer; the reporter additionally abandons
               # superseded in-flight reports via drop_oldest_of (a
               # snapshot is cumulative, so only the newest matters)
+    b"RSP",   # REQUEST_SPANS  any -> controller: per-request trace
+              # span batch (serve/request_trace.py). Same contract as
+              # TEV — exactly-once-effect at the controller (the store
+              # additionally dedups by (request_id, part, seq) so a
+              # chaos dup never doubles a waterfall), fire-and-forget
+              # for the producer
 })
 
 #: payload key carrying ``(sender tag, seq)``; popped before handlers

@@ -243,10 +243,40 @@ def _make_handler(head: DashboardHead):
                     # along as counter tracks ("ph":"C") — tokens/s,
                     # queue depth and occupancy curves next to spans.
                     from ray_tpu.core.events import build_chrome_trace
+                    store = head.controller.request_traces
                     self._json(build_chrome_trace(
                         head.state("task_events", 100_000),
                         counters=head.controller.metrics_plane
-                        .chrome_counters()))
+                        .chrome_counters(),
+                        requests=[w for w in (
+                            store.waterfall(r["request_id"])
+                            for r in store.rows(limit=50))
+                            if w is not None]))
+                elif path == "/api/v0/requests":
+                    # tail-sampled request-trace summaries (slow /
+                    # failed / 1-in-N); RequestTraceStore is internally
+                    # locked like MetricsPlane, so no loop marshal
+                    from urllib.parse import parse_qs
+                    q = parse_qs(parsed.query)
+                    try:
+                        limit = int(q.get("limit", ["50"])[0])
+                    except ValueError:
+                        self._json({"error": "limit must be an int"},
+                                   400)
+                        return
+                    self._json({"rows": head.controller
+                                .request_traces.rows(limit=limit)})
+                elif path.startswith("/api/v0/requests/"):
+                    # /api/v0/requests/<request_id> -> full waterfall
+                    rid = path.rsplit("/", 1)[-1]
+                    w = head.controller.request_traces.waterfall(rid)
+                    if w is None:
+                        self._json(
+                            {"error": f"no trace for {rid!r} (fast "
+                             "requests outside the tail sample ship "
+                             "no spans)"}, 404)
+                    else:
+                        self._json(w)
                 elif path == "/api/jobs":
                     self._json(head.job_manager.list_jobs())
                 elif path == "/api/v0/admission/policy":

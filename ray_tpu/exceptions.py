@@ -205,19 +205,25 @@ class AdmissionRejectedError(RayTpuError):
 
     def __init__(self, tenant: str = "default",
                  priority: str = "normal", reason: str = "overload",
-                 detail: str = ""):
+                 detail: str = "", request_id: str = ""):
         self.tenant = tenant
         self.priority = priority
         self.reason = reason
         self.detail = detail
+        # trace identity of the shed request, when the router minted
+        # one — lets 429 bodies and ARBITER_REJECT events be joined
+        # against the request-trace store's SHED waterfall
+        self.request_id = request_id
         super().__init__(
             f"request shed at admission ({reason}): tenant "
             f"{tenant!r}, priority {priority!r}"
-            + (f" — {detail}" if detail else ""))
+            + (f" — {detail}" if detail else "")
+            + (f" [request_id={request_id}]" if request_id else ""))
 
     def __reduce__(self):
         return (AdmissionRejectedError,
-                (self.tenant, self.priority, self.reason, self.detail))
+                (self.tenant, self.priority, self.reason, self.detail,
+                 self.request_id))
 
 
 class ObjectStoreFullError(RayTpuError):

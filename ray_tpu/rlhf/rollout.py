@@ -252,23 +252,39 @@ class RolloutEngine:
                     self._seq += 1
                 prompt = list(cfg.system_prompt) + [int(t)
                                                     for t in suffix]
+                # every trajectory is a traced serve request: the
+                # engine keeps its own 1-in-N tail sample, but the
+                # round's RLHF_ROLLOUT event names the slowest
+                # trajectory's request_id so `ray-tpu trace` can open
+                # its waterfall from the flight recorder
+                from ray_tpu.serve.request_trace import new_request_id
+                rid = new_request_id()
                 req = eng.submit(prompt, cfg.max_new_tokens,
-                                 eos_token_id=None, detailed=True)
+                                 eos_token_id=None, detailed=True,
+                                 trace_ctx={"request_id": rid,
+                                            "policy": "rlhf",
+                                            "admission": "bypass",
+                                            "enqueue_ts": time.time()})
                 futs.append(self._pool.submit(
                     self._drain, j % len(self.engines), seq, prompt,
-                    req, eng, stream))
+                    req, eng, stream, rid))
             tokens = 0
             versions: set = set()
+            slowest_rid, slowest_s = None, -1.0
             for f in futs:
-                n_tok, vers = f.result()
+                n_tok, vers, rid, dur_s = f.result()
                 tokens += n_tok
                 versions |= vers
+                if dur_s > slowest_s:
+                    slowest_rid, slowest_s = rid, dur_s
             if self._recorder is not None:
                 try:
                     self._recorder.record(
                         "RLHF_ROLLOUT", round=rnd,
                         trajectories=len(suffixes), tokens=tokens,
-                        policy_versions=sorted(versions))
+                        policy_versions=sorted(versions),
+                        slowest_request_id=slowest_rid,
+                        slowest_s=round(max(slowest_s, 0.0), 6))
                 except Exception:
                     pass
             stream.finish()
@@ -276,9 +292,11 @@ class RolloutEngine:
             stream.finish(err=e)
 
     def _drain(self, engine_idx: int, seq: int, prompt: List[int],
-               req, eng, stream: LocalBlockStream
-               ) -> Tuple[int, set]:
+               req, eng, stream: LocalBlockStream,
+               request_id: Optional[str] = None
+               ) -> Tuple[int, set, Optional[str], float]:
         from ray_tpu.serve.llm_engine import _DONE, EngineDeadError
+        t_start = time.monotonic()
         toks: List[int] = []
         vers: List[int] = []
         lps: List[float] = []
@@ -320,9 +338,10 @@ class RolloutEngine:
         }
         info = {"uid": uid, "worker_index": engine_idx,
                 "shard_key": seq, "block": seq, "reward": reward,
-                "versions": sorted(set(vers))}
+                "versions": sorted(set(vers)),
+                "request_id": request_id}
         stream.push(batch, info)
-        return T, set(vers)
+        return T, set(vers), request_id, time.monotonic() - t_start
 
     # ----------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:
@@ -431,9 +450,14 @@ def rlhf_rollout_blocks(model: Dict[str, Any], engine: Dict[str, Any],
                             __import__("signal").SIGKILL)
             prompt = [int(t) for t in system_prompt] + \
                 [int(t) for t in suffix]
+            from ray_tpu.serve.request_trace import new_request_id
+            rid = new_request_id()
             items = list(eng.generate_sync(
                 prompt, max_new_tokens, eos_token_id=None,
-                detailed=True))
+                detailed=True,
+                trace_ctx={"request_id": rid, "policy": "rlhf",
+                           "admission": "bypass",
+                           "enqueue_ts": time.time()}))
             toks = [int(t) for t, _v, _l in items]
             vers = [int(v) for _t, v, _l in items]
             lps = [float(l) if l is not None else 0.0

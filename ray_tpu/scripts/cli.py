@@ -171,6 +171,33 @@ def cmd_top(args) -> None:
     raise SystemExit(top_main(argv))
 
 
+def cmd_trace(args) -> None:
+    """`ray-tpu trace <request_id>` — one serve request's waterfall
+    from the controller's tail-sampled trace store (tools/trace.py
+    renders; the dashboard's /api/v0/requests/<id> serves)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.path.insert(0, repo_root)
+    try:
+        from tools.trace import main as trace_main
+    except ImportError:
+        raise SystemExit(
+            "ray-tpu trace needs tools/trace.py from the repository "
+            "checkout (run `python tools/trace.py` directly)")
+    argv = []
+    if args.request_id:
+        argv.append(args.request_id)
+    if args.dashboard:
+        argv += ["--dashboard", args.dashboard]
+    if args.input:
+        argv += ["--input", args.input]
+    if args.perfetto:
+        argv += ["--perfetto", args.perfetto]
+    if not args.dashboard and not args.input:
+        _connect()
+    raise SystemExit(trace_main(argv))
+
+
 def cmd_timeline(args) -> None:
     ray_tpu = _connect()
     out = args.output or f"/tmp/ray_tpu/timeline_{int(time.time())}.json"
@@ -340,6 +367,21 @@ def main() -> None:
     sp = sub.add_parser("timeline", help="dump Chrome trace")
     sp.add_argument("--output", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "trace", help="render a serve request's trace waterfall")
+    sp.add_argument("request_id", nargs="?", default=None,
+                    help="request id (X-Request-Id header / 429 body; "
+                    "omit to list the captured tail)")
+    sp.add_argument("--dashboard", default=None,
+                    help="dashboard address (defaults to the running "
+                    "session's)")
+    sp.add_argument("--input", default=None,
+                    help="waterfall JSON dump instead of a live "
+                    "cluster")
+    sp.add_argument("--perfetto", default=None, metavar="OUT",
+                    help="also export Chrome-trace JSON")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("top", help="live fleet metrics view")
     sp.add_argument("--dashboard", default=None,

@@ -34,6 +34,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
 from ray_tpu.serve.http import Request, Response
+from ray_tpu.serve.request_trace import new_request_id
 
 MAX_BODY = 256 << 20          # reject absurd request bodies
 ROUTE_CACHE_TTL_S = 1.0
@@ -195,7 +196,8 @@ class HTTPProxy:
             found = self._match(path)
         return found
 
-    def _handle_for(self, name: str, stream: bool, req=None):
+    def _handle_for(self, name: str, stream: bool, req=None,
+                    request_id: Optional[str] = None):
         from ray_tpu.serve.handle import DeploymentHandle
         table = self._stream_handles if stream else self._handles
         h = table.get(name)
@@ -210,17 +212,29 @@ class HTTPProxy:
         # radix KV cache (options() shares the cached handle's router —
         # load/affinity state spans all sessions)
         if req is not None:
-            sid = req.header("x-session-id")
-            tenant = req.header("x-tenant")
-            priority = req.header("x-priority")
-            if sid or tenant or priority:
+            # absent headers read back as "" — keep them None so the
+            # per-request options() copy (request_id is always set now)
+            # doesn't turn "no x-priority header" into a 400
+            sid = req.header("x-session-id") or None
+            tenant = req.header("x-tenant") or None
+            priority = req.header("x-priority") or None
+            if sid or tenant or priority or request_id:
                 try:
                     h = h.options(stream=stream, session_id=sid,
-                                  tenant=tenant, priority=priority)
+                                  tenant=tenant, priority=priority,
+                                  request_id=request_id)
                 except ValueError:
                     raise _HTTPError(
                         400, f"unknown x-priority {priority!r}")
         return h
+
+    @staticmethod
+    def _request_id_for(req: Request) -> str:
+        """Trace identity for this HTTP request: honour the client's
+        ``x-request-id`` (so their logs join our waterfalls), else mint
+        one. Echoed back in the ``X-Request-Id`` response header and in
+        429/500 error bodies either way."""
+        return req.header("x-request-id") or new_request_id()
 
     # ---------------------------------------------------------- dispatch
     async def _dispatch(self, req: Request,
@@ -242,8 +256,9 @@ class HTTPProxy:
         return json.loads(req.body) if req.body else None
 
     async def _dispatch_unary(self, req_route, req, writer, loop):
+        rid = self._request_id_for(req)
         handle = self._handle_for(req_route["name"], stream=False,
-                                  req=req)
+                                  req=req, request_id=rid)
 
         def call():
             payload = self._payload(req)
@@ -254,20 +269,23 @@ class HTTPProxy:
         try:
             result = await loop.run_in_executor(self._pool, call)
         except Exception as e:  # noqa: BLE001
-            await self._write_error(writer, e)
+            await self._write_error(writer, e, request_id=rid)
             return
         if isinstance(result, Response):
             await self._write_head(writer, result.status, result.headers
-                                   + [("Content-Length",
+                                   + [("X-Request-Id", rid),
+                                      ("Content-Length",
                                        str(len(result.body)))])
             writer.write(result.body)
             await writer.drain()
             return
-        await self._write_simple(writer, 200, result)
+        await self._write_simple(writer, 200, result,
+                                 extra_headers=[("X-Request-Id", rid)])
 
     async def _dispatch_stream(self, req_route, req, writer, loop):
+        rid = self._request_id_for(req)
         handle = self._handle_for(req_route["name"], stream=True,
-                                  req=req)
+                                  req=req, request_id=rid)
 
         def start():
             payload = self._payload(req)
@@ -285,11 +303,12 @@ class HTTPProxy:
         except Exception as e:  # noqa: BLE001
             # admission sheds before headers go out, so a 429 is still
             # expressible here (unlike mid-stream failures below)
-            await self._write_error(writer, e)
+            await self._write_error(writer, e, request_id=rid)
             return
         await self._write_head(
             writer, 200,
             [("Content-Type", "text/plain; charset=utf-8"),
+             ("X-Request-Id", rid),
              ("X-Accel-Buffering", "no")])
         try:
             chunk = first
@@ -355,18 +374,28 @@ class HTTPProxy:
                     writer, 500, {"error": "stream failed"})
 
     # ------------------------------------------------------------ output
-    async def _write_error(self, writer, e: BaseException) -> None:
+    async def _write_error(self, writer, e: BaseException,
+                           request_id: Optional[str] = None) -> None:
         """Typed error mapping: an admission shed is the CLIENT's
         signal to back off (429 + tenant/priority/reason so it can
-        retry with a higher class), not a server fault."""
+        retry with a higher class), not a server fault. Both bodies
+        carry ``request_id`` — the same id the SHED/FAILED waterfall is
+        filed under, so ``ray-tpu trace <id>`` explains the error."""
         from ray_tpu.exceptions import AdmissionRejectedError
         if isinstance(e, AdmissionRejectedError):
+            rid = e.request_id or request_id or ""
             await self._write_simple(
                 writer, 429,
                 {"error": str(e), "tenant": e.tenant,
-                 "priority": e.priority, "reason": e.reason})
+                 "priority": e.priority, "reason": e.reason,
+                 "request_id": rid},
+                extra_headers=[("X-Request-Id", rid)] if rid else None)
             return
-        await self._write_simple(writer, 500, {"error": str(e)})
+        await self._write_simple(
+            writer, 500,
+            {"error": str(e), "request_id": request_id or ""},
+            extra_headers=([("X-Request-Id", request_id)]
+                           if request_id else None))
 
     @staticmethod
     async def _write_head(writer, status: int,
@@ -385,13 +414,14 @@ class HTTPProxy:
         writer.write(b"\r\n".join(out) + b"\r\n\r\n")
         await writer.drain()
 
-    async def _write_simple(self, writer, status: int,
-                            payload: Any) -> None:
+    async def _write_simple(self, writer, status: int, payload: Any,
+                            extra_headers=None) -> None:
         body = json.dumps(payload).encode()
         await self._write_head(
             writer, status,
             [("Content-Type", "application/json"),
-             ("Content-Length", str(len(body)))])
+             ("Content-Length", str(len(body)))]
+            + list(extra_headers or []))
         writer.write(body)
         await writer.drain()
 

@@ -24,6 +24,15 @@ def get_multiplexed_model_id() -> str:
     return _request_context.get().get("multiplexed_model_id", "")
 
 
+def get_request_context() -> Dict[str, Any]:
+    """Full request-scoped routing context for the current request:
+    ``request_id`` and the router's ``trace`` stamp (sampling verdict,
+    enqueue timestamp, routing policy/score, admission verdict) in
+    addition to the multiplexed model id. Empty dict outside a
+    request."""
+    return _request_context.get()
+
+
 class Replica:
     def __init__(self, func_or_class, init_args, init_kwargs,
                  user_config=None, deployment_name: str = "",

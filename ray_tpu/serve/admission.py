@@ -199,9 +199,13 @@ class AdmissionController:
     def admit(self, tenant: Optional[str],
               priority: Union[str, int, None],
               gauges: Dict[Any, Dict[str, Any]],
-              tokens: Optional[float] = None) -> None:
+              tokens: Optional[float] = None,
+              request_id: Optional[str] = None) -> None:
         """Admit (charging the tenant's budget window) or raise
-        :class:`AdmissionRejectedError`."""
+        :class:`AdmissionRejectedError`. ``request_id`` is the trace
+        identity minted by the router; sheds stamp it into the error,
+        the ARBITER_REJECT event, and (at the caller) the SHED span so
+        a 429 body can be joined against its waterfall."""
         tenant = tenant or "default"
         prio = priority_value(priority)
         pname = priority_name(priority)
@@ -217,18 +221,20 @@ class AdmissionController:
             if rate + tokens / self.policy.budget_window_s > budget:
                 self._reject(tenant, pname, "over-budget",
                              f"{rate:.1f} tok/s against a "
-                             f"{budget:.1f} tok/s budget")
+                             f"{budget:.1f} tok/s budget",
+                             request_id=request_id)
         if prio < priority_value(self.policy.shed_below_priority) \
                 and self.overloaded(gauges):
             q, ttft = self._best_replica_load(gauges)
             self._reject(tenant, pname, "overload",
                          f"best replica queue {q:.0f}, "
-                         f"ttft {ttft:.2f}s")
+                         f"ttft {ttft:.2f}s",
+                         request_id=request_id)
         self._charge(tenant, tokens, now)
         self.admitted += 1
 
     def _reject(self, tenant: str, priority: str, reason: str,
-                detail: str) -> None:
+                detail: str, request_id: Optional[str] = None) -> None:
         self.rejected += 1
         try:
             from ray_tpu.core.metric_defs import runtime_metrics
@@ -246,11 +252,13 @@ class AdmissionController:
         if r is not None:
             try:
                 r.record("ARBITER_REJECT", tenant=tenant,
-                         priority=priority, reason=reason)
+                         priority=priority, reason=reason,
+                         request_id=request_id or "")
             except Exception:
                 pass
         raise AdmissionRejectedError(tenant=tenant, priority=priority,
-                                     reason=reason, detail=detail)
+                                     reason=reason, detail=detail,
+                                     request_id=request_id or "")
 
     def stats(self) -> Dict[str, Any]:
         now = self._now()

@@ -48,6 +48,22 @@ def gauge_score(g: Dict[str, Any]) -> float:
         - min(float(ttft), 2.0)
 
 
+def _ship_failure(tracer, trace, err: BaseException) -> None:
+    """Client-observed terminal failure: the replica (possibly dead —
+    SIGKILL mid-decode) cannot ship this request's trace, so the
+    router part does, ending it in a FAILED span naming the typed
+    error. No-op without a trace; single-shot per trace."""
+    if trace is None or tracer is None:
+        return
+    try:
+        from ray_tpu.serve import request_trace as RT
+        trace.span(RT.FAILED, time.time(), None,
+                   error=type(err).__name__, detail=str(err)[:200])
+        tracer.finish(trace)
+    except Exception:
+        pass
+
+
 class DeploymentResponse:
     """Future-like result of ``handle.remote()`` (reference
     ``handle.py:DeploymentResponse``). Submission to a dead replica
@@ -55,17 +71,24 @@ class DeploymentResponse:
     retry lives HERE: on actor death, the originating handle refreshes
     membership and re-routes once."""
 
-    def __init__(self, ref, retry=None):
+    def __init__(self, ref, retry=None, tracer=None, trace=None):
         self._ref = ref
         self._retry = retry  # () -> DeploymentResponse, single-shot
+        self._tracer = tracer
+        self._trace = trace
 
     def result(self, timeout_s: Optional[float] = None):
         try:
-            return ray_tpu.get(self._ref, timeout=timeout_s)
+            out = ray_tpu.get(self._ref, timeout=timeout_s)
+            self._trace = None   # replica-side trace owns the outcome
+            return out
         except Exception as e:
             if self._retry is not None and _is_actor_death(e):
                 retry, self._retry = self._retry, None
+                self._trace = None   # the retry mints a fresh trace
                 return retry().result(timeout_s=timeout_s)
+            trace, self._trace = self._trace, None
+            _ship_failure(self._tracer, trace, e)
             raise
 
     def _to_object_ref(self):
@@ -88,11 +111,14 @@ class DeploymentResponseGenerator:
     frees unconsumed items. A replica death before the first item
     re-routes once, like unary ``DeploymentResponse``."""
 
-    def __init__(self, gen, router=None, rkey=None, retry=None):
+    def __init__(self, gen, router=None, rkey=None, retry=None,
+                 tracer=None, trace=None):
         self._gen = gen          # core ObjectRefGenerator
         self._router = router
         self._rkey = rkey
         self._retry = retry      # () -> DeploymentResponseGenerator
+        self._tracer = tracer
+        self._trace = trace
         self._started = False
         self._done = False
 
@@ -103,6 +129,7 @@ class DeploymentResponseGenerator:
         try:
             ref = next(self._gen)
         except StopIteration:
+            self._trace = None   # clean end: the replica shipped it
             self._finish()
             raise
         except Exception as e:
@@ -116,16 +143,24 @@ class DeploymentResponseGenerator:
                 self._gen = fresh._gen
                 self._router = fresh._router
                 self._rkey = fresh._rkey
+                self._tracer = fresh._tracer
+                self._trace = fresh._trace
                 self._done = False
                 return next(self)
+            trace, self._trace = self._trace, None
+            _ship_failure(self._tracer, trace, e)
             self._finish()
             raise
         self._started = True
         try:
             return ray_tpu.get(ref)
-        except BaseException:
+        except BaseException as e:
             # a mid-stream exception is delivered as the failing item:
-            # the stream is over — release the router's stream count
+            # the stream is over — release the router's stream count.
+            # A dead replica cannot ship its trace, so the router part
+            # records the FAILED terminal here.
+            trace, self._trace = self._trace, None
+            _ship_failure(self._tracer, trace, e)
             self._finish()
             raise
 
@@ -190,6 +225,23 @@ class _Router:
         # budget accounting spans them. None = admit everything.
         self.admission = None
         self._last_policy_poll = 0.0
+        # per-request tracer (serve/request_trace.py): mints
+        # request_ids + the 1-in-N sampling verdict at the routing
+        # tier; shared across options() copies so the sample cadence
+        # spans them. Built lazily (needs the runtime config).
+        self.tracer = None
+
+    def _get_tracer(self):
+        if self.tracer is None:
+            from ray_tpu.serve.request_trace import RequestTracer
+            cfg = None
+            try:
+                from ray_tpu.core.global_state import try_global_worker
+                cfg = getattr(try_global_worker(), "config", None)
+            except Exception:
+                pass
+            self.tracer = RequestTracer(cfg, part="router")
+        return self.tracer
 
     @staticmethod
     def _key(replica) -> bytes:
@@ -436,7 +488,8 @@ class DeploymentHandle:
                  _routing_policy: Optional[str] = None,
                  _prefix_fingerprint: Optional[int] = None,
                  _tenant: Optional[str] = None,
-                 _priority=None):
+                 _priority=None,
+                 _request_id: Optional[str] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._controller = controller
@@ -448,6 +501,7 @@ class DeploymentHandle:
         self._prefix_fingerprint = _prefix_fingerprint
         self._tenant = _tenant
         self._priority = _priority
+        self._request_id = _request_id
 
     # -- admission ----------------------------------------------------
     def enable_admission(self, policy=None):
@@ -471,15 +525,41 @@ class DeploymentHandle:
         if not r.replicas:
             raise RuntimeError(
                 f"Deployment {self.deployment_name!r} has no replicas")
+        # Mint the request's trace identity HERE — the routing tier is
+        # the first hop that sees every request (proxy-supplied ids
+        # arrive via options(request_id=...)). The router is also the
+        # sampling authority: the 1-in-N verdict rides the call context
+        # to the replica, which materialises the waterfall and ships.
+        tracer = r._get_tracer()
+        trace = tracer.begin(request_id=self._request_id)
+        rid = trace.request_id if trace is not None else self._request_id
+        t_enqueue = time.time()
         if r.admission is not None:
             # Shed BEFORE pick: a rejected request must never touch a
             # replica queue (that queue depth is exactly what the shed
             # is protecting). Freshest engine gauges decide overload.
             r._poll_admission_policy()
             r._poll_gauges()
-            r.admission.admit(
-                self._tenant, self._priority, r._fresh_gauges(),
-                tokens=kwargs.get("max_tokens"))
+            try:
+                r.admission.admit(
+                    self._tenant, self._priority, r._fresh_gauges(),
+                    tokens=kwargs.get("max_tokens"), request_id=rid)
+            except Exception as e:
+                # terminal at the router: the replica never sees this
+                # request, so the router part ships the (QUEUED, SHED)
+                # waterfall — a shed request is traceable from its id
+                if trace is not None:
+                    from ray_tpu.serve import request_trace as RT
+                    now = time.time()
+                    trace.span(RT.QUEUED, t_enqueue, now)
+                    trace.span(RT.SHED, now, None,
+                               error=type(e).__name__,
+                               reason=getattr(e, "reason", None),
+                               tenant=self._tenant,
+                               priority=str(self._priority)
+                               if self._priority is not None else None)
+                    tracer.finish(trace)
+                raise
         # Unwrap chained responses so downstream gets values, not
         # wrapper objects (reference: DeploymentResponse passing).
         args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
@@ -490,12 +570,23 @@ class DeploymentHandle:
         replica, rkey = r.pick(self._model_id, self._session_id,
                                self._routing_policy,
                                prefix_fp=self._prefix_fingerprint)
+        ctx = {"multiplexed_model_id": self._model_id or ""}
+        if trace is not None:
+            g = r.gauges.get(rkey)
+            ctx["request_id"] = rid
+            ctx["trace"] = {
+                "sampled": trace.sampled,
+                "enqueue_ts": t_enqueue,
+                "policy": self._routing_policy or r.policy,
+                "score": round(gauge_score(g), 4) if g else None,
+                "admission": "admitted" if r.admission is not None
+                else "bypass",
+            }
         if self._stream:
             # core streaming generator task: the replica method's items
             # arrive as first-class objects with backpressure and the
             # runtime's delivery/fault guarantees — no replica-held
             # generator state, no chunk polling
-            ctx = {"multiplexed_model_id": self._model_id or ""}
             gen = replica.handle_request_stream.options(
                 num_returns="streaming").remote(
                     ctx, method, *args, **kwargs)
@@ -506,9 +597,9 @@ class DeploymentHandle:
                 return self._route(method, args, kwargs)
 
             return DeploymentResponseGenerator(
-                gen, r, rkey, retry=retry_on_dead_replica)
-        if self._model_id is not None:
-            ctx = {"multiplexed_model_id": self._model_id}
+                gen, r, rkey, retry=retry_on_dead_replica,
+                tracer=tracer, trace=trace)
+        if trace is not None or self._model_id is not None:
             ref = replica.handle_request_ctx.remote(
                 ctx, method, *args, **kwargs)
         else:
@@ -520,7 +611,8 @@ class DeploymentHandle:
             r.refresh(force=True)
             return self._route(method, args, kwargs)
 
-        return DeploymentResponse(ref, retry=retry_on_dead_replica)
+        return DeploymentResponse(ref, retry=retry_on_dead_replica,
+                                  tracer=tracer, trace=trace)
 
     def remote(self, *args, **kwargs):
         return self._route("__call__", args, kwargs)
@@ -537,6 +629,7 @@ class DeploymentHandle:
                 prefix_fingerprint: Optional[int] = None,
                 tenant: Optional[str] = None,
                 priority=None,
+                request_id: Optional[str] = None,
                 **kwargs) -> "DeploymentHandle":
         """Configured copy of this handle (reference: handle.options).
         ``session_id`` pins every call to one replica while it lives
@@ -546,7 +639,10 @@ class DeploymentHandle:
         kv_block_size)``) steers a first-turn request to the replica
         whose radix cache already holds that prefix; ``tenant`` /
         ``priority`` ("low"/"normal"/"high" or int) tag calls for
-        SLO-aware admission when :meth:`enable_admission` is on.
+        SLO-aware admission when :meth:`enable_admission` is on;
+        ``request_id`` pins the next call's trace identity (the HTTP
+        proxy forwards the client's ``x-request-id`` through here — an
+        unset id is minted fresh per call).
         Unknown options raise rather than silently no-op."""
         if kwargs:
             raise TypeError(
@@ -563,7 +659,8 @@ class DeploymentHandle:
             _model_id=multiplexed_model_id, _session_id=session_id,
             _routing_policy=routing_policy,
             _prefix_fingerprint=prefix_fingerprint,
-            _tenant=tenant, _priority=priority)
+            _tenant=tenant, _priority=priority,
+            _request_id=request_id)
 
     def __reduce__(self):
         # options survive pickling; router state is rebuilt on the far
@@ -572,16 +669,17 @@ class DeploymentHandle:
                 (self.deployment_name, self._controller, self.app_name,
                  self._stream, self._model_id, self._session_id,
                  self._routing_policy, self._prefix_fingerprint,
-                 self._tenant, self._priority))
+                 self._tenant, self._priority, self._request_id))
 
 
 def _rebuild_handle(deployment_name, controller, app_name, stream,
                     model_id, session_id=None, routing_policy=None,
                     prefix_fingerprint=None, tenant=None,
-                    priority=None):
+                    priority=None, request_id=None):
     return DeploymentHandle(deployment_name, controller, app_name,
                             _stream=stream, _model_id=model_id,
                             _session_id=session_id,
                             _routing_policy=routing_policy,
                             _prefix_fingerprint=prefix_fingerprint,
-                            _tenant=tenant, _priority=priority)
+                            _tenant=tenant, _priority=priority,
+                            _request_id=request_id)

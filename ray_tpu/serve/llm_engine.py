@@ -60,6 +60,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ray_tpu.exceptions import RayTpuError
+from ray_tpu.serve import request_trace as RT
 
 
 class EngineDeadError(RayTpuError):
@@ -111,6 +112,13 @@ class EngineConfig:
     spec_ngram: int = 3
     spec_min_acceptance: float = 0.1
     capture_logprobs: bool = False
+    #: Per-request tracing (serve/request_trace.py): None follows the
+    #: runtime config's enable_request_trace; True/False force it for
+    #: this engine (bench_serve's trace-overhead on/off legs).
+    enable_trace: Optional[bool] = None
+    #: Tokens per DECODE trace span — bounds span count for long
+    #: generations (a 4k-token decode is ~256 spans at 16, not 4k).
+    trace_decode_tick: int = 16
 
     @property
     def blocks_per_seq(self) -> int:
@@ -142,7 +150,8 @@ class _Request:
                  "seq_len", "generated", "cancelled", "t_submit",
                  "t_first_token", "history", "hit_blocks", "trie_node",
                  "trie_cursor", "spec_ewma", "spec_disabled", "warmup",
-                 "detailed")
+                 "detailed", "trace", "t_enqueue_wall", "queue_wait_s",
+                 "last_tok_wall", "tick_t0", "tick_toks")
 
     def __init__(self, rid: int, prompt: List[int], max_new_tokens: int,
                  eos_token_id: Optional[int]):
@@ -162,6 +171,13 @@ class _Request:
         self.detailed = False     # stream (tok, version, logprob) tuples
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
+        # -- per-request tracing (serve/request_trace.py)
+        self.trace = None             # RequestTrace or None
+        self.t_enqueue_wall = 0.0     # router (or submit) wall clock
+        self.queue_wait_s = 0.0       # enqueue -> engine admission
+        self.last_tok_wall: Optional[float] = None
+        self.tick_t0: Optional[float] = None   # open DECODE tick start
+        self.tick_toks = 0            # tokens in the open DECODE tick
         # -- prefix sharing (prefix_cache.PrefixBlockPool)
         self.hit_blocks = 0           # prompt blocks prefill skipped
         self.trie_node = None         # deepest trie node of this prompt
@@ -352,6 +368,29 @@ class LLMEngine:
             self._recorder = getattr(w, "recorder", None)
         except Exception:
             pass
+        # -- per-request tracing + SLO watchdog --------------------------
+        # (serve/request_trace.py, serve/slo.py): the engine is the
+        # waterfall's single shipper — router annotations arrive in the
+        # call context, every phase span is materialised here, and ONE
+        # REQUEST_SPANS batch ships at request end iff sampled /
+        # SLO-tripped / failed.
+        self._tracer = self._slo = None
+        self._queue_wait_ewma: Optional[float] = None
+        try:
+            from ray_tpu.serve.request_trace import RequestTracer
+            from ray_tpu.serve.slo import SLOBudget, SLOWatchdog
+            cfg = None
+            try:
+                from ray_tpu.core.global_state import try_global_worker
+                cfg = getattr(try_global_worker(), "config", None)
+            except Exception:
+                pass
+            self._tracer = RequestTracer(cfg, part="engine")
+            if ec.enable_trace is not None:
+                self._tracer.enabled = bool(ec.enable_trace)
+            self._slo = SLOWatchdog(SLOBudget.from_config(cfg))
+        except Exception:
+            pass
 
         # Engine-owned executor for consumer-side queue polls: sharing
         # the actor event loop's default executor would let stream
@@ -393,6 +432,7 @@ class LLMEngine:
                max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                detailed: bool = False,
+               trace_ctx: Optional[Dict[str, Any]] = None,
                _warmup: bool = False) -> _Request:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
@@ -413,9 +453,42 @@ class LLMEngine:
             req = _Request(self._rid, prompt, max(1, int(mnt)), eos)
             req.warmup = _warmup
             req.detailed = detailed
+            if not _warmup:
+                self._attach_trace(req, trace_ctx)
             self._pending.append(req)
             self._work.notify_all()
         return req
+
+    def _attach_trace(self, req: _Request,
+                      trace_ctx: Optional[Dict[str, Any]]) -> None:
+        """Open this request's trace. ``trace_ctx`` is the router's
+        stamp (request_id, sampled verdict, enqueue timestamp, routing
+        annotations) flattened out of the replica call context; a
+        direct ``submit`` (RLHF rollouts, tests) gets a locally-minted
+        request_id and the tracer's own 1-in-N sampling decision."""
+        tr = self._tracer
+        if tr is None or not tr.enabled:
+            return
+        now = time.time()
+        ctx = trace_ctx or {}
+        rid = ctx.get("request_id")
+        # a caller-pinned id with no explicit sampling verdict (RLHF
+        # rollouts stamping ids) keeps the tracer's own 1-in-N; the
+        # router always stamps its verdict explicitly
+        sampled = ctx.get("sampled") if rid else None
+        if sampled is not None:
+            sampled = bool(sampled)
+        meta = {k: ctx[k] for k in ("policy", "score", "admission")
+                if ctx.get(k) is not None}
+        trace = tr.begin(request_id=rid, sampled=sampled,
+                         meta=meta or None)
+        if trace is None:
+            return
+        req.trace = trace
+        # clamp a skewed cross-process enqueue stamp: the QUEUED span
+        # must never start in this process's future
+        req.t_enqueue_wall = min(float(ctx.get("enqueue_ts") or now),
+                                 now)
 
     def cancel(self, req: _Request) -> None:
         """Mark a request cancelled; the step thread frees its slot and
@@ -427,12 +500,14 @@ class LLMEngine:
 
     async def generate(self, prompt_ids: Sequence[int],
                        max_new_tokens: Optional[int] = None,
-                       eos_token_id: Optional[int] = None):
+                       eos_token_id: Optional[int] = None,
+                       trace_ctx: Optional[Dict[str, Any]] = None):
         """Async token stream for one request. Raises typed errors
         (``EngineDeadError`` / ``RequestTooLargeError``) instead of
         hanging; early ``aclose()`` cancels the request and frees its
         slot + blocks."""
-        req = self.submit(prompt_ids, max_new_tokens, eos_token_id)
+        req = self.submit(prompt_ids, max_new_tokens, eos_token_id,
+                          trace_ctx=trace_ctx)
         loop = asyncio.get_running_loop()
         get = functools.partial(req.out.get, timeout=0.2)
         try:
@@ -456,10 +531,11 @@ class LLMEngine:
                       max_new_tokens: Optional[int] = None,
                       eos_token_id: Optional[int] = None,
                       timeout_s: float = 120.0,
-                      detailed: bool = False):
+                      detailed: bool = False,
+                      trace_ctx: Optional[Dict[str, Any]] = None):
         """Blocking token stream (tests / direct embedding)."""
         req = self.submit(prompt_ids, max_new_tokens, eos_token_id,
-                          detailed=detailed)
+                          detailed=detailed, trace_ctx=trace_ctx)
         deadline = time.monotonic() + timeout_s
         try:
             while True:
@@ -569,6 +645,13 @@ class LLMEngine:
                 "occupancy_hist": dict(self._occupancy),
                 "ttft_ewma_s": (round(self._ttft_ewma, 6)
                                 if self._ttft_ewma is not None else None),
+                # router-enqueue -> engine-admission wait (EWMA): the
+                # component that, added to the engine-scoped TTFT,
+                # gives the full user-facing TTFT the serve_ttft
+                # histogram and the request waterfalls report
+                "queue_wait_ewma_s": (
+                    round(self._queue_wait_ewma, 6)
+                    if self._queue_wait_ewma is not None else None),
                 # in-flight weight refresh accounting (RLHF rollout
                 # backend): swaps are pointer flips between decode
                 # steps, so sync_stall_s — decode time lost waiting on
@@ -633,6 +716,7 @@ class LLMEngine:
         err = EngineDeadError(f"engine step loop died: {e!r}")
         err.__cause__ = e
         for r in set(reqs):
+            self._close_trace(r, err)
             r.out.put(err)
 
     # one engine step: swap staged weights -> reap -> admit -> one
@@ -657,11 +741,20 @@ class LLMEngine:
             if staged is None:
                 return
             self._staged_weights = None
-            active = sum(1 for r in self._slots if r is not None)
+            active_reqs = [r for r in self._slots if r is not None]
+            active = len(active_reqs)
+        t0w = time.time()
         t0 = time.monotonic()
         params, version = staged
         self._params = params
         swap_s = time.monotonic() - t0
+        now_w = time.time()
+        for r in active_reqs:
+            # the swap overlapped these requests' decode: annotate each
+            # waterfall with the version boundary it decoded across
+            if r.trace is not None:
+                r.trace.span(RT.WEIGHT_SWAP, t0w, now_w,
+                             version=version)
         with self._lock:
             self._weight_version = version
             self._weight_swaps += 1
@@ -684,6 +777,7 @@ class LLMEngine:
             for req in list(self._pending):
                 if req.cancelled:
                     self._pending.remove(req)
+                    self._close_trace(req)
                     req.out.put(_DONE)
             for req in self._slots:
                 if req is not None and req.cancelled:
@@ -755,6 +849,18 @@ class LLMEngine:
                             req.hit_blocks)
                     except Exception:
                         pass
+                if req.trace is not None:
+                    now = time.time()
+                    req.queue_wait_s = max(
+                        0.0, now - req.t_enqueue_wall)
+                    req.trace.span(RT.QUEUED, req.t_enqueue_wall, now)
+                    req.trace.span(RT.ADMITTED, now, None,
+                                   slot=req.slot,
+                                   hit_blocks=req.hit_blocks,
+                                   prefix_tokens=mtok,
+                                   cow=cow_src is not None)
+                    self._slo.observe_queue(req.trace,
+                                            req.queue_wait_s)
             # device-side CoW copy OUTSIDE the lock (the step thread is
             # the only device user; submit/cancel stay responsive)
             if cow_src is not None:
@@ -776,6 +882,7 @@ class LLMEngine:
         n = min(C, len(req.prompt) - start)
         chunk = np.zeros((1, C), np.int32)
         chunk[0, :n] = req.prompt[start:start + n]
+        t0w = time.time()
         t0 = time.monotonic()
         out = self._jit_prefill(
             self._params, jnp.asarray(chunk), self._cache,
@@ -791,6 +898,9 @@ class LLMEngine:
         self._prefill_wall_s += time.monotonic() - t0
         req.prefill_pos += n
         self._prefill_chunks += 1
+        if req.trace is not None:
+            req.trace.span(RT.PREFILL, t0w, time.time(),
+                           pos=start, tokens=n)
         # index newly-completed FULL prompt blocks in the radix trie so
         # concurrent/later requests with the same prefix share them; a
         # lost insert race (same chunk path already indexed) keeps our
@@ -885,6 +995,7 @@ class LLMEngine:
         out = self._np.asarray(out)
         self._decode_wall_s += time.monotonic() - t0
         produced = 0
+        now_w = time.time()
         with self._lock:
             for req in active:
                 if req.cancelled or self._slots[req.slot] is not req:
@@ -903,6 +1014,7 @@ class LLMEngine:
                 req.history.append(tok)
                 self._tokens_total += 1
                 produced += 1
+                self._trace_token(req, now_w)
                 if req.generated >= req.max_new_tokens \
                         or req.seq_len + 1 >= self.config.max_seq_len:
                     self._release_locked(req)
@@ -984,6 +1096,7 @@ class LLMEngine:
             bt = self._block_tables.copy()
         self._account_decode_pages(starts + lens)
         jnp = self._jnp
+        t0w = time.time()
         t0 = time.monotonic()
         preds, self._cache = self._jit_verify(
             self._params, jnp.asarray(toks), self._cache,
@@ -991,6 +1104,7 @@ class LLMEngine:
         preds = np.asarray(preds)
         self._decode_wall_s += time.monotonic() - t0
         produced = 0
+        now_w = time.time()
         with self._lock:
             for req in active:
                 if req.cancelled or self._slots[req.slot] is not req:
@@ -1012,6 +1126,7 @@ class LLMEngine:
                     self._tokens_total += 1
                     produced += 1
                     emitted += 1
+                    self._trace_token(req, now_w)
                     if req.generated >= req.max_new_tokens \
                             or req.seq_len + 1 >= ec.max_seq_len:
                         self._release_locked(req)
@@ -1025,6 +1140,10 @@ class LLMEngine:
                     accepted = max(0, emitted - 1)
                     self._spec_drafted += len(d)
                     self._spec_accepted += accepted
+                    if req.trace is not None:
+                        req.trace.span(RT.SPEC_VERIFY, t0w, now_w,
+                                       drafted=len(d),
+                                       accepted=accepted)
                     ratio = accepted / len(d)
                     req.spec_ewma = ratio if req.spec_ewma is None \
                         else 0.8 * req.spec_ewma + 0.2 * ratio
@@ -1043,6 +1162,28 @@ class LLMEngine:
                 self._metrics.serve_tokens.inc(produced)
             except Exception:
                 pass
+
+    def _trace_token(self, req: _Request, now_w: float) -> None:
+        """Book one emitted decode token into the request's trace:
+        inter-token gap to the SLO watchdog, and a DECODE span every
+        ``trace_decode_tick`` tokens (bounding span count for long
+        generations). Speculative bursts emit several tokens at one
+        wall instant — the intra-burst gaps are genuinely ~0, which is
+        exactly what the user-perceived stream looks like."""
+        tr = req.trace
+        if tr is None:
+            return
+        last = req.last_tok_wall
+        req.last_tok_wall = now_w
+        if last is not None:
+            self._slo.observe_gap(tr, max(0.0, now_w - last))
+        if req.tick_t0 is None:
+            req.tick_t0 = last if last is not None else now_w
+        req.tick_toks += 1
+        if req.tick_toks >= self.config.trace_decode_tick:
+            tr.span(RT.DECODE, req.tick_t0, now_w,
+                    tokens=req.tick_toks)
+            req.tick_t0, req.tick_toks = None, 0
 
     def _item(self, req: _Request, tok: int, logprob):
         """Shape one stream item: plain int for serving consumers,
@@ -1072,8 +1213,32 @@ class LLMEngine:
             req.slot = None
             req.trie_node = None
         req.state = _FINISHED
+        self._close_trace(req, err)
         req.out.put(err if err is not None else _DONE)
         self._work.notify_all()
+
+    def _close_trace(self, req: _Request,
+                     err: Optional[BaseException] = None) -> None:
+        """Terminal span + ship decision for one request's trace
+        (exactly once — the trace is detached first). FAILED names the
+        typed error; DONE carries the token count. Shipping is an
+        out-queue put, so holding the engine lock here is fine."""
+        tr = req.trace
+        if tr is None:
+            return
+        req.trace = None
+        now = time.time()
+        if req.tick_toks and req.tick_t0 is not None:
+            tr.span(RT.DECODE, req.tick_t0, now, tokens=req.tick_toks)
+            req.tick_t0, req.tick_toks = None, 0
+        if err is not None:
+            tr.span(RT.FAILED, now, None,
+                    error=type(err).__name__, detail=str(err)[:200])
+        else:
+            tr.span(RT.DONE, now, None, tokens=req.generated,
+                    cancelled=bool(req.cancelled))
+        if self._tracer is not None:
+            self._tracer.finish(tr)
 
     # ------------------------------------------------ metrics / events
     def _record_ttft(self, req: _Request) -> None:
@@ -1082,22 +1247,46 @@ class LLMEngine:
             # both the router's EWMA and the flight recorder
             return
         ttft = req.t_first_token - req.t_submit
+        # full TTFT = router-enqueue -> first token: queue_wait_s is
+        # the router-stamped component the engine never used to see.
+        # The fleet histogram observes the FULL number so its quantiles
+        # agree with the request waterfalls on what TTFT means; the
+        # EWMA stays engine-scoped (it is the router's own-capacity
+        # gauge — charging it the router's queueing would feed back).
+        qw = getattr(req, "queue_wait_s", 0.0)
+        t_enq = getattr(req, "t_enqueue_wall", 0.0)
+        full = max(ttft, time.time() - t_enq) if t_enq else ttft
         self._ttft_ewma = ttft if self._ttft_ewma is None \
             else 0.8 * self._ttft_ewma + 0.2 * ttft
+        qw_ewma = getattr(self, "_queue_wait_ewma", None)
+        self._queue_wait_ewma = qw if qw_ewma is None \
+            else 0.8 * qw_ewma + 0.2 * qw
         if self._metrics is not None:
             try:
-                self._metrics.serve_ttft.observe(ttft)
+                self._metrics.serve_ttft.observe(full)
                 self._metrics.serve_tokens.inc()
             except Exception:
                 pass
+        trace = getattr(req, "trace", None)
         if self._recorder is not None:
             try:
                 self._recorder.record(
                     "ENGINE_TTFT", replica=self.replica_tag,
                     rid=req.rid, ttft_s=round(ttft, 6),
-                    prompt_len=len(req.prompt))
+                    queue_wait_s=round(qw, 6),
+                    prompt_len=len(req.prompt),
+                    request_id=(trace.request_id
+                                if trace is not None else None))
             except Exception:
                 pass
+        if trace is not None:
+            now = time.time()
+            req.last_tok_wall = now     # inter-token gap baseline
+            trace.event(RT.FIRST_TOKEN, now,
+                        ttft_s=round(full, 6),
+                        engine_ttft_s=round(ttft, 6),
+                        queue_wait_s=round(qw, 6))
+            self._slo.observe_ttft(trace, full)
 
     def _emit_stats(self, interval_s: float = 0.5) -> None:
         now = time.monotonic()
@@ -1184,17 +1373,36 @@ class LLMServer:
             except Exception:
                 pass
 
+    @staticmethod
+    def _trace_ctx() -> Optional[Dict[str, Any]]:
+        """Flatten the router's trace stamp out of the replica call
+        context (request_id + sampling verdict + routing annotations),
+        so the engine opens the request's trace under the id the
+        client/proxy already knows."""
+        try:
+            from ray_tpu.serve._private.replica import \
+                get_request_context
+            ctx = get_request_context()
+        except Exception:
+            return None
+        rid = ctx.get("request_id")
+        if not rid:
+            return None
+        return dict(ctx.get("trace") or {}, request_id=rid)
+
     async def generate(self, prompt_ids: Sequence[int],
                        max_new_tokens: Optional[int] = None,
                        eos_token_id: Optional[int] = None):
         async for tok in self.engine.generate(
-                prompt_ids, max_new_tokens, eos_token_id):
+                prompt_ids, max_new_tokens, eos_token_id,
+                trace_ctx=self._trace_ctx()):
             yield tok
 
     async def __call__(self, prompt_ids: Sequence[int],
                        max_new_tokens: Optional[int] = None):
-        async for tok in self.engine.generate(prompt_ids,
-                                              max_new_tokens):
+        async for tok in self.engine.generate(
+                prompt_ids, max_new_tokens,
+                trace_ctx=self._trace_ctx()):
             yield tok
 
     def stats(self) -> Dict[str, Any]:

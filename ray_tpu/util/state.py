@@ -137,6 +137,26 @@ def fleet_metrics(window_s: float = 30.0) -> Dict[str, Any]:
         "metrics_fleet", params={"window_s": window_s})
 
 
+def list_requests(limit: int = 50) -> List[dict]:
+    """Tail-sampled serve request traces at the controller, newest
+    first (serve/request_trace.py): one summary row per request —
+    request_id, terminal status, duration, SLO trips, and a per-phase
+    breakdown. Only slow / failed / 1-in-N requests ship spans, so
+    this is the interesting tail, not all traffic."""
+    return global_worker().state_query(
+        "requests", limit=limit)
+
+
+def get_request_trace(request_id: str) -> Optional[dict]:
+    """Full waterfall for one traced request — every recorded span
+    (phase, t0, t1, attrs) sorted by start time, plus SLO trips and
+    routing metadata. None when the id never shipped (fast request
+    outside the sample, or the trace aged out of the ring)."""
+    rows = global_worker().state_query(
+        "request_trace", params={"request_id": request_id})
+    return rows[0] if rows else None
+
+
 def summarize_task_latency() -> Dict[str, Any]:
     """Per-task-name latency summary from the flight recorder:
     scheduling delay (SUBMITTED→RUNNING) and execution time

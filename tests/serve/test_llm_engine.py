@@ -576,6 +576,25 @@ def test_serve_fleet_chaos_soak(seed):
         assert audits is not None and all(a == [] for a in audits), \
             audits
     finally:
+        # chaos-matrix sidecar: the slowest captured request waterfall
+        # (render with `python tools/trace.py --input <file>`) next to
+        # the Perfetto postmortem — the per-request view of what the
+        # drops + SIGKILL did to latency
+        wf_file = os.environ.get("RAY_TPU_CHAOS_WATERFALL_FILE")
+        if wf_file:
+            try:
+                from ray_tpu.util.state import (get_request_trace,
+                                                list_requests)
+                rows = list_requests(limit=200)
+                if rows:
+                    slow = max(rows,
+                               key=lambda r: r.get("dur_s") or 0.0)
+                    w = get_request_trace(slow["request_id"])
+                    if w is not None:
+                        with open(wf_file, "w") as f:
+                            json.dump(w, f, indent=1)
+            except Exception:
+                pass
         serve.shutdown()
         ray_tpu.shutdown()
         os.environ.pop(chaos.ENV_SEED, None)

@@ -72,6 +72,18 @@ def test_bench_serve_smoke_subprocess():
     assert su["new_replica_tokens"] > 0, su
     assert su["replicas_end"] == 2
     assert su["ttft_recovery"] is not None
+    # trace-overhead guard: both legs replay the same schedule clean,
+    # the span-record hot path holds its <=20µs budget, and the
+    # tokens/s ratio is recorded (within_2pct is the TPU-record gate;
+    # on a noisy shared CPU the ratio itself is informational)
+    to = d["trace_overhead"]
+    assert to["tracing_on"]["errors"] == [], to
+    assert to["tracing_off"]["errors"] == [], to
+    assert to["tracing_on"]["tokens_total"] == \
+        to["tracing_off"]["tokens_total"]
+    assert to["span_record_us"] <= to["span_budget_us"], to
+    assert to["overhead_pct"] is not None
+    assert isinstance(to["within_2pct"], bool)
     # the record feeds the gate, fleet rows included
     from tools.perf_gate import extract_serve_metrics, parse_bench_record
     m = extract_serve_metrics(parse_bench_record(rec))
@@ -82,6 +94,8 @@ def test_bench_serve_smoke_subprocess():
     assert m["serve/mixed_len_work_reduction"] == ml["work_reduction"]
     assert m["serve/scaleup_new_replica_share"] == \
         su["new_replica_share"]
+    # spans/µs inverse-cost row: >= 0.05 is exactly the <=20µs budget
+    assert m["serve/trace_span_record_inv"] >= 0.05
     assert "serve/paged_kernel_speedup" not in m   # CPU: no kernel wall
 
 

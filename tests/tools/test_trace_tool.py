@@ -1,0 +1,170 @@
+"""tools/trace.py tests: the terminal waterfall renderer, the request
+listing, and the Perfetto export — byte-compared against a committed
+golden file (regenerate with REGEN_TRACE_GOLDEN=1 after an intentional
+format change)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from tools.trace import (_fmt_dur, export_perfetto,  # noqa: E402
+                         render_rows, render_waterfall)
+
+pytestmark = [pytest.mark.serve_llm, pytest.mark.observability]
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "data", "request_perfetto_golden.json")
+
+
+def _waterfall():
+    """A fixed six-phase waterfall (the RequestTraceStore.waterfall
+    shape) with an SLO trip and a router+engine procs map — every
+    feature the renderer and the Perfetto export handle."""
+    rid = "req-00000000deadbeef"
+    mk = lambda ph, t0, t1, **a: dict(  # noqa: E731
+        {"request_id": rid, "phase": ph, "t0": t0, "t1": t1},
+        **({"attrs": a} if a else {}))
+    spans = [
+        mk("QUEUED", 100.0, 100.25),
+        mk("ADMITTED", 100.25, 100.25, slot=0, hit_blocks=2,
+           prefix_tokens=8, cow=False),
+        mk("PREFILL", 100.25, 100.3, pos=0, tokens=12),
+        mk("FIRST_TOKEN", 100.3, 100.3, ttft_s=0.3, engine_ttft_s=0.05,
+           queue_wait_s=0.25),
+        mk("DECODE", 100.3, 100.9, tokens=16),
+        mk("DONE", 100.9, 100.9, tokens=17, cancelled=False),
+    ]
+    return {
+        "request_id": rid, "status": "DONE", "ts": 101.0,
+        "dur_s": 0.9, "slo": {"queue": {"value": 0.25, "budget": 0.1}},
+        "meta": {"policy": "gauge", "admission": "admitted"},
+        "procs": {"engine": "worker-1", "router": "driver"},
+        "dropped": 0,
+        "phases": {"DECODE": {"count": 1, "dur_s": 0.6}},
+        "spans": spans,
+    }
+
+
+def test_perfetto_export_matches_golden(tmp_path):
+    out = str(tmp_path / "trace.json")
+    export_perfetto([_waterfall()], out)
+    with open(out) as f:
+        trace = json.load(f)
+    if os.environ.get("REGEN_TRACE_GOLDEN"):
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as f:
+            json.dump(trace, f, indent=1, sort_keys=True)
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert json.loads(json.dumps(trace)) == golden
+
+
+def test_perfetto_export_shape():
+    """Structural invariants independent of the golden bytes: one
+    b/e pair per span on the shared requests lane, each e at or after
+    its b, and a flow arrow into the engine's process track."""
+    from ray_tpu.core.events import build_chrome_trace
+    w = _waterfall()
+    trace = build_chrome_trace([], requests=[w])
+    evs = trace["traceEvents"]
+    bs = [e for e in evs if e.get("ph") == "b"]
+    es = [e for e in evs if e.get("ph") == "e"]
+    assert len(bs) == len(es) == len(w["spans"])
+    assert {e["id"] for e in bs} == {w["request_id"]}
+    assert all(e["cat"] == "request" for e in bs)
+    by_ts = sorted(e["ts"] for e in bs)
+    assert by_ts == [s["t0"] * 1e6 for s in w["spans"]]
+    for b, e in zip(bs, es):
+        assert e["ts"] >= b["ts"]
+    # flow s on the requests lane, f on the engine proc's track
+    flows = [e for e in evs if e.get("cat") == "flow"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    procs = trace["otherData"]["processes"]
+    f = next(e for e in flows if e["ph"] == "f")
+    assert procs[f["pid"]] == "worker-1"
+    s = next(e for e in flows if e["ph"] == "s")
+    assert procs[s["pid"]] == "requests"
+
+
+def test_perfetto_no_flow_without_engine_proc():
+    from ray_tpu.core.events import build_chrome_trace
+    w = _waterfall()
+    w["procs"] = {}
+    evs = build_chrome_trace([], requests=[w])["traceEvents"]
+    assert not [e for e in evs if e.get("cat") == "flow"]
+    assert [e for e in evs if e.get("ph") == "b"]
+
+
+def test_render_waterfall_text_gantt():
+    import io
+    buf = io.StringIO()
+    render_waterfall(_waterfall(), out=buf)
+    out = buf.getvalue()
+    for ph in ("QUEUED", "ADMITTED", "PREFILL", "FIRST_TOKEN",
+               "DECODE", "DONE"):
+        assert ph in out
+    assert "req-00000000deadbeef" in out and "status=DONE" in out
+    assert "SLO TRIP [queue]: 0.250s over budget 0.100s" in out
+    assert "policy=gauge" in out
+    assert "tokens=16" in out          # span attrs on the row
+    # offsets render against the request's own window (the QUEUED
+    # row's duration; the bar column pads between "+" and the value)
+    assert "250.0ms" in out
+
+
+def test_render_rows_listing():
+    import io
+    buf = io.StringIO()
+    render_rows([], out=buf)
+    assert "no traced requests captured" in buf.getvalue()
+    buf = io.StringIO()
+    w = _waterfall()
+    render_rows([{"request_id": w["request_id"], "status": "FAILED",
+                  "dur_s": 1.5, "n_spans": 6, "slo": w["slo"],
+                  "phases": w["phases"]}], out=buf)
+    out = buf.getvalue()
+    assert "req-00000000deadbeef" in out and "FAILED" in out
+    assert "queue" in out
+
+
+def test_fmt_dur_units():
+    assert _fmt_dur(2.5) == "2.500s"
+    assert _fmt_dur(0.0314) == "31.4ms"
+    assert _fmt_dur(0.000021) == "21us"
+
+
+def test_cli_input_and_perfetto_roundtrip(tmp_path):
+    """The chaos-postmortem path: a waterfall dump on disk renders and
+    exports without a cluster."""
+    dump = tmp_path / "slowest_waterfall.json"
+    out = tmp_path / "req.json"
+    dump.write_text(json.dumps(_waterfall()))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace.py"),
+         "--input", str(dump), "--perfetto", str(out)],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr
+    assert "DECODE" in proc.stdout and "SLO TRIP" in proc.stdout
+    with open(out) as f:
+        trace = json.load(f)
+    assert any(e.get("ph") == "b" for e in trace["traceEvents"])
+
+
+def test_cli_missing_trace_exits_nonzero(tmp_path):
+    bad = tmp_path / "notawaterfall.json"
+    bad.write_text(json.dumps({"rows": []}))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace.py"),
+         "--input", str(bad)],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode != 0
+    assert "not a request waterfall dump" in proc.stderr

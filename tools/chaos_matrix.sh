@@ -101,14 +101,19 @@ done
 # exactly one complete greedy stream (exactly-once token accounting;
 # pre-kill partials must be prefixes of the final stream), surviving
 # pools auditing clean (tests/serve/test_llm_engine.py::
-# test_serve_fleet_chaos_soak)
+# test_serve_fleet_chaos_soak). Every request ships its trace
+# (sample_n=1) and the soak dumps the slowest captured waterfall as a
+# sidecar next to the Perfetto postmortem.
 for seed in "${seeds[@]}"; do
     echo "=== serve-fleet soak: seed=$seed ==="
     if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        RAY_TPU_TRACE_SAMPLE_N=1 \
+        RAY_TPU_CHAOS_WATERFALL_FILE="$postmortem_dir/serve_waterfall_$seed.json" \
         JAX_PLATFORMS=cpu python -m pytest \
         "tests/serve/test_llm_engine.py::test_serve_fleet_chaos_soak" \
         -q -p no:cacheprovider -p no:randomly; then
         echo "=== serve seed=$seed PASSED ==="
+        rm -f "$postmortem_dir/serve_waterfall_$seed.json"
     else
         echo "=== serve seed=$seed FAILED ==="
         failed+=("serve:$seed")
@@ -261,6 +266,16 @@ if [ "${#failed[@]}" -gt 0 ]; then
             s="${seed#serve:}"
             echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
                  "tests/serve/test_llm_engine.py::test_serve_fleet_chaos_soak -q"
+            # slowest request waterfall captured before teardown — the
+            # per-request latency postmortem of the failing seed
+            wf="$postmortem_dir/serve_waterfall_$s.json"
+            if [ -f "$wf" ]; then
+                echo "  slowest waterfall: $wf" \
+                     "(python tools/trace.py --input $wf)"
+            else
+                echo "  slowest waterfall: none captured (died before" \
+                     "any trace shipped)"
+            fi
             continue
             ;;
         rlhf:*)

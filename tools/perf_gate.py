@@ -187,7 +187,9 @@ def extract_serve_metrics(rec: dict) -> dict:
     interpreter overhead, not kernel cost) and the autoscaling leg's
     new-replica traffic share (``detail.scale_up.new_replica_share`` —
     proof the gauge router reaches a mid-run replica). Earlier
-    baselines bootstrap-skip all three."""
+    baselines bootstrap-skip all three. Request-tracing records add
+    the span-record inverse cost (``detail.trace_overhead.
+    span_record_us`` as spans/µs, higher is better)."""
     out = {"serve_tokens_per_s_chip": float(rec["value"])}
     vs = rec.get("vs_serial")
     out["serve_vs_serial"] = float(vs) if vs is not None else None
@@ -220,6 +222,12 @@ def extract_serve_metrics(rec: dict) -> dict:
             su.get("new_replica_share") is not None:
         out["serve/scaleup_new_replica_share"] = \
             float(su["new_replica_share"])
+    # request-tracing era: the span-record hot-path cost, gated
+    # lower-is-better as its inverse (spans per µs) like the TTFT rows
+    to = detail.get("trace_overhead") or {}
+    if isinstance(to, dict) and to.get("span_record_us"):
+        out["serve/trace_span_record_inv"] = round(
+            1.0 / float(to["span_record_us"]), 4)
     return out
 
 

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""trace.py — render one serve request's waterfall in the terminal.
+
+The request-trace pipeline (``ray_tpu/serve/request_trace.py``) ships
+tail-sampled span batches to the controller; this tool fetches one
+request's merged waterfall and renders it as an aligned text gantt:
+one row per span, offset + duration against the request's own
+timeline, SLO trips called out, terminal status last. ``--perfetto``
+exports the same waterfall as Chrome-trace JSON (async ``b``/``e``
+track per request, flow arrows into the engine's stage slices when
+flight-recorder events are available alongside).
+
+Usage:
+
+  # one request, from the live cluster / dashboard:
+  python tools/trace.py req-1b2c3d4e5f607182
+  python tools/trace.py --dashboard http://127.0.0.1:8265 req-1b2c...
+
+  # no request id: list the recently captured tail (slow/failed/1-in-N)
+  python tools/trace.py
+  python tools/trace.py --dashboard http://127.0.0.1:8265
+
+  # from a waterfall dump (e.g. a chaos postmortem sidecar):
+  python tools/trace.py --input slowest_waterfall.json
+
+  # Perfetto export (open at https://ui.perfetto.dev):
+  python tools/trace.py req-1b2c... --perfetto /tmp/req.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BAR_WIDTH = 40
+
+
+# ------------------------------------------------------------- sources
+def _from_input(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "spans" in data:
+        return data
+    raise SystemExit(f"{path}: not a request waterfall dump "
+                     "(expected an object with a 'spans' list)")
+
+
+def _http_json(url: str) -> Any:
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _waterfall_from_dashboard(address: str,
+                              request_id: str) -> Optional[dict]:
+    out = _http_json(address.rstrip("/")
+                     + f"/api/v0/requests/{request_id}")
+    return None if (isinstance(out, dict) and out.get("error")) else out
+
+
+def _rows_from_dashboard(address: str) -> List[dict]:
+    return _http_json(address.rstrip("/") + "/api/v0/requests")["rows"]
+
+
+def _events_from_dashboard(address: str) -> List[dict]:
+    try:
+        return _http_json(address.rstrip("/") + "/api/v0/events")["rows"]
+    except Exception:
+        return []
+
+
+def _waterfall_from_cluster(request_id: str) -> Optional[dict]:
+    from ray_tpu.util.state import get_request_trace
+    return get_request_trace(request_id)
+
+
+def _rows_from_cluster() -> List[dict]:
+    from ray_tpu.util.state import list_requests
+    return list_requests()
+
+
+def _events_from_cluster() -> List[dict]:
+    try:
+        from ray_tpu.util.state import list_task_events
+        return list_task_events()
+    except Exception:
+        return []
+
+
+# ------------------------------------------------------------ rendering
+def _fmt_dur(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.3f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s * 1e6:.0f}us"
+
+
+def _attr_text(span: Dict[str, Any]) -> str:
+    attrs = span.get("attrs") or {}
+    parts = [f"{k}={v}" for k, v in sorted(attrs.items())
+             if v is not None]
+    return " ".join(parts)
+
+
+def render_waterfall(w: Dict[str, Any], out=sys.stdout) -> None:
+    """Aligned text gantt: span offsets/durations against the
+    request's own [t_first, t_last] window."""
+    spans = w.get("spans") or []
+    rid = w.get("request_id", "?")
+    status = w.get("status") or "OPEN"
+    dur = w.get("dur_s", 0.0)
+    print(f"request {rid}  status={status}  "
+          f"total={_fmt_dur(dur)}  spans={len(spans)}", file=out)
+    meta = w.get("meta") or {}
+    if meta:
+        print("  meta: " + " ".join(
+            f"{k}={v}" for k, v in sorted(meta.items())), file=out)
+    for phase, trip in sorted((w.get("slo") or {}).items()):
+        print(f"  SLO TRIP [{phase}]: {trip.get('value', 0):.3f}s "
+              f"over budget {trip.get('budget', 0):.3f}s", file=out)
+    if w.get("dropped"):
+        print(f"  ({w['dropped']} oldest spans dropped at the "
+              f"per-request cap)", file=out)
+    if not spans:
+        return
+    t_base = spans[0].get("t0", 0.0)
+    t_end = max(s.get("t1", 0.0) for s in spans)
+    window = max(t_end - t_base, 1e-9)
+    for s in spans:
+        off = s.get("t0", 0.0) - t_base
+        sdur = max(0.0, s.get("t1", 0.0) - s.get("t0", 0.0))
+        lo = int(BAR_WIDTH * off / window)
+        hi = int(BAR_WIDTH * (off + sdur) / window)
+        lo = min(lo, BAR_WIDTH - 1)
+        hi = max(hi, lo + 1)
+        bar = " " * lo + "#" * (hi - lo) + " " * (BAR_WIDTH - hi)
+        attrs = _attr_text(s)
+        print(f"  {s.get('phase', '?'):<12} {_fmt_dur(off):>9} "
+              f"+{_fmt_dur(sdur):>9} |{bar}| {attrs}", file=out)
+
+
+def render_rows(rows: List[dict], out=sys.stdout) -> None:
+    if not rows:
+        print("no traced requests captured yet (only slow / failed / "
+              "1-in-N requests ship spans)", file=out)
+        return
+    print(f"{'request_id':<24} {'status':<8} {'dur':>9} "
+          f"{'spans':>5}  slo  phases", file=out)
+    for r in rows:
+        slo = ",".join(sorted(r.get("slo") or {})) or "-"
+        phases = ",".join(sorted((r.get("phases") or {}).keys()))
+        print(f"{r.get('request_id', '?'):<24} "
+              f"{(r.get('status') or 'OPEN'):<8} "
+              f"{_fmt_dur(r.get('dur_s', 0.0)):>9} "
+              f"{r.get('n_spans', 0):>5}  {slo}  {phases}", file=out)
+
+
+def export_perfetto(waterfalls: List[dict], filename: str,
+                    events: Optional[List[dict]] = None) -> str:
+    """Chrome-trace JSON of the given waterfalls (async request lanes;
+    when ``events`` are supplied the flight-recorder tracks render too,
+    with flow arrows joining each waterfall to its engine's slices)."""
+    from ray_tpu.core.events import build_chrome_trace
+    trace = build_chrome_trace(events or [], requests=waterfalls)
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return filename
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a serve request's trace waterfall "
+        "(no request id: list the captured tail)")
+    ap.add_argument("request_id", nargs="?", default=None)
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--input", help="waterfall JSON dump (e.g. the "
+                     "chaos postmortem's slowest_waterfall.json)")
+    src.add_argument("--dashboard", help="dashboard address "
+                     "(http://host:port) to fetch /api/v0/requests "
+                     "from")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="also export Chrome-trace JSON (open at "
+                    "https://ui.perfetto.dev)")
+    ap.add_argument("--no-events", action="store_true",
+                    help="perfetto export: skip the flight-recorder "
+                    "event tracks (request lanes only)")
+    args = ap.parse_args(argv)
+
+    events: List[dict] = []
+    if args.input:
+        w = _from_input(args.input)
+        waterfalls = [w]
+    elif args.request_id:
+        if args.dashboard:
+            w = _waterfall_from_dashboard(args.dashboard,
+                                          args.request_id)
+        else:
+            w = _waterfall_from_cluster(args.request_id)
+        if w is None:
+            print(f"no trace for {args.request_id!r} — fast requests "
+                  "outside the tail sample ship no spans; slow, "
+                  "failed and 1-in-N requests are captured",
+                  file=sys.stderr)
+            return 1
+        waterfalls = [w]
+    else:
+        rows = _rows_from_dashboard(args.dashboard) if args.dashboard \
+            else _rows_from_cluster()
+        render_rows(rows)
+        return 0
+
+    render_waterfall(w)
+    if args.perfetto:
+        if not args.no_events and not args.input:
+            events = _events_from_dashboard(args.dashboard) \
+                if args.dashboard else _events_from_cluster()
+        out = os.path.abspath(args.perfetto)
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        export_perfetto(waterfalls, out, events=events)
+        print(f"wrote {out} ({len(events)} flight-recorder events "
+              "alongside; open at https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
