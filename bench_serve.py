@@ -42,6 +42,19 @@ only the work accounting is meaningful) — and a live mixed short+long
 engine run reporting ``decode_block_work_frac`` (pages touched / window
 pages) and the engine's per-step prefill/decode device-wall split.
 
+**Disaggregated prefill/decode** (``detail.disagg`` /
+``detail.migration`` / ``detail.disagg_parity``): 1 prefill + 1 decode
+replica with the KV-block hand-off shipping packed slabs between them
+vs 2 colocated replicas at equal chip count, on a seeded
+long-prefill/short-decode schedule — emits disagg tokens/s/chip, p99
+TTFT, decode-slot occupancy, and the measured hand-off cost (KV bytes
++ wall per shipped request). ``detail.migration`` is the drain A/B: a
+warmed victim's radix-trie chains migrate to one survivor and not the
+other, and the same single-pass replay must score a strictly higher
+prefix hit rate on the migrated survivor. ``detail.disagg_parity``
+asserts greedy decode is bit-identical disagg on vs off on the exact
+``bf16`` wire.
+
 **Autoscaling under load** (``detail.scale_up``, ``--scale-up-mid-load``):
 a deliberately backlogged single replica must scale up MID-RUN off its
 engine gauges; the leg asserts routed traffic reaches the new replica
@@ -58,6 +71,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import threading
 import time
@@ -432,6 +446,237 @@ def bench_paged_kernel(on_tpu: bool, seed: int = 0) -> Dict:
     return out
 
 
+# ------------------------------------------------- disaggregated legs
+def _disagg_fleet_run(name: str, model: Dict, engine: Dict,
+                      workload: List[dict], clients: int,
+                      decode_slots: int,
+                      timeout_s: float = 600.0) -> Dict:
+    """One disaggregated measurement: 1 prefill + 1 decode replica
+    (2 procs), KV shipped between them, the decode replica running
+    ``decode_slots`` slots since it never interleaves prefill chunks.
+    Returns the load plus the hand-off accounting from both fleets."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import api as serve_api
+    from ray_tpu.serve.disagg import deploy_disaggregated
+
+    router = deploy_disaggregated(
+        model, engine, name=name, num_prefill=1, num_decode=1,
+        decode_slots=decode_slots,
+        max_ongoing_requests=4 * clients + 8)
+    # one throwaway request compiles both fleets' programs (and the
+    # hand-off path) outside the measured window
+    list(router.options(stream=True).generate.remote(
+        workload[0]["prompt"][:4], 2))
+    load = run_load(lambda: router, workload, clients,
+                    timeout_s=timeout_s)
+    ctrl = serve_api._controller_or_none()
+    pf = ray_tpu.get(ctrl.get_replicas.remote(f"{name}-prefill"))
+    dc = ray_tpu.get(ctrl.get_replicas.remote(f"{name}-decode"))
+    pstats = [(ray_tpu.get(r.stats.remote(), timeout=60) or {}
+               ).get("engine") or {} for r in pf]
+    dstats = [(ray_tpu.get(r.stats.remote(), timeout=60) or {}
+               ).get("engine") or {} for r in dc]
+    audits = [ray_tpu.get(r.handle_request.remote("pool_audit"),
+                          timeout=60) for r in pf + dc]
+    serve.delete(f"{name}-prefill")
+    serve.delete(f"{name}-decode")
+    adopts = sum(e.get("kv_adopts") or 0 for e in dstats)
+    ship_bytes = sum(e.get("kv_adopt_bytes") or 0 for e in dstats)
+    ship_wall = sum(e.get("kv_ship_wall_s") or 0.0 for e in dstats)
+    occ = {}
+    for e in dstats:
+        for k, v in (e.get("occupancy_hist") or {}).items():
+            occ[int(k)] = occ.get(int(k), 0) + v
+    steps = sum(occ.values())
+    mean_occ = (sum(k * v for k, v in occ.items()) / steps
+                if steps else 0.0)
+    return {
+        "replicas": 2,
+        "decode_slots": decode_slots,
+        "tokens_per_s": load["tokens_per_s"],
+        "tokens_per_s_chip": round(load["tokens_per_s"] / 2, 2),
+        "ttft_ms": load["ttft_ms"],
+        "inter_token_ms": load["inter_token_ms"],
+        "wall_s": load["wall_s"],
+        "tokens_total": load["tokens_total"],
+        "requests_done": load["requests_done"],
+        "errors": load["errors"],
+        "router": dict(router.stats),
+        "kv_adopts": adopts,
+        "kv_ship_bytes_total": ship_bytes,
+        "kv_ship_wall_s": round(ship_wall, 4),
+        "kv_ship_bytes_per_request": (round(ship_bytes / adopts)
+                                      if adopts else None),
+        "kv_ship_ms_per_request": (round(1e3 * ship_wall / adopts, 3)
+                                   if adopts else None),
+        "kv_exports": sum(e.get("kv_exports") or 0 for e in pstats),
+        "decode_slot_occupancy": round(mean_occ / decode_slots, 4)
+        if decode_slots else None,
+        "pool_audits_clean": all(a == [] for a in audits),
+    }
+
+
+def bench_disagg(model: Dict, engine: Dict, seed: int, clients: int,
+                 requests: int, mean_interarrival_s: float,
+                 prompt_rng, out_rng, timeout_s: float = 600.0) -> Dict:
+    """The disaggregation comparison at equal chip count: 1 prefill +
+    1 decode replica (decode running 2x the slots — it never
+    interleaves prefill) vs 2 colocated replicas, same seeded Poisson
+    schedule of long-prefill/short-decode requests. Long prompts make
+    colocated replicas stall decode behind chunk trains; the decode
+    fleet never does, which is the tokens/s/chip claim. Also reports
+    the hand-off's measured cost: KV bytes + wall per shipped
+    request."""
+    workload = make_workload(requests, clients, seed,
+                             mean_interarrival_s=mean_interarrival_s,
+                             prompt_rng=prompt_rng, out_rng=out_rng)
+    coloc = _fleet_leg("llm_disagg_base", model, engine, workload,
+                       clients, replicas=2, policy="gauge",
+                       timeout_s=timeout_s)
+    disagg = _disagg_fleet_run(
+        "llm_disagg", model, engine, workload, clients,
+        decode_slots=2 * engine["decode_slots"], timeout_s=timeout_s)
+    disagg["clients"] = clients
+    disagg["requests"] = requests
+    disagg["kv_wire"] = engine.get("kv_wire", "bf16")
+    disagg["colocated"] = coloc
+    disagg["vs_colocated"] = (
+        round(disagg["tokens_per_s_chip"] / coloc["tokens_per_s_chip"],
+              3) if coloc["tokens_per_s_chip"] else None)
+    return disagg
+
+
+def bench_disagg_parity(model: Dict, engine: Dict, seed: int) -> Dict:
+    """Greedy bit-parity, disagg on vs off: the same prompt decoded
+    colocated and via prefill_export -> ship -> submit_adopt on a
+    SECOND engine (same seed => identical params) must produce
+    bit-identical token streams on the exact "bf16" wire; the int8
+    wire must stay within quantization tolerance (identical tokens are
+    typical but not guaranteed, so only exactness of the default wire
+    gates)."""
+    from ray_tpu.models import TransformerConfig
+    from ray_tpu.serve.llm_engine import (EngineConfig, LLMEngine,
+                                          _resolve_dtype)
+
+    mconf = dict(model)
+    if "dtype" in mconf:
+        mconf["dtype"] = _resolve_dtype(mconf["dtype"])
+    rng = random.Random(seed + 7)
+    prompt = [rng.randrange(2, 128)
+              for _ in range(3 * engine["kv_block_size"] + 3)]
+    out: Dict[str, Dict] = {}
+    for wire in ("bf16", "int8"):
+        a = LLMEngine(TransformerConfig(**mconf),
+                      EngineConfig(**dict(engine, kv_wire=wire)),
+                      seed=seed)
+        b = LLMEngine(TransformerConfig(**mconf),
+                      EngineConfig(**dict(engine, kv_wire=wire)),
+                      seed=seed)
+        try:
+            ref = list(a.generate_sync(prompt, 16))
+            payload = a.prefill_export(prompt)
+            req = b.submit_adopt(payload, max_new_tokens=16)
+            got = _drain_request(b, req)
+            out[wire] = {
+                "bit_identical": ref == got,
+                "tokens": len(got),
+                "wire_bytes": payload["wire_bytes"],
+            }
+        finally:
+            a.shutdown()
+            b.shutdown()
+    out["ok"] = bool(out["bf16"]["bit_identical"])
+    return out
+
+
+def _drain_request(engine, req) -> List[int]:
+    from ray_tpu.serve.llm_engine import _DONE
+    toks: List[int] = []
+    try:
+        while True:
+            item = req.out.get(timeout=60)
+            if item is _DONE:
+                return toks
+            if isinstance(item, BaseException):
+                raise item
+            toks.append(item)
+    finally:
+        engine.cancel(req)
+
+
+def bench_migration(model: Dict, engine: Dict, seed: int,
+                    sessions: int = 4, turns: int = 3) -> Dict:
+    """Warm-prefix migration across a drain, A/B: a victim engine is
+    warmed with ``sessions`` distinct shared prefixes (``turns``
+    requests each, so the trie chains carry hits), then its warm
+    chains are exported and imported into survivor A; survivor B
+    starts cold (the no-migration drain). The SAME single-pass replay
+    (one request per session) runs on each: A's prefix hit rate must
+    strictly beat B's, which only scores within-replay repeats (none
+    here)."""
+    from ray_tpu.models import TransformerConfig
+    from ray_tpu.serve.llm_engine import (EngineConfig, LLMEngine,
+                                          _resolve_dtype)
+
+    mconf = dict(model)
+    if "dtype" in mconf:
+        mconf["dtype"] = _resolve_dtype(mconf["dtype"])
+    bs = engine["kv_block_size"]
+    rng = random.Random(seed + 13)
+    prefixes = [[rng.randrange(2, 128) for _ in range(3 * bs)]
+                for _ in range(sessions)]
+
+    def make(tag):
+        return LLMEngine(TransformerConfig(**mconf),
+                         EngineConfig(**engine), seed=seed,
+                         replica_tag=tag)
+
+    victim = make("victim")
+    surv_a = make("survivor_migrated")
+    surv_b = make("survivor_cold")
+    try:
+        for p in prefixes:
+            for t in range(turns):
+                list(victim.generate_sync(p + [40 + t], 4))
+        payload = victim.export_warm_prefixes(min_hits=1)
+        migrated = surv_a.import_warm_prefixes(payload) \
+            if payload is not None else 0
+
+        def replay(eng):
+            for i, p in enumerate(prefixes):
+                list(eng.generate_sync(p + [99, i], 4))
+            s = eng.stats()
+            return {
+                "prefix_hit_blocks": s["prefix_hit_blocks_total"],
+                "prompt_blocks": s["prompt_blocks_total"],
+                "prefix_hit_rate": s["prefix_hit_rate"] or 0.0,
+            }
+
+        with_mig = replay(surv_a)
+        without = replay(surv_b)
+        audits = [victim.pool_audit(), surv_a.pool_audit(),
+                  surv_b.pool_audit()]
+    finally:
+        victim.shutdown()
+        surv_a.shutdown()
+        surv_b.shutdown()
+    return {
+        "sessions": sessions,
+        "turns": turns,
+        "migrated_blocks": migrated,
+        "payload_bytes": (payload or {}).get("wire_bytes"),
+        "with_migration": with_mig,
+        "without_migration": without,
+        "hit_retention": round(
+            with_mig["prefix_hit_rate"]
+            - without["prefix_hit_rate"], 4),
+        "migration_wins": with_mig["prefix_hit_rate"]
+        > without["prefix_hit_rate"],
+        "pool_audits_clean": all(a == [] for a in audits),
+    }
+
+
 def _scale_up_run(name: str, model: Dict, engine: Dict,
                   workload: List[dict], clients: int,
                   autoscale: bool, timeout_s: float):
@@ -647,6 +892,10 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
         trace_kw = dict(requests=8, clients=4)
         scale_kw = dict(clients=8, requests=40,
                         mean_interarrival_s=0.06, timeout_s=150.0)
+        disagg_kw = dict(clients=4, requests=8,
+                         mean_interarrival_s=0.02,
+                         prompt_rng=(16, 40), out_rng=(4, 8),
+                         timeout_s=120.0)
     elif on_tpu:
         model = {"vocab_size": 32000, "d_model": 2048, "n_layers": 8,
                  "n_heads": 16, "head_dim": 128, "d_ff": 8192,
@@ -667,6 +916,9 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
         trace_kw = dict(requests=48, clients=16)
         scale_kw = dict(clients=64, requests=128,
                         mean_interarrival_s=0.005)
+        disagg_kw = dict(clients=64, requests=128,
+                         mean_interarrival_s=0.01,
+                         prompt_rng=(256, 768), out_rng=(16, 64))
     else:
         # CPU sizing: wide enough that a decode step is weight-stream /
         # gemv bound, so step cost is nearly batch-independent — the
@@ -693,12 +945,22 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
         trace_kw = dict(requests=16, clients=4)
         scale_kw = dict(clients=12, requests=100,
                         mean_interarrival_s=0.06)
+        # long-prefill/short-decode shape: prompts span 2-3 prefill
+        # chunks while outputs stay short of the prompt, so colocated
+        # replicas interleave chunk trains with half-batch decode — the
+        # regime disaggregation targets (the decode fleet runs 2x slots
+        # at weight-stream-bound step cost, halving decode steps)
+        disagg_kw = dict(clients=8, requests=32,
+                         mean_interarrival_s=0.02,
+                         prompt_rng=(48, 96), out_rng=(16, 32))
 
     # clusterless legs first: the paged-kernel op comparison and the
     # mixed-length engine run need a device, not the cluster
     paged = bench_paged_kernel(on_tpu, seed=seed)
     mixed = bench_mixed_lengths(model, engine, seed=seed, **mixed_kw)
     trace = bench_trace_overhead(model, engine, seed=seed, **trace_kw)
+    parity = bench_disagg_parity(model, engine, seed=seed)
+    migration = bench_migration(model, engine, seed=seed)
 
     ray_tpu.init(num_cpus=max(8, clients + 4,
                               fleet_kw["clients"] // 2 + 6),
@@ -729,6 +991,11 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
         t_fleet = time.monotonic()
         fleet = bench_fleet(model, engine, seed=seed, **fleet_kw)
         fleet["leg_wall_s"] = round(time.monotonic() - t_fleet, 2)
+        # disaggregated prefill/decode vs colocated at equal chip count,
+        # same seeded long-prefill/short-decode schedule
+        t_disagg = time.monotonic()
+        disagg = bench_disagg(model, engine, seed=seed, **disagg_kw)
+        disagg["leg_wall_s"] = round(time.monotonic() - t_disagg, 2)
         # autoscaling fleet under load: a backlogged single replica
         # must scale up MID-RUN and TTFT must recover (--scale-up-mid-
         # load; a deliberately small engine so the backlog forms fast)
@@ -755,6 +1022,10 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
         "detail": {
             "backend": backend,
             "n_chips": n_chips,
+            # record the host's core count: CPU-backend ratios (e.g.
+            # vs_serial) compress when every replica time-slices one
+            # core, and the baseline locks read this to judge them
+            "host_cpus": os.cpu_count(),
             "clients": clients,
             "requests": requests,
             "seed": seed,
@@ -769,6 +1040,9 @@ def bench(smoke: bool = False, clients: int = 8, requests: int = 24,
                                   "total_blocks")}
                              for m, s in stats.items()},
             "fleet": fleet,
+            "disagg": disagg,
+            "disagg_parity": parity,
+            "migration": migration,
             "paged_kernel": paged,
             "mixed_len": mixed,
             "trace_overhead": trace,

@@ -91,6 +91,15 @@ Event taxonomy (the ``ev`` field):
                    ``tokens``/``policy_versions`` — which policies
                    generated this round's trajectories, the staleness
                    record PPO importance weights are computed against)
+``KV_SHIP``        disagg prefill replica shipped a request's finished
+                   KV blocks toward a decode replica (``blocks``/
+                   ``bytes``/``wire`` — the hand-off's wire cost)
+``KV_ADOPT``       decode replica adopted shipped KV blocks into its
+                   pool + radix trie (``blocks``/``reused``/``dur_s``
+                   — scatter + trie-insert wall before the first tick)
+``PREFIX_MIGRATE`` warm radix-trie blocks moved off a draining replica
+                   onto a survivor (``blocks``/``chains``/``dir``
+                   export|import — the downscale warm-cache rescue)
 =================  =====================================================
 """
 
@@ -128,6 +137,9 @@ ARBITER_RETURN = "ARBITER_RETURN"
 ARBITER_REJECT = "ARBITER_REJECT"
 RLHF_SYNC = "RLHF_SYNC"
 RLHF_ROLLOUT = "RLHF_ROLLOUT"
+KV_SHIP = "KV_SHIP"
+KV_ADOPT = "KV_ADOPT"
+PREFIX_MIGRATE = "PREFIX_MIGRATE"
 
 #: lifecycle events a task timeline is built from (exporter slice pairs)
 LIFECYCLE = (SUBMITTED, LEASED, DISPATCHED, RUNNING, YIELDED,

@@ -133,6 +133,23 @@ class RuntimeMetrics:
             "Accepted/drafted ratio per speculative verify step "
             "(prompt-lookup multi-token decode)",
             boundaries=[0.0, 0.25, 0.5, 0.75, 1.0])
+        # -- disaggregated prefill/decode hand-off (serve/disagg.py)
+        self.serve_kv_ship_bytes = Counter(
+            "serve_kv_ship_bytes_total",
+            "Wire bytes of finished prefill KV blocks shipped toward "
+            "decode replicas (bf16 raw or int8 blockwise payloads)",
+            tag_keys=("wire",))
+        self.serve_kv_ship_seconds = Histogram(
+            "serve_kv_ship_seconds",
+            "Ship-to-adopt wall per disagg hand-off (prefill export "
+            "complete to decode-side blocks adopted)",
+            boundaries=[0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                        0.5, 1, 2.5])
+        self.serve_prefix_migrated = Counter(
+            "serve_prefix_migrated_blocks_total",
+            "Warm radix-trie KV blocks exported off draining replicas "
+            "and adopted by survivors (warm-prefix migration)",
+            tag_keys=("dir",))
         # -- flight recorder (core/events.py)
         self.events_dropped = Counter(
             "runtime_events_dropped_total",

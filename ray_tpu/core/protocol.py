@@ -163,6 +163,20 @@ def loads(data: bytes) -> Any:
     return pickle.loads(data)
 
 
+def wire_sizeof(obj: Any) -> int:
+    """Wire footprint of ``obj`` as the runtime would actually ship it:
+    pickle-5 meta plus the out-of-band buffers the zero-copy serializer
+    strips (``core/serialization.py``). Large numpy/jax payloads are
+    counted at their raw buffer size instead of being copied through a
+    flat pickle — this is the accounting the disagg KV hand-off reports
+    as ``serve_kv_ship_bytes_total``."""
+    try:
+        from ray_tpu.core.serialization import default_context
+        return int(default_context().serialize(obj).total_bytes())
+    except Exception:
+        return len(dumps(obj))
+
+
 class ReplyWaiter:
     """Correlates request/reply over the async socket pump.
 

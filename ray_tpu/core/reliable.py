@@ -187,6 +187,10 @@ class ReliableTransport:
                 "target": target, "mtype": mtype, "payload": payload,
                 "attempts": 0, "due": now + self._delay(0), "born": now}
             self.stats["sent"] += 1
+            # per-type sent accounting: lets tests and postmortems
+            # assert which paths rode reliable delivery (e.g. the disagg
+            # KV hand-off's ACL calls) without scraping the ring
+            self.stats["sent:" + mtype.decode("ascii", "replace")] += 1
             self._cond.notify()
         return payload
 

@@ -87,6 +87,11 @@ class TransformerConfig:
     # chip-aware query-row block (ops.paged_flash.default_paged_block_r).
     paged_impl: str = "auto"
     paged_block_r: int = 0
+    # Chunked prefill runs the same paged kernel at chunk*(heads/kv)
+    # query rows — far more than decode's heads/kv — so a larger row
+    # block can win there. 0 = use paged_block_r; the engine autotunes
+    # this at long windows (allowing > 128) and records the winner.
+    paged_block_r_prefill: int = 0
     # MoE (0 = dense): every layer's MLP becomes n_experts experts with
     # Switch top-1 routing, weights sharded on the ep mesh axis
     n_experts: int = 0
@@ -646,9 +651,15 @@ def _paged_attn_sublayer(c, h, lp, sin, cos, layout, kc, vc,
     kc = kc.at[bid, slot].set(k.astype(kc.dtype), mode="drop")
     vc = vc.at[bid, slot].set(v.astype(vc.dtype), mode="drop")
 
+    # h.shape[1] is static under jit: > 1 means a prefill chunk, whose
+    # much larger query-row count can carry a bigger row block than the
+    # single-token decode step compiled from this same sublayer
+    br = c.paged_block_r_prefill \
+        if (h.shape[1] > 1 and c.paged_block_r_prefill) \
+        else c.paged_block_r
     att = paged_attention(q, kc, vc, block_tables, positions,
                           lens=lens, impl=c.paged_impl,
-                          block_r=c.paged_block_r or None)
+                          block_r=br or None)
     out = jnp.einsum("bshd,hde->bse", att,
                      lp["wo"].reshape(c.n_heads, c.head_dim, e).astype(dt))
     return out, kc, vc

@@ -247,7 +247,7 @@ def default_paged_block_r(rows: int, head_dim: int,
 # Winner cache: (chip, block_size, table_len, rows, head_dim) -> block_r.
 _PAGED_AUTOTUNE_CACHE: dict = {}
 
-_PAGED_CANDIDATES = (8, 16, 32, 64, 128, 256)
+_PAGED_CANDIDATES = (8, 16, 32, 64, 128, 256, 512)
 
 
 def _paged_disk_key(key: tuple) -> str:

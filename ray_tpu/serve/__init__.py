@@ -23,6 +23,10 @@ from ray_tpu.serve.http import Request, Response, ingress
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import (
     Application, AutoscalingConfig, Deployment, deployment)
+from ray_tpu.serve.disagg import (
+    DisaggHandoffError, DisaggRouter, deploy_disaggregated,
+    kv_ship_bytes, migrate_warm_prefixes, pack_kv_blocks,
+    unpack_kv_blocks)
 from ray_tpu.serve.handle import (
     DeploymentHandle, DeploymentResponse, DeploymentResponseGenerator)
 from ray_tpu.serve._private.replica import get_multiplexed_model_id
@@ -39,6 +43,13 @@ __all__ = [
     "PRIORITY_CLASSES",
     "Deployment",
     "DeploymentHandle",
+    "DisaggHandoffError",
+    "DisaggRouter",
+    "deploy_disaggregated",
+    "kv_ship_bytes",
+    "migrate_warm_prefixes",
+    "pack_kv_blocks",
+    "unpack_kv_blocks",
     "DeploymentResponse",
     "DeploymentResponseGenerator",
     "EngineConfig",

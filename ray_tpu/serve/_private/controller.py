@@ -72,6 +72,10 @@ class ServeController:
         self._deployments: Dict[str, _DeploymentInfo] = {}
         self._routes: Dict[str, str] = {}  # route_prefix -> deployment
         self._apps: Dict[str, str] = {}    # app name -> ingress deploy
+        # route_prefix -> {"prefill": name, "decode": name}: HTTP
+        # ingress for disaggregated pairs (serve/disagg.py) — the proxy
+        # drives a DisaggRouter over both fleets instead of a handle
+        self._disagg_routes: Dict[str, Dict[str, str]] = {}
         self._lock = threading.RLock()
         # admission config plane: routers poll (seq, policy dict);
         # the dashboard POST endpoint bumps seq on every accepted write
@@ -104,6 +108,21 @@ class ServeController:
                 self._apps[app_name] = name
             self._reconcile_one(name, info)
 
+    def scale_deployment(self, name: str, num_replicas: int) -> int:
+        """Imperative scale: pin the deployment's target replica count
+        and reconcile now. A downscale runs the same drain path as
+        autoscaling — for ``migrate_prefixes`` fleets the victim's warm
+        radix-trie chains are exported to a survivor before the kill."""
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                raise KeyError(f"no deployment named {name!r}")
+            info.target_num = max(0, int(num_replicas))
+            # pin against the autoscaler immediately re-deciding
+            info._last_scale_up = info._last_scale_down = time.time()
+            self._reconcile_one(name, info)
+            return len(info.replicas)
+
     def delete_deployment(self, name: str) -> None:
         with self._lock:
             info = self._deployments.pop(name, None)
@@ -111,6 +130,9 @@ class ServeController:
                 self._scale_to(name, info, 0)
             self._routes = {r: d for r, d in self._routes.items()
                             if d != name}
+            self._disagg_routes = {
+                r: pair for r, pair in self._disagg_routes.items()
+                if name not in pair.values()}
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -119,6 +141,7 @@ class ServeController:
                 self._scale_to(name, info, 0)
             self._deployments.clear()
             self._routes.clear()
+            self._disagg_routes.clear()
 
     # -- handle/proxy API ---------------------------------------------
     def get_version(self, name: str) -> int:
@@ -163,7 +186,23 @@ class ServeController:
                             or inspect.isasyncgenfunction(target)))
                 out[prefix] = {"name": name, "asgi": asgi,
                                "streaming": streaming}
+            for prefix, pair in self._disagg_routes.items():
+                out[prefix] = {"name": pair["decode"], "asgi": False,
+                               "streaming": True, "disagg": dict(pair)}
             return out
+
+    def register_disagg_route(self, route_prefix: str, prefill: str,
+                              decode: str) -> None:
+        """Route HTTP traffic at ``route_prefix`` through the
+        disaggregated (prefill, decode) deployment pair."""
+        with self._lock:
+            if prefill not in self._deployments \
+                    or decode not in self._deployments:
+                raise ValueError(
+                    f"disagg route {route_prefix!r} references unknown "
+                    f"deployments {prefill!r}/{decode!r}")
+            self._disagg_routes[route_prefix] = {
+                "prefill": prefill, "decode": decode}
 
     # -- admission config plane ---------------------------------------
     def set_admission_policy(self, policy: Dict[str, Any]) -> int:
@@ -238,6 +277,20 @@ class ServeController:
     def _scale_to(self, name: str, info: _DeploymentInfo, n: int) -> None:
         while len(info.replicas) > n:
             replica = info.replicas.pop()
+            if info.replicas and getattr(
+                    info.deployment, "migrate_prefixes", False):
+                # warm-prefix migration: drain the victim's warm
+                # radix-trie KV chains into a survivor before the kill,
+                # worker-to-worker (the export ref rides straight into
+                # the import call). Strictly best-effort and bounded —
+                # a wedged victim must never stall the downscale.
+                try:
+                    ref = replica.prepare_drain.remote(1, 0)
+                    survivor = info.replicas[-1]
+                    ray_tpu.get(survivor.handle_request.remote(
+                        "import_warm_prefixes", ref), timeout=5)
+                except Exception:
+                    pass
             try:
                 ray_tpu.kill(replica)
             except Exception:

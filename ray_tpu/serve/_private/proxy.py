@@ -50,6 +50,8 @@ class HTTPProxy:
         self._controller = controller
         self._handles: Dict[str, Any] = {}
         self._stream_handles: Dict[str, Any] = {}
+        # (prefill, decode) -> DisaggRouter, for disagg-flagged routes
+        self._disagg_routers: Dict[Tuple[str, str], Any] = {}
         self._routes: Dict[str, dict] = {}
         self._routes_at = 0.0
         self._routes_lock = threading.Lock()
@@ -244,7 +246,9 @@ class HTTPProxy:
         if route is None:
             await self._write_simple(writer, 404, {"error": "no route"})
             return
-        if route["asgi"]:
+        if route.get("disagg"):
+            await self._dispatch_disagg(route, req, writer, loop)
+        elif route["asgi"]:
             await self._dispatch_asgi(route, req, writer, loop)
         elif route["streaming"]:
             await self._dispatch_stream(route, req, writer, loop)
@@ -323,6 +327,58 @@ class HTTPProxy:
             # the stream. Cancel so the replica's live stream (and its
             # ongoing-count used for load balancing) is not leaked.
             gen.cancel()
+
+    async def _dispatch_disagg(self, req_route, req, writer, loop):
+        """Disaggregated route: drive the (prefill, decode) pair
+        through a cached :class:`~ray_tpu.serve.disagg.DisaggRouter`
+        instead of a single-deployment handle. Body: a prompt-id list,
+        or ``{"prompt": [...], "max_new_tokens": n}``; tokens stream
+        back exactly like a colocated streaming route."""
+        rid = self._request_id_for(req)
+        pair = req_route["disagg"]
+        key = (pair["prefill"], pair["decode"])
+        router = self._disagg_routers.get(key)
+        if router is None:
+            from ray_tpu.serve.disagg import DisaggRouter
+            router = DisaggRouter(pair["prefill"], pair["decode"],
+                                  self._controller)
+            self._disagg_routers[key] = router
+        sid = req.header("x-session-id") or None
+        payload = self._payload(req)
+        if isinstance(payload, dict):
+            prompt = payload.get("prompt") or []
+            mnt = payload.get("max_new_tokens")
+        else:
+            prompt, mnt = payload or [], None
+
+        def start():
+            it = router.options(
+                stream=True, session_id=sid,
+                request_id=rid).generate.remote(prompt, mnt)
+            return it, iter(it)
+
+        try:
+            gen, it = await loop.run_in_executor(self._pool, start)
+            first = await loop.run_in_executor(
+                self._pool, next, it, _END)
+        except Exception as e:  # noqa: BLE001
+            await self._write_error(writer, e, request_id=rid)
+            return
+        await self._write_head(
+            writer, 200,
+            [("Content-Type", "text/plain; charset=utf-8"),
+             ("X-Request-Id", rid),
+             ("X-Accel-Buffering", "no")])
+        try:
+            chunk = first
+            while chunk is not _END:
+                writer.write(_as_bytes(chunk))
+                await writer.drain()
+                chunk = await loop.run_in_executor(
+                    self._pool, next, it, _END)
+        except BaseException:  # noqa: BLE001
+            # headers are out: closing mid-body is the error signal
+            gen.close()
 
     async def _dispatch_asgi(self, req_route, req, writer, loop):
         handle = self._handle_for(req_route["name"], stream=True)

@@ -127,6 +127,20 @@ class Replica:
         finally:
             self._num_ongoing -= 1
 
+    async def prepare_drain(self, min_hits: int = 1,
+                            max_blocks: int = 0):
+        """Downscale hook: before the controller kills this replica,
+        ask an engine-aware deployment for its warm-prefix export so a
+        survivor can adopt it (warm-prefix migration). Deployments
+        without ``export_warm_prefixes`` drain with nothing to say."""
+        fn = getattr(self._instance, "export_warm_prefixes", None)
+        if fn is None:
+            return None
+        out = fn(min_hits=min_hits, max_blocks=max_blocks)
+        if inspect.iscoroutine(out):
+            out = await out
+        return out
+
     def num_ongoing_requests(self) -> int:
         return self._num_ongoing
 

@@ -48,7 +48,8 @@ class Deployment:
                  max_ongoing_requests: int = 100,
                  user_config: Optional[Any] = None,
                  health_check_period_s: float = 10.0,
-                 version: Optional[str] = None):
+                 version: Optional[str] = None,
+                 migrate_prefixes: bool = False):
         self.func_or_class = func_or_class
         self.name = name
         if isinstance(autoscaling_config, dict):
@@ -65,6 +66,12 @@ class Deployment:
         self.user_config = user_config
         self.health_check_period_s = health_check_period_s
         self.version = version
+        #: drain-time warm-prefix migration: before the controller
+        #: kills a replica on downscale, its warm radix-trie KV chains
+        #: are exported (``Replica.prepare_drain``) and adopted by a
+        #: surviving replica, so the fleet's prefix hit rate survives
+        #: the drain (serve/disagg.py::migrate_warm_prefixes)
+        self.migrate_prefixes = migrate_prefixes
 
     def options(self, **kwargs) -> "Deployment":
         merged = dict(
@@ -75,7 +82,8 @@ class Deployment:
             max_ongoing_requests=self.max_ongoing_requests,
             user_config=self.user_config,
             health_check_period_s=self.health_check_period_s,
-            version=self.version)
+            version=self.version,
+            migrate_prefixes=self.migrate_prefixes)
         merged.update(kwargs)
         return Deployment(**merged)
 
@@ -109,7 +117,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                max_ongoing_requests: int = 100,
                user_config: Optional[Any] = None,
                health_check_period_s: float = 10.0,
-               version: Optional[str] = None):
+               version: Optional[str] = None,
+               migrate_prefixes: bool = False):
     """``@serve.deployment`` (reference ``api.py``)."""
     def wrap(fc):
         return Deployment(
@@ -119,7 +128,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             max_ongoing_requests=max_ongoing_requests,
             user_config=user_config,
             health_check_period_s=health_check_period_s,
-            version=version)
+            version=version,
+            migrate_prefixes=migrate_prefixes)
     if _func_or_class is not None:
         return wrap(_func_or_class)
     return wrap

@@ -367,6 +367,14 @@ class _Router:
             k = self._pids.get(row.get("pid"))
             if k is None:
                 continue
+            # a fleet row is only as fresh as its origin's last metric
+            # report: stamping it "now" would let a long-dead replica's
+            # numbers route traffic forever. Rows older than the
+            # staleness bound are skipped (pow2 fallback); adopted rows
+            # carry their ring timestamp so they age out naturally.
+            age = float(row.get("last_report_s") or 0.0)
+            if age > self.gauge_stale_s:
+                continue
             g = self.gauges.setdefault(k, {})
             if now - g.get("t", 0.0) <= self.gauge_stale_s:
                 continue   # direct probe is fresher
@@ -374,7 +382,7 @@ class _Router:
                 g["queue_depth"] = row["queue_depth"]
             if row.get("ttft_p50_ms") is not None:
                 g["ttft_ewma_s"] = row["ttft_p50_ms"] / 1e3
-            g["t"] = now
+            g["t"] = now - age
 
     @staticmethod
     def _has_signal(g: Dict[str, Any]) -> bool:

@@ -119,6 +119,16 @@ class EngineConfig:
     #: Tokens per DECODE trace span — bounds span count for long
     #: generations (a 4k-token decode is ~256 spans at 16, not 4k).
     trace_decode_tick: int = 16
+    #: Wire format for disaggregated KV hand-offs (serve/disagg.py):
+    #: "bf16" ships blocks raw in the cache's native dtype (bit-exact
+    #: adoption — an f32 cache ships f32); "int8" ships blockwise-
+    #: quantized values + f32 scales (~4x smaller, quant tolerance).
+    kv_wire: str = "bf16"
+    #: Part label this engine's trace span batches ship under. The
+    #: controller store dedups by (part, seq) per request — a disagg
+    #: pair (prefill engine + decode engine) sharing one request_id
+    #: MUST ship under distinct parts or one side's spans vanish.
+    trace_part: str = "engine"
 
     @property
     def blocks_per_seq(self) -> int:
@@ -151,7 +161,8 @@ class _Request:
                  "t_first_token", "history", "hit_blocks", "trie_node",
                  "trie_cursor", "spec_ewma", "spec_disabled", "warmup",
                  "detailed", "trace", "t_enqueue_wall", "queue_wait_s",
-                 "last_tok_wall", "tick_t0", "tick_toks")
+                 "last_tok_wall", "tick_t0", "tick_toks", "export",
+                 "adopt")
 
     def __init__(self, rid: int, prompt: List[int], max_new_tokens: int,
                  eos_token_id: Optional[int]):
@@ -169,6 +180,9 @@ class _Request:
         self.cancelled = False
         self.warmup = False       # compile-only request: no telemetry
         self.detailed = False     # stream (tok, version, logprob) tuples
+        # -- disaggregated hand-off (serve/disagg.py)
+        self.export = False       # terminate at prompt end: ship KV
+        self.adopt: Optional[dict] = None   # shipped payload to adopt
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
         # -- per-request tracing (serve/request_trace.py)
@@ -220,6 +234,34 @@ class LLMEngine:
                 "capture_logprobs is incompatible with speculative "
                 "decode (spec_tokens > 0): the verify path scores "
                 "positions out of emission order")
+        if ec.kv_wire not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_wire must be 'bf16' or 'int8', got {ec.kv_wire!r}")
+
+        # Carried-over paged-kernel follow-on: at long table windows
+        # (>= 4k tokens per sequence) the chunked-prefill side of the
+        # paged kernel may win with row blocks > 128 — autotune once
+        # (winner persists in the flash autotune cache under paged|
+        # keys; off-TPU without an injected timer this is the chip
+        # default and the config is left alone).
+        window = ec.blocks_per_seq * ec.kv_block_size
+        if (getattr(model_config, "paged_block_r_prefill", 0) == 0
+                and window >= 4096 and ec.prefill_chunk > 1):
+            try:
+                from ray_tpu.ops.paged_flash import (
+                    autotune_paged_block_r)
+                rows = ec.prefill_chunk * (model_config.n_heads
+                                           // model_config.kv_heads)
+                br = autotune_paged_block_r(
+                    ec.kv_block_size, ec.blocks_per_seq, rows,
+                    model_config.head_dim,
+                    candidates=(32, 64, 128, 256, 512))
+                if br:
+                    model_config = dataclasses.replace(
+                        model_config, paged_block_r_prefill=int(br))
+                    self.model_config = model_config
+            except Exception:
+                pass
 
         self._params = params if params is not None \
             else init_params(model_config, jax.random.PRNGKey(seed))
@@ -308,11 +350,34 @@ class LLMEngine:
 
         self._jit_copy = jax.jit(_copy_fn, donate_argnums=(0,))
 
+        # disaggregated hand-off block I/O (serve/disagg.py): gather
+        # pulls a request's blocks into one contiguous slab for the
+        # wire; scatter adopts a shipped slab into this pool. Both run
+        # at the FIXED padded shape (blocks_per_seq ids) so adoption
+        # never recompiles — pad ids point at the reserved trash block
+        # and pad data is zeros, so the duplicate block-0 writes all
+        # write zeros and scatter order cannot matter.
+        def _gather_fn(cache, ids):
+            return (jnp.take(cache["k"], ids, axis=1),
+                    jnp.take(cache["v"], ids, axis=1))
+
+        def _scatter_fn(cache, ids, k_slab, v_slab):
+            return {"k": cache["k"].at[:, ids].set(k_slab),
+                    "v": cache["v"].at[:, ids].set(v_slab)}
+
+        self._jit_gather = jax.jit(_gather_fn)
+        self._jit_scatter = jax.jit(_scatter_fn, donate_argnums=(0,))
+
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._pending: "collections.deque[_Request]" = collections.deque()
         self._prefilling: "collections.deque[_Request]" = \
             collections.deque()
+        #: step-thread op queue: device work posted from actor-call
+        #: threads (warm-prefix export/import) runs at the top of the
+        #: next step, where the step thread exclusively owns the
+        #: donated caches — no cross-thread device races by design
+        self._ops: "collections.deque[dict]" = collections.deque()
         self._rid = 0
         self._stop = False
         self._dead: Optional[BaseException] = None
@@ -346,6 +411,15 @@ class LLMEngine:
         self._decode_pages_window = 0
         self._prompt_blocks_total = 0   # full prompt blocks seen
         self._cow_copies = 0
+        # disagg hand-off accounting (the bench's per-request ship
+        # bytes/wall come from here; exports count on the prefill
+        # fleet, adopts on the decode fleet)
+        self._kv_exports = 0
+        self._kv_export_bytes = 0
+        self._kv_adopts = 0
+        self._kv_adopt_bytes = 0
+        self._kv_adopt_blocks = 0
+        self._kv_ship_wall_s = 0.0
         self._spec_drafted = 0
         self._spec_accepted = 0
         self._spec_disables = 0
@@ -385,7 +459,7 @@ class LLMEngine:
                 cfg = getattr(try_global_worker(), "config", None)
             except Exception:
                 pass
-            self._tracer = RequestTracer(cfg, part="engine")
+            self._tracer = RequestTracer(cfg, part=ec.trace_part)
             if ec.enable_trace is not None:
                 self._tracer.enabled = bool(ec.enable_trace)
             self._slo = SLOWatchdog(SLOBudget.from_config(cfg))
@@ -433,7 +507,8 @@ class LLMEngine:
                eos_token_id: Optional[int] = None,
                detailed: bool = False,
                trace_ctx: Optional[Dict[str, Any]] = None,
-               _warmup: bool = False) -> _Request:
+               _warmup: bool = False, _export: bool = False,
+               _adopt: Optional[Dict[str, Any]] = None) -> _Request:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("empty prompt")
@@ -453,6 +528,8 @@ class LLMEngine:
             req = _Request(self._rid, prompt, max(1, int(mnt)), eos)
             req.warmup = _warmup
             req.detailed = detailed
+            req.export = _export
+            req.adopt = _adopt
             if not _warmup:
                 self._attach_trace(req, trace_ctx)
             self._pending.append(req)
@@ -556,6 +633,213 @@ class LLMEngine:
         finally:
             self.cancel(req)
 
+    # ------------------------------------------- disagg hand-off API
+    def prefill_export(self, prompt_ids: Sequence[int],
+                       trace_ctx: Optional[Dict[str, Any]] = None,
+                       timeout_s: float = 120.0) -> Dict[str, Any]:
+        """Run a prompt through chunked prefill and return the hand-off
+        payload (prompt + first token + packed KV slab) instead of
+        decoding — the prefill half of the disaggregated pipeline.
+        Blocking; see :class:`LLMServer.prefill_export` for the actor
+        wrapper."""
+        req = self.submit(prompt_ids, max_new_tokens=1,
+                          trace_ctx=trace_ctx, _export=True)
+        deadline = time.monotonic() + timeout_s
+        try:
+            while True:
+                try:
+                    item = req.out.get(timeout=0.2)
+                except queue.Empty:
+                    if self._dead is not None:
+                        raise EngineDeadError(
+                            f"engine step loop died: {self._dead!r}")
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("prefill_export timed out")
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                if isinstance(item, dict):
+                    return item
+                if item is _DONE:
+                    raise EngineDeadError(
+                        "prefill_export stream ended without a payload")
+        finally:
+            self.cancel(req)
+
+    def submit_adopt(self, payload: Dict[str, Any],
+                     max_new_tokens: Optional[int] = None,
+                     eos_token_id: Optional[int] = None,
+                     detailed: bool = False,
+                     trace_ctx: Optional[Dict[str, Any]] = None
+                     ) -> _Request:
+        """Enqueue a shipped prefill payload for adoption + decode —
+        the decode half of the disaggregated pipeline. The returned
+        request streams exactly what a colocated ``submit`` of the same
+        prompt would have streamed (first token included)."""
+        if int(payload.get("block_size", 0)) != self.config.kv_block_size:
+            raise ValueError(
+                f"shipped block_size {payload.get('block_size')} != "
+                f"engine kv_block_size {self.config.kv_block_size}")
+        return self.submit(payload["prompt"], max_new_tokens,
+                           eos_token_id, detailed=detailed,
+                           trace_ctx=trace_ctx, _adopt=payload)
+
+    # --------------------------------------- warm-prefix migration API
+    def export_warm_prefixes(self, min_hits: int = 1,
+                             max_blocks: int = 0
+                             ) -> Optional[Dict[str, Any]]:
+        """Package this engine's warm ref-0 radix-trie chains (hits >=
+        ``min_hits``) for migration to a surviving replica — the
+        drain-path rescue of a trie that would otherwise die with this
+        process. Runs on the step thread. Returns None when there is
+        nothing worth shipping."""
+        ec = self.config
+        bs = ec.kv_block_size
+        np, jnp = self._np, self._jnp
+
+        def _do():
+            with self._lock:
+                chains = self._pool.export_chains(
+                    min_hits, max_blocks) \
+                    if ec.enable_prefix_sharing else []
+                # chains share root prefixes: ship each block once
+                slab_idx: Dict[int, int] = {}
+                entries: List[tuple] = []   # (chunk tokens, block id)
+                for chain in chains:
+                    for key, blk in chain:
+                        if blk not in slab_idx:
+                            slab_idx[blk] = len(entries)
+                            entries.append((key, blk))
+            if not entries:
+                return None
+            T = ec.blocks_per_seq
+            ks, vs = [], []
+            for i0 in range(0, len(entries), T):
+                grp = entries[i0:i0 + T]
+                ids = np.zeros((T,), np.int32)
+                ids[:len(grp)] = [b for _, b in grp]
+                k, v = self._jit_gather(self._cache, jnp.asarray(ids))
+                ks.append(np.asarray(k)[:, :len(grp)])
+                vs.append(np.asarray(v)[:, :len(grp)])
+            from ray_tpu.serve.disagg import pack_kv_blocks
+            kv = pack_kv_blocks(np.concatenate(ks, axis=1),
+                                np.concatenate(vs, axis=1), ec.kv_wire)
+            payload = {
+                "chains": [[(list(key), slab_idx[blk])
+                            for key, blk in chain] for chain in chains],
+                "kv": kv,
+                "n_blocks": len(entries),
+                "block_size": bs,
+                "wire": ec.kv_wire,
+                "wire_bytes": kv["wire_bytes"],
+                "src": self.replica_tag,
+            }
+            if self._metrics is not None:
+                try:
+                    self._metrics.serve_prefix_migrated.inc(
+                        len(entries), tags={"dir": "export"})
+                except Exception:
+                    pass
+            if self._recorder is not None:
+                try:
+                    self._recorder.record(
+                        "PREFIX_MIGRATE", replica=self.replica_tag,
+                        dir="export", blocks=len(entries),
+                        chains=len(chains))
+                except Exception:
+                    pass
+            return payload
+
+        return self._run_on_step_thread(_do)
+
+    def import_warm_prefixes(self, payload: Dict[str, Any]) -> int:
+        """Adopt a migrated warm-prefix payload into this engine's pool
+        + radix trie (ref-0 cached blocks, evictable like any local
+        cache). Opportunistic by design: chunks already held locally
+        are skipped, and import stops at pool pressure rather than
+        evicting this replica's own warm cache — migrated cold blocks
+        must never displace proven-hot local ones. Runs on the step
+        thread; returns the number of blocks adopted."""
+        if payload is None:
+            return 0
+        if int(payload.get("block_size", 0)) != self.config.kv_block_size:
+            raise ValueError(
+                f"migrated block_size {payload.get('block_size')} != "
+                f"engine kv_block_size {self.config.kv_block_size}")
+        ec = self.config
+        np, jnp = self._np, self._jnp
+
+        def _do():
+            from ray_tpu.serve.disagg import unpack_kv_blocks
+            k_slab, v_slab = unpack_kv_blocks(
+                payload["kv"], dtype=self._cache["k"].dtype)
+            plan: List[tuple] = []     # (slab index, local block id)
+            with self._lock:
+                if not ec.enable_prefix_sharing:
+                    return 0
+                pool = self._pool
+                for chain in payload["chains"]:
+                    node = pool._root
+                    for key, idx in chain:
+                        key = tuple(int(t) for t in key)
+                        child = node.children.get(key)
+                        if child is not None and not child.detached:
+                            node = child
+                            continue
+                        # pressure guard: free-list only — migration
+                        # never evicts local warm cache, and never
+                        # recycles a block another import just planned
+                        if not pool._free:
+                            node = None
+                            break
+                        blk = pool.allocate(1)[0]
+                        nnode, inserted = pool.insert_child(
+                            node, key, blk)
+                        if not inserted:
+                            pool.release([blk])
+                            node = nnode
+                            if node is None:
+                                break
+                            continue
+                        plan.append((idx, blk))
+                        pool.decref(blk)   # ref-0, trie-resident
+                        node = nnode
+                    # chain truncated: deeper chunks need their parent
+            if not plan:
+                return 0
+            T = ec.blocks_per_seq
+            shp = self._cache["k"].shape
+            for i0 in range(0, len(plan), T):
+                grp = plan[i0:i0 + T]
+                ids = np.zeros((T,), np.int32)
+                ids[:len(grp)] = [b for _, b in grp]
+                k_pad = np.zeros((shp[0], T) + shp[2:], k_slab.dtype)
+                v_pad = np.zeros_like(k_pad)
+                for j, (idx, _) in enumerate(grp):
+                    k_pad[:, j] = k_slab[:, idx]
+                    v_pad[:, j] = v_slab[:, idx]
+                self._cache = self._jit_scatter(
+                    self._cache, jnp.asarray(ids), jnp.asarray(k_pad),
+                    jnp.asarray(v_pad))
+            self._jax.block_until_ready(self._cache["k"])
+            if self._metrics is not None:
+                try:
+                    self._metrics.serve_prefix_migrated.inc(
+                        len(plan), tags={"dir": "import"})
+                except Exception:
+                    pass
+            if self._recorder is not None:
+                try:
+                    self._recorder.record(
+                        "PREFIX_MIGRATE", replica=self.replica_tag,
+                        dir="import", blocks=len(plan),
+                        chains=len(payload["chains"]))
+                except Exception:
+                    pass
+            return len(plan)
+
+        return self._run_on_step_thread(_do)
+
     def warmup(self, timeout_s: float = 600.0) -> None:
         """Compile every jitted program (one tiny end-to-end generate)
         and reset the session counters it skewed: the TTFT EWMA would
@@ -656,6 +940,15 @@ class LLMEngine:
                 # backend): swaps are pointer flips between decode
                 # steps, so sync_stall_s — decode time lost waiting on
                 # a refresh — must stay 0.0 (the bench gates on it)
+                # disagg hand-off accounting: exports tick on the
+                # prefill fleet, adopts (+ ship wall measured
+                # ship_ts -> adoption-complete) on the decode fleet
+                "kv_exports": self._kv_exports,
+                "kv_export_bytes": self._kv_export_bytes,
+                "kv_adopts": self._kv_adopts,
+                "kv_adopt_bytes": self._kv_adopt_bytes,
+                "kv_adopt_blocks": self._kv_adopt_blocks,
+                "kv_ship_wall_s": round(self._kv_ship_wall_s, 4),
                 "weight_version": self._weight_version,
                 "weight_swaps": self._weight_swaps,
                 "weight_swap_wall_s": round(self._weight_swap_wall_s,
@@ -703,6 +996,7 @@ class LLMEngine:
 
     def _has_work_locked(self) -> bool:
         return bool(self._pending) or bool(self._prefilling) \
+            or bool(self._ops) \
             or self._staged_weights is not None \
             or any(r is not None for r in self._slots)
 
@@ -713,15 +1007,52 @@ class LLMEngine:
             reqs += list(self._prefilling) + list(self._pending)
             self._pending.clear()
             self._prefilling.clear()
+            ops = list(self._ops)
+            self._ops.clear()
         err = EngineDeadError(f"engine step loop died: {e!r}")
         err.__cause__ = e
         for r in set(reqs):
             self._close_trace(r, err)
             r.out.put(err)
+        for op in ops:                 # never strand an op waiter
+            op["box"]["e"] = err
+            op["done"].set()
 
-    # one engine step: swap staged weights -> reap -> admit -> one
-    # prefill chunk -> one decode
+    def _run_on_step_thread(self, fn, timeout_s: float = 30.0):
+        """Run ``fn`` on the step thread (the donated caches' only
+        owner) at the next step boundary and return its result. The
+        warm-prefix migration paths use this so their gathers/scatters
+        can never interleave with an in-flight donated-cache update."""
+        op = {"fn": fn, "done": threading.Event(), "box": {}}
+        with self._work:
+            if self._dead is not None:
+                raise EngineDeadError(
+                    f"engine step loop died: {self._dead!r}")
+            self._ops.append(op)
+            self._work.notify_all()
+        if not op["done"].wait(timeout_s):
+            raise TimeoutError("engine step-thread op timed out")
+        if "e" in op["box"]:
+            raise op["box"]["e"]
+        return op["box"].get("r")
+
+    def _drain_ops(self) -> None:
+        while True:
+            with self._lock:
+                op = self._ops.popleft() if self._ops else None
+            if op is None:
+                return
+            try:
+                op["box"]["r"] = op["fn"]()
+            except BaseException as e:  # noqa: BLE001 — typed to waiter
+                op["box"]["e"] = e
+            finally:
+                op["done"].set()
+
+    # one engine step: drain posted ops -> swap staged weights -> reap
+    # -> admit -> one prefill chunk -> one decode
     def _step(self) -> None:
+        self._drain_ops()
         self._maybe_swap_weights()
         self._reap_cancelled()
         self._admit()
@@ -787,6 +1118,15 @@ class LLMEngine:
         ec = self.config
         bs = ec.kv_block_size
         while True:
+            with self._lock:
+                if not self._pending or not self._free_slots:
+                    return
+                head_adopt = self._pending[0].adopt is not None
+            if head_adopt:
+                # disagg adoption: shipped KV blocks, no prefill
+                if not self._admit_adopt(self._pending[0]):
+                    return          # pool pressure: wait for blocks
+                continue
             with self._lock:
                 if not self._pending or not self._free_slots:
                     return
@@ -870,6 +1210,213 @@ class LLMEngine:
                 with self._lock:
                     self._pool.release([cow_src])
 
+    # --------------------------------------------- disagg adopt / export
+    def _admit_adopt(self, req: _Request) -> bool:
+        """Admit a disagg hand-off: slot + blocks like a normal request,
+        but the prompt's KV arrives in the shipped slab instead of via
+        prefill. Blocks the local radix trie already holds are reused
+        (their slab copy is skipped — the bytes were shipped but the
+        scatter isn't repeated); the rest are scattered into the pool,
+        then every full prompt chunk is trie-indexed so the shipped
+        prefix is warm here from now on. Never copy-on-write: the first
+        token came with the payload, so a fully block-aligned matched
+        prompt just starts decode in a fresh private block. Returns
+        False — with nothing taken — on pool pressure (admission wait).
+        """
+        np = self._np
+        ec = self.config
+        bs = ec.kv_block_size
+        payload = req.adopt
+        t0w = time.time()
+        with self._lock:
+            if not self._free_slots:
+                return False
+            plen = len(req.prompt)
+            n_ship = min(int(payload["n_blocks"]), -(-plen // bs))
+            need = -(-min(plen + req.max_new_tokens,
+                          ec.max_seq_len) // bs)
+            matched: List[int] = []
+            mtok = 0
+            if ec.enable_prefix_sharing:
+                matched, mtok, req.trie_node = \
+                    self._pool.match_prefix(req.prompt)
+            priv = self._pool.allocate(need - len(matched))
+            if priv is None:
+                self._pool.release(matched)
+                req.trie_node = None
+                return False
+            req.blocks = matched + priv
+            req.hit_blocks = len(matched)
+            self._pool.count_hits(req.hit_blocks)
+            req.trie_cursor = req.hit_blocks
+            req.prefill_pos = plen
+            self._prompt_blocks_total += -(-plen // bs)
+            self._pending.popleft()
+            req.slot = self._free_slots.pop()
+            self._block_tables[req.slot, :] = 0
+            self._block_tables[req.slot, :len(req.blocks)] = req.blocks
+            self._seq_lens[req.slot] = 0
+            req.state = _PREFILL
+            self._slots[req.slot] = req
+            if req.hit_blocks and self._metrics is not None:
+                try:
+                    self._metrics.serve_prefix_hits.inc(req.hit_blocks)
+                except Exception:
+                    pass
+            if req.trace is not None:
+                now = time.time()
+                req.queue_wait_s = max(0.0, now - req.t_enqueue_wall)
+                req.trace.span(RT.QUEUED, req.t_enqueue_wall, now)
+                req.trace.span(RT.ADMITTED, now, None, slot=req.slot,
+                               hit_blocks=req.hit_blocks,
+                               prefix_tokens=mtok, adopt=True)
+                self._slo.observe_queue(req.trace, req.queue_wait_s)
+            # physical destinations for the slab blocks the local trie
+            # did NOT already hold
+            dst = req.blocks[req.hit_blocks:n_ship]
+        # scatter OUTSIDE the lock (step thread owns the device)
+        if dst:
+            from ray_tpu.serve.disagg import unpack_kv_blocks
+            k_slab, v_slab = unpack_kv_blocks(
+                payload["kv"], dtype=self._cache["k"].dtype)
+            T = ec.blocks_per_seq
+            ids = np.zeros((T,), np.int32)
+            ids[:len(dst)] = dst
+            shp = self._cache["k"].shape
+            k_pad = np.zeros((shp[0], T) + shp[2:], k_slab.dtype)
+            v_pad = np.zeros_like(k_pad)
+            k_pad[:, :len(dst)] = k_slab[:, req.hit_blocks:n_ship]
+            v_pad[:, :len(dst)] = v_slab[:, req.hit_blocks:n_ship]
+            jnp = self._jnp
+            self._cache = self._jit_scatter(
+                self._cache, jnp.asarray(ids), jnp.asarray(k_pad),
+                jnp.asarray(v_pad))
+            self._jax.block_until_ready(self._cache["k"])
+        t1w = time.time()
+        first = int(payload["first"])
+        req.seq_len = plen
+        req.t_first_token = time.monotonic()
+        ship_ts = min(float(payload.get("ship_ts") or t0w), t0w)
+        wire = payload.get("wire", ec.kv_wire)
+        if req.trace is not None:
+            req.trace.span(RT.KV_SHIP, ship_ts, t0w,
+                           bytes=payload.get("wire_bytes"), wire=wire,
+                           src=payload.get("src"))
+            req.trace.span(RT.KV_ADOPT, t0w, t1w,
+                           blocks=len(dst), reused=req.hit_blocks,
+                           bytes=payload.get("wire_bytes"), wire=wire)
+        self._kv_adopts += 1
+        self._kv_adopt_bytes += int(payload.get("wire_bytes") or 0)
+        self._kv_adopt_blocks += len(dst)
+        self._kv_ship_wall_s += max(0.0, t1w - ship_ts)
+        if self._metrics is not None:
+            try:
+                self._metrics.serve_kv_ship_seconds.observe(
+                    max(0.0, t1w - ship_ts))
+            except Exception:
+                pass
+        if self._recorder is not None:
+            try:
+                self._recorder.record(
+                    "KV_ADOPT", replica=self.replica_tag,
+                    blocks=len(dst), reused=req.hit_blocks,
+                    dur_s=round(t1w - t0w, 6))
+            except Exception:
+                pass
+        self._record_ttft(req)
+        with self._lock:
+            # trie-index every full prompt chunk: the shipped prefix
+            # is warm on THIS replica for later requests
+            if req.trie_node is not None:
+                while req.trie_node is not None and \
+                        req.trie_cursor < plen // bs:
+                    i = req.trie_cursor
+                    node, _ = self._pool.insert_child(
+                        req.trie_node, req.prompt[i * bs:(i + 1) * bs],
+                        req.blocks[i])
+                    req.trie_node = node
+                    req.trie_cursor += 1
+            if req.cancelled:
+                self._release_locked(req)
+                return True
+            if req.eos_token_id is not None \
+                    and first == req.eos_token_id:
+                self._release_locked(req)
+                return True
+            req.generated = 1
+            req.out.put(self._item(req, first,
+                                   payload.get("first_lp")))
+            req.history.append(first)
+            self._tokens_total += 1
+            if req.generated >= req.max_new_tokens:
+                self._release_locked(req)
+                return True
+            req.state = _DECODE
+            self._last_tok[req.slot] = first
+            self._seq_lens[req.slot] = req.seq_len
+        return True
+
+    def _finish_export(self, req: _Request, first: int, lp) -> None:
+        """Terminal step of a prefill-export request: gather the
+        prompt's finished KV blocks into one contiguous slab, pack it
+        for the wire, hand the payload to the waiting ``prefill_export``
+        call, and free the slot — this engine never decodes it."""
+        np = self._np
+        ec = self.config
+        bs = ec.kv_block_size
+        plen = len(req.prompt)
+        n_ship = -(-plen // bs)
+        t0w = time.time()
+        with self._lock:
+            self._prefilling.popleft()
+            if req.cancelled:
+                self._release_locked(req)
+                return
+            ids = np.zeros((ec.blocks_per_seq,), np.int32)
+            ids[:n_ship] = req.blocks[:n_ship]
+        k_slab, v_slab = self._jit_gather(self._cache,
+                                          self._jnp.asarray(ids))
+        k_np = np.asarray(k_slab)[:, :n_ship]
+        v_np = np.asarray(v_slab)[:, :n_ship]
+        from ray_tpu.serve.disagg import pack_kv_blocks
+        kv = pack_kv_blocks(k_np, v_np, ec.kv_wire)
+        payload = {
+            "prompt": list(req.prompt),
+            "first": int(first),
+            "first_lp": None if lp is None else float(lp[0]),
+            "kv": kv,
+            "n_blocks": n_ship,
+            "block_size": bs,
+            "wire": ec.kv_wire,
+            "wire_bytes": kv["wire_bytes"],
+            "ship_ts": time.time(),
+            "src": self.replica_tag,
+        }
+        t1w = time.time()
+        self._kv_exports += 1
+        self._kv_export_bytes += int(kv["wire_bytes"])
+        if req.trace is not None:
+            req.trace.span(RT.KV_SHIP, t0w, t1w,
+                           bytes=kv["wire_bytes"], wire=ec.kv_wire,
+                           blocks=n_ship, dir="export")
+        if self._metrics is not None:
+            try:
+                self._metrics.serve_kv_ship_bytes.inc(
+                    kv["wire_bytes"], tags={"wire": ec.kv_wire})
+            except Exception:
+                pass
+        if self._recorder is not None:
+            try:
+                self._recorder.record(
+                    "KV_SHIP", replica=self.replica_tag,
+                    blocks=n_ship, bytes=kv["wire_bytes"],
+                    wire=ec.kv_wire)
+            except Exception:
+                pass
+        with self._lock:
+            req.out.put(payload)
+            self._release_locked(req)
+
     def _prefill_one_chunk(self) -> None:
         with self._lock:
             req = self._prefilling[0] if self._prefilling else None
@@ -922,6 +1469,11 @@ class LLMEngine:
         # prompt fully cached: the final chunk's last logits give the
         # first generated token — TTFT stops here
         first = int(tok[0])
+        if req.export:
+            # disagg prefill replica: ship the finished blocks instead
+            # of decoding (user-facing TTFT is the decode side's)
+            self._finish_export(req, first, lp)
+            return
         req.seq_len = len(req.prompt)
         req.t_first_token = time.monotonic()
         self._record_ttft(req)
@@ -1404,6 +1956,87 @@ class LLMServer:
                 prompt_ids, max_new_tokens,
                 trace_ctx=self._trace_ctx()):
             yield tok
+
+    # ------------------------------------------ disagg replica surface
+    async def prefill_export(self, prompt_ids: Sequence[int]
+                             ) -> Dict[str, Any]:
+        """Prefill-fleet actor method: chunked-prefill the prompt and
+        return the KV hand-off payload. The payload's device slabs ride
+        the out-of-band zero-copy serializer; the decode replica pulls
+        them peer-to-peer when the router chains this call's ObjectRef
+        into ``adopt_generate``."""
+        eng = self.engine
+        req = eng.submit(prompt_ids, max_new_tokens=1,
+                         trace_ctx=self._trace_ctx(), _export=True)
+        loop = asyncio.get_running_loop()
+        get = functools.partial(req.out.get, timeout=0.2)
+        try:
+            while True:
+                try:
+                    item = await loop.run_in_executor(
+                        eng._poll_pool, get)
+                except queue.Empty:
+                    if eng._dead is not None:
+                        raise EngineDeadError(
+                            f"engine step loop died: {eng._dead!r}")
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                if isinstance(item, dict):
+                    return item
+                if item is _DONE:
+                    raise EngineDeadError(
+                        "prefill_export ended without a payload")
+        finally:
+            eng.cancel(req)
+
+    async def adopt_generate(self, payload: Dict[str, Any],
+                             max_new_tokens: Optional[int] = None,
+                             eos_token_id: Optional[int] = None):
+        """Decode-fleet actor method: adopt a shipped prefill payload
+        and stream tokens — the first token (computed by the prefill
+        replica) included, so the stream is exactly what a colocated
+        ``generate`` would produce."""
+        eng = self.engine
+        req = eng.submit_adopt(payload, max_new_tokens, eos_token_id,
+                               trace_ctx=self._trace_ctx())
+        loop = asyncio.get_running_loop()
+        get = functools.partial(req.out.get, timeout=0.2)
+        try:
+            while True:
+                try:
+                    item = await loop.run_in_executor(
+                        eng._poll_pool, get)
+                except queue.Empty:
+                    if eng._dead is not None:
+                        raise EngineDeadError(
+                            f"engine step loop died: {eng._dead!r}")
+                    continue
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            eng.cancel(req)
+
+    async def export_warm_prefixes(self, min_hits: int = 1,
+                                   max_blocks: int = 0
+                                   ) -> Optional[Dict[str, Any]]:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self.engine._poll_pool,
+            functools.partial(self.engine.export_warm_prefixes,
+                              min_hits, max_blocks))
+
+    async def import_warm_prefixes(self,
+                                   payload: Optional[Dict[str, Any]]
+                                   ) -> int:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self.engine._poll_pool,
+            functools.partial(self.engine.import_warm_prefixes,
+                              payload))
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()

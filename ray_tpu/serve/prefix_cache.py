@@ -58,7 +58,7 @@ class _TrieNode:
     physical block holding that chunk's KV."""
 
     __slots__ = ("children", "parent", "key", "block", "touch",
-                 "detached")
+                 "detached", "hits")
 
     def __init__(self, parent: Optional["_TrieNode"],
                  key: Optional[tuple], block: Optional[int]):
@@ -68,6 +68,7 @@ class _TrieNode:
         self.block = block
         self.touch = 0          # LRU clock stamp
         self.detached = False   # evicted — inserts under it must abort
+        self.hits = 0           # prefix-match count (migration floor)
 
 
 class PrefixBlockPool:
@@ -139,6 +140,7 @@ class PrefixBlockPool:
             blocks.append(node.block)
             self.incref(node.block)
             self._touch(node)
+            node.hits += 1
         # hits_total is NOT bumped here: a match may be released when
         # allocation fails (admission wait) and retried — the engine
         # counts hits once, on successful admission (count_hits)
@@ -216,6 +218,49 @@ class PrefixBlockPool:
         self._touch(node)
         self.inserts_total += 1
         return node, True
+
+    # ------------------------------------------------------- migration
+    def export_chains(self, min_hits: int = 1,
+                      max_blocks: int = 0) -> List[List[Tuple[tuple, int]]]:
+        """Warm prefix chains worth migrating off a draining replica.
+
+        A chain is a contiguous root-anchored trie path of ref-0
+        (cached) nodes whose ``hits`` meet the floor — exactly the
+        blocks that would die with this replica but have proven reuse.
+        Chains truncate at the first node that is referenced (a live
+        request still writes against it), below the hit floor, or
+        detached: an importer re-inserts from its own root, so a gap
+        would orphan everything deeper. Returns
+        ``[[(chunk_tokens, block_id), ...], ...]`` ordered hottest
+        chain first; ``max_blocks > 0`` caps the total block count.
+        """
+        chains: List[List[Tuple[tuple, int]]] = []
+
+        def walk(node: _TrieNode, path: List[Tuple[tuple, int]]):
+            extended = False
+            for child in sorted(node.children.values(),
+                                key=lambda n: -n.hits):
+                if (child.detached or child.block in self._ref
+                        or child.hits < min_hits):
+                    continue
+                walk(child, path + [(child.key, child.block)])
+                extended = True
+            if not extended and path:
+                chains.append(path)
+
+        walk(self._root, [])
+        chains.sort(key=lambda c: -len(c))
+        if max_blocks > 0:
+            out, n = [], 0
+            for c in chains:
+                if n + len(c) > max_blocks:
+                    c = c[:max_blocks - n]
+                if not c:
+                    break
+                out.append(c)
+                n += len(c)
+            chains = out
+        return chains
 
     # -------------------------------------------------------- introspection
     def root_fingerprints(self, limit: int = 64) -> List[int]:

@@ -13,6 +13,10 @@ phase          meaning
 QUEUED         router enqueue -> engine admission (a decode slot won)
 ADMITTED       slot assignment incl. prefix-cache match / CoW forks
 PREFILL        one chunked-prefill step (per chunk)
+KV_SHIP        disagg hand-off: finished prefill KV blocks in flight
+               from the prefill replica to the chosen decode replica
+KV_ADOPT       disagg hand-off: decode replica adopting shipped blocks
+               into its pool + radix trie (bytes/blocks/wire in attrs)
 SPEC_VERIFY    one speculative verify step (drafted/accepted counts)
 DECODE         a per-N-token tick of batched decode
 WEIGHT_SWAP    an in-flight weight refresh overlapping this request
@@ -50,6 +54,8 @@ from typing import Any, Dict, List, Optional
 QUEUED = "QUEUED"
 ADMITTED = "ADMITTED"
 PREFILL = "PREFILL"
+KV_SHIP = "KV_SHIP"
+KV_ADOPT = "KV_ADOPT"
 SPEC_VERIFY = "SPEC_VERIFY"
 DECODE = "DECODE"
 WEIGHT_SWAP = "WEIGHT_SWAP"
@@ -61,8 +67,9 @@ SHED = "SHED"
 TERMINAL_PHASES = frozenset({DONE, FAILED, SHED})
 
 #: Render/aggregation order for waterfalls and per-phase breakdowns.
-PHASE_ORDER = (QUEUED, ADMITTED, PREFILL, SPEC_VERIFY, DECODE,
-               WEIGHT_SWAP, FIRST_TOKEN, DONE, FAILED, SHED)
+PHASE_ORDER = (QUEUED, ADMITTED, PREFILL, KV_SHIP, KV_ADOPT,
+               SPEC_VERIFY, DECODE, WEIGHT_SWAP, FIRST_TOKEN, DONE,
+               FAILED, SHED)
 
 #: Cap on spans buffered per request: a pathological 100k-token decode
 #: must not make its own trace unbounded. Oldest non-terminal spans are

@@ -247,6 +247,27 @@ def test_gqa_reference_has_no_materialized_repeat():
     assert f"({B}, {T * bs}, {H}, {D})" not in jaxpr
 
 
+@pytest.mark.parametrize("block_r", [256, 512])
+def test_wide_row_blocks_parity_chunked_prefill(block_r):
+    """Row blocks past the old 128 cap, prefill-like row counts: 288
+    rows (C·rep = 72·4) split across two 256-row blocks or pad into one
+    512-row block — either way bitwise-masked parity with the XLA
+    reference on every valid row."""
+    B, C, H, KVH, D, bs, T = 1, 72, 8, 2, 8, 4, 4
+    _, _, kc, vc, bt = _paged_case(18, B, 16, H, KVH, D, bs, T)
+    rng = np.random.default_rng(19)
+    q = rng.normal(size=(B, C, H, D)).astype(np.float32)
+    lens = np.array([15], np.int32)
+    pos = np.arange(C, dtype=np.int32)[None, :] % 15
+    ref = paged_attention(q, kc, vc, bt, jnp.asarray(pos),
+                          impl="reference")
+    ker = paged_flash_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(bt), jnp.asarray(pos), jnp.asarray(lens),
+        block_r=block_r, interpret=True)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref), **TOL)
+
+
 # ------------------------------------------------ autotune / disk cache
 def test_default_paged_block_r_shapes():
     assert default_paged_block_r(2, 32, chip="cpu") == 8
@@ -286,6 +307,36 @@ def test_autotune_paged_block_r_times_and_persists(tmp_path,
     assert autotune_paged_block_r(16, 8, 256, 64, timer=timer,
                                   chip="v5e") == 32
     assert not calls
+
+
+def test_autotune_large_prefill_window_picks_past_128(tmp_path,
+                                                      monkeypatch):
+    """A ≥4k-row chunked-prefill window can win at block_r > 128: with
+    a timer that rewards wider blocks the tuner must consider the 256
+    and 512 candidates (not clamp at the old decode cap) and persist
+    the >128 winner under its paged| disk key."""
+    import json
+    import ray_tpu.ops.paged_flash as pf
+
+    monkeypatch.setenv("RAY_TPU_FLASH_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(pf, "_PAGED_AUTOTUNE_CACHE", {})
+    timed = []
+
+    def timer(br):
+        timed.append(br)
+        return 1.0 / br              # wider is strictly faster
+
+    win = autotune_paged_block_r(16, 256, 4096, 128, timer=timer,
+                                 chip="v5e")
+    assert win == 512 and {256, 512} <= set(timed)
+    data = json.loads((tmp_path / "flash_autotune.json").read_text())
+    key = [k for k in data if k.startswith("paged|v5e|")]
+    assert key and data[key[0]][0] == 512
+    # reload path honours the wide winner too
+    monkeypatch.setattr(pf, "_PAGED_AUTOTUNE_CACHE", {})
+    assert autotune_paged_block_r(16, 256, 4096, 128,
+                                  timer=lambda br: 1.0,
+                                  chip="v5e") == 512
 
 
 def test_autotune_off_tpu_returns_default_without_running(monkeypatch):

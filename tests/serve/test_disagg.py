@@ -1,0 +1,507 @@
+"""Disaggregated prefill/decode serving tests: the KV wire codec
+(bf16 bit-exact + int8 blockwise through the OOB serializer), the
+engine-level hand-off (adopt parity vs in-place prefill, prefix-hit
+block skipping, pool audits), warm-prefix migration (hit-count floor,
+A/B hit rate across a drain), the router's fleet-backfill staleness
+bound, and the chaos-matrix disagg legs (prefill SIGKILLed mid-ship /
+decode SIGKILLed mid-adopt -> retried on a fresh pair, no leaks)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ray_tpu.models import TransformerConfig
+from ray_tpu.serve.disagg import (DisaggHandoffError, DisaggRouter,
+                                  kv_ship_bytes, pack_kv_blocks,
+                                  unpack_kv_blocks)
+from ray_tpu.serve.llm_engine import EngineConfig, LLMEngine
+
+MODEL_KW = dict(vocab_size=64, d_model=16, n_layers=2, n_heads=2,
+                head_dim=8, d_ff=32, max_seq_len=64, rotary_dim=8,
+                dtype=jnp.float32, remat_policy="none")
+MODEL_DICT = dict(MODEL_KW, dtype="float32")
+ENGINE_KW = dict(decode_slots=4, kv_block_size=4, max_seq_len=48,
+                 prefill_chunk=8, max_new_tokens=16)
+
+
+def _engine(**kw):
+    ekw = dict(ENGINE_KW)
+    ekw.update(kw)
+    return LLMEngine(TransformerConfig(**MODEL_KW), EngineConfig(**ekw))
+
+
+def _slab(shape, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape).astype(dtype)
+
+
+# --------------------------------------------------- KV wire codec
+def test_kv_wire_bf16_bit_exact():
+    """The default wire ships the slab in its native dtype, bit-exact —
+    including an actual-bfloat16 cache (extension dtype, no buffer
+    protocol)."""
+    import ml_dtypes
+    shape = (2, 5, 4, 2, 8)   # [n_layers, blocks, block_size, kvh, hd]
+    for dtype in (np.float32, ml_dtypes.bfloat16):
+        k, v = _slab(shape, dtype, 1), _slab(shape, dtype, 2)
+        kv = pack_kv_blocks(k, v, wire="bf16")
+        k2, v2 = unpack_kv_blocks(kv)
+        assert k2.dtype == np.dtype(dtype)
+        assert k2.tobytes() == k.tobytes()
+        assert v2.tobytes() == v.tobytes()
+        assert kv["wire_bytes"] >= k.nbytes + v.nbytes
+
+
+def test_kv_wire_oob_serializer_roundtrip():
+    """The packed payload survives the runtime's own zero-copy
+    serializer (what actually moves worker-to-worker) bit-exact, and
+    the big slabs ride out-of-band buffers, not the pickle stream."""
+    from ray_tpu.core.serialization import SerializationContext
+
+    shape = (2, 6, 4, 2, 8)
+    k, v = _slab(shape, np.float32, 3), _slab(shape, np.float32, 4)
+    kv = pack_kv_blocks(k, v, wire="bf16")
+    ser = SerializationContext()
+    so = ser.serialize(kv)
+    assert so.buffers, "KV slabs should ship out-of-band"
+    wire = so.to_bytes()
+    # wire_bytes counts the slab payload; the full dict adds only
+    # pickle meta framing on top
+    assert 0 <= len(wire) - kv["wire_bytes"] <= 1024, \
+        (len(wire), kv["wire_bytes"])
+    got, _refs = ser.deserialize_from_view(memoryview(wire))
+    k2, v2 = unpack_kv_blocks(got)
+    assert k2.tobytes() == k.tobytes()
+    assert v2.tobytes() == v.tobytes()
+
+
+def test_kv_wire_int8_uneven_last_block():
+    """int8 blockwise with a slab whose numel is NOT a multiple of the
+    256-element quant block: the zero-padded last block must not leak
+    into the reconstruction, and the error stays within the symmetric-
+    quant bound."""
+    shape = (1, 3, 6, 2, 5)   # numel 180: one partial quant block
+    k, v = _slab(shape, np.float32, 5), _slab(shape, np.float32, 6)
+    kv = pack_kv_blocks(k, v, wire="int8")
+    assert kv["k"].dtype == np.int8
+    k2, v2 = unpack_kv_blocks(kv)
+    assert k2.shape == shape and k2.dtype == np.float32
+    for a, b in ((k, k2), (v, v2)):
+        err = np.abs(a - b).max()
+        # symmetric int8: |err| <= max|x| / 127 per quant block
+        assert err <= np.abs(a).max() / 127 + 1e-7, err
+    assert kv["wire_bytes"] < k.nbytes + v.nbytes  # actually smaller
+
+
+def test_kv_wire_rejects_bad_input():
+    k = _slab((1, 2, 4, 2, 8), np.float32)
+    with pytest.raises(ValueError, match="wire"):
+        pack_kv_blocks(k, k, wire="fp4")
+    with pytest.raises(ValueError, match="shape"):
+        pack_kv_blocks(k, k[:, :1], wire="bf16")
+    kv = pack_kv_blocks(k, k, wire="bf16")
+    kv["wire"] = "zstd"
+    with pytest.raises(ValueError, match="wire"):
+        unpack_kv_blocks(kv)
+
+
+def test_kv_ship_bytes_analytic_matches_packed():
+    """The README's bytes-per-ship math tracks the measured wire
+    footprint to within pickle framing (< 2%+1KiB here)."""
+    shape = (2, 8, 4, 2, 8)   # numel 2*4096
+    k, v = _slab(shape, np.float32, 7), _slab(shape, np.float32, 8)
+    for wire, dtype_bytes in (("bf16", 4), ("int8", 1)):
+        kv = pack_kv_blocks(k, v, wire=wire)
+        analytic = kv_ship_bytes(n_blocks=8, block_size=4, kv_heads=2,
+                                 head_dim=8, n_layers=2, wire=wire,
+                                 dtype_bytes=dtype_bytes)
+        assert analytic <= kv["wire_bytes"] <= analytic * 1.02 + 1024, \
+            (wire, analytic, kv["wire_bytes"])
+
+
+# ----------------------------------------------- engine-level hand-off
+@pytest.fixture(scope="module")
+def handoff_engines():
+    """A colocated reference + a prefill/decode pair, all same seed
+    (identical params => the hand-off must be invisible to greedy)."""
+    ref = _engine()
+    pre = _engine()
+    dec = _engine()
+    yield ref, pre, dec
+    for e in (ref, pre, dec):
+        e.shutdown()
+
+
+def _drain(req, timeout_s=60.0):
+    from ray_tpu.serve.llm_engine import _DONE
+    toks, deadline = [], time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            item = req.out.get(timeout=0.5)
+        except Exception:
+            continue
+        if item is _DONE:
+            return toks
+        if isinstance(item, BaseException):
+            raise item
+        toks.append(item)
+    raise TimeoutError("adopt stream did not finish")
+
+
+def test_handoff_bit_parity_bf16(handoff_engines):
+    """prefill_export -> ship -> submit_adopt streams the exact greedy
+    tokens of a colocated run (first token included), and both pools
+    audit clean after."""
+    ref, pre, dec = handoff_engines
+    prompt = [7, 11, 13, 17, 19, 23, 29, 31, 37, 3]   # crosses blocks
+    want = list(ref.generate_sync(prompt, 12))
+    payload = pre.prefill_export(prompt)
+    assert payload["n_blocks"] >= 2
+    assert payload["wire"] == "bf16"
+    got = _drain(dec.submit_adopt(payload, max_new_tokens=12))
+    assert got == want
+    assert int(payload["first"]) == want[0]
+    assert pre.pool_audit() == [] and dec.pool_audit() == []
+    s = dec.stats()
+    assert s["kv_adopts"] >= 1 and s["kv_adopt_bytes"] > 0
+    assert pre.stats()["kv_exports"] >= 1
+
+
+def test_handoff_int8_decode_parity():
+    """The int8 wire's decode must match in-place prefill within quant
+    tolerance; the first token is computed pre-quantization on the
+    prefill side, so it is exact by construction. (Greedy argmax over
+    this seeded tiny model is stable under the quant noise, so the
+    seed-pinned stream compares equal.)"""
+    ref = _engine()
+    pre = _engine(kv_wire="int8")
+    dec = _engine(kv_wire="int8")
+    try:
+        prompt = [5, 9, 14, 22, 33, 41, 2, 8, 12]
+        want = list(ref.generate_sync(prompt, 10))
+        payload = pre.prefill_export(prompt)
+        assert payload["wire"] == "int8"
+        got = _drain(dec.submit_adopt(payload, max_new_tokens=10))
+        assert got[0] == want[0]          # exact: shipped, not recomputed
+        assert got == want                # stable for this seed
+        assert dec.pool_audit() == []
+    finally:
+        for e in (ref, pre, dec):
+            e.shutdown()
+
+
+def test_adopt_block_size_mismatch_raises(handoff_engines):
+    _, pre, dec = handoff_engines
+    payload = pre.prefill_export([3, 5, 7, 9, 11])
+    bad = dict(payload, block_size=payload["block_size"] * 2)
+    with pytest.raises(ValueError, match="block_size"):
+        dec.submit_adopt(bad, max_new_tokens=4)
+    assert pre.pool_audit() == [] and dec.pool_audit() == []
+
+
+def test_adopt_prefix_hit_skips_shipped_blocks(handoff_engines):
+    """Adopting a payload whose prefix the decode trie already holds
+    scatters only the novel blocks (the shipped bytes for matched
+    blocks are dropped, not re-scattered)."""
+    _, pre, dec = handoff_engines
+    prompt = [2, 4, 6, 8, 10, 12, 14, 16, 18]   # 2 full blocks + tail
+    payload = pre.prefill_export(prompt)
+    s0 = dec.stats()
+    got1 = _drain(dec.submit_adopt(payload, max_new_tokens=4))
+    s1 = dec.stats()
+    first_blocks = s1["kv_adopt_blocks"] - s0["kv_adopt_blocks"]
+    payload2 = pre.prefill_export(prompt)
+    got2 = _drain(dec.submit_adopt(payload2, max_new_tokens=4))
+    s2 = dec.stats()
+    second_blocks = s2["kv_adopt_blocks"] - s1["kv_adopt_blocks"]
+    assert got1 == got2
+    assert second_blocks < first_blocks, (first_blocks, second_blocks)
+    assert s2["prefix_hit_blocks_total"] > s1["prefix_hit_blocks_total"]
+    assert dec.pool_audit() == []
+
+
+# ------------------------------------------------ warm-prefix migration
+def test_export_warm_prefixes_hits_floor():
+    """Only chains PROVEN warm ship: a once-used prefix has hits=0 and
+    stays; after a repeat request its chain exports. import(None) is
+    the no-op drain."""
+    victim = _engine()
+    survivor = _engine()
+    try:
+        prefix = [3, 1, 4, 1, 5, 9, 2, 6]    # 2 full blocks
+        list(victim.generate_sync(prefix + [30], 4))
+        assert victim.export_warm_prefixes(min_hits=1) is None
+        list(victim.generate_sync(prefix + [31], 4))   # hits bump
+        payload = victim.export_warm_prefixes(min_hits=1)
+        assert payload is not None and payload["n_blocks"] >= 2
+        assert survivor.import_warm_prefixes(None) == 0
+        n = survivor.import_warm_prefixes(payload)
+        assert n == payload["n_blocks"]
+        # the migrated prefix is warm on the survivor: a first-touch
+        # request scores trie hits immediately
+        list(survivor.generate_sync(prefix + [32], 4))
+        s = survivor.stats()
+        assert s["prefix_hit_blocks_total"] >= 2
+        assert victim.pool_audit() == []
+        assert survivor.pool_audit() == []
+    finally:
+        victim.shutdown()
+        survivor.shutdown()
+
+
+def test_import_never_evicts_under_pressure():
+    """Migration is strictly opportunistic: a survivor with a full pool
+    adopts at most what its free list holds and never evicts live
+    blocks to make room."""
+    victim = _engine()
+    # tiny survivor pool: max_seq_len 16 / block 4 => few blocks total
+    survivor = _engine(max_seq_len=16, decode_slots=1)
+    try:
+        prefix = [3, 1, 4, 1, 5, 9, 2, 6]
+        for t in (30, 31, 32):
+            list(victim.generate_sync(prefix + [t], 4))
+        payload = victim.export_warm_prefixes(min_hits=1)
+        assert payload is not None
+        free0 = survivor.stats()["free_blocks"]
+        n = survivor.import_warm_prefixes(payload)
+        assert 0 <= n <= free0
+        assert survivor.pool_audit() == []
+        # engine still serves after the pressured import
+        assert list(survivor.generate_sync([2, 4, 6], 3))
+    finally:
+        victim.shutdown()
+        survivor.shutdown()
+
+
+# ------------------------------------- router fleet-backfill staleness
+def test_fleet_backfill_staleness_bound(monkeypatch):
+    """Fleet-metrics backfill rows carry their origin's last-report
+    age: rows older than gauge_stale_s are skipped (pow2 fallback
+    territory), adopted rows are stamped now-age so they age out
+    naturally, and a fresher direct probe is never overwritten."""
+    from ray_tpu.serve.handle import _Router
+    from ray_tpu.util import state as state_mod
+
+    r = object.__new__(_Router)
+    r.gauge_stale_s = 3.0
+    r._pids = {101: b"fresh", 102: b"stale", 103: b"probed"}
+    now = time.monotonic()
+    r.gauges = {b"probed": {"t": now - 0.1, "queue_depth": 7}}
+    rows = [
+        {"pid": 101, "queue_depth": 1, "last_report_s": 1.0},
+        {"pid": 102, "queue_depth": 2, "last_report_s": 9.0},  # stale
+        {"pid": 103, "queue_depth": 3, "last_report_s": 0.5},
+        {"pid": 999, "queue_depth": 4, "last_report_s": 0.0},  # unknown
+    ]
+    monkeypatch.setattr(state_mod, "fleet_metrics",
+                        lambda window_s=10.0: {"rows": rows})
+    r._fleet_backfill()
+    assert r.gauges[b"fresh"]["queue_depth"] == 1
+    # adopted with its ring age, not "now": t ~= now - 1.0
+    assert r.gauges[b"fresh"]["t"] == pytest.approx(now - 1.0, abs=0.5)
+    assert b"stale" not in r.gauges or \
+        "queue_depth" not in r.gauges[b"stale"]
+    assert r.gauges[b"probed"]["queue_depth"] == 7  # probe wins
+
+
+# --------------------------------------------------- cluster e2e legs
+def _deploy_pair(serve, cls_prefill, cls_decode, engine=None,
+                 replicas=2):
+    eng = dict(ENGINE_KW, **(engine or {}))
+    for suffix, cls in (("prefill", cls_prefill), ("decode", cls_decode)):
+        dep = serve.deployment(
+            name=f"dllm-{suffix}", num_replicas=replicas,
+            max_ongoing_requests=32)(cls)
+        serve.run(dep.bind(model=MODEL_DICT, engine=eng),
+                  name=f"dllm-{suffix}", route_prefix=None)
+    return DisaggRouter("dllm-prefill", "dllm-decode")
+
+
+@pytest.mark.slow
+def test_disagg_drain_migrates_prefixes_to_survivor(serve_session):
+    """Controller downscale of a migrate_prefixes=True decode fleet
+    ships the victim's warm chains to the survivor: post-drain traffic
+    on the migrated prefix scores trie hits on first touch."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import api as serve_api
+    from ray_tpu.serve.disagg import deploy_disaggregated
+
+    router = deploy_disaggregated(
+        MODEL_DICT, dict(ENGINE_KW), name="dmig", num_prefill=1,
+        num_decode=2, migrate_prefixes=True)
+    try:
+        prefix = [3, 1, 4, 1, 5, 9, 2, 6]
+        # pin a session to ONE decode replica and warm its trie (>= 2
+        # requests so the chain's hit count clears the export floor)
+        for t in (40, 41, 42):
+            assert list(router.options(
+                stream=True, session_id="warm").generate.remote(
+                    prefix + [t], 4))
+        ctrl = serve_api._controller_or_none()
+        # drain one decode replica: scale 2 -> 1. The controller pops
+        # the victim, exports its warm chains to the survivor, kills it.
+        ray_tpu.get(ctrl.scale_deployment.remote("dmig-decode", 1))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            reps = ray_tpu.get(ctrl.get_replicas.remote("dmig-decode"))
+            if len(reps) == 1:
+                break
+            time.sleep(0.2)
+        reps = ray_tpu.get(ctrl.get_replicas.remote("dmig-decode"))
+        assert len(reps) == 1
+        s = ray_tpu.get(reps[0].stats.remote())["engine"]
+        audits = ray_tpu.get(reps[0].handle_request.remote("pool_audit"))
+        assert audits == []
+        # survivor either WAS the warm replica (hits from the warm
+        # phase) or received the migration: both surface as a warm trie
+        hits0 = s["prefix_hit_blocks_total"]
+        router.decode.session_affinity.clear()
+        router.decode.refresh(force=True)
+        assert list(router.options(stream=True).generate.remote(
+            prefix + [43], 4))
+        reps = ray_tpu.get(ctrl.get_replicas.remote("dmig-decode"))
+        s2 = ray_tpu.get(reps[0].stats.remote())["engine"]
+        assert s2["prefix_hit_blocks_total"] > hits0 or hits0 > 0
+    finally:
+        serve.delete("dmig-prefill")
+        serve.delete("dmig-decode")
+
+
+_CHAOS_SEEDS = [int(s) for s in os.environ.get(
+    "RAY_TPU_CHAOS_SOAK_SEEDS", "1101").split(",")]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", _CHAOS_SEEDS)
+def test_disagg_chaos_kill_prefill_mid_ship(seed, tmp_path):
+    """Chaos-matrix disagg leg, prefill side: the chosen prefill
+    replica SIGKILLs itself inside prefill_export (mid-ship — the
+    decode side's argument pull fails). The router must classify it,
+    retry the request on a fresh pair, and stream the exact greedy
+    tokens; surviving pools audit clean, nothing leaks."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    flag = tmp_path / f"kill_prefill_{seed}"
+    flag.write_text("armed")
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_TEST_DISAGG_KILL"] = str(flag)
+
+    class KillOnShipLLM(serve.LLMServer):
+        async def prefill_export(self, prompt_ids):
+            import os as _os
+            import signal as _signal
+            f = _os.environ.get("RAY_TPU_TEST_DISAGG_KILL")
+            if f:
+                try:
+                    _os.rename(f, f + ".taken")   # exactly one victim
+                    _os.kill(_os.getpid(), _signal.SIGKILL)
+                except OSError:
+                    pass
+            return await super().prefill_export(prompt_ids)
+
+    try:
+        ray_tpu.init(num_cpus=10, _num_initial_workers=4,
+                     ignore_reinit_error=True)
+        router = _deploy_pair(serve, KillOnShipLLM, serve.LLMServer)
+        prompt = [7, 11, 13, 17, 19, 23 + seed % 5]
+        got = list(router.options(stream=True).generate.remote(
+            prompt, 8))
+        assert router.stats["retries"] >= 1, router.stats
+        assert router.stats["handoff_errors"] == 0
+        # parity vs a colocated reference engine
+        ref = _engine()
+        try:
+            assert got == list(ref.generate_sync(prompt, 8))
+        finally:
+            ref.shutdown()
+        _assert_fleet_clean(ray_tpu)
+    finally:
+        os.environ.pop("RAY_TPU_TEST_DISAGG_KILL", None)
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", _CHAOS_SEEDS)
+def test_disagg_chaos_kill_decode_mid_adopt(seed, tmp_path):
+    """Chaos-matrix disagg leg, decode side: the chosen decode replica
+    SIGKILLs itself inside adopt_generate before the first token — the
+    hand-off is retried on a fresh pair and completes bit-exact;
+    exhaustion of all pairs would be DisaggHandoffError (typed), never
+    a hang."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    flag = tmp_path / f"kill_decode_{seed}"
+    flag.write_text("armed")
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_TEST_DISAGG_KILL"] = str(flag)
+
+    class KillOnAdoptLLM(serve.LLMServer):
+        async def adopt_generate(self, payload, max_new_tokens=None,
+                                 eos_token_id=None):
+            import os as _os
+            import signal as _signal
+            f = _os.environ.get("RAY_TPU_TEST_DISAGG_KILL")
+            if f:
+                try:
+                    _os.rename(f, f + ".taken")
+                    _os.kill(_os.getpid(), _signal.SIGKILL)
+                except OSError:
+                    pass
+            async for tok in super().adopt_generate(
+                    payload, max_new_tokens, eos_token_id):
+                yield tok
+
+    try:
+        ray_tpu.init(num_cpus=10, _num_initial_workers=4,
+                     ignore_reinit_error=True)
+        router = _deploy_pair(serve, serve.LLMServer, KillOnAdoptLLM)
+        prompt = [5, 9, 14, 22, 33 + seed % 7]
+        got = list(router.options(stream=True).generate.remote(
+            prompt, 8))
+        assert router.stats["retries"] >= 1, router.stats
+        assert router.stats["handoff_errors"] == 0
+        ref = _engine()
+        try:
+            assert got == list(ref.generate_sync(prompt, 8))
+        finally:
+            ref.shutdown()
+        _assert_fleet_clean(ray_tpu)
+    finally:
+        os.environ.pop("RAY_TPU_TEST_DISAGG_KILL", None)
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def _assert_fleet_clean(ray_tpu):
+    """Every CURRENT replica of both fleets (the controller restarts
+    the corpse) audits a clean block pool — the no-leak invariant."""
+    from ray_tpu.serve import api as serve_api
+    ctrl = serve_api._controller_or_none()
+    for name in ("dllm-prefill", "dllm-decode"):
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            reps = ray_tpu.get(ctrl.get_replicas.remote(name))
+            try:
+                audits = [ray_tpu.get(
+                    r.handle_request.remote("pool_audit"), timeout=30)
+                    for r in reps]
+                assert all(a == [] for a in audits), audits
+                break
+            except AssertionError:
+                raise
+            except Exception:
+                time.sleep(0.5)   # a replica still restarting
+        else:
+            raise AssertionError(f"{name}: no clean audit before "
+                                 f"deadline")

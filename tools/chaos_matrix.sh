@@ -120,6 +120,28 @@ for seed in "${seeds[@]}"; do
     fi
 done
 
+# ---- disagg soak leg: a prefill+decode split fleet takes a SIGKILL
+# on each side of the KV hand-off — the prefill replica dies inside
+# prefill_export (mid-ship; the decode worker's argument pull fails)
+# and, separately, the decode replica dies inside adopt_generate before
+# its first token. Invariants: the DisaggRouter classifies the death,
+# retries on a fresh pair, streams the exact greedy tokens; surviving
+# block pools audit clean, no leaked KV blocks
+# (tests/serve/test_disagg.py chaos tests)
+for seed in "${seeds[@]}"; do
+    echo "=== disagg soak: seed=$seed ==="
+    if RAY_TPU_CHAOS_SOAK_SEEDS="$seed" \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/serve/test_disagg.py::test_disagg_chaos_kill_prefill_mid_ship" \
+        "tests/serve/test_disagg.py::test_disagg_chaos_kill_decode_mid_adopt" \
+        -q -p no:cacheprovider -p no:randomly; then
+        echo "=== disagg seed=$seed PASSED ==="
+    else
+        echo "=== disagg seed=$seed FAILED ==="
+        failed+=("disagg:$seed")
+    fi
+done
+
 # ---- rlhf soak leg: a 2-worker rollout fleet streams version-stamped
 # trajectory blocks under 5% message drops/dups/delays while a seeded-
 # random worker is SIGKILLed at a seeded-random block after its
@@ -276,6 +298,13 @@ if [ "${#failed[@]}" -gt 0 ]; then
                 echo "  slowest waterfall: none captured (died before" \
                      "any trace shipped)"
             fi
+            continue
+            ;;
+        disagg:*)
+            s="${seed#disagg:}"
+            echo "replay with: RAY_TPU_CHAOS_SOAK_SEEDS=$s python -m pytest" \
+                 "tests/serve/test_disagg.py::test_disagg_chaos_kill_prefill_mid_ship" \
+                 "tests/serve/test_disagg.py::test_disagg_chaos_kill_decode_mid_adopt -q"
             continue
             ;;
         rlhf:*)

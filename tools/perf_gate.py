@@ -228,6 +228,26 @@ def extract_serve_metrics(rec: dict) -> dict:
     if isinstance(to, dict) and to.get("span_record_us"):
         out["serve/trace_span_record_inv"] = round(
             1.0 / float(to["span_record_us"]), 4)
+    # disaggregation-era records: the disagg fleet's tokens/s/chip and
+    # p99 TTFT (inverted, like the fleet row), and the drain A/B's
+    # prefix-hit retention (migrated-survivor hit rate; the leg itself
+    # asserts it strictly beats the cold survivor). Pre-disagg
+    # baselines carry none of these and bootstrap-skip.
+    dg = detail.get("disagg") or {}
+    if isinstance(dg, dict):
+        if dg.get("tokens_per_s_chip") is not None:
+            out["serve/disagg_tokens_per_s_chip"] = \
+                float(dg["tokens_per_s_chip"])
+        p99 = (dg.get("ttft_ms") or {}).get("p99")
+        if p99:
+            out["serve/disagg_ttft_p99_inv"] = round(
+                1000.0 / float(p99), 4)
+    mg = detail.get("migration") or {}
+    if isinstance(mg, dict) and \
+            (mg.get("with_migration") or {}).get("prefix_hit_rate") \
+            is not None:
+        out["serve/migration_hit_retention"] = \
+            float(mg["with_migration"]["prefix_hit_rate"])
     return out
 
 
